@@ -1,0 +1,22 @@
+"""TPU-native distributed LLM inference framework.
+
+A from-scratch re-design of the capabilities of PFC-star/distributed_inference_demo
+(LinguaLinked-style heterogeneous pipeline inference) as an idiomatic
+JAX/XLA/Pallas/pjit system:
+
+- Models are pure functions over parameter pytrees with stacked per-layer weights,
+  so a "module" (a contiguous layer range, cf. reference server.py:893-905) is an
+  array slice, not an ONNX export.
+- KV-cached autoregressive decoding from day one (the reference re-runs modules on
+  a single token per step, Communication.java:322-327 — a known defect).
+- Parallelism over a jax.sharding.Mesh with axes (dp, pp, tp, sp): tensor-parallel
+  attention/MLP shards, pipeline stages via shard_map + ppermute collectives,
+  ring-attention sequence parallelism for long context.
+- A schema'd msgpack control plane (device pool, heartbeats, lifecycle FSM,
+  partition planner) replacing the reference's order-coupled raw ZMQ frames
+  (Client.java:69-82).
+- A versioned, endian-explicit tensor wire codec for the heterogeneous
+  (CPU/edge <-> TPU host) boundary, replacing utils.cpp:124-264.
+"""
+
+__version__ = "0.1.0"

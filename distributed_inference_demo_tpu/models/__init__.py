@@ -1,0 +1,12 @@
+from .base import ModelConfig, KVCache, StageParams, StageSpec
+from .registry import MODEL_REGISTRY, get_model_config, get_model_family
+
+__all__ = [
+    "ModelConfig",
+    "KVCache",
+    "StageParams",
+    "StageSpec",
+    "MODEL_REGISTRY",
+    "get_model_config",
+    "get_model_family",
+]

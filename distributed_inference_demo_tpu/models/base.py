@@ -1,0 +1,237 @@
+"""Core model abstractions: configs, KV cache, pipeline-stage parameter slices.
+
+Design notes (TPU-first, not a port):
+
+The reference splits an HF torch model into ``split_size`` sequential ONNX
+"modules", one per device (reference ``server.py:831-832,893-905``).  Here a
+model is a pure function over a parameter pytree whose per-layer weights are
+*stacked* along a leading ``layer`` axis.  A pipeline stage ("module") is then
+just ``jax.tree.map(lambda x: x[lo:hi], params.layers)`` — a zero-copy array
+slice — and the per-stage forward is a single ``lax.scan`` over the stacked
+layers, which XLA compiles into one fused loop that keeps the MXU busy.
+
+The KV cache is first-class (the reference has none — SURVEY.md §2.7): a
+preallocated ``[layers, batch, max_seq, kv_heads, head_dim]`` pair updated in
+place via ``lax.dynamic_update_slice`` with donated buffers, so decode steps
+are O(1) in allocation and fully jit-compatible (static shapes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=[],
+         meta_fields=["family", "vocab_size", "hidden_size", "num_layers",
+                      "num_heads", "num_kv_heads", "intermediate_size",
+                      "max_seq_len", "rope_theta", "norm_eps", "dtype_name",
+                      "tie_embeddings", "use_alibi", "use_rope",
+                      "attn_layernorm", "num_experts", "experts_per_token",
+                      "quantization"])
+@dataclass(frozen=True)
+class ModelConfig:
+    """Static, hashable architecture description shared by all model families.
+
+    ``family`` selects the block flavor ("llama", "bloom", "mixtral", ...).
+    The feature flags (rope/alibi/gated-mlp) let one decoder implementation
+    cover the whole catalog the reference supports (bloom560m..7b1,
+    reference ``data/Data.kt:19-33``) plus the BASELINE.json targets
+    (TinyLlama, Llama-3-8B, Mixtral-8x7B).
+    """
+
+    family: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    num_layers: int = 22
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    intermediate_size: int = 5632
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype_name: str = "bfloat16"
+    tie_embeddings: bool = False
+    # bloom-style ALiBi positional bias vs llama-style RoPE
+    use_alibi: bool = False
+    use_rope: bool = True
+    # bloom uses LayerNorm (with bias); llama uses RMSNorm
+    attn_layernorm: bool = False
+    # MoE (mixtral): 0 experts means dense MLP
+    num_experts: int = 0
+    experts_per_token: int = 2
+    # weight-only quantization: "none" | "int8" (ops/quant.py)
+    quantization: str = "none"
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype_name)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class StageSpec:
+    """A contiguous layer range assigned to one pipeline stage/worker.
+
+    Mirrors the role of a reference "module" (``server.py:893-905``): the
+    first stage owns the embedding, the last owns the final norm + LM head.
+    """
+
+    stage_id: int
+    num_stages: int
+    layer_start: int
+    layer_end: int  # exclusive
+
+    @property
+    def is_first(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.stage_id == self.num_stages - 1
+
+    @property
+    def num_layers(self) -> int:
+        return self.layer_end - self.layer_start
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["keys", "values", "length"], meta_fields=[])
+@dataclass
+class KVCache:
+    """Per-stage KV cache: stacked over the stage's layers.
+
+    keys/values: ``[num_layers, batch, max_seq, num_kv_heads, head_dim]``.
+    ``length`` is a scalar int32 tracking how many positions are filled.
+
+    Capacity is NOT checked inside traced code (``dynamic_update_slice``
+    clamps silently) — the engine layer enforces
+    ``prompt_len + new_tokens <= max_seq`` host-side, where both are static.
+    """
+
+    keys: jax.Array
+    values: jax.Array
+    length: jax.Array
+
+    @staticmethod
+    def create(cfg: ModelConfig, num_layers: int, batch: int,
+               max_seq: Optional[int] = None, dtype=None) -> "KVCache":
+        max_seq = max_seq or cfg.max_seq_len
+        dtype = dtype or cfg.dtype
+        shape = (num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+        return KVCache(
+            keys=jnp.zeros(shape, dtype),
+            values=jnp.zeros(shape, dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def max_seq(self) -> int:
+        return self.keys.shape[2]
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["layers", "embed", "final_norm", "lm_head"],
+         meta_fields=[])
+@dataclass
+class StageParams:
+    """Parameters owned by one pipeline stage.
+
+    ``layers`` is a dict of stacked arrays with leading dim = stage layer
+    count.  ``embed`` / ``final_norm`` / ``lm_head`` are present only on the
+    stages that own them (first / last), else None.
+    """
+
+    layers: dict
+    embed: Optional[dict] = None
+    final_norm: Optional[dict] = None
+    lm_head: Optional[dict] = None
+
+    def nbytes(self) -> int:
+        return sum(x.nbytes for x in jax.tree.leaves(
+            (self.layers, self.embed, self.final_norm, self.lm_head)))
+
+
+def slice_stage(full: StageParams, cfg: ModelConfig, spec: StageSpec) -> StageParams:
+    """Cut a full-model StageParams into the slice owned by ``spec``.
+
+    This is the TPU-native equivalent of the reference's per-module ONNX
+    export + zip + ship (``server.py:910-957``): shard manifests instead of
+    ONNX zips, realized as array slices.
+    """
+    layers = jax.tree.map(lambda x: x[spec.layer_start:spec.layer_end], full.layers)
+    # Tied embeddings: the last stage needs the token table for the LM head.
+    needs_embed = spec.is_first or (spec.is_last and cfg.tie_embeddings)
+    return StageParams(
+        layers=layers,
+        embed=full.embed if needs_embed else None,
+        final_norm=full.final_norm if spec.is_last else None,
+        lm_head=full.lm_head if spec.is_last else None,
+    )
+
+
+def split_layer_ranges(num_layers: int, num_stages: int,
+                       weights: Optional[list] = None) -> list:
+    """Partition ``num_layers`` into ``num_stages`` contiguous ranges.
+
+    With ``weights`` (per-layer cost, e.g. FLOPs from the cost model), uses a
+    balanced greedy prefix split; otherwise an even split.  Returns a list of
+    StageSpec.  Replaces the reference's round_robin_module_arrangement
+    (``server.py:893-905``).
+    """
+    if num_stages > num_layers:
+        raise ValueError(
+            f"cannot split {num_layers} layers into {num_stages} stages")
+    if weights is None:
+        weights = [1.0] * num_layers
+    if len(weights) != num_layers:
+        raise ValueError("weights must have one entry per layer")
+
+    # Dynamic programming over cut points minimizing the max stage cost
+    # (the pipeline's throughput is set by its slowest stage).  O(S * L^2)
+    # with L = model depth — trivial at planning time.
+    prefix = [0.0]
+    for w in weights:
+        prefix.append(prefix[-1] + float(w))
+
+    def cost(i, j):  # cost of layers [i, j)
+        return prefix[j] - prefix[i]
+
+    INF = float("inf")
+    # best[s][j] = minimal max-stage-cost splitting layers [0, j) into s
+    # stages of >= 1 layer each; cut[s][j] = the last cut position.
+    best = [[INF] * (num_layers + 1) for _ in range(num_stages + 1)]
+    cut = [[0] * (num_layers + 1) for _ in range(num_stages + 1)]
+    best[0][0] = 0.0
+    for s in range(1, num_stages + 1):
+        for j in range(s, num_layers + 1):
+            for i in range(s - 1, j):
+                c = max(best[s - 1][i], cost(i, j))
+                if c < best[s][j]:
+                    best[s][j] = c
+                    cut[s][j] = i
+    bounds = [num_layers]
+    j = num_layers
+    for s in range(num_stages, 0, -1):
+        j = cut[s][j]
+        bounds.append(j)
+    bounds.reverse()
+
+    specs = []
+    for s in range(num_stages):
+        specs.append(StageSpec(stage_id=s, num_stages=num_stages,
+                               layer_start=bounds[s], layer_end=bounds[s + 1]))
+    assert all(sp.num_layers >= 1 for sp in specs)
+    return specs

@@ -1,0 +1,230 @@
+"""One unified decoder implementation for every supported model family.
+
+Instead of per-family ONNX exports (reference ``util.model_card.ModelCard``,
+inferred at SURVEY.md §2.2), a single pure ``stage_forward`` covers:
+
+- **llama family** (TinyLlama-1.1B, Llama-3-8B): RMSNorm, RoPE, GQA, SwiGLU.
+- **bloom family** (bloom560m..7b1, reference ``data/Data.kt:19-33``):
+  LayerNorm+bias, ALiBi, fused dense MLP with GELU.
+- **mixtral family** (Mixtral-8x7B): llama blocks with top-k routed MoE MLP.
+
+The per-stage forward is a single ``lax.scan`` over stacked layer weights —
+XLA compiles one loop body reused across layers, keeping compile time flat in
+depth and the MXU saturated.  The KV cache threads through the scan as
+per-layer xs/ys so each layer updates its slice functionally.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import alibi_slopes, attention, update_kv_cache
+from ..ops.quant import dense
+from ..ops.norms import layer_norm, rms_norm
+from ..ops.rope import apply_rope
+from .base import KVCache, ModelConfig, StageParams, StageSpec
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+def _dense_init(rng, shape, dtype, scale=None):
+    fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(fan_in)
+    return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_layer_params(rng: jax.Array, cfg: ModelConfig, num_layers: int) -> dict:
+    """Stacked per-layer weights, leading dim = num_layers."""
+    H, nh, nkv, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    I, L = cfg.intermediate_size, num_layers
+    dt = cfg.dtype
+    keys = jax.random.split(rng, 16)
+    p = {
+        "attn_norm_w": jnp.ones((L, H), dt),
+        "wq": _dense_init(keys[0], (L, H, nh * hd), dt),
+        "wk": _dense_init(keys[1], (L, H, nkv * hd), dt),
+        "wv": _dense_init(keys[2], (L, H, nkv * hd), dt),
+        "wo": _dense_init(keys[3], (L, nh * hd, H), dt),
+        "mlp_norm_w": jnp.ones((L, H), dt),
+    }
+    if cfg.attn_layernorm:  # bloom: LayerNorm has bias; linears have bias
+        p["attn_norm_b"] = jnp.zeros((L, H), dt)
+        p["mlp_norm_b"] = jnp.zeros((L, H), dt)
+        p["bq"] = jnp.zeros((L, nh * hd), dt)
+        p["bk"] = jnp.zeros((L, nkv * hd), dt)
+        p["bv"] = jnp.zeros((L, nkv * hd), dt)
+        p["bo"] = jnp.zeros((L, H), dt)
+    if cfg.num_experts > 0:  # mixtral MoE
+        E = cfg.num_experts
+        p["router"] = _dense_init(keys[4], (L, H, E), dt)
+        p["w_gate"] = _dense_init(keys[5], (L, E, H, I), dt)
+        p["w_up"] = _dense_init(keys[6], (L, E, H, I), dt)
+        p["w_down"] = _dense_init(keys[7], (L, E, I, H), dt)
+    elif cfg.family == "bloom":  # dense 4H GELU MLP with bias
+        p["w_up"] = _dense_init(keys[5], (L, H, I), dt)
+        p["b_up"] = jnp.zeros((L, I), dt)
+        p["w_down"] = _dense_init(keys[7], (L, I, H), dt)
+        p["b_down"] = jnp.zeros((L, H), dt)
+    else:  # llama SwiGLU
+        p["w_gate"] = _dense_init(keys[5], (L, H, I), dt)
+        p["w_up"] = _dense_init(keys[6], (L, H, I), dt)
+        p["w_down"] = _dense_init(keys[7], (L, I, H), dt)
+    return p
+
+
+def init_full_params(rng: jax.Array, cfg: ModelConfig) -> StageParams:
+    """Random-init full model as a single StageParams (stage 0 of 1)."""
+    k_emb, k_layers, k_head = jax.random.split(rng, 3)
+    dt = cfg.dtype
+    embed = {"tokens": _dense_init(k_emb, (cfg.vocab_size, cfg.hidden_size), dt,
+                                   scale=0.02)}
+    if cfg.family == "bloom":  # bloom applies LayerNorm right after embedding
+        embed["norm_w"] = jnp.ones((cfg.hidden_size,), dt)
+        embed["norm_b"] = jnp.zeros((cfg.hidden_size,), dt)
+    final_norm = {"w": jnp.ones((cfg.hidden_size,), dt)}
+    if cfg.attn_layernorm:
+        final_norm["b"] = jnp.zeros((cfg.hidden_size,), dt)
+    if cfg.tie_embeddings:
+        lm_head = {}  # reuse embed["tokens"]
+    else:
+        lm_head = {"w": _dense_init(k_head, (cfg.hidden_size, cfg.vocab_size), dt)}
+    return StageParams(
+        layers=init_layer_params(k_layers, cfg, cfg.num_layers),
+        embed=embed, final_norm=final_norm, lm_head=lm_head)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.num_experts > 0:
+        return _moe_mlp(cfg, lp, x)
+    if cfg.family == "bloom":
+        h = dense(x, lp["w_up"], "bsh,hi->bsi") + lp["b_up"]
+        h = jax.nn.gelu(h.astype(jnp.float32)).astype(x.dtype)
+        return dense(h, lp["w_down"], "bsi,ih->bsh") + lp["b_down"]
+    gate = dense(x, lp["w_gate"], "bsh,hi->bsi")
+    up = dense(x, lp["w_up"], "bsh,hi->bsi")
+    h = (jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)).astype(x.dtype)
+    return dense(h, lp["w_down"], "bsi,ih->bsh")
+
+
+def _moe_mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Top-k routed MoE (mixtral).
+
+    Round-1 strategy: compute all experts batched on the MXU and combine with
+    the (sparse) routing weights.  For small decode batches this trades FLOPs
+    for zero gather/scatter overhead and static shapes; a capacity-based
+    dispatch kernel is the later optimization.  Expert parallelism shards the
+    leading E axis of w_gate/w_up/w_down over the "ep"/"tp" mesh axis.
+    """
+    E, k = cfg.num_experts, cfg.experts_per_token
+    logits = jnp.einsum("bsh,he->bse", x, lp["router"]).astype(jnp.float32)
+    topv, topi = jax.lax.top_k(logits, k)                      # [b,s,k]
+    weights = jax.nn.softmax(topv, axis=-1)                    # [b,s,k]
+    # dense routing matrix [b,s,E] with top-k softmax weights, zeros elsewhere
+    route = jnp.zeros_like(logits).at[
+        jnp.arange(x.shape[0])[:, None, None],
+        jnp.arange(x.shape[1])[None, :, None],
+        topi].set(weights)
+    gate = dense(x, lp["w_gate"], "bsh,ehi->besi")
+    up = dense(x, lp["w_up"], "bsh,ehi->besi")
+    h = (jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)).astype(x.dtype)
+    out = dense(h, lp["w_down"], "besi,eih->besh")        # [b,E,s,h]
+    return jnp.einsum("besh,bse->bsh", out, route.astype(x.dtype))
+
+
+def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+           k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+           positions: jnp.ndarray, cache_start: jnp.ndarray,
+           slopes: Optional[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decoder block. x: [b, s, H]. Returns (x', k_cache', v_cache')."""
+    b, s, H = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    if cfg.attn_layernorm:
+        h = layer_norm(x, lp["attn_norm_w"], lp["attn_norm_b"], cfg.norm_eps)
+    else:
+        h = rms_norm(x, lp["attn_norm_w"], cfg.norm_eps)
+
+    q = dense(h, lp["wq"], "bsh,hd->bsd")
+    k = dense(h, lp["wk"], "bsh,hd->bsd")
+    v = dense(h, lp["wv"], "bsh,hd->bsd")
+    if cfg.attn_layernorm:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(b, s, nh, hd)
+    k = k.reshape(b, s, nkv, hd)
+    v = v.reshape(b, s, nkv, hd)
+
+    if cfg.use_rope:
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+
+    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, cache_start)
+    new_len = cache_start + s
+    attn = attention(q, k_cache, v_cache, positions, new_len, slopes)
+    attn = attn.reshape(b, s, nh * hd)
+    attn = dense(attn, lp["wo"], "bsd,dh->bsh")
+    if cfg.attn_layernorm:
+        attn = attn + lp["bo"]
+    x = x + attn
+
+    if cfg.attn_layernorm:
+        h = layer_norm(x, lp["mlp_norm_w"], lp["mlp_norm_b"], cfg.norm_eps)
+    else:
+        h = rms_norm(x, lp["mlp_norm_w"], cfg.norm_eps)
+    x = x + _mlp(cfg, lp, h)
+    return x, k_cache, v_cache
+
+
+def stage_forward(
+    params: StageParams,
+    cfg: ModelConfig,
+    spec: StageSpec,
+    inputs: jnp.ndarray,        # [b, s] int32 ids (first stage) or [b, s, H] hidden
+    cache: KVCache,             # this stage's cache (num_layers = spec.num_layers)
+    positions: jnp.ndarray,     # [b, s] absolute positions of the chunk
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run this stage's layer range. Returns (hidden or logits, updated cache).
+
+    The stage seam replaces the reference's ``run_inference`` module boundary
+    (``cpp/inference.cpp:145-218``): first stage embeds ids, last stage
+    applies final norm + LM head.  Residual/skip routing between stages
+    (reference ``LoadBalance.java:37-88`` dependencyMap machinery) is
+    dissolved by construction — stages own whole decoder blocks, so the only
+    inter-stage tensor is the [b, s, H] hidden state.
+    """
+    if spec.is_first:
+        x = params.embed["tokens"][inputs]  # [b, s, H]
+        if "norm_w" in params.embed:  # bloom embedding LayerNorm
+            x = layer_norm(x, params.embed["norm_w"], params.embed["norm_b"],
+                           cfg.norm_eps)
+    else:
+        x = inputs.astype(cfg.dtype)
+
+    slopes = alibi_slopes(cfg.num_heads) if cfg.use_alibi else None
+    cache_start = cache.length
+
+    def body(x, scanned):
+        lp, kc, vc = scanned
+        x, kc, vc = _layer(cfg, lp, x, kc, vc, positions, cache_start, slopes)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params.layers, cache.keys, cache.values))
+    new_cache = KVCache(new_k, new_v, cache_start + inputs.shape[1])
+
+    if spec.is_last:
+        if cfg.attn_layernorm:
+            x = layer_norm(x, params.final_norm["w"], params.final_norm["b"],
+                           cfg.norm_eps)
+        else:
+            x = rms_norm(x, params.final_norm["w"], cfg.norm_eps)
+        head = (params.embed["tokens"].T if cfg.tie_embeddings
+                else params.lm_head["w"])
+        x = jnp.einsum("bsh,hv->bsv", x, head)
+    return x, new_cache

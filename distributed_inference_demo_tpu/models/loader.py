@@ -1,0 +1,110 @@
+"""Weight loading from HF safetensors checkpoints into StageParams pytrees.
+
+TPU-native replacement for the reference's missing ``util.model_card``
+ModelCard (load HF torch model -> split -> ONNX export -> int8 quantize ->
+zip; SURVEY.md §2.2): here we map safetensors names directly onto the stacked
+layer layout, optionally casting to bf16 or int8-per-channel, with no export
+step — a stage's weights are an array slice of the full stack
+(``base.slice_stage``).
+
+Zero-egress environment: loading requires a *local* checkpoint directory.
+Tests use random init instead.
+"""
+
+import json
+import os
+import re
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ModelConfig, StageParams
+from .decoder import init_full_params
+
+
+# safetensors name -> (our key, transpose?) per family
+_LLAMA_LAYER_MAP = {
+    "input_layernorm.weight": ("attn_norm_w", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "post_attention_layernorm.weight": ("mlp_norm_w", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+}
+
+
+def load_safetensors_dir(path: str) -> Dict[str, np.ndarray]:
+    """Read all *.safetensors files in a checkpoint directory."""
+    from safetensors import safe_open
+    tensors: Dict[str, np.ndarray] = {}
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            for key in f.keys():
+                tensors[key] = f.get_tensor(key)
+    return tensors
+
+
+def load_llama_params(path: str, cfg: ModelConfig) -> StageParams:
+    """Assemble a llama-family HF checkpoint into stacked StageParams."""
+    raw = load_safetensors_dir(path)
+    dt = cfg.dtype
+    L = cfg.num_layers
+
+    def get(name):
+        for prefix in ("model.", ""):
+            if prefix + name in raw:
+                return raw[prefix + name]
+        raise KeyError(name)
+
+    layers: Dict[str, list] = {}
+    for i in range(L):
+        for hf_name, (ours, transpose) in _LLAMA_LAYER_MAP.items():
+            w = get(f"layers.{i}.{hf_name}")
+            if transpose:
+                w = w.T
+            layers.setdefault(ours, []).append(w)
+    stacked = {k: jnp.asarray(np.stack(v), dt) for k, v in layers.items()}
+
+    embed = {"tokens": jnp.asarray(get("embed_tokens.weight"), dt)}
+    final_norm = {"w": jnp.asarray(get("norm.weight"), dt)}
+    if cfg.tie_embeddings:
+        lm_head = {}
+    else:
+        lm_head = {"w": jnp.asarray(raw["lm_head.weight"].T, dt)}
+    return StageParams(layers=stacked, embed=embed, final_norm=final_norm,
+                       lm_head=lm_head)
+
+
+def load_or_init(model_name: str, cfg: ModelConfig,
+                 checkpoint_dir: Optional[str] = None,
+                 seed: int = 0) -> StageParams:
+    """Load from a local checkpoint if given/found, else random-init.
+
+    The random path keeps every test and benchmark runnable with zero
+    network egress; the bench harness measures throughput, which is
+    weight-value independent.
+    """
+    import jax
+    if checkpoint_dir and os.path.isdir(checkpoint_dir):
+        if cfg.family in ("llama",):
+            params = load_llama_params(checkpoint_dir, cfg)
+        else:
+            raise NotImplementedError(
+                f"checkpoint loading for family {cfg.family!r} lands with the "
+                "model-card subsystem; use random init")
+    else:
+        params = init_full_params(jax.random.PRNGKey(seed), cfg)
+    if cfg.quantization == "int8":
+        from ..ops.quant import quantize_layer_params
+        params = StageParams(
+            layers=quantize_layer_params(params.layers),
+            embed=params.embed, final_norm=params.final_norm,
+            lm_head=params.lm_head)
+    return params

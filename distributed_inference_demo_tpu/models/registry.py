@@ -1,0 +1,80 @@
+"""Model catalog: every family the reference supports plus BASELINE targets.
+
+Replaces the reference's hardcoded catalog (``data/Data.kt:19-33``:
+bloom560m/1b1/1b7/3b/7b each +- int8) and the per-model branches in
+``server.py:796-801`` / ``init_server.py:131-136``.  Quantized variants are a
+runtime dtype choice here (``-int8`` suffix), not separate exports.
+
+Also provides tiny "-test" configs for fast unit tests and virtual-mesh
+dry runs.
+"""
+
+from .base import ModelConfig
+
+
+def _bloom(hidden, layers, heads, vocab=250880) -> ModelConfig:
+    return ModelConfig(
+        family="bloom", vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_heads=heads, num_kv_heads=heads, intermediate_size=4 * hidden,
+        max_seq_len=2048, use_alibi=True, use_rope=False, attn_layernorm=True,
+        tie_embeddings=True, norm_eps=1e-5)
+
+
+MODEL_REGISTRY = {
+    # --- bloom family (reference parity: data/Data.kt:19-33) ---
+    "bloom560m": _bloom(1024, 24, 16),
+    "bloom1b1": _bloom(1536, 24, 16),
+    "bloom1b7": _bloom(2048, 24, 16),
+    "bloom3b": _bloom(2560, 30, 32),
+    "bloom7b1": _bloom(4096, 30, 32),
+    # --- llama family (BASELINE.json configs 1-3) ---
+    "tinyllama-1.1b": ModelConfig(
+        family="llama", vocab_size=32000, hidden_size=2048, num_layers=22,
+        num_heads=32, num_kv_heads=4, intermediate_size=5632,
+        max_seq_len=2048, rope_theta=10000.0),
+    "llama-3-8b": ModelConfig(
+        family="llama", vocab_size=128256, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, intermediate_size=14336,
+        max_seq_len=8192, rope_theta=500000.0),
+    # --- mixtral MoE (BASELINE.json config 4) ---
+    "mixtral-8x7b": ModelConfig(
+        family="mixtral", vocab_size=32000, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, intermediate_size=14336,
+        max_seq_len=8192, rope_theta=1000000.0, num_experts=8,
+        experts_per_token=2),
+    # --- tiny configs for tests and virtual-mesh dry runs ---
+    "llama-test": ModelConfig(
+        family="llama", vocab_size=256, hidden_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=2, intermediate_size=128, max_seq_len=128,
+        dtype_name="float32"),
+    "bloom-test": ModelConfig(
+        family="bloom", vocab_size=256, hidden_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=4, intermediate_size=256, max_seq_len=128,
+        use_alibi=True, use_rope=False, attn_layernorm=True,
+        tie_embeddings=True, dtype_name="float32"),
+    "mixtral-test": ModelConfig(
+        family="mixtral", vocab_size=256, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, intermediate_size=128, max_seq_len=128,
+        num_experts=4, experts_per_token=2, dtype_name="float32"),
+}
+
+
+def get_model_config(name: str) -> ModelConfig:
+    """Resolve a model name; an ``-int8`` suffix selects weight-only int8
+    quantization (the reference's quantized exports, ``data/Data.kt:19-33``,
+    as a runtime transform — ops/quant.py)."""
+    base = name
+    quant = "none"
+    if name.endswith("-int8"):
+        base = name[: -len("-int8")]
+        quant = "int8"
+    if base not in MODEL_REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(MODEL_REGISTRY)}")
+    cfg = MODEL_REGISTRY[base]
+    if quant != "none":
+        cfg = cfg.replace(quantization=quant)
+    return cfg
+
+
+def get_model_family(name: str) -> str:
+    return get_model_config(name).family

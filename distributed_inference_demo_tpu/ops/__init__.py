@@ -1,0 +1,9 @@
+from .norms import rms_norm, layer_norm
+from .rope import apply_rope, rope_frequencies
+from .attention import attention, alibi_slopes
+from .sampling import sample_logits, SamplingParams
+
+__all__ = [
+    "rms_norm", "layer_norm", "apply_rope", "rope_frequencies",
+    "attention", "alibi_slopes", "sample_logits", "SamplingParams",
+]

@@ -1,0 +1,99 @@
+"""KV-cached multi-head / grouped-query attention as a pure function.
+
+The reference has *no* KV cache — every decode step re-runs the module on a
+1-token sequence with no memory of the prompt (``Communication.java:322-327``,
+acknowledged "repetitive generation issue" ``BackgroundService.java:195``).
+Here the cache is the contract: ``attention`` always reads K/V from the
+caller-provided cache buffers after inserting the current chunk, so prefill
+(chunk = prompt) and decode (chunk = 1 token) are the same code path with
+static shapes — one compiled program each.
+
+Masking uses position arithmetic instead of materialized [L, L] boolean
+masks where possible so XLA can fuse it into the softmax.
+
+Supports GQA (num_kv_heads < num_heads) by logical head-group broadcast, and
+ALiBi bias for the bloom family.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (bloom family), shape [num_heads]."""
+    import math
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+    if math.log2(num_heads).is_integer():
+        slopes = pow2_slopes(num_heads)
+    else:
+        closest = 2 ** math.floor(math.log2(num_heads))
+        slopes = pow2_slopes(closest)
+        extra = pow2_slopes(2 * closest)
+        slopes += extra[0::2][: num_heads - closest]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def attention(
+    q: jnp.ndarray,             # [batch, chunk, num_heads, head_dim]
+    k_cache: jnp.ndarray,       # [batch, max_seq, num_kv_heads, head_dim]
+    v_cache: jnp.ndarray,       # [batch, max_seq, num_kv_heads, head_dim]
+    q_positions: jnp.ndarray,   # [batch, chunk] absolute positions of q tokens
+    cache_len: jnp.ndarray,     # scalar int32: valid length of the cache
+    slopes: Optional[jnp.ndarray] = None,  # [num_heads] ALiBi, or None
+) -> jnp.ndarray:
+    """Causal attention of the current chunk against the full cache.
+
+    Returns [batch, chunk, num_heads, head_dim].
+    """
+    b, chunk, nh, hd = q.shape
+    max_seq = k_cache.shape[1]
+    nkv = k_cache.shape[2]
+    groups = nh // nkv
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    qf = q.astype(jnp.float32) * scale
+    # [b, chunk, nkv, groups, hd]
+    qf = qf.reshape(b, chunk, nkv, groups, hd)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+
+    # scores: [b, nkv, groups, chunk, max_seq]
+    scores = jnp.einsum("bqkgh,bskh->bkgqs", qf, kf)
+
+    kv_pos = jnp.arange(max_seq)[None, None, :]                  # [1, 1, s]
+    qpos = q_positions[:, :, None]                               # [b, q, 1]
+    # causal + validity: a q token at position p attends to kv positions <= p
+    # that are inside the filled cache region.
+    valid = (kv_pos <= qpos) & (kv_pos < cache_len)              # [b, q, s]
+    mask = valid[:, None, None, :, :]                            # [b,1,1,q,s]
+
+    if slopes is not None:
+        # ALiBi: bias = -slope * (qpos - kvpos); shape [b, nh, q, s]
+        dist = (qpos - kv_pos).astype(jnp.float32)               # [b, q, s]
+        bias = -slopes[None, :, None, None] * dist[:, None, :, :]
+        scores = scores + bias.reshape(b, nkv, groups, chunk, max_seq)
+
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, vf)
+    return out.reshape(b, chunk, nh, hd).astype(q.dtype)
+
+
+def update_kv_cache(
+    k_cache: jnp.ndarray,  # [batch, max_seq, nkv, hd]
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,    # [batch, chunk, nkv, hd]
+    v_new: jnp.ndarray,
+    start: jnp.ndarray,    # scalar int32 insert offset
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Insert the chunk's K/V at ``start`` via dynamic_update_slice."""
+    zeros = jnp.zeros((), jnp.int32)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new.astype(k_cache.dtype), (zeros, start, zeros, zeros))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_new.astype(v_cache.dtype), (zeros, start, zeros, zeros))
+    return k_cache, v_cache

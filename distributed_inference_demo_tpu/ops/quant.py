@@ -1,0 +1,81 @@
+"""Weight-only int8 quantization.
+
+The reference ships separate int8 ONNX exports per model
+(``data/Data.kt:19-33`` ``-int8`` variants; ModelCard ``quantization_option``,
+``server.py:831``).  TPU-native version: weights live in HBM as int8 with a
+float32 per-output-channel scale (half the HBM bytes and bandwidth of bf16 —
+decode is bandwidth-bound, so this is a throughput feature, not just a memory
+one), and are dequantized on the fly inside the matmul — XLA fuses the
+``convert + multiply`` into the MXU feed, so there is no materialized bf16
+copy.
+
+``QuantizedArray`` is a pytree whose leaves both carry the stacked-layer
+leading axis, so pipeline-stage slicing (``base.slice_stage``) works on
+quantized params unchanged.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["q", "scale"], meta_fields=[])
+@dataclass
+class QuantizedArray:
+    """int8 values + float32 scale broadcastable over the last axis."""
+
+    q: jax.Array      # int8, original shape
+    scale: jax.Array  # float32, shape = (*1s, last_dim)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self):
+        return self.q.nbytes + self.scale.nbytes
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def quantize_array(w: jax.Array, stacked: bool = False) -> QuantizedArray:
+    """Symmetric per-output-channel (last axis) int8 quantization.
+
+    With ``stacked=True`` the leading axis is the pipeline layer stack and
+    gets its own scales, so both leaves keep the layer axis (required for
+    lax.scan over layers and for stage slicing).
+    """
+    wf = w.astype(jnp.float32)
+    reduce_from = 1 if stacked else 0
+    axes = tuple(range(reduce_from, w.ndim - 1))
+    absmax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QuantizedArray(q=q, scale=scale)
+
+
+# Weight keys worth quantizing: the large matmul operands.  Norm scales,
+# biases and router gates stay in the model dtype (tiny, precision-critical).
+_QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_layer_params(layers: dict) -> dict:
+    return {k: (quantize_array(v, stacked=True) if k in _QUANTIZABLE else v)
+            for k, v in layers.items()}
+
+
+def dense(x: jax.Array, w: Union[jax.Array, QuantizedArray],
+          eq: str) -> jax.Array:
+    """einsum that transparently handles quantized weights.
+
+    Dequantizes to the activation dtype right at the contraction so XLA
+    fuses the int8->bf16 convert into the matmul's operand feed.
+    """
+    if isinstance(w, QuantizedArray):
+        w = w.dequantize(x.dtype)
+    return jnp.einsum(eq, x, w)

@@ -1,0 +1,54 @@
+"""Token sampling: temperature / top-k / top-p / greedy, jit-friendly.
+
+TPU-native replacement for the reference's ``StaticDecoding`` C++ sampler
+(``cpp/decoding.cpp:24-66``: top-k over the last position via partial_sort,
+renormalize, discrete_distribution) and its mislabeled ``GreedyDecoding``
+(actually top-k=6 sampling, ``cpp/inference.cpp:107-143``).  All variants are
+static-shape jnp programs so they fuse into the tail stage's jitted step —
+no host round-trip per token.  The reference's temperature support exists but
+is commented out (``decoding.cpp:51-52``); here it works.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=[], meta_fields=["temperature", "top_k", "top_p", "greedy"])
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.7   # reference default: BackgroundService.java:113
+    top_k: int = 7             # reference default k=7
+    top_p: float = 1.0
+    greedy: bool = False
+
+
+def sample_logits(logits: jnp.ndarray, rng: jax.Array,
+                  params: SamplingParams) -> jnp.ndarray:
+    """Sample next-token ids from [batch, vocab] logits -> [batch] int32."""
+    if params.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits.astype(jnp.float32)
+    if params.temperature != 1.0:
+        logits = logits / jnp.maximum(params.temperature, 1e-6)
+
+    if params.top_k > 0 and params.top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, params.top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+
+    if params.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always keep top-1)
+        cutoff_mask = cum - probs > params.top_p
+        cutoff = jnp.where(cutoff_mask, -jnp.inf, sorted_logits)
+        threshold = jnp.min(jnp.where(jnp.isfinite(cutoff), cutoff, jnp.inf),
+                            axis=-1, keepdims=True)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

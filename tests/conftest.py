@@ -1,0 +1,32 @@
+"""Test harness: emulate an 8-device TPU-like mesh on CPU.
+
+Per SURVEY.md §4, the reference has no multi-node test affordances at all;
+here every test runs against a virtual 8-device CPU backend so pipeline /
+tensor / sequence parallel paths are exercised without hardware.
+
+Note: the environment preloads jax via sitecustomize with JAX_PLATFORMS=axon
+(a remote TPU tunnel), so plain env-var assignment inside this process is too
+late — we must force the platform through jax.config before any backend
+initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
