@@ -1,0 +1,57 @@
+"""Benchmark harness: decode throughput on the flagship model, real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: decode tokens/sec on TinyLlama-1.1B (bf16, KV-cached, fused decode
+scan) — BASELINE.json config #1's model.  ``vs_baseline`` compares against
+the reference-shaped 2-worker CPU pipeline baseline (see CPU_BASELINE_TPS
+provenance note below); the north-star target is >=10x.
+"""
+
+import json
+import os
+import sys
+import time
+
+# Reference-shaped baseline: TinyLlama-1.1B split across 2 localhost CPU
+# worker processes (BASELINE.json config #1), measured with
+# tools/cpu_baseline.py on this machine (see that file for the exact
+# invocation).  Updated whenever the baseline harness is re-run.
+CPU_BASELINE_TPS = 1.0  # placeholder until tools/cpu_baseline.py lands
+
+
+def main():
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+    model = os.environ.get("BENCH_MODEL", "tinyllama-1.1b")
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", "64"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(
+        cfg, params, max_seq=prompt_len + new_tokens,
+        sampling=SamplingParams(temperature=0.7, top_k=7))  # ref defaults
+
+    prompt = np.arange(batch * prompt_len).reshape(batch, prompt_len) % 1000
+    result = engine.generate(prompt, new_tokens, seed=0)
+    tps = result.tokens_per_second
+
+    print(json.dumps({
+        "metric": f"decode tokens/sec ({model}, bf16, batch={batch}, "
+                  f"prompt={prompt_len}, new={new_tokens}, "
+                  f"device={jax.devices()[0].device_kind})",
+        "value": round(tps, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps / CPU_BASELINE_TPS, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -100,20 +100,34 @@ def init_full_params(rng: jax.Array, cfg: ModelConfig) -> StageParams:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+         tp_axis: Optional[str] = None) -> jnp.ndarray:
+    """MLP block.  Under manual TP (``tp_axis`` set inside shard_map),
+    w_gate/w_up arrive column-sliced and w_down row-sliced: the partial
+    products are summed with an explicit psum (Megatron layout); biases are
+    added once, after the reduction."""
     if cfg.num_experts > 0:
-        return _moe_mlp(cfg, lp, x)
+        return _moe_mlp(cfg, lp, x, tp_axis)
     if cfg.family == "bloom":
+        # under manual TP, b_up arrives column-sliced (P(None, "tp")) to
+        # match w_up's local columns, so a plain add is correct either way.
         h = dense(x, lp["w_up"], "bsh,hi->bsi") + lp["b_up"]
         h = jax.nn.gelu(h.astype(jnp.float32)).astype(x.dtype)
-        return dense(h, lp["w_down"], "bsi,ih->bsh") + lp["b_down"]
+        out = dense(h, lp["w_down"], "bsi,ih->bsh")
+        if tp_axis is not None:
+            out = jax.lax.psum(out, tp_axis)
+        return out + lp["b_down"]
     gate = dense(x, lp["w_gate"], "bsh,hi->bsi")
     up = dense(x, lp["w_up"], "bsh,hi->bsi")
     h = (jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)).astype(x.dtype)
-    return dense(h, lp["w_down"], "bsi,ih->bsh")
+    out = dense(h, lp["w_down"], "bsi,ih->bsh")
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out
 
 
-def _moe_mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+def _moe_mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+             tp_axis: Optional[str] = None) -> jnp.ndarray:
     """Top-k routed MoE (mixtral).
 
     Round-1 strategy: compute all experts batched on the MXU and combine with
@@ -131,20 +145,38 @@ def _moe_mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
         jnp.arange(x.shape[0])[:, None, None],
         jnp.arange(x.shape[1])[None, :, None],
         topi].set(weights)
+    if tp_axis is not None:
+        # expert parallelism: this rank holds E_local experts; select its
+        # slice of the routing matrix and psum partial outputs across ranks.
+        e_local = lp["w_gate"].shape[0]  # QuantizedArray exposes .shape
+        e0 = jax.lax.axis_index(tp_axis) * e_local
+        route = jax.lax.dynamic_slice_in_dim(route, e0, e_local, axis=-1)
     gate = dense(x, lp["w_gate"], "bsh,ehi->besi")
     up = dense(x, lp["w_up"], "bsh,ehi->besi")
     h = (jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)).astype(x.dtype)
     out = dense(h, lp["w_down"], "besi,eih->besh")        # [b,E,s,h]
-    return jnp.einsum("besh,bse->bsh", out, route.astype(x.dtype))
+    out = jnp.einsum("besh,bse->bsh", out, route.astype(x.dtype))
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out
 
 
 def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
            k_cache: jnp.ndarray, v_cache: jnp.ndarray,
            positions: jnp.ndarray, cache_start: jnp.ndarray,
-           slopes: Optional[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One decoder block. x: [b, s, H]. Returns (x', k_cache', v_cache')."""
+           slopes: Optional[jnp.ndarray],
+           tp_axis: Optional[str] = None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decoder block. x: [b, s, H]. Returns (x', k_cache', v_cache').
+
+    Head counts derive from the weight shards, not the config, so the same
+    code runs full-model (GSPMD) and per-TP-rank (manual shard_map) — under
+    TP this rank sees nh/tp query heads and nkv/tp kv heads.
+    """
     b, s, H = x.shape
-    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    hd = cfg.head_dim
+    wq_shape = lp["wq"].shape  # QuantizedArray exposes .shape too
+    nh = wq_shape[-1] // hd
+    nkv = lp["wk"].shape[-1] // hd
 
     if cfg.attn_layernorm:
         h = layer_norm(x, lp["attn_norm_w"], lp["attn_norm_b"], cfg.norm_eps)
@@ -155,6 +187,7 @@ def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
     k = dense(h, lp["wk"], "bsh,hd->bsd")
     v = dense(h, lp["wv"], "bsh,hd->bsd")
     if cfg.attn_layernorm:
+        # bq/bk/bv are column-sharded with their weights under TP
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(b, s, nh, hd)
     k = k.reshape(b, s, nkv, hd)
@@ -169,6 +202,8 @@ def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
     attn = attention(q, k_cache, v_cache, positions, new_len, slopes)
     attn = attn.reshape(b, s, nh * hd)
     attn = dense(attn, lp["wo"], "bsd,dh->bsh")
+    if tp_axis is not None:
+        attn = jax.lax.psum(attn, tp_axis)
     if cfg.attn_layernorm:
         attn = attn + lp["bo"]
     x = x + attn
@@ -177,7 +212,7 @@ def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
         h = layer_norm(x, lp["mlp_norm_w"], lp["mlp_norm_b"], cfg.norm_eps)
     else:
         h = rms_norm(x, lp["mlp_norm_w"], cfg.norm_eps)
-    x = x + _mlp(cfg, lp, h)
+    x = x + _mlp(cfg, lp, h, tp_axis)
     return x, k_cache, v_cache
 
 
@@ -188,6 +223,7 @@ def stage_forward(
     inputs: jnp.ndarray,        # [b, s] int32 ids (first stage) or [b, s, H] hidden
     cache: KVCache,             # this stage's cache (num_layers = spec.num_layers)
     positions: jnp.ndarray,     # [b, s] absolute positions of the chunk
+    tp_axis: Optional[str] = None,  # set inside shard_map for manual TP
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run this stage's layer range. Returns (hidden or logits, updated cache).
 
@@ -207,11 +243,16 @@ def stage_forward(
         x = inputs.astype(cfg.dtype)
 
     slopes = alibi_slopes(cfg.num_heads) if cfg.use_alibi else None
+    if slopes is not None and tp_axis is not None:
+        nh_local = params.layers["wq"].shape[-1] // cfg.head_dim
+        slopes = jax.lax.dynamic_slice_in_dim(
+            slopes, jax.lax.axis_index(tp_axis) * nh_local, nh_local, axis=0)
     cache_start = cache.length
 
     def body(x, scanned):
         lp, kc, vc = scanned
-        x, kc, vc = _layer(cfg, lp, x, kc, vc, positions, cache_start, slopes)
+        x, kc, vc = _layer(cfg, lp, x, kc, vc, positions, cache_start, slopes,
+                           tp_axis)
         return x, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -227,4 +268,10 @@ def stage_forward(
         head = (params.embed["tokens"].T if cfg.tie_embeddings
                 else params.lm_head["w"])
         x = jnp.einsum("bsh,hv->bsv", x, head)
+        if tp_axis is not None and x.shape[-1] != cfg.vocab_size:
+            # vocab-parallel head: gather the logit shards so every rank
+            # sees full logits at the sampling boundary.  Skipped when the
+            # head was replicated (e.g. tied embeddings) and logits are
+            # already full-width.
+            x = jax.lax.all_gather(x, tp_axis, axis=-1, tiled=True)
     return x, new_cache

@@ -1,0 +1,5 @@
+from .mesh import MeshConfig, make_mesh
+from .sharding import param_shardings, shard_params, cache_shardings
+
+__all__ = ["MeshConfig", "make_mesh", "param_shardings", "shard_params",
+           "cache_shardings"]

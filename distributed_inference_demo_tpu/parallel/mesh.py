@@ -1,0 +1,62 @@
+"""Device mesh construction: the TPU-native replacement for the reference's
+device ring.
+
+The reference arranges devices in a TCP ring (header -> workers -> tail ->
+header, ``Config.java:111-134``) with hand-rolled port arithmetic
+(``Communication.java:937-961``).  Here the topology is a
+``jax.sharding.Mesh`` with named axes:
+
+- ``dp``: data parallel (concurrent samples — the reference's
+  ``core_pool_size`` in-flight pipelining, ``server.py:1003``)
+- ``pp``: pipeline stages (the reference's per-device layer ranges)
+- ``tp``: tensor parallel (attention heads / MLP columns; absent in the
+  reference — SURVEY.md §2.7)
+- ``sp``: sequence/context parallel for long sequences (ring attention;
+  absent in the reference — SURVEY.md §5.7)
+
+Expert parallelism for MoE rides the ``tp`` axis (experts are sharded over
+the same chips that would otherwise shard heads).
+
+Collectives ride ICI when the mesh maps to a physical slice; across hosts
+XLA routes them over DCN.  Axis order is chosen so the innermost (fastest)
+mesh dim carries ``tp`` — the axis with the chattiest collectives.
+"""
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+AXES = ("dp", "pp", "tp", "sp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.pp * self.tp * self.sp
+
+    def axis_sizes(self) -> dict:
+        return {"dp": self.dp, "pp": self.pp, "tp": self.tp, "sp": self.sp}
+
+
+def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the named mesh.  dp is outermost (DCN-friendly: gradient/batch
+    collectives are infrequent), tp innermost (ICI-neighbor heavy)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = cfg.num_devices
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {cfg} needs {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(cfg.dp, cfg.pp, cfg.tp, cfg.sp)
+    # order: (dp, pp, tp, sp) with sp adjacent to tp; ring attention wants
+    # sp neighbors physically adjacent, which reshape order provides.
+    return Mesh(arr.transpose(0, 1, 3, 2), ("dp", "pp", "sp", "tp"))

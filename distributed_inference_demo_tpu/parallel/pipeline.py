@@ -1,0 +1,234 @@
+"""SPMD pipeline parallelism over the device mesh.
+
+The reference's pipeline is a TCP ring of processes, each pulling activations
+from its predecessor with a "Request Data" handshake per token
+(``Communication.java:682-928``).  The TPU-native equivalent is a *circular
+collective pipeline*: every pp rank holds a contiguous layer range (the
+stacked layer stack sharded on its leading axis), microbatches stream through
+a ``lax.scan``, and the inter-stage hop is a single ``lax.ppermute`` over ICI
+— no handshake, no serialization; backpressure is the scan's data dependence.
+
+Composes with manual Megatron-style TP (``decoder.stage_forward(tp_axis=)``:
+psum after row-parallel matmuls) and manual DP (batch sliced over ``dp``,
+gradient psum).  Everything runs inside ONE ``jax.shard_map`` /
+``jax.jit``, so XLA schedules collective/compute overlap — the reference's
+hand-rolled comm/compute threading (``OneStep`` phases) dissolves into the
+compiler schedule.
+
+Gradient correctness rule: a parameter leaf's gradient must be psum-reduced
+over every *manual* mesh axis the leaf is replicated on (e.g. embed grads
+over pp and tp, norm grads over tp) — sharded leaves are already exact.
+``_grad_sync_axes`` encodes this from the sharding specs.
+"""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
+from ..ops.quant import QuantizedArray
+from .sharding import layer_spec
+
+
+def _pp_in_specs(params: StageParams, cfg: ModelConfig, use_tp: bool):
+    """shard_map in_specs for the params tree: layer stack split over pp
+    (leading axis) and tp (head/column axes); embed/norms/head replicated."""
+    def map_layers(layers):
+        out = {}
+        for k, v in layers.items():
+            spec = layer_spec(k, cfg, pp_shard=True)
+            if not use_tp:
+                spec = P("pp", *([None] * (len(spec) - 1)))
+            if isinstance(v, QuantizedArray):
+                scale_spec = P(*([None] * (len(spec) - 1)),
+                               spec[-1] if len(spec) else None)
+                out[k] = QuantizedArray(q=spec, scale=scale_spec)
+            else:
+                out[k] = spec
+        return out
+
+    def rep(tree):
+        return None if tree is None else {k: P() for k in tree}
+
+    # vocab-column-shard the untied head under TP (same layout as
+    # parallel/tensor.py); head_fn all-gathers logit shards by shape.
+    lm_head = (None if params.lm_head is None else
+               {k: (P(None, "tp") if use_tp else P())
+                for k in params.lm_head})
+    return StageParams(layers=map_layers(params.layers),
+                       embed=rep(params.embed),
+                       final_norm=rep(params.final_norm),
+                       lm_head=lm_head)
+
+
+def _grad_sync_axes(params: StageParams, cfg: ModelConfig, use_tp: bool):
+    """For each leaf, the tuple of manual axes to psum its gradient over.
+
+    Covers pp/tp replication only; dp gradients are a *mean* (each dp group
+    computed a mean loss over its batch slice) and are pmean'd separately.
+    """
+    in_specs = _pp_in_specs(params, cfg, use_tp)
+
+    def axes_for(spec):
+        named = {ax for part in spec if part is not None
+                 for ax in ((part,) if isinstance(part, str) else part)}
+        return tuple(ax for ax in ("pp", "tp") if ax not in named)
+
+    return jax.tree.map(axes_for, in_specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def pipeline_apply(
+    cfg: ModelConfig,
+    params: StageParams,      # LOCAL shards (inside shard_map)
+    ids_mb: jnp.ndarray,      # [M, b, s] microbatched token ids
+    targets_mb: jnp.ndarray,  # [M, b, s] next-token targets (-100 = pad)
+    tp_axis: Optional[str],
+    pp_axis: str = "pp",
+) -> jnp.ndarray:
+    """Forward + mean cross-entropy through the circular pipeline.
+
+    Runs M + S - 1 scan steps; stage 0 ingests microbatch t at step t, the
+    last stage emits microbatch t-(S-1) at step t.  Every rank executes the
+    same program (SPMD); first/last-stage roles are data selections, not
+    control flow.
+    """
+    S = jax.lax.axis_size(pp_axis)
+    my = jax.lax.axis_index(pp_axis)
+    is_first = my == 0
+    is_last = my == S - 1
+    M, b, s = ids_mb.shape
+    T = M + S - 1
+    H = cfg.hidden_size
+    dt = cfg.dtype
+
+    # every rank carries the full (replicated) embed/head; the pipeline body
+    # below masks their *use* by rank role.
+    spec_mid = StageSpec(stage_id=1, num_stages=3, layer_start=0,
+                         layer_end=0)  # "not first, not last": raw layers
+
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    def embed_fn(ids):
+        x = params.embed["tokens"][ids]
+        if cfg.family == "bloom":
+            from ..ops.norms import layer_norm
+            x = layer_norm(x, params.embed["norm_w"], params.embed["norm_b"],
+                           cfg.norm_eps)
+        return x.astype(dt)
+
+    def head_fn(h):
+        from ..ops.norms import layer_norm, rms_norm
+        if cfg.attn_layernorm:
+            h = layer_norm(h, params.final_norm["w"], params.final_norm["b"],
+                           cfg.norm_eps)
+        else:
+            h = rms_norm(h, params.final_norm["w"], cfg.norm_eps)
+        head = (params.embed["tokens"].T if cfg.tie_embeddings
+                else params.lm_head["w"])
+        logits = jnp.einsum("bsh,hv->bsv", h, head)
+        if tp_axis is not None and logits.shape[-1] != cfg.vocab_size:
+            logits = jax.lax.all_gather(logits, tp_axis, axis=-1, tiled=True)
+        return logits
+
+    from ..models.decoder import stage_forward
+
+    def run_local_layers(x):
+        nkv_local = params.layers["wk"].shape[-1] // cfg.head_dim
+        L_local = jax.tree.leaves(params.layers)[0].shape[0]
+        cache = KVCache(
+            keys=jnp.zeros((L_local, b, s, nkv_local, cfg.head_dim), dt),
+            values=jnp.zeros((L_local, b, s, nkv_local, cfg.head_dim), dt),
+            length=jnp.zeros((), jnp.int32))
+        mid_params = StageParams(layers=params.layers)
+        out, _ = stage_forward(mid_params, cfg, spec_mid, x, cache, positions,
+                               tp_axis=tp_axis)
+        return out
+
+    def step(carry, t):
+        recv, loss_sum, tok_sum = carry
+        m_in = jnp.minimum(t, M - 1)
+        ids_t = jax.lax.dynamic_index_in_dim(ids_mb, m_in, 0, keepdims=False)
+        x0 = embed_fn(ids_t)
+        x = jnp.where(is_first, x0, recv)
+        h = run_local_layers(x)
+
+        # last stage: loss for microbatch t-(S-1), valid when t >= S-1
+        m_out = jnp.clip(t - (S - 1), 0, M - 1)
+        tgt = jax.lax.dynamic_index_in_dim(targets_mb, m_out, 0,
+                                           keepdims=False)
+        logits = head_fn(h)
+        mask = (tgt != -100) & (t >= S - 1) & is_last
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tok_ll = jnp.take_along_axis(
+            logp, jnp.maximum(tgt, 0)[..., None], axis=-1)[..., 0]
+        loss_sum = loss_sum - jnp.sum(jnp.where(mask, tok_ll, 0.0))
+        tok_sum = tok_sum + jnp.sum(mask)
+
+        # rotate activations one stage forward (ICI neighbor hop)
+        send = jax.lax.ppermute(
+            h, pp_axis, [(i, (i + 1) % S) for i in range(S)])
+        return (send, loss_sum, tok_sum), None
+
+    recv0 = jnp.zeros((b, s, H), dt)
+    (_, loss_sum, tok_sum), _ = jax.lax.scan(
+        step, (recv0, jnp.float32(0.0), jnp.int32(0)), jnp.arange(T))
+
+    loss_sum = jax.lax.psum(loss_sum, pp_axis)
+    tok_sum = jax.lax.psum(tok_sum, pp_axis)
+    return loss_sum / jnp.maximum(tok_sum, 1)
+
+
+def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh, optimizer,
+                             num_microbatches: int):
+    """Build a jitted data+pipeline+tensor-parallel training step.
+
+    Returns ``train_step(params, opt_state, ids, targets) ->
+    (params, opt_state, loss)`` where ids/targets are
+    ``[batch, seq]`` int32 on host; batch must divide by dp*num_microbatches.
+    """
+    use_tp = mesh.shape.get("tp", 1) > 1
+    use_dp = mesh.shape.get("dp", 1) > 1
+    axis_names = set(mesh.axis_names)
+    assert {"dp", "pp", "tp"} <= axis_names, mesh.axis_names
+
+    def build(params_template):
+        in_specs_params = _pp_in_specs(params_template, cfg, use_tp)
+        sync_axes = _grad_sync_axes(params_template, cfg, use_tp)
+
+        def sm_loss_and_grads(params_local, ids_mb, targets_mb):
+            def loss_fn(p):
+                return pipeline_apply(cfg, p, ids_mb, targets_mb,
+                                      "tp" if use_tp else None)
+            loss, grads = jax.value_and_grad(loss_fn)(params_local)
+            grads = jax.tree.map(
+                lambda g, axes: jax.lax.psum(g, axes) if axes else g,
+                grads, sync_axes)
+            if use_dp:
+                loss = jax.lax.pmean(loss, "dp")
+                grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+            return loss, grads
+
+        data_spec = P(None, "dp")  # [M, batch, seq]: batch over dp
+        sharded = jax.shard_map(
+            sm_loss_and_grads, mesh=mesh,
+            in_specs=(in_specs_params, data_spec, data_spec),
+            out_specs=(P(), in_specs_params),
+            check_vma=False)
+        return sharded
+
+    def train_step(params, opt_state, ids, targets):
+        M = num_microbatches
+        B, s = ids.shape
+        ids_mb = ids.reshape(M, B // M, s)
+        targets_mb = targets.reshape(M, B // M, s)
+        loss, grads = build(params)(params, ids_mb, targets_mb)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1))

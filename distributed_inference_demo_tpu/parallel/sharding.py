@@ -1,0 +1,136 @@
+"""Sharding rules: where every parameter and activation lives on the mesh.
+
+GSPMD path: annotate params with NamedSharding and let XLA insert the
+collectives (all-gather for column-parallel outputs, reduce-scatter for
+row-parallel) — the "pick a mesh, annotate, let XLA do the rest" recipe.
+The manual shard_map pipeline (parallel/pipeline.py) slices the same layout.
+
+Megatron-style TP layout:
+- wq/wk/wv  [L, H, heads*hd]   -> shard last axis over tp (column parallel)
+- wo        [L, heads*hd, H]   -> shard first non-L axis over tp (row parallel)
+- w_gate/up [L, H, I]          -> column parallel
+- w_down    [L, I, H]          -> row parallel
+- MoE experts [L, E, H, I]     -> shard E over tp (expert parallelism)
+- embed [V, H] / lm_head [H, V]-> shard V over tp (vocab parallel); logits
+                                  all-gather only at the sampling boundary
+- KV cache [Ls, B, S, nkv, hd] -> batch over dp, kv heads over tp, seq over sp
+"""
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.base import ModelConfig, StageParams
+from ..ops.quant import QuantizedArray
+
+
+# per-key PartitionSpec for the stacked layer dict; None entries = replicated
+_LAYER_SPECS = {
+    "attn_norm_w": P(),
+    "attn_norm_b": P(),
+    "mlp_norm_w": P(),
+    "mlp_norm_b": P(),
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    "bq": P(None, "tp"),
+    "bk": P(None, "tp"),
+    "bv": P(None, "tp"),
+    "wo": P(None, "tp", None),
+    "bo": P(),
+    "w_gate": P(None, None, "tp"),
+    "w_up": P(None, None, "tp"),
+    "b_up": P(None, "tp"),
+    "w_down": P(None, "tp", None),
+    "b_down": P(),
+    "router": P(),
+}
+
+# MoE expert stacks carry an extra E axis at position 1: shard experts.
+_MOE_SPECS = {
+    "w_gate": P(None, "tp", None, None),
+    "w_up": P(None, "tp", None, None),
+    "w_down": P(None, "tp", None, None),
+}
+
+
+def layer_spec(key: str, cfg: ModelConfig, pp_shard: bool = False) -> P:
+    """PartitionSpec for one stacked-layer weight.  ``pp_shard`` additionally
+    splits the leading layer axis over pp (SPMD pipeline layout)."""
+    if cfg.num_experts > 0 and key in _MOE_SPECS:
+        spec = _MOE_SPECS[key]
+    else:
+        spec = _LAYER_SPECS.get(key, P())
+    if pp_shard:
+        spec = P("pp", *spec[1:]) if len(spec) > 0 else P("pp")
+    return spec
+
+
+def _embed_specs(cfg: ModelConfig) -> dict:
+    # vocab-parallel embedding: the gather masks out-of-shard ids and psums.
+    specs = {"tokens": P("tp", None)}
+    if cfg.family == "bloom":
+        specs["norm_w"] = P()
+        specs["norm_b"] = P()
+    return specs
+
+
+def param_shardings(params: StageParams, cfg: ModelConfig, mesh: Mesh,
+                    pp_shard: bool = False) -> StageParams:
+    """Alias for :func:`stage_param_shardings` (full model == stage 0 of 1)."""
+    return stage_param_shardings(params, cfg, mesh, pp_shard)
+
+
+def stage_param_shardings(params: StageParams, cfg: ModelConfig, mesh: Mesh,
+                          pp_shard: bool = False) -> StageParams:
+    """Shardings matching an actual params tree (handles absent embed/head)."""
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    def map_layers(layers):
+        out = {}
+        for k, v in layers.items():
+            spec = layer_spec(k, cfg, pp_shard)
+            if isinstance(v, QuantizedArray):
+                scale_spec = P(*([None] * (len(spec) - 1)),
+                               spec[-1] if len(spec) else None)
+                out[k] = QuantizedArray(q=ns(spec), scale=ns(scale_spec))
+            else:
+                out[k] = ns(spec)
+        return out
+
+    embed = None
+    if params.embed is not None:
+        embed = {k: ns(s) for k, s in _embed_specs(cfg).items()
+                 if k in params.embed}
+    final_norm = None
+    if params.final_norm is not None:
+        final_norm = {k: ns(P()) for k in params.final_norm}
+    lm_head = None
+    if params.lm_head is not None:
+        lm_head = {k: ns(P(None, "tp")) for k in params.lm_head}
+    return StageParams(layers=map_layers(params.layers), embed=embed,
+                       final_norm=final_norm, lm_head=lm_head)
+
+
+def shard_params(params: StageParams, cfg: ModelConfig, mesh: Mesh,
+                 pp_shard: bool = False) -> StageParams:
+    """Place a host-resident params tree onto the mesh."""
+    shardings = stage_param_shardings(params, cfg, mesh, pp_shard)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), params, shardings)
+
+
+def cache_shardings(mesh: Mesh, shard_heads: bool = True,
+                    shard_seq: bool = False):
+    """NamedShardings for KVCache (keys/values/length).
+
+    [layers, batch, seq, kv_heads, head_dim]: batch over dp, kv heads over
+    tp (requires num_kv_heads % tp == 0), seq over sp for long-context.
+    """
+    from ..models.base import KVCache
+    kv = P(None, "dp", "sp" if shard_seq else None,
+           "tp" if shard_heads else None, None)
+    return KVCache(keys=NamedSharding(mesh, kv),
+                   values=NamedSharding(mesh, kv),
+                   length=NamedSharding(mesh, P()))

@@ -1,0 +1,81 @@
+"""Tensor-parallel stage execution (Megatron layout over the ``tp`` axis).
+
+BASELINE.json config #3: "Llama-3-8B tensor-parallel: attention-head shards
+across 8 TPU chips via ICI all-gather".  The forward is
+``decoder.stage_forward`` run inside ``jax.shard_map`` with column/row-
+sliced weights and explicit psum/all-gather collectives (see
+``decoder._layer(tp_axis=...)``); the KV cache lives sharded by kv-head so
+each chip only touches its heads' cache lines.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
+from ..models.decoder import stage_forward
+from ..ops.quant import QuantizedArray
+from .sharding import layer_spec
+
+
+def _tp_param_specs(params: StageParams, cfg: ModelConfig) -> StageParams:
+    def map_layers(layers):
+        out = {}
+        for k, v in layers.items():
+            spec = layer_spec(k, cfg, pp_shard=False)
+            if isinstance(v, QuantizedArray):
+                scale_spec = P(*([None] * (len(spec) - 1)),
+                               spec[-1] if len(spec) else None)
+                out[k] = QuantizedArray(q=spec, scale=scale_spec)
+            else:
+                out[k] = spec
+        return out
+
+    def rep(tree):
+        return None if tree is None else {k: P() for k in tree}
+
+    # lm_head is vocab-column-sharded; stage_forward all-gathers the logit
+    # shards at the sampling boundary.  embed stays replicated (id gather).
+    lm_head = (None if params.lm_head is None
+               else {k: P(None, "tp") for k in params.lm_head})
+    return StageParams(layers=map_layers(params.layers),
+                       embed=rep(params.embed),
+                       final_norm=rep(params.final_norm),
+                       lm_head=lm_head)
+
+
+_CACHE_SPEC = KVCache(keys=P(None, None, None, "tp", None),
+                      values=P(None, None, None, "tp", None),
+                      length=P())
+
+
+def make_tp_stage_fn(cfg: ModelConfig, spec: StageSpec, mesh: Mesh,
+                     params_template: StageParams):
+    """Jitted fn(params, inputs, cache, positions) -> (out, cache) with the
+    stage's weights and KV cache sharded over ``tp``.
+
+    Requires ``cfg.num_kv_heads %% tp == 0`` (cache shards by kv head).
+    Activations and logits come back replicated — the caller samples or
+    forwards them without caring about the mesh.
+    """
+    tp = mesh.shape["tp"]
+    if tp > 1 and cfg.num_kv_heads % tp:
+        raise ValueError(
+            f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}")
+
+    p_specs = _tp_param_specs(params_template, cfg)
+
+    def body(p, i, c, pos):
+        return stage_forward(p, cfg, spec, i, c, pos, tp_axis="tp")
+
+    def fn(params, inputs, cache, positions):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(p_specs, P(), _CACHE_SPEC, P()),
+            out_specs=(P(), _CACHE_SPEC),
+            check_vma=False,
+        )(params, inputs, cache, positions)
+
+    return jax.jit(fn, donate_argnums=(2,))

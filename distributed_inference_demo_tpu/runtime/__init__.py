@@ -1,0 +1,3 @@
+from .engine import InferenceEngine, GenerationResult
+
+__all__ = ["InferenceEngine", "GenerationResult"]
