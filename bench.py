@@ -40,7 +40,8 @@ def main():
         sampling=SamplingParams(temperature=0.7, top_k=7))  # ref defaults
 
     prompt = np.arange(batch * prompt_len).reshape(batch, prompt_len) % 1000
-    result = engine.generate(prompt, new_tokens, seed=0)
+    engine.generate(prompt, new_tokens, seed=0)        # compile warmup
+    result = engine.generate(prompt, new_tokens, seed=0)  # steady-state
     tps = result.tokens_per_second
 
     print(json.dumps({

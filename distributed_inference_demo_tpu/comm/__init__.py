@@ -1,0 +1,14 @@
+"""Communication layer: wire codec, transports, message schema.
+
+The heterogeneous boundary of the framework: TPU mesh ranks talk to each
+other via XLA collectives over ICI (parallel/), but CPU/edge workers and the
+control plane talk over sockets.  This package owns that socket side —
+replacing the reference's ZeroMQ + hand-rolled binary framing
+(``utils.cpp:124-368``, ``Communication.java``).
+"""
+
+from .wire import (DType, TensorMessage, deserialize_tensors,
+                   serialize_tensors, deserialize_token, serialize_token)
+
+__all__ = ["DType", "TensorMessage", "serialize_tensors",
+           "deserialize_tensors", "serialize_token", "deserialize_token"]

@@ -1,0 +1,1 @@
+"""Native (C++) comm components, built on demand with g++ (see build.py)."""

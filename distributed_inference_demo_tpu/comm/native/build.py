@@ -1,0 +1,47 @@
+"""Build the native comm library (codec + tokenizer) with g++.
+
+No pybind11 in the image, so everything is a plain C ABI shared object
+loaded via ctypes.  Build is on-demand and cached next to the sources;
+``python -m distributed_inference_demo_tpu.comm.native.build`` forces a
+rebuild.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+
+_DIR = Path(__file__).resolve().parent
+SOURCES = ["codec.cc", "tokenizer.cc"]
+LIB_NAME = "libdwt_native.so"
+
+
+def lib_path() -> Path:
+    return _DIR / LIB_NAME
+
+
+def _needs_build() -> bool:
+    lib = lib_path()
+    if not lib.exists():
+        return True
+    lib_mtime = lib.stat().st_mtime
+    return any((_DIR / s).exists() and (_DIR / s).stat().st_mtime > lib_mtime
+               for s in SOURCES)
+
+
+def build(force: bool = False) -> Path:
+    """Compile the shared library if sources changed.  Returns its path."""
+    lib = lib_path()
+    if not force and not _needs_build():
+        return lib
+    srcs = [str(_DIR / s) for s in SOURCES if (_DIR / s).exists()]
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-Wall",
+           "-o", str(lib)] + srcs
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return lib
+
+
+if __name__ == "__main__":
+    path = build(force=True)
+    print(f"built {path} ({os.path.getsize(path)} bytes)")

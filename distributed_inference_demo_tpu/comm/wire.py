@@ -1,0 +1,188 @@
+"""Versioned, endian-explicit tensor wire format.
+
+Replaces the reference's cross-device serialization protocol
+(``cpp/utils.cpp:124-368``): ``[numTensors: size_t][dtype, ndims, dims...,
+raw]`` in *native* endianness with ``size_t`` fields — defect #9 in
+SURVEY.md Appendix B (not portable across hosts).  This format fixes that:
+
+- explicit little-endian for every header field and for raw data;
+- fixed-width field types (no ``size_t``);
+- a 4-byte magic + 1-byte version so the receiver can reject garbage and
+  future revisions can evolve the layout;
+- bfloat16 as a first-class dtype (the TPU-native activation dtype — the
+  reference's ORT path had no bf16 at the wire, forcing f32 activations).
+
+Layout (all little-endian):
+
+    header:  magic "DWT1" | version:u8 | flags:u8 | reserved:u16 | ntensors:u32
+    tensor:  dtype:u8 | ndims:u8 | reserved:u16 | nbytes:u64 | dims:u64*ndims
+             | raw bytes (C-contiguous)
+
+Token ids travel as 4-byte little-endian ints (reference
+``utils.cpp:11-25`` used native-endian).
+
+A byte-identical C++ implementation lives in ``native/codec.cc`` (loaded via
+``comm.native``); this module is the reference implementation and the
+fallback when the native lib isn't built.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass
+from typing import List, Sequence
+
+import numpy as np
+
+try:  # bfloat16 numpy dtype (always present in this env via jax)
+    import ml_dtypes
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BFLOAT16 = None
+
+MAGIC = b"DWT1"
+VERSION = 1
+_HEADER = struct.Struct("<4sBBHI")          # magic, version, flags, rsv, n
+_TENSOR_HDR = struct.Struct("<BBHQ")        # dtype, ndims, rsv, nbytes
+
+
+class DType(enum.IntEnum):
+    """Wire dtype enum (stable across versions; extend, never renumber).
+
+    Mirrors the 11-dtype table of the reference's ``CopyOrtValue``
+    (``utils.cpp:49-113``) plus bfloat16.
+    """
+
+    F32 = 0
+    F64 = 1
+    F16 = 2
+    BF16 = 3
+    I8 = 4
+    I16 = 5
+    I32 = 6
+    I64 = 7
+    U8 = 8
+    U16 = 9
+    U32 = 10
+    U64 = 11
+    BOOL = 12
+
+
+_TO_NP = {
+    DType.F32: np.dtype("<f4"), DType.F64: np.dtype("<f8"),
+    DType.F16: np.dtype("<f2"),
+    DType.I8: np.dtype("i1"), DType.I16: np.dtype("<i2"),
+    DType.I32: np.dtype("<i4"), DType.I64: np.dtype("<i8"),
+    DType.U8: np.dtype("u1"), DType.U16: np.dtype("<u2"),
+    DType.U32: np.dtype("<u4"), DType.U64: np.dtype("<u8"),
+    DType.BOOL: np.dtype("bool"),
+}
+if _BFLOAT16 is not None:
+    _TO_NP[DType.BF16] = _BFLOAT16
+
+_FROM_NP = {v: k for k, v in _TO_NP.items()}
+
+
+class WireError(ValueError):
+    """Malformed or incompatible wire payload."""
+
+
+@dataclass
+class TensorMessage:
+    """A decoded wire payload: a list of ndarrays plus the header flags."""
+
+    tensors: List[np.ndarray]
+    flags: int = 0
+
+
+def _np_dtype_to_wire(dt: np.dtype) -> DType:
+    dt = np.dtype(dt)
+    # normalize endianness: the wire is little-endian
+    key = dt.newbyteorder("<") if dt.byteorder == ">" else dt
+    try:
+        return _FROM_NP[key]
+    except KeyError:
+        raise WireError(f"unsupported dtype for wire: {dt}") from None
+
+
+def serialize_tensors(arrays: Sequence[np.ndarray], flags: int = 0) -> bytes:
+    """Encode a sequence of arrays into one wire message.
+
+    Counterpart of ``SerializeTensorVectorToBytes`` (``utils.cpp:124-264``),
+    including its total-size self-check — here the check is structural
+    (we build the buffer piecewise and verify the final length).
+    """
+    parts = [_HEADER.pack(MAGIC, VERSION, flags & 0xFF, 0, len(arrays))]
+    expected = _HEADER.size
+    for a in arrays:
+        a = np.asarray(a)
+        if not a.flags["C_CONTIGUOUS"]:  # 0-d arrays are always contiguous,
+            a = np.ascontiguousarray(a)  # so this never promotes 0-d to 1-d
+
+        wdt = _np_dtype_to_wire(a.dtype)
+        if a.dtype.byteorder == ">":
+            a = a.astype(a.dtype.newbyteorder("<"))
+        raw = a.tobytes()
+        parts.append(_TENSOR_HDR.pack(int(wdt), a.ndim, 0, len(raw)))
+        parts.append(struct.pack(f"<{a.ndim}Q", *a.shape))
+        parts.append(raw)
+        expected += _TENSOR_HDR.size + 8 * a.ndim + len(raw)
+    out = b"".join(parts)
+    if len(out) != expected:  # structural self-check (utils.cpp:250-261)
+        raise WireError(f"serializer size mismatch: {len(out)} != {expected}")
+    return out
+
+
+def deserialize_tensors(data: bytes) -> TensorMessage:
+    """Decode one wire message.  Counterpart of
+    ``DeserializeTensorVectorFromBytes`` (``utils.cpp:266-368``)."""
+    if len(data) < _HEADER.size:
+        raise WireError(f"short message: {len(data)} bytes")
+    magic, version, flags, _rsv, n = _HEADER.unpack_from(data, 0)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise WireError(f"unsupported wire version {version}")
+    off = _HEADER.size
+    out: List[np.ndarray] = []
+    for _ in range(n):
+        if off + _TENSOR_HDR.size > len(data):
+            raise WireError("truncated tensor header")
+        dt_raw, ndims, _rsv, nbytes = _TENSOR_HDR.unpack_from(data, off)
+        off += _TENSOR_HDR.size
+        try:
+            wdt = DType(dt_raw)
+            np_dt = _TO_NP[wdt]
+        except (ValueError, KeyError):
+            raise WireError(f"unknown wire dtype {dt_raw}") from None
+        if off + 8 * ndims > len(data):
+            raise WireError("truncated dims")
+        dims = struct.unpack_from(f"<{ndims}Q", data, off)
+        off += 8 * ndims
+        count = 1
+        for d in dims:
+            count *= d
+        if count * np_dt.itemsize != nbytes:
+            raise WireError(
+                f"nbytes {nbytes} inconsistent with shape {dims} {np_dt}")
+        if off + nbytes > len(data):
+            raise WireError("truncated tensor data")
+        arr = np.frombuffer(data, np_dt, count=count, offset=off)
+        out.append(arr.reshape(dims).copy())  # own the memory
+        off += nbytes
+    if off != len(data):
+        raise WireError(f"{len(data) - off} trailing bytes")
+    return TensorMessage(tensors=out, flags=flags)
+
+
+def serialize_token(token_id: int) -> bytes:
+    """4-byte little-endian token id (reference ``utils.cpp:11-17``)."""
+    return struct.pack("<i", token_id)
+
+
+def deserialize_token(data: bytes) -> int:
+    """Counterpart of ``DeserializeInt`` (``utils.cpp:19-25``)."""
+    if len(data) != 4:
+        raise WireError(f"token message must be 4 bytes, got {len(data)}")
+    return struct.unpack("<i", data)[0]

@@ -56,7 +56,9 @@ def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
     if len(devices) < need:
         raise ValueError(
             f"mesh {cfg} needs {need} devices, have {len(devices)}")
-    arr = np.asarray(devices[:need]).reshape(cfg.dp, cfg.pp, cfg.tp, cfg.sp)
-    # order: (dp, pp, tp, sp) with sp adjacent to tp; ring attention wants
-    # sp neighbors physically adjacent, which reshape order provides.
-    return Mesh(arr.transpose(0, 1, 3, 2), ("dp", "pp", "sp", "tp"))
+    # tp innermost: consecutive physical devices are tp-neighbors (the
+    # chattiest collectives — per-layer psums — ride adjacent ICI links);
+    # sp next (ring-attention ppermute hops one tp-group over), then pp,
+    # then dp outermost (infrequent gradient/batch collectives, DCN-ok).
+    arr = np.asarray(devices[:need]).reshape(cfg.dp, cfg.pp, cfg.sp, cfg.tp)
+    return Mesh(arr, ("dp", "pp", "sp", "tp"))

@@ -29,39 +29,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
-from ..ops.quant import QuantizedArray
-from .sharding import layer_spec
+from .sharding import stage_param_spec_tree
 
 
 def _pp_in_specs(params: StageParams, cfg: ModelConfig, use_tp: bool):
     """shard_map in_specs for the params tree: layer stack split over pp
-    (leading axis) and tp (head/column axes); embed/norms/head replicated."""
-    def map_layers(layers):
-        out = {}
-        for k, v in layers.items():
-            spec = layer_spec(k, cfg, pp_shard=True)
-            if not use_tp:
-                spec = P("pp", *([None] * (len(spec) - 1)))
-            if isinstance(v, QuantizedArray):
-                scale_spec = P(*([None] * (len(spec) - 1)),
-                               spec[-1] if len(spec) else None)
-                out[k] = QuantizedArray(q=spec, scale=scale_spec)
-            else:
-                out[k] = spec
-        return out
-
-    def rep(tree):
-        return None if tree is None else {k: P() for k in tree}
-
-    # vocab-column-shard the untied head under TP (same layout as
-    # parallel/tensor.py); head_fn all-gathers logit shards by shape.
-    lm_head = (None if params.lm_head is None else
-               {k: (P(None, "tp") if use_tp else P())
-                for k in params.lm_head})
-    return StageParams(layers=map_layers(params.layers),
-                       embed=rep(params.embed),
-                       final_norm=rep(params.final_norm),
-                       lm_head=lm_head)
+    (leading axis) and tp (head/column axes); embed/norms replicated; the
+    untied head vocab-column-sharded under TP (head_fn all-gathers logit
+    shards by shape)."""
+    return stage_param_spec_tree(params, cfg, pp_shard=True, use_tp=use_tp,
+                                 vocab_parallel_embed=False)
 
 
 def _grad_sync_axes(params: StageParams, cfg: ModelConfig, use_tp: bool):
@@ -199,6 +176,15 @@ def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh, optimizer,
         in_specs_params = _pp_in_specs(params_template, cfg, use_tp)
         sync_axes = _grad_sync_axes(params_template, cfg, use_tp)
 
+        # Under check_vma=False the transpose of every forward psum (the
+        # loss reduction over pp, the row-parallel psums over tp) is itself
+        # a psum, so raw grads come back uniformly scaled by pp*tp relative
+        # to the single-device gradient (verified empirically on the virtual
+        # mesh for pp/tp in {1,2}x{1,2}).  Normalize once here so optimizers
+        # that are not scale-invariant (sgd, clipping, weight decay) are
+        # correct.
+        grad_norm = 1.0 / (mesh.shape.get("pp", 1) * mesh.shape.get("tp", 1))
+
         def sm_loss_and_grads(params_local, ids_mb, targets_mb):
             def loss_fn(p):
                 return pipeline_apply(cfg, p, ids_mb, targets_mb,
@@ -207,6 +193,7 @@ def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh, optimizer,
             grads = jax.tree.map(
                 lambda g, axes: jax.lax.psum(g, axes) if axes else g,
                 grads, sync_axes)
+            grads = jax.tree.map(lambda g: g * grad_norm, grads)
             if use_dp:
                 loss = jax.lax.pmean(loss, "dp")
                 grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)

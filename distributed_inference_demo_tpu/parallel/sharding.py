@@ -76,6 +76,66 @@ def _embed_specs(cfg: ModelConfig) -> dict:
     return specs
 
 
+def quant_scale_spec(q_spec: P) -> P:
+    """Scale spec matching ``quantize_array(stacked=True)`` layout.
+
+    The scale's shape is ``[L, 1, ..., out]`` — only the leading layer axis
+    and the final output axis are real, so only those can inherit the q
+    array's sharding (the collapsed middle axes are size 1 and must stay
+    unsharded; e.g. MoE experts shard q's E axis but the scale broadcasts
+    over it).
+    """
+    if len(q_spec) == 0:
+        return P()
+    if len(q_spec) == 1:
+        return P(q_spec[0])
+    return P(q_spec[0], *([None] * (len(q_spec) - 2)), q_spec[-1])
+
+
+def stage_param_spec_tree(params: StageParams, cfg: ModelConfig, *,
+                          pp_shard: bool = False, use_tp: bool = True,
+                          vocab_parallel_embed: bool = False) -> StageParams:
+    """Raw PartitionSpec tree for a params tree — the single source of truth
+    shared by the GSPMD path (wrapped in NamedSharding below) and the manual
+    shard_map paths (pipeline.py / tensor.py in_specs).
+
+    ``use_tp=False`` strips tp from layer specs (pipeline-only meshes);
+    ``vocab_parallel_embed`` shards the token table over tp (GSPMD path) vs
+    replicating it (manual paths, which gather by id locally).
+    """
+    def strip_tp(spec):
+        return P(*(s if s == "pp" else None for s in spec))
+
+    def map_layers(layers):
+        out = {}
+        for k, v in layers.items():
+            spec = layer_spec(k, cfg, pp_shard)
+            if not use_tp:
+                spec = strip_tp(spec)
+            if isinstance(v, QuantizedArray):
+                out[k] = QuantizedArray(q=spec, scale=quant_scale_spec(spec))
+            else:
+                out[k] = spec
+        return out
+
+    embed = None
+    if params.embed is not None:
+        if vocab_parallel_embed and use_tp:
+            embed = {k: s for k, s in _embed_specs(cfg).items()
+                     if k in params.embed}
+        else:
+            embed = {k: P() for k in params.embed}
+    final_norm = None
+    if params.final_norm is not None:
+        final_norm = {k: P() for k in params.final_norm}
+    lm_head = None
+    if params.lm_head is not None:
+        lm_head = {k: (P(None, "tp") if use_tp else P())
+                   for k in params.lm_head}
+    return StageParams(layers=map_layers(params.layers), embed=embed,
+                       final_norm=final_norm, lm_head=lm_head)
+
+
 def param_shardings(params: StageParams, cfg: ModelConfig, mesh: Mesh,
                     pp_shard: bool = False) -> StageParams:
     """Alias for :func:`stage_param_shardings` (full model == stage 0 of 1)."""
@@ -84,34 +144,11 @@ def param_shardings(params: StageParams, cfg: ModelConfig, mesh: Mesh,
 
 def stage_param_shardings(params: StageParams, cfg: ModelConfig, mesh: Mesh,
                           pp_shard: bool = False) -> StageParams:
-    """Shardings matching an actual params tree (handles absent embed/head)."""
-    def ns(spec):
-        return NamedSharding(mesh, spec)
-
-    def map_layers(layers):
-        out = {}
-        for k, v in layers.items():
-            spec = layer_spec(k, cfg, pp_shard)
-            if isinstance(v, QuantizedArray):
-                scale_spec = P(*([None] * (len(spec) - 1)),
-                               spec[-1] if len(spec) else None)
-                out[k] = QuantizedArray(q=ns(spec), scale=ns(scale_spec))
-            else:
-                out[k] = ns(spec)
-        return out
-
-    embed = None
-    if params.embed is not None:
-        embed = {k: ns(s) for k, s in _embed_specs(cfg).items()
-                 if k in params.embed}
-    final_norm = None
-    if params.final_norm is not None:
-        final_norm = {k: ns(P()) for k in params.final_norm}
-    lm_head = None
-    if params.lm_head is not None:
-        lm_head = {k: ns(P(None, "tp")) for k in params.lm_head}
-    return StageParams(layers=map_layers(params.layers), embed=embed,
-                       final_norm=final_norm, lm_head=lm_head)
+    """NamedShardings matching an actual params tree (GSPMD placement)."""
+    specs = stage_param_spec_tree(params, cfg, pp_shard=pp_shard,
+                                  vocab_parallel_embed=True)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def shard_params(params: StageParams, cfg: ModelConfig, mesh: Mesh,

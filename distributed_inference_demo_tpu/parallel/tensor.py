@@ -16,34 +16,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..models.decoder import stage_forward
-from ..ops.quant import QuantizedArray
-from .sharding import layer_spec
+from .sharding import stage_param_spec_tree
 
 
 def _tp_param_specs(params: StageParams, cfg: ModelConfig) -> StageParams:
-    def map_layers(layers):
-        out = {}
-        for k, v in layers.items():
-            spec = layer_spec(k, cfg, pp_shard=False)
-            if isinstance(v, QuantizedArray):
-                scale_spec = P(*([None] * (len(spec) - 1)),
-                               spec[-1] if len(spec) else None)
-                out[k] = QuantizedArray(q=spec, scale=scale_spec)
-            else:
-                out[k] = spec
-        return out
-
-    def rep(tree):
-        return None if tree is None else {k: P() for k in tree}
-
     # lm_head is vocab-column-sharded; stage_forward all-gathers the logit
     # shards at the sampling boundary.  embed stays replicated (id gather).
-    lm_head = (None if params.lm_head is None
-               else {k: P(None, "tp") for k in params.lm_head})
-    return StageParams(layers=map_layers(params.layers),
-                       embed=rep(params.embed),
-                       final_norm=rep(params.final_norm),
-                       lm_head=lm_head)
+    return stage_param_spec_tree(params, cfg, pp_shard=False, use_tp=True,
+                                 vocab_parallel_embed=False)
 
 
 _CACHE_SPEC = KVCache(keys=P(None, None, None, "tp", None),

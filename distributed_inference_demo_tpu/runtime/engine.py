@@ -117,24 +117,23 @@ class InferenceEngine:
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0) -> GenerationResult:
-        """Batch generation, fused decode scan (the throughput path)."""
+        """Batch generation, fused decode scan (the throughput path).
+
+        Runs exactly once; ``seconds`` includes compile on the first call
+        for a given shape signature (jit-cached afterwards).  Benchmarks
+        wanting steady-state timing call this twice and keep the second
+        result (see bench.py).
+        """
         import time
         ids = jnp.asarray(prompt_ids, jnp.int32)
         b, plen = ids.shape
         self._check_capacity(plen, max_new_tokens)
-        cache = self.new_cache(b)
         rng = jax.random.PRNGKey(seed)
 
-        last_logits, cache = self._prefill(self.params, ids, cache)
-        toks, cache = self._decode(self.params, last_logits, cache, rng,
-                                   max_new_tokens)
-        toks.block_until_ready()
-
-        # timed run measures steady-state (compile already done above)
         t0 = time.perf_counter()
-        cache2 = self.new_cache(b)
-        last_logits, cache2 = self._prefill(self.params, ids, cache2)
-        toks, _ = self._decode(self.params, last_logits, cache2, rng,
+        cache = self.new_cache(b)
+        last_logits, cache = self._prefill(self.params, ids, cache)
+        toks, _ = self._decode(self.params, last_logits, cache, rng,
                                max_new_tokens)
         toks = np.asarray(toks)
         dt = time.perf_counter() - t0

@@ -115,3 +115,84 @@ def test_shard_params_placement(devices):
     shard_shapes = {s.data.shape for s in wq.addressable_shards}
     assert shard_shapes == {(cfg.num_layers, cfg.hidden_size,
                              cfg.num_heads * cfg.head_dim // 2)}
+
+
+@pytest.mark.parametrize("pp,tp", [(2, 1), (1, 2), (2, 2)])
+def test_pipeline_sgd_update_matches_single_device(pp, tp, devices):
+    """Regression: grads through the shard_map pipeline must match the
+    single-device gradient in *scale*, not just direction.  With sgd(1.0)
+    the param delta IS the gradient, so any leftover pp/tp scaling (the
+    check_vma=False psum-transpose artifact) fails this immediately."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (4, 8), 0,
+                             cfg.vocab_size, jnp.int32)
+    targets = jnp.roll(ids, -1, axis=1).at[:, -1].set(-100)
+
+    # single-device reference gradient
+    spec = _full_spec(cfg)
+    pos = jnp.broadcast_to(jnp.arange(8), (4, 8))
+
+    def ref_loss_fn(p):
+        logits, _ = stage_forward(p, cfg, spec, ids,
+                                  KVCache.create(cfg, cfg.num_layers, 4, 8),
+                                  pos)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        mask = targets != -100
+        ll = jnp.take_along_axis(logp, jnp.maximum(targets, 0)[..., None],
+                                 -1)[..., 0]
+        return -jnp.sum(jnp.where(mask, ll, 0)) / jnp.sum(mask)
+
+    ref_grads = jax.grad(ref_loss_fn)(params)
+
+    # host copies before stepping: the train step donates its params arg
+    old = {k: np.asarray(params.layers[k], np.float32)
+           for k in ("wq", "w_down")}
+    old_embed = np.asarray(params.embed["tokens"], np.float32)
+
+    mesh = make_mesh(MeshConfig(pp=pp, tp=tp), devices)
+    opt = optax.sgd(1.0)  # delta == -grad
+    step = make_pipeline_train_step(cfg, mesh, opt, num_microbatches=2)
+    with mesh:
+        new_params, _, _ = step(params, opt.init(params), ids, targets)
+
+    for key in ("wq", "w_down"):
+        got = old[key] - np.asarray(new_params.layers[key], np.float32)
+        want = np.asarray(ref_grads.layers[key], np.float32)
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-5)
+    got_embed = old_embed - np.asarray(new_params.embed["tokens"], np.float32)
+    np.testing.assert_allclose(
+        got_embed, np.asarray(ref_grads.embed["tokens"], np.float32),
+        rtol=2e-3, atol=2e-5)
+
+
+def test_pipeline_quantized_params(devices):
+    """'-int8' quantized layer stacks must trace and run through the
+    pipeline shard_map (regression: scale spec must keep the pp axis)."""
+    from distributed_inference_demo_tpu.ops.quant import quantize_layer_params
+    from distributed_inference_demo_tpu.models.base import StageParams
+
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    from distributed_inference_demo_tpu.parallel.pipeline import (
+        _pp_in_specs, pipeline_apply)
+    from jax.sharding import PartitionSpec as P
+
+    qparams = StageParams(layers=quantize_layer_params(params.layers),
+                          embed=params.embed, final_norm=params.final_norm,
+                          lm_head=params.lm_head)
+    mesh = make_mesh(MeshConfig(pp=2, tp=2), devices)
+    ids = jax.random.randint(jax.random.PRNGKey(4), (4, 8), 0,
+                             cfg.vocab_size, jnp.int32)
+    targets = jnp.roll(ids, -1, axis=1).at[:, -1].set(-100)
+    ids_mb = ids.reshape(2, 2, 8)
+    targets_mb = targets.reshape(2, 2, 8)
+
+    in_specs = _pp_in_specs(qparams, cfg, use_tp=True)
+    fwd = jax.shard_map(
+        lambda p, i, t: pipeline_apply(cfg, p, i, t, "tp"),
+        mesh=mesh, in_specs=(in_specs, P(), P()), out_specs=P(),
+        check_vma=False)
+    with mesh:
+        loss = fwd(qparams, ids_mb, targets_mb)
+    assert np.isfinite(float(loss))

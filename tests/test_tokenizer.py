@@ -1,0 +1,155 @@
+"""Tokenizer tests: native C++ vs pure-Python twin vs HuggingFace.
+
+The HF ``tokenizers`` library (in the image) is used as ground truth: a
+ByteLevel-BPE tokenizer trained in-test plus a hand-built metaspace
+(llama-style) tokenizer.json.  The reference shipped its tokenizer stack
+(Rust + sentencepiece) with zero project-owned tests (SURVEY.md §4).
+"""
+
+import json
+
+import pytest
+
+from distributed_inference_demo_tpu.tokenizer import (
+    PyBPETokenizer, Tokenizer, TokenizerSpec)
+
+TEXTS = [
+    "Hello world! This is a test.",
+    "The year 2024's results weren't great...",
+    "  leading spaces and\nnewlines\t tabs  ",
+    "héllo wörld ünïcode ¡Ω≈ç√",
+    "I'll we've don't it's 'quoted'",
+    "x",
+    "",
+    "   ",
+    "a  b   c",
+]
+
+
+@pytest.fixture(scope="module")
+def bytelevel_json(tmp_path_factory):
+    """Train a small ByteLevel BPE with the real HF tokenizers library."""
+    from tokenizers import Tokenizer as HFTok
+    from tokenizers.models import BPE
+    from tokenizers.trainers import BpeTrainer
+    from tokenizers import pre_tokenizers, decoders
+
+    tok = HFTok(BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False,
+                                                 use_regex=True)
+    tok.decoder = decoders.ByteLevel()
+    corpus = [t for t in TEXTS if t.strip()] * 50 + [
+        "the quick brown fox jumps over the lazy dog",
+        "pipeline parallel inference on tpu meshes",
+    ] * 50
+    trainer = BpeTrainer(vocab_size=400, special_tokens=["<s>", "</s>"],
+                         show_progress=False)
+    tok.train_from_iterator(corpus, trainer)
+    return tok.to_str()
+
+
+@pytest.fixture(scope="module")
+def metaspace_json():
+    """Hand-built llama-style metaspace BPE with byte fallback."""
+    pieces = ["<unk>", "<s>", "</s>"]
+    pieces += [f"<0x{b:02X}>" for b in range(256)]
+    base = list("▁abcdefghijklmnopqrstuvwxyz.!?'")
+    words = ["▁hello", "▁world", "▁the", "▁test", "hel", "llo", "wor",
+             "ld", "th", "he", "st", "▁t", "▁w", "▁h", "es", "te"]
+    vocab = {}
+    for p in pieces + base + words:
+        if p not in vocab:
+            vocab[p] = len(vocab)
+    merges = [["th", "e"], ["h", "e"], ["e", "s"], ["t", "e"],
+              ["▁", "t"], ["▁", "w"], ["▁", "h"],
+              ["he", "l"], ["l", "lo"], ["l", "o"], ["l", "l"],
+              ["hel", "lo"], ["▁h", "hello"],
+              ["wor", "ld"], ["w", "or"], ["o", "r"], ["w", "o"]]
+    merges = [m for m in merges
+              if m[0] in vocab and m[1] in vocab and (m[0] + m[1]) in vocab]
+    return json.dumps({
+        "model": {"type": "BPE", "vocab": vocab,
+                  "merges": [f"{a} {b}" for a, b in merges],
+                  "unk_token": "<unk>", "byte_fallback": True},
+        "pre_tokenizer": {"type": "Metaspace", "replacement": "▁",
+                          "prepend_scheme": "always", "split": True},
+        "decoder": {"type": "Sequence", "decoders": [
+            {"type": "Replace", "pattern": {"String": "▁"}, "content": " "},
+            {"type": "ByteFallback"},
+            {"type": "Fuse"},
+            {"type": "Strip", "content": " ", "start": 1, "stop": 0},
+        ]},
+        "added_tokens": [
+            {"id": i, "content": c, "special": True, "single_word": False,
+             "lstrip": False, "rstrip": False, "normalized": False}
+            for i, c in ((0, "<unk>"), (1, "<s>"), (2, "</s>"))
+        ],
+    })
+
+
+@pytest.mark.parametrize("text", TEXTS, ids=range(len(TEXTS)))
+def test_bytelevel_matches_hf(bytelevel_json, text):
+    ours_native = Tokenizer.from_json(bytelevel_json, backend="native")
+    ours_py = Tokenizer.from_json(bytelevel_json, backend="python")
+    hf = Tokenizer.from_json(bytelevel_json, backend="hf")
+    assert ours_native.backend == "native"
+    ref = hf.encode(text)
+    assert ours_py.encode(text) == ref
+    assert ours_native.encode(text) == ref
+    # decode round-trips the original text exactly (byte-level is lossless)
+    assert ours_native.decode(ref) == text
+    assert ours_py.decode(ref) == text
+
+
+@pytest.mark.parametrize("text", [
+    "hello world", "the test.", "hello", " hello  world ",
+    "unknown UPPER chars 123", "héllo"])
+def test_metaspace_matches_hf(metaspace_json, text):
+    ours_native = Tokenizer.from_json(metaspace_json, backend="native")
+    ours_py = Tokenizer.from_json(metaspace_json, backend="python")
+    hf = Tokenizer.from_json(metaspace_json, backend="hf")
+    ref = hf.encode(text)
+    assert ours_py.encode(text) == ref, (text, ours_py.encode(text), ref)
+    assert ours_native.encode(text) == ref
+    assert ours_py.decode(ref) == ours_native.decode(ref) == hf.decode(ref)
+
+
+def test_special_token_split(metaspace_json):
+    tok = Tokenizer.from_json(metaspace_json, backend="python")
+    ids = tok.encode("<s>hello</s>")
+    assert ids[0] == tok.bos_id == 1
+    assert ids[-1] == tok.eos_id == 2
+    assert tok.is_eos(ids[-1])
+    nat = Tokenizer.from_json(metaspace_json, backend="native")
+    assert nat.encode("<s>hello</s>") == ids
+    # skip_special drops them on decode
+    assert "<s>" not in tok.decode(ids)
+    assert "<s>" in tok.decode(ids, skip_special=False)
+
+
+def test_surface_parity(metaspace_json):
+    """tokenizers_cpp.h:25-48 surface on both backends."""
+    for backend in ("python", "native"):
+        tok = Tokenizer.from_json(metaspace_json, backend=backend)
+        i = tok.token_to_id("▁hello")
+        assert i >= 0
+        assert tok.id_to_token(i) == "▁hello"
+        assert tok.token_to_id("definitely-not-a-token") == -1
+        assert tok.id_to_token(10 ** 6) is None
+        assert tok.vocab_size() > 256
+
+
+def test_bos_eos_helpers(metaspace_json):
+    tok = Tokenizer.from_json(metaspace_json, backend="python")
+    plain = tok.encode("hello")
+    wrapped = tok.encode("hello", add_bos=True, add_eos=True)
+    assert wrapped == [tok.bos_id] + plain + [tok.eos_id]
+
+
+def test_byte_fallback(metaspace_json):
+    tok = Tokenizer.from_json(metaspace_json, backend="python")
+    nat = Tokenizer.from_json(metaspace_json, backend="native")
+    ids = tok.encode("Z")  # uppercase: not in vocab -> byte fallback
+    assert ids == nat.encode("Z")
+    assert tok.decode(ids) == "Z"
+    assert nat.decode(ids) == "Z"

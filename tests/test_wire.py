@@ -1,0 +1,93 @@
+"""Wire codec tests: Python reference impl, native C++ impl, cross-compat.
+
+The reference's codec had no tests at all (SURVEY.md §4); its known defect
+(native-endian size_t fields, Appendix B #9) is exactly what these lock in
+against regressing.
+"""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from distributed_inference_demo_tpu.comm import wire
+from distributed_inference_demo_tpu.comm import native_codec
+
+
+CASES = [
+    [],
+    [np.arange(12, dtype=np.float32).reshape(3, 4)],
+    [np.zeros((2, 0, 3), np.int64)],  # zero-size dim
+    [np.float64(3.5).reshape(())],    # scalar, ndims=0
+    [np.arange(6, dtype=np.int8),
+     np.ones((2, 2), np.float16),
+     np.array([[True, False]], bool),
+     np.arange(5, dtype=np.uint32)],
+    [np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(2, 4)],
+]
+
+
+@pytest.mark.parametrize("arrays", CASES, ids=range(len(CASES)))
+def test_python_roundtrip(arrays):
+    blob = wire.serialize_tensors(arrays, flags=7)
+    msg = wire.deserialize_tensors(blob)
+    assert msg.flags == 7
+    assert len(msg.tensors) == len(arrays)
+    for a, b in zip(arrays, msg.tensors):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), b)
+
+
+def test_native_available():
+    assert native_codec.available(), "native codec failed to build/load"
+
+
+@pytest.mark.parametrize("arrays", CASES, ids=range(len(CASES)))
+def test_native_python_byte_identical(arrays):
+    py = wire.serialize_tensors(arrays, flags=3)
+    nat = native_codec.serialize_tensors(arrays, flags=3)
+    assert py == nat  # byte-for-byte identical wire output
+
+
+@pytest.mark.parametrize("arrays", CASES, ids=range(len(CASES)))
+def test_cross_decode(arrays):
+    # python-encoded → native-decoded and vice versa
+    py_blob = wire.serialize_tensors(arrays)
+    nat_msg = native_codec.deserialize_tensors(py_blob)
+    for a, b in zip(arrays, nat_msg.tensors):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    nat_blob = native_codec.serialize_tensors(arrays)
+    py_msg = wire.deserialize_tensors(nat_blob)
+    for a, b in zip(arrays, py_msg.tensors):
+        np.testing.assert_array_equal(np.asarray(a), b)
+
+
+def test_big_endian_input_normalized():
+    a = np.arange(4, dtype=">i4")  # big-endian input
+    msg = wire.deserialize_tensors(wire.serialize_tensors([a]))
+    assert msg.tensors[0].dtype == np.dtype("<i4")
+    np.testing.assert_array_equal(msg.tensors[0], a)
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b[:3],                        # shorter than header
+    lambda b: b"XXXX" + b[4:],              # bad magic
+    lambda b: b[:4] + b"\x09" + b[5:],      # bad version
+    lambda b: b + b"\x00",                  # trailing bytes
+    lambda b: b[:-1],                       # truncated data
+])
+def test_malformed_rejected_both_impls(mutate):
+    blob = mutate(wire.serialize_tensors(
+        [np.arange(6, dtype=np.float32).reshape(2, 3)]))
+    with pytest.raises(wire.WireError):
+        wire.deserialize_tensors(blob)
+    with pytest.raises(wire.WireError):
+        native_codec.deserialize_tensors(blob)
+
+
+def test_token_roundtrip():
+    for t in (0, 1, -1, 2**31 - 1, -(2**31)):
+        assert wire.deserialize_token(wire.serialize_token(t)) == t
+    assert wire.serialize_token(1) == b"\x01\x00\x00\x00"  # little-endian
+    with pytest.raises(wire.WireError):
+        wire.deserialize_token(b"\x00" * 8)
