@@ -125,10 +125,20 @@ void* dwt_open(const uint8_t* buf, uint64_t len) {
       delete msg; return nullptr;
     }
     tv.dims = base + off;
+    // Overflow-safe element count: dims are attacker-controlled, so the
+    // product must be checked against wrap before the nbytes comparison
+    // (count*itemsize could wrap to a small value and "match").
     uint64_t count = 1;
-    for (uint8_t d = 0; d < tv.ndims; ++d) count *= get_u64(tv.dims + 8 * d);
+    bool overflow = false;
+    for (uint8_t d = 0; d < tv.ndims; ++d) {
+      uint64_t dim = get_u64(tv.dims + 8 * d);
+      if (dim != 0 && count > UINT64_MAX / dim) { overflow = true; break; }
+      count *= dim;
+    }
+    uint64_t item = (uint64_t)kItemSize[tv.dtype];
+    if (overflow || count > UINT64_MAX / item) { delete msg; return nullptr; }
     off += 8ull * tv.ndims;
-    if (count * kItemSize[tv.dtype] != tv.nbytes || off + tv.nbytes > len) {
+    if (count * item != tv.nbytes || off + tv.nbytes > len) {
       delete msg; return nullptr;
     }
     tv.data = base + off;

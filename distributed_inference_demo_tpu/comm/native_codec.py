@@ -68,6 +68,10 @@ def serialize_tensors(arrays: Sequence[np.ndarray], flags: int = 0) -> bytes:
         raise WireError("native codec not available")
     def _contig(x):
         x = np.asarray(x)
+        # Wire format is little-endian (wire.py does the same normalization);
+        # byteswap any big-endian input before handing raw bytes to C++.
+        if x.dtype.byteorder == ">":
+            x = x.astype(x.dtype.newbyteorder("<"))
         # ascontiguousarray would promote 0-d to 1-d; 0-d is always contiguous
         return x if x.flags["C_CONTIGUOUS"] else np.ascontiguousarray(x)
 
@@ -99,7 +103,10 @@ def deserialize_tensors(data: bytes) -> TensorMessage:
     lib = _load()
     if lib is None:
         raise WireError("native codec not available")
-    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    # Zero-copy handoff: c_char_p keeps a reference to `data`; dwt_open makes
+    # its own owned copy, so no Python-side staging copy is needed.
+    buf = ctypes.cast(ctypes.c_char_p(data),
+                      ctypes.POINTER(ctypes.c_uint8))
     h = lib.dwt_open(buf, len(data))
     if not h:
         raise WireError("native codec rejected message")
@@ -119,9 +126,13 @@ def deserialize_tensors(data: bytes) -> TensorMessage:
                 raise WireError("native codec: bad tensor info")
             np_dt = _TO_NP[DType(dt.value)]
             ptr = lib.dwt_tensor_data(h, i)
-            raw = ctypes.string_at(ptr, nbytes.value)
             shape = tuple(dims[d] for d in range(nd.value))
-            out.append(np.frombuffer(raw, np_dt).reshape(shape).copy())
+            # Single copy, straight from the C++ buffer into the final
+            # writable array (no string_at staging + trailing .copy()).
+            arr = np.empty(shape, np_dt)
+            if nbytes.value:
+                ctypes.memmove(arr.ctypes.data, ptr, nbytes.value)
+            out.append(arr)
         return TensorMessage(tensors=out, flags=flags)
     finally:
         lib.dwt_close(h)

@@ -1,0 +1,22 @@
+"""Control plane: device pool, registration, heartbeats, lifecycle FSM.
+
+Typed, schema'd re-design of the reference's Python root server
+(``server.py:38-473``) and its order-coupled ZMQ lifecycle protocol
+(``RootServer.java:2-17`` / ``Client.java:50-173``).  Every message on the
+wire is a versioned msgpack map (control/messages.py) instead of raw frames
+whose meaning depends on position (reference ``Client.java:69-82`` — defect
+#4 in SURVEY.md Appendix B).
+"""
+
+from .messages import (Envelope, MsgType, decode, encode)
+from .pool import DeviceInfo, DevicePoolManager, DeviceRole
+from .service import RegistrationClient, RegistrationService
+from .lifecycle import (LifecycleClient, LifecycleServer, RunConfig,
+                        LifecycleState)
+
+__all__ = [
+    "Envelope", "MsgType", "encode", "decode",
+    "DeviceInfo", "DevicePoolManager", "DeviceRole",
+    "RegistrationClient", "RegistrationService",
+    "LifecycleClient", "LifecycleServer", "RunConfig", "LifecycleState",
+]

@@ -1,0 +1,339 @@
+"""Lifecycle FSM: Ready → Open → Prepare → Initialized → Start → Running →
+Finish → Close.
+
+Re-design of the reference protocol documented at ``RootServer.java:2-17``
+and implemented client-side in ``Client.communicationOpenClose``
+(``Client.java:50-173``) with the server side missing from the snapshot
+(``SecureConnection.root_server.communication_open_close``, inferred —
+SURVEY.md §2.2).  Differences from the reference:
+
+- **One schema'd OPEN message** (RunConfig as msgpack) instead of eight
+  order-coupled raw frames (``Client.java:69-82``; defect #4).
+- **Event-driven single ROUTER loop** on the server handling all devices by
+  identity, with a barrier when every device reports INITIALIZED — the
+  reference spawns one Python thread per device (``server.py:1032-1040``).
+- **Chunked, checksummed artifact streaming** replacing the model-zip
+  download (``Client.java:174-256``): artifacts are named blobs (weight
+  shard manifests, tokenizer files) with sha256 verification; devices that
+  already hold an artifact skip the transfer (``skip_model_transmission``,
+  ``server.py:1009``; ``MODEL_EXIST_ON_DEVICE``, ``init_server.py:19``).
+- All receives are polled with timeouts — no blocking ``recv(0)`` hangs
+  (defect #7).
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import zmq
+
+from .messages import Envelope, MsgType, decode, make
+
+log = logging.getLogger(__name__)
+
+ARTIFACT_CHUNK_BYTES = 1 << 20  # 1 MiB chunks (reference streams the zip in
+                                # chunks too, Client.java:174-223)
+
+
+class LifecycleState(str, enum.Enum):
+    READY = "ready"
+    OPEN = "open"
+    PREPARE = "prepare"
+    INITIALIZED = "initialized"
+    RUNNING = "running"
+    FINISHED = "finished"
+    CLOSED = "closed"
+
+
+@dataclass
+class RunConfig:
+    """The full run configuration broadcast at OPEN.
+
+    Replaces the reference config dict (``server.py:998-1013``: num_sample,
+    max_length, core_pool_size, head/tail node, dependency, session_index,
+    graph, skip_model_transmission, onnx) with named, typed fields.
+    """
+
+    model: str = "tinyllama-1.1b"
+    task_type: str = "generation"          # generation | classification
+    num_samples: int = 1
+    max_new_tokens: int = 40               # reference max_length=40
+    pool_size: int = 1                     # in-flight microbatches
+    device_graph: List[str] = field(default_factory=list)   # ring order, addr
+    device_ids: List[str] = field(default_factory=list)     # ring order, ids
+    # stage assignment: device_id -> [layer_start, layer_end)
+    stage_ranges: Dict[str, List[int]] = field(default_factory=dict)
+    # mesh axes for TPU devices within a stage: {"dp":1,"tp":8,"sp":1,...}
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+    sampling: Dict[str, float] = field(default_factory=lambda: {
+        "temperature": 0.7, "top_k": 7})   # reference k=7, temp=0.7
+    skip_artifact_transfer: bool = False
+    reload_sample_id: Optional[int] = None  # drain/resume (server.py:1011)
+    plan_version: int = 0
+
+    def to_payload(self) -> dict:
+        return {
+            "model": self.model, "task_type": self.task_type,
+            "num_samples": self.num_samples,
+            "max_new_tokens": self.max_new_tokens,
+            "pool_size": self.pool_size,
+            "device_graph": self.device_graph,
+            "device_ids": self.device_ids,
+            "stage_ranges": self.stage_ranges,
+            "mesh_axes": self.mesh_axes,
+            "sampling": self.sampling,
+            "skip_artifact_transfer": self.skip_artifact_transfer,
+            "reload_sample_id": self.reload_sample_id,
+            "plan_version": self.plan_version,
+        }
+
+    @staticmethod
+    def from_payload(p: dict) -> "RunConfig":
+        return RunConfig(
+            model=p["model"], task_type=p["task_type"],
+            num_samples=p["num_samples"],
+            max_new_tokens=p["max_new_tokens"], pool_size=p["pool_size"],
+            device_graph=list(p["device_graph"]),
+            device_ids=list(p["device_ids"]),
+            stage_ranges={k: list(v) for k, v in p["stage_ranges"].items()},
+            mesh_axes=dict(p["mesh_axes"]), sampling=dict(p["sampling"]),
+            skip_artifact_transfer=p["skip_artifact_transfer"],
+            reload_sample_id=p.get("reload_sample_id"),
+            plan_version=p.get("plan_version", 0),
+        )
+
+
+# artifact provider: (device_id, artifact_name) -> bytes (or raise KeyError)
+ArtifactProvider = Callable[[str, str], bytes]
+
+
+class LifecycleServer:
+    """Server side of the FSM: drives every device through the state chain
+    and releases them together at START."""
+
+    def __init__(self, config: RunConfig,
+                 artifact_provider: Optional[ArtifactProvider] = None,
+                 bind_host: str = "127.0.0.1", port: int = 0,
+                 ctx: Optional[zmq.Context] = None):
+        self.config = config
+        self.artifact_provider = artifact_provider
+        self._ctx = ctx or zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        if port == 0:
+            self.port = self._sock.bind_to_random_port(f"tcp://{bind_host}")
+        else:
+            self._sock.bind(f"tcp://{bind_host}:{port}")
+            self.port = port
+        self.address = f"{bind_host}:{self.port}"
+        self.states: Dict[str, LifecycleState] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.expected = set(config.device_ids)
+        self.all_finished = threading.Event()
+        self.all_running = threading.Event()
+        # (device_id, name) -> (blob, sha256); lives while a pull-based
+        # chunked download is in progress, dropped after the last chunk.
+        self._artifact_cache: Dict = {}
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f"lifecycle-{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3.0)
+            self._thread = None
+        self._sock.close(linger=0)
+
+    # -- internals ---------------------------------------------------------
+
+    def _serve(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._sock, zmq.POLLIN)
+        while not self._stop.is_set():
+            if not dict(poller.poll(timeout=100)):
+                continue
+            frames = self._sock.recv_multipart()
+            identity, raw = frames[0], frames[-1]
+            dev_id = identity.decode()
+            try:
+                msg = decode(raw)
+            except Exception as e:
+                self._sock.send_multipart(
+                    [identity, make(MsgType.ERROR, reason=str(e))])
+                continue
+            for reply in self._handle(dev_id, msg):
+                self._sock.send_multipart([identity, reply])
+
+    def _handle(self, dev_id: str, msg: Envelope) -> List[bytes]:
+        if msg.type == MsgType.READY:
+            # Ready → Open: send the full config (Client.java:57-84)
+            self.states[dev_id] = LifecycleState.OPEN
+            return [make(MsgType.OPEN, config=self.config.to_payload())]
+        if msg.type == MsgType.ARTIFACT_REQUEST:
+            return self._artifact_chunk(dev_id, msg.get("name", ""),
+                                        msg.get("index", 0))
+        if msg.type == MsgType.INITIALIZED:
+            # Initialized → barrier → Start (Client.java:103-121)
+            with self._lock:
+                self.states[dev_id] = LifecycleState.INITIALIZED
+                ready = all(
+                    self.states.get(d) in (LifecycleState.INITIALIZED,
+                                           LifecycleState.RUNNING)
+                    for d in self.expected)
+            if ready:
+                self._broadcast_start()
+            return []
+        if msg.type == MsgType.FINISH:
+            with self._lock:
+                self.states[dev_id] = LifecycleState.FINISHED
+                done = all(self.states.get(d) == LifecycleState.FINISHED
+                           for d in self.expected)
+            if done:
+                self.all_finished.set()
+            return [make(MsgType.CLOSE)]
+        return [make(MsgType.ERROR,
+                     reason=f"unexpected {msg.type.value}")]
+
+    def _artifact_chunk(self, dev_id: str, name: str,
+                        index: int) -> List[bytes]:
+        """Serve ONE chunk per request (pull-based, like the reference's
+        "Request Data" handshake, ``Communication.java:712-716``).  One chunk
+        in flight per device bounds memory and keeps the single ROUTER loop
+        responsive for other devices' lifecycle traffic."""
+        if self.artifact_provider is None:
+            return [make(MsgType.ERROR, reason="no artifacts served")]
+        key = (dev_id, name)
+        cached = self._artifact_cache.get(key)
+        if cached is None:
+            try:
+                blob = self.artifact_provider(dev_id, name)
+            except KeyError:
+                return [make(MsgType.ERROR,
+                             reason=f"unknown artifact {name!r}")]
+            cached = (blob, hashlib.sha256(blob).hexdigest())
+            self._artifact_cache[key] = cached
+        blob, digest = cached
+        total = max(1, -(-len(blob) // ARTIFACT_CHUNK_BYTES))
+        if not 0 <= index < total:
+            return [make(MsgType.ERROR,
+                         reason=f"chunk {index} out of range 0..{total-1}")]
+        chunk = blob[index * ARTIFACT_CHUNK_BYTES:
+                     (index + 1) * ARTIFACT_CHUNK_BYTES]
+        last = index == total - 1
+        if last:
+            self._artifact_cache.pop(key, None)
+        return [make(MsgType.ARTIFACT_CHUNK, name=name, index=index,
+                     total=total, data=chunk,
+                     sha256=digest if last else None)]
+
+    def _broadcast_start(self) -> None:
+        # Commit server-side state BEFORE any START hits the wire, so a
+        # client that reacts instantly to START observes a consistent server.
+        with self._lock:
+            for dev_id in self.expected:
+                self.states[dev_id] = LifecycleState.RUNNING
+        self.all_running.set()
+        for dev_id in self.expected:
+            self._sock.send_multipart([dev_id.encode(), make(MsgType.START)])
+
+    def wait_all_finished(self, timeout: Optional[float] = None) -> bool:
+        return self.all_finished.wait(timeout)
+
+
+class LifecycleClient:
+    """Device side of the FSM (mirror of ``Client.communicationOpenClose``,
+    ``Client.java:50-173``)."""
+
+    def __init__(self, server_address: str, device_id: str,
+                 timeout_ms: int = 10000,
+                 ctx: Optional[zmq.Context] = None):
+        self._ctx = ctx or zmq.Context.instance()
+        self.device_id = device_id
+        self._sock = self._ctx.socket(zmq.DEALER)
+        self._sock.setsockopt(zmq.IDENTITY, device_id.encode())
+        self._sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
+        self._sock.setsockopt(zmq.SNDTIMEO, timeout_ms)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.connect(f"tcp://{server_address}")
+        self.state = LifecycleState.READY
+        self.config: Optional[RunConfig] = None
+
+    def _recv(self) -> Envelope:
+        msg = decode(self._sock.recv())
+        if msg.type == MsgType.ERROR:
+            raise RuntimeError(f"lifecycle server error: {msg.get('reason')}")
+        return msg
+
+    def open(self) -> RunConfig:
+        """Ready → Open: announce readiness, receive the RunConfig."""
+        self._sock.send(make(MsgType.READY, device_id=self.device_id))
+        msg = self._recv()
+        if msg.type != MsgType.OPEN:
+            raise RuntimeError(f"expected OPEN, got {msg.type.value}")
+        self.config = RunConfig.from_payload(msg.get("config"))
+        self.state = LifecycleState.OPEN
+        return self.config
+
+    def fetch_artifact(self, name: str) -> bytes:
+        """Prepare: pull-based chunked download with sha256 verification
+        (replaces ``Client.receiveModelFile``, ``Client.java:174-223``)."""
+        parts: List[bytes] = []
+        digest: Optional[str] = None
+        index = 0
+        while True:
+            self._sock.send(make(MsgType.ARTIFACT_REQUEST, name=name,
+                                 index=index))
+            msg = self._recv()
+            if msg.type != MsgType.ARTIFACT_CHUNK:
+                raise RuntimeError(
+                    f"expected ARTIFACT_CHUNK, got {msg.type.value}")
+            parts.append(msg.get("data", b""))
+            if msg.get("index") == msg.get("total") - 1:
+                digest = msg.get("sha256")
+                break
+            index += 1
+        blob = b"".join(parts)
+        actual = hashlib.sha256(blob).hexdigest()
+        if digest is not None and actual != digest:
+            raise RuntimeError(
+                f"artifact {name!r} checksum mismatch: {actual} != {digest}")
+        self.state = LifecycleState.PREPARE
+        return blob
+
+    def initialized(self, wait_start: bool = True,
+                    timeout_ms: Optional[int] = None) -> None:
+        """Initialized → (barrier) → Start → Running
+        (``Client.java:103-121``)."""
+        self._sock.send(make(MsgType.INITIALIZED, device_id=self.device_id))
+        self.state = LifecycleState.INITIALIZED
+        if not wait_start:
+            return
+        if timeout_ms is not None:
+            self._sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
+        msg = self._recv()
+        if msg.type != MsgType.START:
+            raise RuntimeError(f"expected START, got {msg.type.value}")
+        self.state = LifecycleState.RUNNING
+
+    def finish(self) -> None:
+        """Finish → Close (``Client.java:156-171``)."""
+        self._sock.send(make(MsgType.FINISH, device_id=self.device_id))
+        msg = self._recv()
+        if msg.type != MsgType.CLOSE:
+            raise RuntimeError(f"expected CLOSE, got {msg.type.value}")
+        self.state = LifecycleState.CLOSED
+
+    def close(self) -> None:
+        self._sock.close(linger=0)

@@ -1,0 +1,94 @@
+"""Versioned msgpack message schema for the control plane.
+
+The reference ships config as a fixed sequence of raw ZMQ frames whose
+meaning is purely positional (``Client.java:69-82`` receives ipGraph,
+sessionIndex, taskType, threadPoolSize, batch, seqLen, dependencyMap,
+numDevice in exactly that order, no tags, no version) — SURVEY.md Appendix B
+defect #4.  Here every control message is one msgpack map with:
+
+- ``v``: protocol version int (bumped on breaking change; receivers reject
+  unknown majors instead of silently misparsing),
+- ``t``: message type tag (MsgType),
+- the payload fields by name.
+
+Registration / heartbeat / status mirror the reference's action strings
+``RegisterIP`` / ``HEARTBEAT`` / ``GET_STATUS`` (``server.py:323-465``,
+``client.py:84-176``).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import msgpack
+
+PROTOCOL_VERSION = 1
+
+
+class MsgType(str, enum.Enum):
+    # registration plane (reference server.py:310-473, client.py:84-176)
+    REGISTER = "register"              # RegisterIP
+    REGISTER_ACK = "register_ack"      # REGISTRATION_SUCCESSFUL / FAILED
+    HEARTBEAT = "heartbeat"
+    HEARTBEAT_ACK = "heartbeat_ack"
+    GET_STATUS = "get_status"
+    STATUS = "status"
+    # monitor plane (reference MonitorService.kt:149-225)
+    MONITOR_HELLO = "monitor_hello"    # MonitorIP handshake
+    MONITOR_GRAPH = "monitor_graph"    # ip graph reply
+    MONITOR_REPORT = "monitor_report"  # {latency, bandwidth, memory, flops}
+    MONITOR_STOP = "monitor_stop"
+    # lifecycle FSM (reference RootServer.java:2-17 states)
+    READY = "ready"
+    OPEN = "open"                      # carries the full RunConfig
+    PREPARE = "prepare"
+    ARTIFACT_REQUEST = "artifact_request"
+    ARTIFACT_CHUNK = "artifact_chunk"
+    INITIALIZED = "initialized"
+    START = "start"
+    RUNNING = "running"
+    FINISH = "finish"
+    CLOSE = "close"
+    # elasticity (reference Client.java:124-153 scaffold, completed here)
+    REPLAN = "replan"                  # new plan broadcast mid-run
+    REPLAN_ACK = "replan_ack"
+    PAUSE = "pause"
+    RESUME = "resume"
+    ERROR = "error"
+
+
+@dataclass
+class Envelope:
+    """One control-plane message: type tag + payload dict."""
+
+    type: MsgType
+    payload: Dict[str, Any] = field(default_factory=dict)
+    version: int = PROTOCOL_VERSION
+
+    def get(self, key: str, default=None):
+        return self.payload.get(key, default)
+
+
+def encode(msg: Envelope) -> bytes:
+    body = {"v": msg.version, "t": msg.type.value}
+    body.update(msg.payload)
+    return msgpack.packb(body, use_bin_type=True)
+
+
+def decode(raw: bytes) -> Envelope:
+    body = msgpack.unpackb(raw, raw=False)
+    if not isinstance(body, dict) or "t" not in body or "v" not in body:
+        raise ValueError("malformed control message: missing v/t tags")
+    v = body.pop("v")
+    if v != PROTOCOL_VERSION:
+        raise ValueError(
+            f"unsupported control protocol version {v} "
+            f"(this build speaks {PROTOCOL_VERSION})")
+    t = MsgType(body.pop("t"))
+    return Envelope(type=t, payload=body, version=v)
+
+
+def make(type_: MsgType, **payload) -> bytes:
+    return encode(Envelope(type_, payload))

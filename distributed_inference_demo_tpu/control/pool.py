@@ -1,0 +1,224 @@
+"""Thread-safe device pool with heartbeat failure detection.
+
+Re-implements the reference's ``DevicePoolManager`` (``server.py:38-301``)
+and heartbeat sweep (``server.py:45-107,303-307``) as a typed, testable
+component:
+
+- register/update with duplicate detection (``server.py:131-198``),
+- availability & allocation with header-first priority (``server.py:248-284``;
+  the header leads the ring, so it is always placed first),
+- heartbeat timestamps, a sweep that moves timed-out devices to a failed
+  pool with ``failure_time``/``failure_reason`` (``server.py:73-100``),
+- release of a task's devices back to the pool (``server.py:286-293``).
+
+Differences from the reference (deliberate):
+- The clock is injectable so timeout logic is unit-testable without sleeps
+  (the reference hardcodes ``time.time()``).
+- Failure events invoke registered callbacks so the elasticity layer can
+  trigger re-planning (the reference only removes the device and lets the
+  in-flight pipeline hang — SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+class DeviceRole(str, enum.Enum):
+    HEADER = "header"
+    WORKER = "worker"
+    TAIL = "tail"
+
+
+@dataclass
+class DeviceInfo:
+    """One registered device (reference device dict, ``server.py:155-198``)."""
+
+    device_id: str
+    address: str                       # host:port of the device's data plane
+    role: DeviceRole = DeviceRole.WORKER
+    model: Optional[str] = None        # header requests carry the model name
+    capabilities: Dict = field(default_factory=dict)  # memory/flops/platform
+    registered_at: float = 0.0
+    last_heartbeat: float = 0.0
+    status: str = "available"          # available | allocated | failed
+    task_id: Optional[str] = None
+    failure_time: Optional[float] = None
+    failure_reason: Optional[str] = None
+
+
+class DevicePoolManager:
+    """Registry + allocator + failure detector for the device fleet."""
+
+    def __init__(self, heartbeat_timeout: float = 30.0,
+                 clock: Callable[[], float] = time.time):
+        self._lock = threading.RLock()
+        self._clock = clock
+        self.heartbeat_timeout = heartbeat_timeout
+        self.devices: Dict[str, DeviceInfo] = {}
+        self.failed_devices: Dict[str, DeviceInfo] = {}
+        self._failure_callbacks: List[Callable[[DeviceInfo], None]] = []
+        self._sweeper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- registration (reference server.py:131-198) ------------------------
+
+    def register_device(self, info: DeviceInfo) -> bool:
+        """Register or refresh a device.  Returns False when another live
+        device already claims the same address (duplicate detection,
+        reference ``server.py:131-153``)."""
+        now = self._clock()
+        with self._lock:
+            for other in self.devices.values():
+                if (other.address == info.address
+                        and other.device_id != info.device_id):
+                    return False
+            # A re-registering previously-failed device rejoins cleanly.
+            self.failed_devices.pop(info.device_id, None)
+            existing = self.devices.get(info.device_id)
+            if existing is not None:
+                existing.address = info.address
+                existing.role = info.role
+                existing.model = info.model or existing.model
+                existing.capabilities.update(info.capabilities)
+                existing.last_heartbeat = now
+                if existing.status == "failed":
+                    existing.status = "available"
+            else:
+                info.registered_at = now
+                info.last_heartbeat = now
+                info.status = "available"
+                self.devices[info.device_id] = info
+            return True
+
+    def heartbeat(self, device_id: str) -> bool:
+        with self._lock:
+            dev = self.devices.get(device_id)
+            if dev is None:
+                return False
+            dev.last_heartbeat = self._clock()
+            return True
+
+    # -- availability & allocation (reference server.py:221-293) -----------
+
+    def get_available_devices(self) -> List[DeviceInfo]:
+        with self._lock:
+            return [d for d in self.devices.values()
+                    if d.status == "available"]
+
+    def allocate_devices_for_task(self, task_id: str, count: int
+                                  ) -> Optional[List[DeviceInfo]]:
+        """Allocate ``count`` devices, header first (reference
+        ``server.py:261-267``: the header device leads the ring), then
+        workers by registration order, tail last when one is present."""
+        with self._lock:
+            avail = self.get_available_devices()
+            if len(avail) < count:
+                return None
+            headers = [d for d in avail if d.role == DeviceRole.HEADER]
+            tails = [d for d in avail if d.role == DeviceRole.TAIL]
+            workers = [d for d in avail
+                       if d.role not in (DeviceRole.HEADER, DeviceRole.TAIL)]
+            ordered = (sorted(headers, key=lambda d: d.registered_at)
+                       + sorted(workers, key=lambda d: d.registered_at)
+                       + sorted(tails, key=lambda d: d.registered_at))
+            chosen = ordered[:count]
+            # keep the tail at the end of the ring if one was chosen
+            chosen.sort(key=lambda d: (d.role == DeviceRole.TAIL,
+                                       d.role != DeviceRole.HEADER))
+            for d in chosen:
+                d.status = "allocated"
+                d.task_id = task_id
+            return chosen
+
+    def release_task_devices(self, task_id: str) -> int:
+        with self._lock:
+            n = 0
+            for d in self.devices.values():
+                if d.task_id == task_id:
+                    d.status = "available"
+                    d.task_id = None
+                    n += 1
+            return n
+
+    # -- failure detection (reference server.py:45-107,303-307) ------------
+
+    def on_failure(self, cb: Callable[[DeviceInfo], None]) -> None:
+        self._failure_callbacks.append(cb)
+
+    def check_device_heartbeats(self) -> List[DeviceInfo]:
+        """One sweep: time out stale devices into the failed pool.  Returns
+        the newly failed devices (reference moves them with
+        ``failure_time``/``failure_reason``, ``server.py:73-100``)."""
+        now = self._clock()
+        newly_failed = []
+        with self._lock:
+            for dev_id in list(self.devices):
+                dev = self.devices[dev_id]
+                if now - dev.last_heartbeat > self.heartbeat_timeout:
+                    dev.status = "failed"
+                    dev.failure_time = now
+                    dev.failure_reason = (
+                        f"heartbeat timeout "
+                        f"({now - dev.last_heartbeat:.1f}s > "
+                        f"{self.heartbeat_timeout}s)")
+                    self.failed_devices[dev_id] = dev
+                    del self.devices[dev_id]
+                    newly_failed.append(dev)
+        for dev in newly_failed:       # callbacks outside the lock
+            for cb in self._failure_callbacks:
+                cb(dev)
+        return newly_failed
+
+    def get_failed_devices(self) -> List[DeviceInfo]:
+        with self._lock:
+            return list(self.failed_devices.values())
+
+    def start_sweeper(self, interval: float = 10.0) -> None:
+        """Background sweep thread (reference 10 s sweep,
+        ``server.py:46-47,303-307``)."""
+        if self._sweeper is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(interval):
+                self.check_device_heartbeats()
+
+        self._sweeper = threading.Thread(target=loop, daemon=True,
+                                         name="heartbeat-sweeper")
+        self._sweeper.start()
+
+    def stop_sweeper(self) -> None:
+        self._stop.set()
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=2.0)
+            self._sweeper = None
+
+    # -- status (reference GET_STATUS reply, server.py:393-465) ------------
+
+    def status_snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "devices": {
+                    d.device_id: {
+                        "address": d.address,
+                        "role": d.role.value,
+                        "model": d.model,
+                        "status": d.status,
+                        "task_id": d.task_id,
+                        "last_heartbeat": d.last_heartbeat,
+                    } for d in self.devices.values()
+                },
+                "failed": {
+                    d.device_id: {
+                        "failure_time": d.failure_time,
+                        "failure_reason": d.failure_reason,
+                    } for d in self.failed_devices.values()
+                },
+                "available": len(self.get_available_devices()),
+                "total": len(self.devices),
+            }

@@ -1,0 +1,203 @@
+"""Registration service: the dynamic-registration ROUTER endpoint.
+
+TPU-native replacement for the reference's ``device_registration_thread``
+(``server.py:310-473``, ZMQ ROUTER on :23457 handling ``RegisterIP`` /
+``HEARTBEAT`` / ``GET_STATUS`` action strings) and its client counterpart
+(``client.py:84-176``).  Differences:
+
+- messages are schema'd msgpack envelopes (control/messages.py), not
+  positional frames;
+- binds an ephemeral port by default so tests and multi-server hosts never
+  collide (the reference hardcodes ports — SURVEY.md §5.6);
+- clean shutdown via a poller instead of blocking recv (reference defect #7).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+import zmq
+
+from .messages import Envelope, MsgType, decode, make
+from .pool import DeviceInfo, DevicePoolManager, DeviceRole
+
+log = logging.getLogger(__name__)
+
+
+class RegistrationService:
+    """ROUTER service feeding a DevicePoolManager."""
+
+    def __init__(self, pool: DevicePoolManager,
+                 bind_host: str = "127.0.0.1", port: int = 0,
+                 ctx: Optional[zmq.Context] = None):
+        self.pool = pool
+        self._ctx = ctx or zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        if port == 0:
+            self.port = self._sock.bind_to_random_port(f"tcp://{bind_host}")
+        else:
+            self._sock.bind(f"tcp://{bind_host}:{port}")
+            self.port = port
+        self.address = f"{bind_host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- server loop -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f"registration-{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3.0)
+            self._thread = None
+        self._sock.close(linger=0)
+
+    def _serve(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._sock, zmq.POLLIN)
+        while not self._stop.is_set():
+            if not dict(poller.poll(timeout=100)):
+                continue
+            frames = self._sock.recv_multipart()
+            if len(frames) < 2:
+                continue
+            identity, raw = frames[0], frames[-1]
+            try:
+                msg = decode(raw)
+                reply = self._handle(identity, msg)
+            except Exception as e:       # malformed message: reply error
+                log.warning("registration: bad message: %s", e)
+                reply = make(MsgType.ERROR, reason=str(e))
+            if reply is not None:
+                self._sock.send_multipart([identity, reply])
+
+    def _handle(self, identity: bytes, msg: Envelope) -> Optional[bytes]:
+        if msg.type == MsgType.REGISTER:
+            # reference RegisterIP action, server.py:323-383
+            info = DeviceInfo(
+                device_id=msg.get("device_id") or identity.decode(),
+                address=msg.get("address", ""),
+                role=DeviceRole(msg.get("role", "worker")),
+                model=msg.get("model"),
+                capabilities=msg.get("capabilities", {}) or {},
+            )
+            ok = self.pool.register_device(info)
+            return make(MsgType.REGISTER_ACK, ok=ok,
+                        reason=None if ok else "duplicate address")
+        if msg.type == MsgType.HEARTBEAT:
+            ok = self.pool.heartbeat(msg.get("device_id", identity.decode()))
+            return make(MsgType.HEARTBEAT_ACK, ok=ok)
+        if msg.type == MsgType.GET_STATUS:
+            return make(MsgType.STATUS, **self.pool.status_snapshot())
+        return make(MsgType.ERROR, reason=f"unexpected {msg.type.value}")
+
+
+class RegistrationClient:
+    """Device-side client: register + heartbeat + status query.
+
+    Mirrors ``client.py:51-176`` (DEALER with device_id identity, 5 s recv
+    timeout, heartbeat thread with 3-strike reconnect)."""
+
+    def __init__(self, server_address: str, device_id: str, address: str,
+                 role: DeviceRole = DeviceRole.WORKER,
+                 model: Optional[str] = None,
+                 capabilities: Optional[dict] = None,
+                 timeout_ms: int = 5000,
+                 ctx: Optional[zmq.Context] = None):
+        self._ctx = ctx or zmq.Context.instance()
+        self.server_address = server_address
+        self.device_id = device_id
+        self.address = address
+        self.role = role
+        self.model = model
+        self.capabilities = capabilities or {}
+        self.timeout_ms = timeout_ms
+        self._sock = self._connect()
+        # One DEALER socket shared by the caller and the heartbeat thread:
+        # ZMQ sockets are not thread-safe, so every request/reply pair holds
+        # this lock for its full duration.
+        self._sock_lock = threading.Lock()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+
+    def _connect(self) -> zmq.Socket:
+        sock = self._ctx.socket(zmq.DEALER)
+        sock.setsockopt(zmq.IDENTITY, self.device_id.encode())
+        sock.setsockopt(zmq.RCVTIMEO, self.timeout_ms)
+        sock.setsockopt(zmq.SNDTIMEO, self.timeout_ms)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(f"tcp://{self.server_address}")
+        return sock
+
+    def _rpc(self, raw: bytes) -> Envelope:
+        with self._sock_lock:
+            try:
+                self._sock.send(raw)
+                return decode(self._sock.recv())
+            except zmq.ZMQError:
+                # A timed-out recv leaves the late reply queued, which would
+                # desync every later request/reply pair — drop the socket so
+                # the stale reply dies with it.
+                self._sock.close(linger=0)
+                self._sock = self._connect()
+                raise
+
+    def register(self) -> bool:
+        reply = self._rpc(make(
+            MsgType.REGISTER, device_id=self.device_id, address=self.address,
+            role=self.role.value, model=self.model,
+            capabilities=self.capabilities))
+        return bool(reply.get("ok"))
+
+    def heartbeat_once(self) -> bool:
+        try:
+            reply = self._rpc(make(MsgType.HEARTBEAT,
+                                   device_id=self.device_id))
+            return bool(reply.get("ok"))
+        except zmq.ZMQError:
+            return False
+
+    def get_status(self) -> dict:
+        return self._rpc(make(MsgType.GET_STATUS)).payload
+
+    def start_heartbeats(self, interval: float = 5.0,
+                         max_strikes: int = 3) -> None:
+        """Heartbeat loop with reconnect after ``max_strikes`` consecutive
+        failures (reference ``client.py:51-82``)."""
+        if self._hb_thread is not None:
+            return
+
+        def loop():
+            strikes = 0
+            while not self._hb_stop.wait(interval):
+                if self.heartbeat_once():
+                    strikes = 0
+                    continue
+                strikes += 1
+                if strikes >= max_strikes:
+                    log.warning("heartbeat: %d strikes, re-registering",
+                                strikes)
+                    try:
+                        self.register()   # _rpc already rebuilt the socket
+                    except zmq.ZMQError:
+                        continue          # server still down; keep striking
+                    strikes = 0
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True,
+                                           name=f"hb-{self.device_id}")
+        self._hb_thread.start()
+
+    def close(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+        self._sock.close(linger=0)

@@ -69,6 +69,39 @@ def test_big_endian_input_normalized():
     np.testing.assert_array_equal(msg.tensors[0], a)
 
 
+@pytest.mark.skipif(not native_codec.available(), reason="native codec absent")
+def test_big_endian_input_normalized_native():
+    # The native serializer must byteswap too, not just pass raw bytes with
+    # a little-endian dtype tag.
+    a = np.arange(4, dtype=">i4")
+    for blob in (native_codec.serialize_tensors([a]),):
+        for decoded in (wire.deserialize_tensors(blob),
+                        native_codec.deserialize_tensors(blob)):
+            np.testing.assert_array_equal(decoded.tensors[0], [0, 1, 2, 3])
+
+
+@pytest.mark.skipif(not native_codec.available(), reason="native codec absent")
+def test_dim_product_overflow_rejected_both_impls():
+    # Crafted message: one F32 tensor claiming dims=[2^62] with nbytes=0.
+    # count * itemsize wraps to 0 in u64; both decoders must reject it.
+    import struct
+    blob = (struct.pack("<4sBBHI", wire.MAGIC, wire.VERSION, 0, 0, 1)
+            + struct.pack("<BBHQ", int(wire.DType.F32), 1, 0, 0)
+            + struct.pack("<Q", 1 << 62))
+    with pytest.raises(wire.WireError):
+        wire.deserialize_tensors(blob)
+    with pytest.raises(wire.WireError):
+        native_codec.deserialize_tensors(blob)
+
+
+@pytest.mark.skipif(not native_codec.available(), reason="native codec absent")
+def test_native_decode_returns_writable_arrays():
+    blob = wire.serialize_tensors([np.arange(6, dtype=np.float32)])
+    arr = native_codec.deserialize_tensors(blob).tensors[0]
+    arr[0] = 42.0  # must not raise (decoded arrays own writable memory)
+    assert arr[0] == 42.0
+
+
 @pytest.mark.parametrize("mutate", [
     lambda b: b[:3],                        # shorter than header
     lambda b: b"XXXX" + b[4:],              # bad magic
