@@ -138,7 +138,9 @@ void* dwt_open(const uint8_t* buf, uint64_t len) {
     uint64_t item = (uint64_t)kItemSize[tv.dtype];
     if (overflow || count > UINT64_MAX / item) { delete msg; return nullptr; }
     off += 8ull * tv.ndims;
-    if (count * item != tv.nbytes || off + tv.nbytes > len) {
+    // off <= len is guaranteed above; compare against the remainder so a
+    // huge nbytes cannot wrap off + nbytes back into range.
+    if (count * item != tv.nbytes || tv.nbytes > len - off) {
       delete msg; return nullptr;
     }
     tv.data = base + off;

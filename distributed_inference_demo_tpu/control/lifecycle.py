@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional
 import zmq
 
 from .messages import Envelope, MsgType, decode, make
+from .router import RouterService
 
 log = logging.getLogger(__name__)
 
@@ -112,28 +113,20 @@ class RunConfig:
 ArtifactProvider = Callable[[str, str], bytes]
 
 
-class LifecycleServer:
+class LifecycleServer(RouterService):
     """Server side of the FSM: drives every device through the state chain
     and releases them together at START."""
+
+    name = "lifecycle"
 
     def __init__(self, config: RunConfig,
                  artifact_provider: Optional[ArtifactProvider] = None,
                  bind_host: str = "127.0.0.1", port: int = 0,
                  ctx: Optional[zmq.Context] = None):
+        super().__init__(bind_host=bind_host, port=port, ctx=ctx)
         self.config = config
         self.artifact_provider = artifact_provider
-        self._ctx = ctx or zmq.Context.instance()
-        self._sock = self._ctx.socket(zmq.ROUTER)
-        self._sock.setsockopt(zmq.LINGER, 0)
-        if port == 0:
-            self.port = self._sock.bind_to_random_port(f"tcp://{bind_host}")
-        else:
-            self._sock.bind(f"tcp://{bind_host}:{port}")
-            self.port = port
-        self.address = f"{bind_host}:{self.port}"
         self.states: Dict[str, LifecycleState] = {}
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self.expected = set(config.device_ids)
         self.all_finished = threading.Event()
@@ -142,41 +135,9 @@ class LifecycleServer:
         # chunked download is in progress, dropped after the last chunk.
         self._artifact_cache: Dict = {}
 
-    def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(target=self._serve, daemon=True,
-                                        name=f"lifecycle-{self.port}")
-        self._thread.start()
+    # -- message handling --------------------------------------------------
 
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=3.0)
-            self._thread = None
-        self._sock.close(linger=0)
-
-    # -- internals ---------------------------------------------------------
-
-    def _serve(self) -> None:
-        poller = zmq.Poller()
-        poller.register(self._sock, zmq.POLLIN)
-        while not self._stop.is_set():
-            if not dict(poller.poll(timeout=100)):
-                continue
-            frames = self._sock.recv_multipart()
-            identity, raw = frames[0], frames[-1]
-            dev_id = identity.decode()
-            try:
-                msg = decode(raw)
-            except Exception as e:
-                self._sock.send_multipart(
-                    [identity, make(MsgType.ERROR, reason=str(e))])
-                continue
-            for reply in self._handle(dev_id, msg):
-                self._sock.send_multipart([identity, reply])
-
-    def _handle(self, dev_id: str, msg: Envelope) -> List[bytes]:
+    def handle(self, dev_id: str, msg: Envelope) -> List[bytes]:
         if msg.type == MsgType.READY:
             # Ready → Open: send the full config (Client.java:57-84)
             self.states[dev_id] = LifecycleState.OPEN
@@ -185,12 +146,17 @@ class LifecycleServer:
             return self._artifact_chunk(dev_id, msg.get("name", ""),
                                         msg.get("index", 0))
         if msg.type == MsgType.INITIALIZED:
-            # Initialized → barrier → Start (Client.java:103-121)
+            # Initialized → barrier → Start (Client.java:103-121).  A device
+            # re-initializing after the run started (mid-run rejoin) gets
+            # its own START immediately; the barrier fires exactly once.
+            if self.all_running.is_set():
+                with self._lock:
+                    self.states[dev_id] = LifecycleState.RUNNING
+                return [make(MsgType.START)]
             with self._lock:
                 self.states[dev_id] = LifecycleState.INITIALIZED
                 ready = all(
-                    self.states.get(d) in (LifecycleState.INITIALIZED,
-                                           LifecycleState.RUNNING)
+                    self.states.get(d) == LifecycleState.INITIALIZED
                     for d in self.expected)
             if ready:
                 self._broadcast_start()
@@ -245,8 +211,8 @@ class LifecycleServer:
             for dev_id in self.expected:
                 self.states[dev_id] = LifecycleState.RUNNING
         self.all_running.set()
-        for dev_id in self.expected:
-            self._sock.send_multipart([dev_id.encode(), make(MsgType.START)])
+        for dev_id in self.expected:   # serve-thread only (see send_to)
+            self.send_to(dev_id, make(MsgType.START))
 
     def wait_all_finished(self, timeout: Optional[float] = None) -> bool:
         return self.all_finished.wait(timeout)

@@ -1,0 +1,94 @@
+"""Shared ROUTER service base: bind, poll loop, thread lifecycle.
+
+All control-plane endpoints (registration, lifecycle FSM, monitor) are
+ZMQ ROUTER services with the same skeleton — bind (ephemeral port by
+default), poll with timeout so shutdown is clean (no blocking ``recv(0)``,
+reference defect #7), decode the envelope, dispatch, reply per identity.
+Subclasses implement ``handle(dev_id, msg) -> list of reply frames``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+import zmq
+
+from .messages import Envelope, MsgType, decode, make
+
+log = logging.getLogger(__name__)
+
+
+class RouterService:
+    """Threaded ROUTER endpoint with schema'd envelope dispatch."""
+
+    name = "router"
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
+                 ctx: Optional[zmq.Context] = None):
+        self._ctx = ctx or zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        if port == 0:
+            self.port = self._sock.bind_to_random_port(f"tcp://{bind_host}")
+        else:
+            self._sock.bind(f"tcp://{bind_host}:{port}")
+            self.port = port
+        self.address = f"{bind_host}:{self.port}"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- subclass API ------------------------------------------------------
+
+    def handle(self, dev_id: str, msg: Envelope) -> List[bytes]:
+        """Process one message; return reply frames for this identity."""
+        raise NotImplementedError
+
+    def send_to(self, dev_id: str, raw: bytes) -> None:
+        """Push a message to a connected identity (server-initiated sends,
+        e.g. START broadcast).  Must only be called from the serve thread
+        or while it is not running — ZMQ sockets are not thread-safe."""
+        self._sock.send_multipart([dev_id.encode(), raw])
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True,
+            name=f"{self.name}-{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3.0)
+            self._thread = None
+        self._sock.close(linger=0)
+
+    def _serve(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._sock, zmq.POLLIN)
+        while not self._stop.is_set():
+            if not dict(poller.poll(timeout=100)):
+                continue
+            frames = self._sock.recv_multipart()
+            if len(frames) < 2:
+                continue
+            identity, raw = frames[0], frames[-1]
+            try:
+                msg = decode(raw)
+            except Exception as e:
+                log.warning("%s: bad message: %s", self.name, e)
+                self._sock.send_multipart(
+                    [identity, make(MsgType.ERROR, reason=str(e))])
+                continue
+            try:
+                replies = self.handle(identity.decode(), msg)
+            except Exception as e:  # handler bug: report, keep serving
+                log.exception("%s: handler error", self.name)
+                replies = [make(MsgType.ERROR, reason=str(e))]
+            for reply in replies:
+                self._sock.send_multipart([identity, reply])

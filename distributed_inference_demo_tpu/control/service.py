@@ -16,88 +16,47 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, Optional
+from typing import List, Optional
 
 import zmq
 
 from .messages import Envelope, MsgType, decode, make
 from .pool import DeviceInfo, DevicePoolManager, DeviceRole
+from .router import RouterService
 
 log = logging.getLogger(__name__)
 
 
-class RegistrationService:
+class RegistrationService(RouterService):
     """ROUTER service feeding a DevicePoolManager."""
+
+    name = "registration"
 
     def __init__(self, pool: DevicePoolManager,
                  bind_host: str = "127.0.0.1", port: int = 0,
                  ctx: Optional[zmq.Context] = None):
+        super().__init__(bind_host=bind_host, port=port, ctx=ctx)
         self.pool = pool
-        self._ctx = ctx or zmq.Context.instance()
-        self._sock = self._ctx.socket(zmq.ROUTER)
-        if port == 0:
-            self.port = self._sock.bind_to_random_port(f"tcp://{bind_host}")
-        else:
-            self._sock.bind(f"tcp://{bind_host}:{port}")
-            self.port = port
-        self.address = f"{bind_host}:{self.port}"
-        self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
 
-    # -- server loop -------------------------------------------------------
-
-    def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(target=self._serve, daemon=True,
-                                        name=f"registration-{self.port}")
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=3.0)
-            self._thread = None
-        self._sock.close(linger=0)
-
-    def _serve(self) -> None:
-        poller = zmq.Poller()
-        poller.register(self._sock, zmq.POLLIN)
-        while not self._stop.is_set():
-            if not dict(poller.poll(timeout=100)):
-                continue
-            frames = self._sock.recv_multipart()
-            if len(frames) < 2:
-                continue
-            identity, raw = frames[0], frames[-1]
-            try:
-                msg = decode(raw)
-                reply = self._handle(identity, msg)
-            except Exception as e:       # malformed message: reply error
-                log.warning("registration: bad message: %s", e)
-                reply = make(MsgType.ERROR, reason=str(e))
-            if reply is not None:
-                self._sock.send_multipart([identity, reply])
-
-    def _handle(self, identity: bytes, msg: Envelope) -> Optional[bytes]:
+    def handle(self, dev_id: str, msg: Envelope) -> List[bytes]:
         if msg.type == MsgType.REGISTER:
             # reference RegisterIP action, server.py:323-383
             info = DeviceInfo(
-                device_id=msg.get("device_id") or identity.decode(),
+                device_id=msg.get("device_id") or dev_id,
                 address=msg.get("address", ""),
                 role=DeviceRole(msg.get("role", "worker")),
                 model=msg.get("model"),
                 capabilities=msg.get("capabilities", {}) or {},
             )
             ok = self.pool.register_device(info)
-            return make(MsgType.REGISTER_ACK, ok=ok,
-                        reason=None if ok else "duplicate address")
+            return [make(MsgType.REGISTER_ACK, ok=ok,
+                         reason=None if ok else "duplicate address")]
         if msg.type == MsgType.HEARTBEAT:
-            ok = self.pool.heartbeat(msg.get("device_id", identity.decode()))
-            return make(MsgType.HEARTBEAT_ACK, ok=ok)
+            ok = self.pool.heartbeat(msg.get("device_id", dev_id))
+            return [make(MsgType.HEARTBEAT_ACK, ok=ok)]
         if msg.type == MsgType.GET_STATUS:
-            return make(MsgType.STATUS, **self.pool.status_snapshot())
-        return make(MsgType.ERROR, reason=f"unexpected {msg.type.value}")
+            return [make(MsgType.STATUS, **self.pool.status_snapshot())]
+        return [make(MsgType.ERROR, reason=f"unexpected {msg.type.value}")]
 
 
 class RegistrationClient:

@@ -1,0 +1,21 @@
+"""Monitor/profiler subsystem: device probes feeding the partition planner.
+
+Re-design of the reference's profiling round (``service/MonitorService.kt``
+device agent + the missing server-side ``SecureConnection.monitor.Monitor``
+aggregator, SURVEY.md §3.4/§2.2): each device measures peer latency, p2p
+bandwidth, memory, and compute throughput, and uploads a structured report;
+the server aggregates reports into the planner's DeviceProfile inputs
+(the ``(ping_latency, bandwidths, TotalMem, AvailMem, flop_speed)`` tuple,
+``server.py:858``).
+"""
+
+from .probes import (flops_probe, memory_info, tcp_latency_probe,
+                     BandwidthServer, bandwidth_probe)
+from .agent import MonitorAgent
+from .aggregator import MonitorAggregator, MonitorService
+
+__all__ = [
+    "flops_probe", "memory_info", "tcp_latency_probe",
+    "BandwidthServer", "bandwidth_probe",
+    "MonitorAgent", "MonitorAggregator", "MonitorService",
+]

@@ -1,0 +1,125 @@
+"""Device-side monitor agent: the measurement loop.
+
+Mirror of the reference's ``MonitorService.kt`` thread (``:149-225``):
+DEALER handshake with the server, receive the peer graph, measure
+latency / bandwidth / memory / flops each round, upload a structured
+report, loop until the server says stop.  Differences: probes are the
+TPU-host versions (probes.py), the report schema is typed msgpack, and the
+loop polls with timeouts instead of busy-waiting
+(``MonitorService.kt:208-211``, defect #5).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+import zmq
+
+from ..control.messages import MsgType, decode, make
+from .probes import (BandwidthServer, bandwidth_probe, flops_probe,
+                     memory_info, tcp_latency_probe)
+
+log = logging.getLogger(__name__)
+
+
+class MonitorAgent:
+    """Runs the measurement loop against a MonitorService."""
+
+    def __init__(self, server_address: str, device_id: str,
+                 host: str = "127.0.0.1",
+                 platform: str = "cpu", chips: int = 1,
+                 measure_flops: bool = True,
+                 bandwidth_duration: float = 0.1,
+                 timeout_ms: int = 5000,
+                 ctx: Optional[zmq.Context] = None):
+        self._ctx = ctx or zmq.Context.instance()
+        self.device_id = device_id
+        self.host = host
+        self.platform = platform
+        self.chips = chips
+        self.measure_flops = measure_flops
+        self.bandwidth_duration = bandwidth_duration
+        self._sock = self._ctx.socket(zmq.DEALER)
+        self._sock.setsockopt(zmq.IDENTITY, device_id.encode())
+        self._sock.setsockopt(zmq.RCVTIMEO, timeout_ms)
+        self._sock.setsockopt(zmq.SNDTIMEO, timeout_ms)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.connect(f"tcp://{server_address}")
+        self.bw_server = BandwidthServer(bind_host=host)
+        self._flops_cache: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- measurements ------------------------------------------------------
+
+    def measure_round(self, peers: Dict[str, dict]) -> dict:
+        """One measurement round against the given peer graph."""
+        latency, bandwidth = {}, {}
+        for peer_id, info in peers.items():
+            host, port = info.get("host"), info.get("bw_port")
+            if not host or not port:
+                continue
+            lat = tcp_latency_probe(host, port)
+            if lat is not None:
+                latency[peer_id] = lat
+            bw = bandwidth_probe(host, port,
+                                 duration=self.bandwidth_duration)
+            if bw is not None:
+                bandwidth[peer_id] = bw
+        if self.measure_flops and self._flops_cache is None:
+            # measured once; hardware speed doesn't change between rounds
+            self._flops_cache = flops_probe()
+        return {
+            "latency": latency,
+            "bandwidth": bandwidth,
+            "memory": memory_info(),
+            "flops": self._flops_cache,
+            "platform": self.platform,
+            "chips": self.chips,
+        }
+
+    # -- protocol loop -----------------------------------------------------
+
+    def run(self, max_rounds: int = 100) -> int:
+        """Hello → (measure → report)* → stop.  Returns rounds completed."""
+        self.bw_server.start()
+        try:
+            self._sock.send(make(MsgType.MONITOR_HELLO,
+                                 device_id=self.device_id, host=self.host,
+                                 bw_port=self.bw_server.port))
+            msg = decode(self._sock.recv())
+            if msg.type != MsgType.MONITOR_GRAPH:
+                raise RuntimeError(
+                    f"expected MONITOR_GRAPH, got {msg.type.value}")
+            peers = msg.get("peers", {})
+            rounds = 0
+            while rounds < max_rounds and not self._stop.is_set():
+                report = self.measure_round(peers)
+                self._sock.send(make(MsgType.MONITOR_REPORT,
+                                     device_id=self.device_id,
+                                     report=report))
+                msg = decode(self._sock.recv())
+                rounds += 1
+                if msg.type == MsgType.MONITOR_STOP:
+                    break
+                if msg.type == MsgType.MONITOR_GRAPH:
+                    peers = msg.get("peers", peers)
+            return rounds
+        finally:
+            self.bw_server.stop()
+
+    def run_async(self, max_rounds: int = 100) -> threading.Thread:
+        self._thread = threading.Thread(
+            target=self.run, kwargs={"max_rounds": max_rounds}, daemon=True,
+            name=f"monitor-agent-{self.device_id}")
+        self._thread.start()
+        return self._thread
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._sock.close(linger=0)

@@ -1,0 +1,150 @@
+"""Device measurement probes: memory, compute throughput, latency, bandwidth.
+
+TPU-native re-implementation of the reference's device-side measurements:
+
+- memory: ``MonitorService.kt:333-342`` reads ActivityManager; here
+  /proc/meminfo (host) + jax device memory stats (accelerator).
+- flops: ``inference.cpp:329-354`` times an ONNX probe module with 2 warmups
+  + 1 timed run; here a timed bf16 matmul on the local jax backend — the
+  shape that actually exercises the MXU.
+- latency: ``MonitorService.kt:280-331`` shells out to ``ping``; here a TCP
+  connect round-trip (no ICMP privileges needed, measures the same path the
+  data plane uses).
+- bandwidth: ``MonitorService.kt:398-507`` floods a peer's TCP :55555 for
+  0.5 s while the peer counts bytes/ms; here the same flood protocol on an
+  ephemeral port with an explicit handshake.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+
+def memory_info() -> Dict[str, int]:
+    """Total/available host memory in bytes (reference TotalMem/AvailMem)."""
+    total = avail = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+    except OSError:  # non-Linux fallback
+        pass
+    return {"total": total, "available": avail}
+
+
+def flops_probe(size: int = 2048, warmups: int = 2,
+                dtype: str = "bfloat16") -> float:
+    """Measured FLOPs/sec of a ``size x size`` matmul on the default jax
+    backend (2 warmups + 1 timed run, mirroring ``inference.cpp:329-354``)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((size, size), jnp.dtype(dtype))
+    f = jax.jit(lambda a: a @ a)
+    for _ in range(warmups):
+        f(x).block_until_ready()
+    t0 = time.perf_counter()
+    f(x).block_until_ready()
+    dt = time.perf_counter() - t0
+    return (2.0 * size ** 3) / max(dt, 1e-9)
+
+
+def tcp_latency_probe(host: str, port: int, attempts: int = 3,
+                      timeout: float = 2.0) -> Optional[float]:
+    """Average TCP connect RTT in seconds over ``attempts`` tries (the
+    reference averages 3 pings, ``MonitorService.kt:291-331``).  None when
+    the peer is unreachable."""
+    samples = []
+    for _ in range(attempts):
+        t0 = time.perf_counter()
+        try:
+            with socket.create_connection((host, port), timeout=timeout):
+                samples.append(time.perf_counter() - t0)
+        except OSError:
+            continue
+    return sum(samples) / len(samples) if samples else None
+
+
+class BandwidthServer:
+    """Receiver side of the bandwidth probe: accepts a flood, counts bytes,
+    reports bytes/sec back on the same connection
+    (``MonitorService.kt:441-507`` with the measurement returned in-band
+    instead of out-of-band)."""
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((bind_host, port))
+        self._srv.listen(4)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._srv.settimeout(0.2)
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._srv.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(target=self._serve_one, args=(conn,),
+                                 daemon=True).start()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"bw-server-{self.port}")
+        self._thread.start()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        # End-of-flood is the client's TCP half-close (shutdown(SHUT_WR)) —
+        # an in-band sentinel could be split across recv() boundaries.
+        with conn:
+            conn.settimeout(5.0)
+            total = 0
+            t0 = None
+            try:
+                while True:
+                    chunk = conn.recv(1 << 16)
+                    if t0 is None:
+                        t0 = time.perf_counter()
+                    if not chunk:        # EOF: client half-closed
+                        break
+                    total += len(chunk)
+                dt = max(time.perf_counter() - (t0 or 0.0), 1e-9)
+                conn.sendall(f"{total / dt:.1f}".encode())
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self._srv.close()
+
+
+def bandwidth_probe(host: str, port: int, duration: float = 0.5,
+                    timeout: float = 5.0) -> Optional[float]:
+    """Flood ``host:port`` for ``duration`` seconds; return measured
+    bytes/sec as counted by the receiver (``MonitorService.kt:398-439``)."""
+    payload = b"\xab" * (1 << 16)
+    try:
+        with socket.create_connection((host, port), timeout=timeout) as s:
+            s.settimeout(timeout)
+            deadline = time.perf_counter() + duration
+            while time.perf_counter() < deadline:
+                s.sendall(payload)
+            s.shutdown(socket.SHUT_WR)   # signal end-of-flood via half-close
+            reply = s.recv(64)
+            return float(reply.decode())
+    except (OSError, ValueError):
+        return None

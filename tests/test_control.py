@@ -246,15 +246,31 @@ def test_lifecycle_start_barrier_waits_for_all():
         server.stop()
 
 
-def test_lifecycle_artifact_checksum_and_unknown():
+def test_lifecycle_artifact_ok_unknown_and_rejoin():
     cfg = run_config(1)
     cfg.device_ids = ["d0"]
-    server = LifecycleServer(cfg, artifact_provider=lambda d, n: b"payload")
+
+    def provider(dev, name):
+        if name != "weights":
+            raise KeyError(name)
+        return b"payload"
+
+    server = LifecycleServer(cfg, artifact_provider=provider)
     server.start()
     try:
         cli = LifecycleClient(server.address, "d0", timeout_ms=2000)
         cli.open()
-        assert cli.fetch_artifact("anything") == b"payload"
+        assert cli.fetch_artifact("weights") == b"payload"
+        # unknown artifact -> typed error surfaced as RuntimeError
+        with pytest.raises(RuntimeError, match="unknown artifact"):
+            cli.fetch_artifact("nonexistent")
+        cli.initialized(wait_start=True)
+        # a device re-initializing after the run started (rejoin) gets its
+        # own START; no duplicate broadcast poisons other devices' queues
+        cli._sock.send(make(MsgType.INITIALIZED, device_id="d0"))
+        msg = decode(cli._sock.recv())
+        assert msg.type == MsgType.START
+        cli.finish()   # next recv must be CLOSE, not a stale START
         cli.close()
     finally:
         server.stop()

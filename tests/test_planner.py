@@ -1,0 +1,146 @@
+"""Planner tests: cost model sanity, optimizer behavior vs round-robin,
+memory constraints, plan caching."""
+
+import pytest
+
+from distributed_inference_demo_tpu.models import get_model_config
+from distributed_inference_demo_tpu.planner import (
+    DeviceProfile, PlanError, load_cached_plan, model_cost_profile,
+    plan_partition, round_robin_plan, save_plan_cache)
+
+
+def dev(i, flops=1e12, mem=16 << 30, platform="cpu", chips=1):
+    return DeviceProfile(device_id=f"d{i}", address=f"10.0.0.{i}:9000",
+                         flops_per_sec=flops, memory_bytes=mem,
+                         platform=platform, chips=chips,
+                         egress_bandwidth=1e9, egress_latency=1e-3)
+
+
+# ---------------------------------------------------------------- cost model
+
+def test_cost_profile_scales_with_architecture():
+    small = model_cost_profile(get_model_config("llama-test"))
+    big = model_cost_profile(get_model_config("llama-3-8b"))
+    assert big.layers[0].flops > small.layers[0].flops * 100
+    assert big.total_param_bytes > small.total_param_bytes * 100
+    # 8B params at bf16 ≈ 16 GB within 25%
+    assert 12e9 < big.total_param_bytes < 20e9
+
+
+def test_cost_profile_int8_halves_weight_bytes():
+    cfg = get_model_config("llama-3-8b")
+    bf16 = model_cost_profile(cfg)
+    int8 = model_cost_profile(cfg.replace(quantization="int8"))
+    ratio = int8.total_param_bytes / bf16.total_param_bytes
+    assert 0.45 < ratio < 0.55
+
+
+def test_cost_profile_moe_flops_sparse():
+    """Mixtral: params count all experts, flops only experts_per_token."""
+    cfg = get_model_config("mixtral-test")
+    prof = model_cost_profile(cfg)
+    dense_equiv = model_cost_profile(cfg.replace(num_experts=0))
+    # 4 experts' params well above dense (layer cost includes attention);
+    # flops ~2x dense mlp (2 of 4 experts routed)
+    assert prof.layers[0].param_bytes > 2.5 * dense_equiv.layers[0].param_bytes
+    assert prof.layers[0].flops < 3 * dense_equiv.layers[0].flops
+
+
+# ------------------------------------------------------------------ planner
+
+def test_round_robin_even_split():
+    cfg = get_model_config("tinyllama-1.1b")  # 22 layers
+    plan = round_robin_plan(cfg, "tinyllama-1.1b", [dev(0), dev(1)])
+    assert plan.stage_ranges == {"d0": [0, 11], "d1": [11, 22]}
+    assert plan.device_graph == ["10.0.0.0:9000", "10.0.0.1:9000"]
+
+
+def test_plan_homogeneous_nearly_even():
+    cfg = get_model_config("tinyllama-1.1b")
+    plan = plan_partition(cfg, "tinyllama-1.1b", [dev(0), dev(1)])
+    sizes = [s.layer_end - s.layer_start for s in plan.stages]
+    assert sum(sizes) == cfg.num_layers
+    # head stage carries the LM-head flops, so it may get fewer layers,
+    # but the split must not be degenerate
+    assert min(sizes) >= cfg.num_layers // 4
+
+
+def test_plan_fast_device_gets_more_layers():
+    cfg = get_model_config("tinyllama-1.1b")
+    slow, fast = dev(0, flops=1e11), dev(1, flops=1e12)
+    plan = plan_partition(cfg, "tinyllama-1.1b", [slow, fast])
+    n_slow = plan.stages[0].layer_end - plan.stages[0].layer_start
+    n_fast = plan.stages[1].layer_end - plan.stages[1].layer_start
+    assert n_fast > n_slow * 2
+    # the bottleneck equals the slowest stage's step time
+    assert plan.est_bottleneck_sec == pytest.approx(
+        max(s.est_step_sec for s in plan.stages))
+
+
+def test_plan_memory_constraint_shifts_layers():
+    cfg = get_model_config("tinyllama-1.1b")
+    # d0 fast but tiny memory: only a few layers fit under 0.7 headroom
+    prof = model_cost_profile(cfg, ctx=128)
+    per_layer = prof.layers[0].param_bytes
+    tiny = dev(0, flops=1e13, mem=int(5 * per_layer / 0.7))
+    big = dev(1, flops=1e11, mem=64 << 30)
+    plan = plan_partition(cfg, "tinyllama-1.1b", [tiny, big], ctx=128)
+    n0 = plan.stages[0].layer_end - plan.stages[0].layer_start
+    assert n0 <= 5
+
+
+def test_plan_infeasible_raises():
+    cfg = get_model_config("tinyllama-1.1b")
+    with pytest.raises(PlanError, match="no feasible"):
+        plan_partition(cfg, "tinyllama-1.1b",
+                       [dev(0, mem=1 << 20), dev(1, mem=1 << 20)])
+    with pytest.raises(PlanError):
+        plan_partition(get_model_config("llama-test"), "llama-test",
+                       [dev(i) for i in range(10)])  # 10 devices, 4 layers
+
+
+def test_plan_tpu_mesh_axes():
+    cfg = get_model_config("llama-3-8b")
+    cpu = dev(0, flops=5e11, mem=64 << 30)
+    tpu = dev(1, flops=2.75e14, mem=32 << 30, platform="tpu", chips=4)
+    plan = plan_partition(cfg, "llama-3-8b", [cpu, tpu])
+    assert plan.stages[1].mesh_axes["tp"] == 4
+    assert plan.stages[0].mesh_axes["tp"] == 1
+    # TPU vastly faster -> takes the overwhelming majority of layers
+    n_tpu = plan.stages[1].layer_end - plan.stages[1].layer_start
+    assert n_tpu >= cfg.num_layers - 4
+
+
+def test_plan_single_device_no_comm():
+    cfg = get_model_config("llama-test")
+    plan = plan_partition(cfg, "llama-test", [dev(0)])
+    assert plan.stage_ranges == {"d0": [0, 4]}
+    assert plan.stages[0].est_comm_sec == 0.0
+
+
+def test_plan_cache_roundtrip(tmp_path):
+    cfg = get_model_config("tinyllama-1.1b")
+    plan = plan_partition(cfg, "tinyllama-1.1b", [dev(0), dev(1)])
+    path = str(tmp_path / "plan.json")
+    save_plan_cache(path, plan)
+    # matching model + device set -> reload (server.py:805-820)
+    got = load_cached_plan(path, "tinyllama-1.1b", ["d0", "d1"])
+    assert got is not None
+    assert got.stage_ranges == plan.stage_ranges
+    assert got.est_bottleneck_sec == pytest.approx(plan.est_bottleneck_sec)
+    # fleet changed -> no reload, forces replan (improves on reference)
+    assert load_cached_plan(path, "tinyllama-1.1b", ["d0", "d2"]) is None
+    assert load_cached_plan(path, "llama-3-8b", ["d0", "d1"]) is None
+    assert load_cached_plan(str(tmp_path / "absent.json"),
+                            "tinyllama-1.1b", ["d0", "d1"]) is None
+
+
+def test_stage_specs_cover_model():
+    cfg = get_model_config("tinyllama-1.1b")
+    plan = plan_partition(cfg, "tinyllama-1.1b", [dev(0), dev(1), dev(2)])
+    specs = plan.stage_specs()
+    assert specs[0].is_first and specs[-1].is_last
+    assert specs[0].layer_start == 0
+    assert specs[-1].layer_end == cfg.num_layers
+    for a, b in zip(specs, specs[1:]):
+        assert a.layer_end == b.layer_start

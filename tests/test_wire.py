@@ -95,6 +95,22 @@ def test_dim_product_overflow_rejected_both_impls():
 
 
 @pytest.mark.skipif(not native_codec.available(), reason="native codec absent")
+def test_huge_nbytes_offset_wrap_rejected_both_impls():
+    # u8 tensor with count == nbytes == 2^64-1: the dim product is
+    # consistent, but off + nbytes would wrap u64; remainder-based bounds
+    # checking must reject it.
+    import struct
+    huge = (1 << 64) - 1
+    blob = (struct.pack("<4sBBHI", wire.MAGIC, wire.VERSION, 0, 0, 1)
+            + struct.pack("<BBHQ", int(wire.DType.U8), 1, 0, huge)
+            + struct.pack("<Q", huge))
+    with pytest.raises(wire.WireError):
+        wire.deserialize_tensors(blob)
+    with pytest.raises(wire.WireError):
+        native_codec.deserialize_tensors(blob)
+
+
+@pytest.mark.skipif(not native_codec.available(), reason="native codec absent")
 def test_native_decode_returns_writable_arrays():
     blob = wire.serialize_tensors([np.arange(6, dtype=np.float32)])
     arr = native_codec.deserialize_tensors(blob).tensors[0]
