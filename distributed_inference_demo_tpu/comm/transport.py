@@ -1,0 +1,231 @@
+"""Inter-stage data-plane transports: ZMQ sockets + in-process loopback.
+
+Replaces the reference's per-edge DEALER/ROUTER socket mesh with its
+pull-based "Request Data" handshake and deterministic port arithmetic
+(``Communication.java:712-744, 937-961``).  Design differences:
+
+- **One inbound ROUTER per worker** instead of a socket set per concurrency
+  slot; concurrent in-flight samples are demultiplexed by message *tag*
+  (``kind:request_id:step``), not by socket identity.
+- **Push with bounded queues** instead of request/reply pull: ZMQ high-water
+  marks give the same backpressure property as the reference's handshake
+  without paying an extra round-trip per tensor per hop.
+- **Loopback transport** with the identical API for in-process multi-stage
+  tests (SURVEY.md §4 calls out the reference's total lack of fake
+  transports).
+
+Payloads are opaque bytes — tensor framing is wire.py's job.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional, Tuple
+
+import zmq
+
+DEFAULT_HWM = 64          # messages buffered per edge before backpressure
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+class TransportTimeout(TransportError):
+    """recv deadline expired (replaces the reference's indefinite blocking
+    ``recv(0)`` hangs, defect #7)."""
+
+
+class BaseTransport:
+    """Tagged message transport between named peers.
+
+    ``recv(tag)`` returns the payload for that tag, stashing any other
+    messages that arrive meanwhile; ``recv_any()`` returns the next message
+    of any tag — the worker-loop entry point.
+    """
+
+    def __init__(self, device_id: str):
+        self.device_id = device_id
+        self._inbox: "queue.Queue[Tuple[str, bytes]]" = queue.Queue()
+        self._stash: Dict[str, list] = {}
+        self._stash_lock = threading.Lock()
+
+    # -- to be provided by implementations ---------------------------------
+
+    def connect(self, peer_id: str, address: str) -> None:
+        raise NotImplementedError
+
+    def send(self, peer_id: str, tag: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    # -- shared receive logic ----------------------------------------------
+
+    def _deliver(self, tag: str, payload: bytes) -> None:
+        self._inbox.put((tag, payload))
+
+    def recv_any(self, timeout: Optional[float] = None
+                 ) -> Tuple[str, bytes]:
+        """Next message of any tag (stashed messages first)."""
+        with self._stash_lock:
+            for tag, items in self._stash.items():
+                if items:
+                    payload = items.pop(0)
+                    if not items:
+                        del self._stash[tag]
+                    return tag, payload
+        try:
+            return self._inbox.get(timeout=timeout)
+        except queue.Empty:
+            raise TransportTimeout(
+                f"{self.device_id}: no message within {timeout}s") from None
+
+    def recv(self, tag: str, timeout: Optional[float] = None) -> bytes:
+        """Payload for ``tag``; other arrivals are stashed, not dropped."""
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._stash_lock:
+            items = self._stash.get(tag)
+            if items:
+                payload = items.pop(0)
+                if not items:
+                    del self._stash[tag]
+                return payload
+        while True:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            try:
+                got_tag, payload = self._inbox.get(timeout=remaining)
+            except queue.Empty:
+                raise TransportTimeout(
+                    f"{self.device_id}: no {tag!r} within {timeout}s"
+                ) from None
+            if got_tag == tag:
+                return payload
+            with self._stash_lock:
+                self._stash.setdefault(got_tag, []).append(payload)
+
+
+class ZmqTransport(BaseTransport):
+    """Socket transport: inbound ROUTER (bound), one outbound DEALER per
+    peer (connected lazily via ``connect``)."""
+
+    def __init__(self, device_id: str, bind_host: str = "127.0.0.1",
+                 port: int = 0, hwm: int = DEFAULT_HWM,
+                 send_timeout: float = 60.0,
+                 ctx: Optional[zmq.Context] = None):
+        super().__init__(device_id)
+        self._ctx = ctx or zmq.Context.instance()
+        self._hwm = hwm
+        self._send_timeout_ms = int(send_timeout * 1000)
+        self._in = self._ctx.socket(zmq.ROUTER)
+        self._in.setsockopt(zmq.LINGER, 0)
+        self._in.setsockopt(zmq.RCVHWM, hwm)
+        if port == 0:
+            self.port = self._in.bind_to_random_port(f"tcp://{bind_host}")
+        else:
+            self._in.bind(f"tcp://{bind_host}:{port}")
+            self.port = port
+        self.address = f"{bind_host}:{self.port}"
+        self._out: Dict[str, zmq.Socket] = {}
+        self._out_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name=f"transport-{device_id}")
+        self._thread.start()
+
+    def _pump(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._in, zmq.POLLIN)
+        while not self._stop.is_set():
+            if not dict(poller.poll(timeout=100)):
+                continue
+            frames = self._in.recv_multipart()
+            # [sender identity, tag, payload]
+            if len(frames) != 3:
+                continue
+            self._deliver(frames[1].decode(), frames[2])
+
+    def connect(self, peer_id: str, address: str) -> None:
+        with self._out_lock:
+            if peer_id in self._out:
+                return
+            sock = self._ctx.socket(zmq.DEALER)
+            sock.setsockopt(zmq.IDENTITY, self.device_id.encode())
+            sock.setsockopt(zmq.LINGER, 0)
+            sock.setsockopt(zmq.SNDHWM, self._hwm)
+            # A dead peer fills the HWM queue; a bounded send turns that
+            # into TransportTimeout instead of an indefinite hang (the
+            # send-side counterpart of reference defect #7).
+            sock.setsockopt(zmq.SNDTIMEO, self._send_timeout_ms)
+            sock.connect(f"tcp://{address}")
+            self._out[peer_id] = sock
+
+    def send(self, peer_id: str, tag: str, payload: bytes) -> None:
+        # one lock hold for lookup + send: a concurrent close() cannot
+        # invalidate the socket between the two
+        with self._out_lock:
+            sock = self._out.get(peer_id)
+            if sock is None:
+                raise TransportError(
+                    f"{self.device_id}: peer {peer_id!r} not connected")
+            try:
+                sock.send_multipart([tag.encode(), payload])
+            except zmq.Again:
+                raise TransportTimeout(
+                    f"{self.device_id}: send to {peer_id!r} blocked "
+                    f"> {self._send_timeout_ms} ms (peer dead?)") from None
+            except zmq.ZMQError as e:
+                raise TransportError(
+                    f"{self.device_id}: send to {peer_id!r} failed: {e}"
+                ) from None
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        with self._out_lock:
+            for sock in self._out.values():
+                sock.close(linger=0)
+            self._out.clear()
+        self._in.close(linger=0)
+
+
+class LoopbackNetwork:
+    """Shared in-process fabric for LoopbackTransport endpoints."""
+
+    def __init__(self):
+        self._endpoints: Dict[str, "LoopbackTransport"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, t: "LoopbackTransport") -> None:
+        with self._lock:
+            self._endpoints[t.device_id] = t
+
+    def deliver(self, peer_id: str, tag: str, payload: bytes) -> None:
+        with self._lock:
+            target = self._endpoints.get(peer_id)
+        if target is None:
+            raise TransportError(f"unknown loopback peer {peer_id!r}")
+        target._deliver(tag, payload)
+
+
+class LoopbackTransport(BaseTransport):
+    """In-process fake with the ZmqTransport API (tests, single-host runs)."""
+
+    def __init__(self, device_id: str, network: LoopbackNetwork):
+        super().__init__(device_id)
+        self._net = network
+        self.address = f"loopback:{device_id}"
+        network.register(self)
+
+    def connect(self, peer_id: str, address: str) -> None:
+        pass  # loopback needs no connection setup
+
+    def send(self, peer_id: str, tag: str, payload: bytes) -> None:
+        self._net.deliver(peer_id, tag, payload)
+
+    def close(self) -> None:
+        pass

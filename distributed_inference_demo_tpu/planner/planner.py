@@ -145,10 +145,19 @@ def _stage_costs(profile: ModelCostProfile, devs: Sequence[DeviceProfile],
     if i == num_devices - 1:
         flops += profile.head.flops * batch
         params += profile.head.param_bytes
+        if cfg.tie_embeddings and num_devices > 1:
+            # slice_stage gives the tail its own copy of the token table
+            # for the tied LM head (models/base.py needs_embed) — charge it.
+            params += profile.embed.param_bytes
     eff_flops = dev.flops_per_sec * (dev.chips if dev.platform == "tpu"
                                      else 1)
     compute = flops / eff_flops
-    act = profile.layers[b - 1].act_bytes * batch if b > a else 0
+    if i == num_devices - 1:
+        # the tail sends a sampled token id back to the header, not a
+        # hidden row
+        act = profile.head.act_bytes * batch
+    else:
+        act = profile.layers[b - 1].act_bytes * batch if b > a else 0
     comm = (dev.egress_latency + act / dev.egress_bandwidth
             if num_devices > 1 else 0.0)
     return compute, comm, params, kv
@@ -265,8 +274,8 @@ def load_cached_plan(path: str, model: str,
     try:
         with open(path) as f:
             plan = PartitionPlan.from_json(json.load(f))
-    except (ValueError, KeyError):
-        return None
+    except (ValueError, KeyError, IndexError, TypeError):
+        return None  # corrupt/stale cache: fall back to replanning
     if plan.model != model or plan.device_ids != list(device_ids):
         return None
     return plan

@@ -1,0 +1,268 @@
+"""Distributed pipeline inference: the ring token loop over a transport.
+
+TPU-native redesign of the reference's hot path (``Communication.running``
+→ ``multiSteps`` → ``OneStep``, ``Communication.java:389-928``; SURVEY.md
+§3.3): header embeds + runs its layer range, hidden states hop stage to
+stage, the tail samples, and the token id rides the ring back to the
+header.  Differences by design:
+
+- **KV-cached decode** at every stage — each step moves a [b, 1, H] hidden
+  row, not a re-run of the whole prefix (the reference re-runs modules
+  statelessly and feeds only the last token, defect #3).
+- **In-flight samples are tags, not socket sets**: ``pool_size`` requests
+  interleave through the same transport edges, each with its own per-stage
+  KV cache slot (the reference allocates a socket set per concurrency slot,
+  ``Communication.java:930-970``).
+- **Sampling fused at the tail** (jit) with a deterministic
+  ``fold_in(rid, step)`` rng — no host round-trip for top-k.
+- All receives carry timeouts (reference defect #7: indefinite blocking).
+
+Message tags (payloads are wire.py tensor messages):
+
+- ``h:{rid}:{step}``   hidden chunk (step 0 = prefill, else one token row)
+- ``tok:{rid}:{step}`` sampled [b] token ids, tail → header
+- ``end:{rid}``        free the request's cache, forwarded along the chain
+- ``stop``             shut down the worker loop, forwarded along the chain
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm import wire
+from ..comm.transport import BaseTransport
+from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
+from ..models.decoder import stage_forward
+from ..ops.sampling import SamplingParams, sample_logits
+
+log = logging.getLogger(__name__)
+
+DEFAULT_STEP_TIMEOUT = 120.0  # generous: first jit compile can be slow
+
+
+class StageRuntime:
+    """Jitted compute for one stage + per-request KV cache slots."""
+
+    def __init__(self, cfg: ModelConfig, spec: StageSpec, params: StageParams,
+                 max_seq: int, sampling: SamplingParams = SamplingParams(),
+                 seed: int = 0):
+        self.cfg = cfg
+        self.spec = spec
+        self.params = params
+        self.max_seq = max_seq
+        self.sampling = sampling
+        self._rng_base = jax.random.PRNGKey(seed)
+        self.caches: Dict[int, KVCache] = {}
+
+        take_last = spec.is_last
+
+        @jax.jit
+        def forward(params, inputs, cache):
+            b, s = inputs.shape[0], inputs.shape[1]
+            pos = cache.length + jnp.broadcast_to(jnp.arange(s), (b, s))
+            out, cache = stage_forward(params, cfg, spec, inputs, cache, pos)
+            return (out[:, -1] if take_last else out), cache
+
+        @jax.jit
+        def sample(last_logits, rng):
+            return sample_logits(last_logits, rng, sampling)
+
+        self._forward = forward
+        self._sample = sample
+
+    def _cache_for(self, rid: int, batch: int) -> KVCache:
+        cache = self.caches.get(rid)
+        if cache is None:
+            cache = KVCache.create(self.cfg, self.spec.num_layers, batch,
+                                   self.max_seq)
+            self.caches[rid] = cache
+        return cache
+
+    def run_chunk(self, rid: int, inputs: np.ndarray) -> jax.Array:
+        """Run this stage on a chunk; updates the request's cache in place.
+        Returns hidden [b,s,H] (or last-position logits on the tail)."""
+        x = jnp.asarray(inputs)
+        cache = self._cache_for(rid, x.shape[0])
+        out, self.caches[rid] = self._forward(self.params, x, cache)
+        return out
+
+    def sample_tokens(self, rid: int, step: int,
+                      last_logits: jax.Array) -> np.ndarray:
+        rng = jax.random.fold_in(jax.random.fold_in(self._rng_base, rid),
+                                 step)
+        return np.asarray(self._sample(last_logits, rng))
+
+    def free(self, rid: int) -> None:
+        self.caches.pop(rid, None)
+
+
+def _h_tag(rid: int, step: int) -> str:
+    return f"h:{rid}:{step}"
+
+
+def _tok_tag(rid: int, step: int) -> str:
+    return f"tok:{rid}:{step}"
+
+
+class PipelineWorker:
+    """A non-header stage: recv → run layer range → send onward; the tail
+    additionally samples and returns tokens to the header (the worker /
+    tailer roles of ``OneStep``, ``Communication.java:682-928``)."""
+
+    def __init__(self, runtime: StageRuntime, transport: BaseTransport,
+                 next_id: Optional[str], header_id: str,
+                 step_timeout: float = DEFAULT_STEP_TIMEOUT):
+        self.rt = runtime
+        self.transport = transport
+        self.next_id = next_id          # None on the tail
+        self.header_id = header_id
+        self.step_timeout = step_timeout
+
+    def _forward_control(self, tag: str) -> None:
+        if self.next_id is not None:
+            self.transport.send(self.next_id, tag, b"")
+
+    def serve_forever(self, idle_timeout: Optional[float] = None) -> None:
+        """Loop until a ``stop`` message arrives (or idle_timeout expires
+        with no traffic at all)."""
+        while True:
+            tag, payload = self.transport.recv_any(
+                timeout=idle_timeout or self.step_timeout)
+            kind, _, rest = tag.partition(":")
+            if kind == "stop":
+                self._forward_control(tag)
+                return
+            if kind == "end":
+                self.rt.free(int(rest))
+                self._forward_control(tag)
+                continue
+            if kind != "h":
+                log.warning("worker %s: unexpected tag %r",
+                            self.transport.device_id, tag)
+                continue
+            rid_s, _, step_s = rest.partition(":")
+            rid, step = int(rid_s), int(step_s)
+            [x] = wire.deserialize_tensors(payload).tensors
+            out = self.rt.run_chunk(rid, x)
+            if self.rt.spec.is_last:
+                toks = self.rt.sample_tokens(rid, step, out)
+                self.transport.send(
+                    self.header_id, _tok_tag(rid, step),
+                    wire.serialize_tensors([toks]))
+            else:
+                self.transport.send(
+                    self.next_id, _h_tag(rid, step),
+                    wire.serialize_tensors([np.asarray(out)]))
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray                 # [b, s] int32
+    max_new_tokens: int
+    tokens: List[np.ndarray] = None    # collected [b] arrays
+    step: int = 0
+    done: bool = False
+
+    def __post_init__(self):
+        if self.tokens is None:
+            self.tokens = []
+
+
+class PipelineHeader:
+    """The header role: owns stage 0, tokenized inputs, the request window,
+    and token collection (``Communication.running``'s driver half)."""
+
+    def __init__(self, runtime: StageRuntime, transport: BaseTransport,
+                 next_id: str, eos_id: Optional[int] = None,
+                 step_timeout: float = DEFAULT_STEP_TIMEOUT):
+        if not runtime.spec.is_first:
+            raise ValueError("header must own stage 0")
+        self.rt = runtime
+        self.transport = transport
+        self.next_id = next_id
+        self.eos_id = eos_id
+        self.step_timeout = step_timeout
+        self._next_rid = 0
+
+    # -- single-stage degenerate case is the engine's job, not ours --------
+
+    def _launch(self, req: _Request) -> None:
+        hidden = self.rt.run_chunk(req.rid, req.prompt.astype(np.int32))
+        self.transport.send(self.next_id, _h_tag(req.rid, 0),
+                            wire.serialize_tensors([np.asarray(hidden)]))
+
+    def _advance(self, req: _Request, toks: np.ndarray) -> None:
+        """Got step's tokens; either issue the next decode chunk or finish."""
+        req.tokens.append(toks)
+        req.step += 1
+        if req.step >= req.max_new_tokens or (
+                self.eos_id is not None
+                and bool(np.all(toks == self.eos_id))):
+            req.done = True
+            self.transport.send(self.next_id, f"end:{req.rid}", b"")
+            self.rt.free(req.rid)
+            return
+        hidden = self.rt.run_chunk(req.rid, toks[:, None].astype(np.int32))
+        self.transport.send(self.next_id, _h_tag(req.rid, req.step),
+                            wire.serialize_tensors([np.asarray(hidden)]))
+
+    def generate_many(self, prompts: Sequence[np.ndarray],
+                      max_new_tokens: int,
+                      pool_size: int = 1) -> List[np.ndarray]:
+        """Generate for all prompts with ``pool_size`` requests in flight
+        (the reference's corePoolSize microbatching,
+        ``Communication.java:425-437``).  Returns [b, new_tokens] arrays in
+        prompt order."""
+        for p in prompts:
+            need = p.shape[1] + max_new_tokens
+            if need > self.rt.max_seq:
+                raise ValueError(
+                    f"prompt ({p.shape[1]}) + new ({max_new_tokens}) = "
+                    f"{need} exceeds KV capacity {self.rt.max_seq}")
+        pending = [
+            _Request(rid=self._next_rid + i, prompt=np.asarray(p),
+                     max_new_tokens=max_new_tokens)
+            for i, p in enumerate(prompts)]
+        self._next_rid += len(pending)
+        by_rid = {r.rid: r for r in pending}
+        queue = list(pending)
+        in_flight: Dict[int, _Request] = {}
+
+        while queue or in_flight:
+            while queue and len(in_flight) < pool_size:
+                req = queue.pop(0)
+                in_flight[req.rid] = req
+                self._launch(req)
+            tag, payload = self.transport.recv_any(
+                timeout=self.step_timeout)
+            kind, _, rest = tag.partition(":")
+            if kind != "tok":
+                log.warning("header: unexpected tag %r", tag)
+                continue
+            rid = int(rest.partition(":")[0])
+            req = in_flight.get(rid)
+            if req is None:
+                continue
+            [toks] = wire.deserialize_tensors(payload).tensors
+            self._advance(req, toks)
+            if req.done:
+                del in_flight[rid]
+
+        return [np.stack(by_rid[r.rid].tokens, axis=1) for r in pending]
+
+    def generate(self, prompt_ids: np.ndarray,
+                 max_new_tokens: int) -> np.ndarray:
+        """Single request; returns [b, new_tokens]."""
+        return self.generate_many([prompt_ids], max_new_tokens)[0]
+
+    def shutdown_pipeline(self) -> None:
+        """Send ``stop`` down the chain (Finish→Close analogue for the data
+        plane)."""
+        self.transport.send(self.next_id, "stop", b"")

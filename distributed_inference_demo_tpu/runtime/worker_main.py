@@ -1,0 +1,87 @@
+"""Standalone stage-worker process entry point.
+
+Launches one pipeline stage over the socket transport — the role of the
+reference's on-device worker runtime (``BackgroundService`` driving
+``Communication.running``, SURVEY.md §3.2/§3.3) as a plain CLI process.
+Used by the multi-process integration tests and the ``worker`` CLI.
+
+Weights come either from a seed (every process derives the same full
+parameter set deterministically, then slices its own stage — the test
+path, replacing the reference's ONNX-zip shipping) or, in the full
+deployment path, from the control plane's artifact channel (cli.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_worker(args):
+    import jax
+
+    from ..comm.transport import ZmqTransport
+    from ..models.base import StageSpec, slice_stage
+    from ..models.decoder import init_full_params
+    from ..models.registry import get_model_config
+    from ..ops.sampling import SamplingParams
+    from .distributed import PipelineWorker, StageRuntime
+
+    cfg = get_model_config(args.model)
+    spec = StageSpec(args.stage_id, args.num_stages,
+                     args.layer_start, args.layer_end)
+    full = init_full_params(jax.random.PRNGKey(args.weights_seed), cfg)
+    params = slice_stage(full, cfg, spec)
+    sampling = SamplingParams(greedy=True) if args.greedy else \
+        SamplingParams(temperature=args.temperature, top_k=args.top_k)
+    runtime = StageRuntime(cfg, spec, params, max_seq=args.max_seq,
+                           sampling=sampling, seed=args.seed)
+
+    transport = ZmqTransport(args.device_id, bind_host=args.bind_host,
+                             port=args.port)
+    next_id = None
+    if args.next:
+        next_id, next_addr = args.next.split("@", 1)
+        transport.connect(next_id, next_addr)
+    header_id, header_addr = args.header.split("@", 1)
+    transport.connect(header_id, header_addr)
+    worker = PipelineWorker(runtime, transport, next_id=next_id,
+                            header_id=header_id,
+                            step_timeout=args.step_timeout)
+    return worker, transport
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="pipeline stage worker")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--stage-id", type=int, required=True)
+    ap.add_argument("--num-stages", type=int, required=True)
+    ap.add_argument("--layer-start", type=int, required=True)
+    ap.add_argument("--layer-end", type=int, required=True)
+    ap.add_argument("--device-id", required=True)
+    ap.add_argument("--bind-host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--next", default="",
+                    help="next stage as id@host:port (empty on the tail)")
+    ap.add_argument("--header", required=True,
+                    help="header as id@host:port (token return edge)")
+    ap.add_argument("--max-seq", type=int, default=256)
+    ap.add_argument("--weights-seed", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--greedy", action="store_true")
+    ap.add_argument("--temperature", type=float, default=0.7)
+    ap.add_argument("--top-k", type=int, default=7)
+    ap.add_argument("--step-timeout", type=float, default=120.0)
+    args = ap.parse_args(argv)
+
+    worker, transport = build_worker(args)
+    print(f"WORKER_READY {args.device_id} {transport.address}", flush=True)
+    try:
+        worker.serve_forever()
+    finally:
+        transport.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
