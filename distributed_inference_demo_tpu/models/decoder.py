@@ -161,11 +161,25 @@ def _moe_mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
     return out
 
 
+def _default_attn(q, k, v, k_cache, v_cache, positions, cache_start, slopes):
+    """Default attention path: insert chunk into cache, attend to cache.
+
+    ``attn_impl`` hooks in ``_layer``/``stage_forward`` share this signature;
+    the sequence-parallel path (parallel/sequence.py) substitutes ring /
+    sharded-cache attention without duplicating the decoder block.
+    """
+    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, cache_start)
+    new_len = cache_start + q.shape[1]
+    out = attention(q, k_cache, v_cache, positions, new_len, slopes)
+    return out, k_cache, v_cache
+
+
 def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
            k_cache: jnp.ndarray, v_cache: jnp.ndarray,
            positions: jnp.ndarray, cache_start: jnp.ndarray,
            slopes: Optional[jnp.ndarray],
-           tp_axis: Optional[str] = None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+           tp_axis: Optional[str] = None,
+           attn_impl=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decoder block. x: [b, s, H]. Returns (x', k_cache', v_cache').
 
     Head counts derive from the weight shards, not the config, so the same
@@ -197,9 +211,9 @@ def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
 
-    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, cache_start)
-    new_len = cache_start + s
-    attn = attention(q, k_cache, v_cache, positions, new_len, slopes)
+    attn_fn = attn_impl if attn_impl is not None else _default_attn
+    attn, k_cache, v_cache = attn_fn(
+        q, k, v, k_cache, v_cache, positions, cache_start, slopes)
     attn = attn.reshape(b, s, nh * hd)
     attn = dense(attn, lp["wo"], "bsd,dh->bsh")
     if tp_axis is not None:
@@ -224,6 +238,7 @@ def stage_forward(
     cache: KVCache,             # this stage's cache (num_layers = spec.num_layers)
     positions: jnp.ndarray,     # [b, s] absolute positions of the chunk
     tp_axis: Optional[str] = None,  # set inside shard_map for manual TP
+    attn_impl=None,             # attention hook (see _default_attn)
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run this stage's layer range. Returns (hidden or logits, updated cache).
 
@@ -252,7 +267,7 @@ def stage_forward(
     def body(x, scanned):
         lp, kc, vc = scanned
         x, kc, vc = _layer(cfg, lp, x, kc, vc, positions, cache_start, slopes,
-                           tp_axis)
+                           tp_axis, attn_impl)
         return x, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(

@@ -1,9 +1,11 @@
 from .norms import rms_norm, layer_norm
 from .rope import apply_rope, rope_frequencies
 from .attention import attention, alibi_slopes
+from .ring_attention import ring_self_attention, sp_decode_attention
 from .sampling import sample_logits, SamplingParams
 
 __all__ = [
     "rms_norm", "layer_norm", "apply_rope", "rope_frequencies",
-    "attention", "alibi_slopes", "sample_logits", "SamplingParams",
+    "attention", "alibi_slopes", "ring_self_attention",
+    "sp_decode_attention", "sample_logits", "SamplingParams",
 ]

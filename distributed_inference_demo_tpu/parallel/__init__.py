@@ -1,5 +1,6 @@
 from .mesh import MeshConfig, make_mesh
 from .sharding import param_shardings, shard_params, cache_shardings
+from .sequence import make_sp_generate_fn
 
 __all__ = ["MeshConfig", "make_mesh", "param_shardings", "shard_params",
-           "cache_shardings"]
+           "cache_shardings", "make_sp_generate_fn"]

@@ -1,0 +1,171 @@
+"""Sequence/context parallelism: long-context generation over the ``sp`` axis.
+
+Absent in the reference (SURVEY.md §5.7: ``max_length=40``, no KV cache, no
+sequence parallelism).  Here long prompts are first-class: the prompt is
+sharded into contiguous chunks over the ``sp`` mesh axis, prefill runs
+**ring attention** (ops/ring_attention.py) so no device ever materializes the
+full sequence, and the KV cache stays sharded by sequence for the whole
+generation — decode combines per-shard partial attention with an exact
+log-sum-exp reduction instead of moving KV.
+
+Decode-token placement is stateless round-robin, derived from the carried
+global length: the d-th decoded token's K/V lands on rank ``d % sp`` at slot
+``chunk + d // sp``, so cache shards stay balanced with no coordination
+traffic; the ``kv_pos`` position map (-1 = empty slot) drives causal masking.
+
+The decoder block itself is shared with every other path via the
+``attn_impl`` hook of ``models.decoder.stage_forward`` — sequence parallelism
+swaps the attention/cache strategy, not the model math.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.base import KVCache, ModelConfig, StageSpec
+from ..models.decoder import stage_forward
+from ..ops.attention import update_kv_cache
+from ..ops.norms import layer_norm, rms_norm
+from ..ops.ring_attention import ring_self_attention, sp_decode_attention
+from ..ops.sampling import SamplingParams, sample_logits
+
+
+def _dynamic_set1(arr: jnp.ndarray, idx: jnp.ndarray, val: jnp.ndarray):
+    """arr[idx] = val for a traced scalar idx (1-element update slice)."""
+    return jax.lax.dynamic_update_slice(arr, val[None].astype(arr.dtype),
+                                        (idx,))
+
+
+def _final_logits(params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + LM head on [b, l, H] hidden (stage_forward's tail,
+    applied here to just the selected last position instead of on every
+    rank's whole chunk)."""
+    if cfg.attn_layernorm:
+        h = layer_norm(h, params.final_norm["w"], params.final_norm["b"],
+                       cfg.norm_eps)
+    else:
+        h = rms_norm(h, params.final_norm["w"], cfg.norm_eps)
+    head = (params.embed["tokens"].T if cfg.tie_embeddings
+            else params.lm_head["w"])
+    return jnp.einsum("blh,hv->blv", h, head)
+
+
+def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
+                        num_new_tokens: int,
+                        sampling: Optional[SamplingParams] = None):
+    """Build a jitted ``fn(params, prompt_ids, rng) -> tokens`` that runs
+    ring-attention prefill + sp-sharded-cache decode over ``mesh``'s sp axis.
+
+    Constraints (checked host-side): ``prompt_len % sp == 0`` (pad the prompt
+    to a chunk multiple before calling) and
+    ``prompt_len + num_new_tokens <= max_seq`` with ``max_seq % sp == 0``.
+    Returns [batch, num_new_tokens] int32; greedy when ``sampling`` is None.
+    """
+    sp = mesh.shape["sp"]
+    if max_seq % sp:
+        raise ValueError(f"max_seq={max_seq} not divisible by sp={sp}")
+    s_loc = max_seq // sp
+    spec = StageSpec(0, 1, 0, cfg.num_layers)
+    sampling = sampling or SamplingParams(greedy=True)
+
+    def body(params, ids, rng):
+        n = jax.lax.axis_size("sp")
+        idx = jax.lax.axis_index("sp")
+        b, chunk = ids.shape
+
+        # ---- prefill: ring attention over the prompt chunks -------------
+        def prefill_attn(q, k, v, kc, vc, pos, cache_start, slopes):
+            kc, vc = update_kv_cache(kc, vc, k, v, jnp.zeros((), jnp.int32))
+            out = ring_self_attention(q, k, v, "sp", slopes=slopes)
+            return out, kc, vc
+
+        shape = (spec.num_layers, b, s_loc, cfg.num_kv_heads, cfg.head_dim)
+        cache = KVCache(keys=jnp.zeros(shape, cfg.dtype),
+                        values=jnp.zeros(shape, cfg.dtype),
+                        length=jnp.zeros((), jnp.int32))
+        positions = jnp.broadcast_to(idx * chunk + jnp.arange(chunk),
+                                     (b, chunk))
+        # body spec (not last): prefill returns hidden states, and the LM
+        # head runs once below on the single selected last position instead
+        # of on every rank's whole [b, chunk, vocab] chunk.
+        body_spec = StageSpec(0, 2, 0, cfg.num_layers)
+        hidden, cache = stage_forward(params, cfg, body_spec, ids, cache,
+                                      positions, attn_impl=prefill_attn)
+        kv_pos = jnp.where(jnp.arange(s_loc) < chunk,
+                           idx * chunk + jnp.arange(s_loc), -1).astype(jnp.int32)
+        length = jnp.asarray(n * chunk, jnp.int32)
+
+        # the global last token lives on rank n-1; broadcast via psum.
+        h_last = jnp.where(idx == n - 1, hidden[:, -1:, :].astype(jnp.float32),
+                           0.0)
+        h_last = jax.lax.psum(h_last, "sp").astype(cfg.dtype)
+        last = _final_logits(params, cfg, h_last)[:, 0, :]
+        rng, r0 = jax.random.split(rng)
+        tok0 = sample_logits(last, r0, sampling)
+
+        # ---- decode: sharded cache + lse-combined partial attention -----
+        def step(carry, step_rng):
+            kc_all, vc_all, kv_pos, length, tok = carry
+            # stateless round-robin placement, derived from the carry: the
+            # d-th decoded token (d = length - prompt_len) lands on rank
+            # d % n at slot chunk + d // n.
+            d = length - n * chunk
+            is_owner = idx == d % n
+            slot = chunk + d // n
+            kv_pos_new = jnp.where(
+                is_owner, _dynamic_set1(kv_pos, slot, length), kv_pos)
+            pos = jnp.broadcast_to(length, (b, 1))
+
+            def dec_attn(q, k, v, kc, vc, pos_, cache_start, slopes):
+                old_k = jax.lax.dynamic_slice(
+                    kc, (0, slot, 0, 0), (b, 1, kc.shape[2], kc.shape[3]))
+                old_v = jax.lax.dynamic_slice(
+                    vc, (0, slot, 0, 0), (b, 1, vc.shape[2], vc.shape[3]))
+                k_ins = jnp.where(is_owner, k.astype(kc.dtype), old_k)
+                v_ins = jnp.where(is_owner, v.astype(vc.dtype), old_v)
+                kc = jax.lax.dynamic_update_slice(kc, k_ins, (0, slot, 0, 0))
+                vc = jax.lax.dynamic_update_slice(vc, v_ins, (0, slot, 0, 0))
+                out = sp_decode_attention(q, kc, vc, kv_pos_new, pos_, "sp",
+                                          slopes=slopes)
+                return out, kc, vc
+
+            cache = KVCache(kc_all, vc_all, length)
+            logits, cache = stage_forward(params, cfg, spec, tok[:, None],
+                                          cache, pos, attn_impl=dec_attn)
+            nxt = sample_logits(logits[:, -1, :], step_rng, sampling)
+            return ((cache.keys, cache.values, kv_pos_new, length + 1, nxt),
+                    nxt)
+
+        rngs = jax.random.split(rng, num_new_tokens - 1) \
+            if num_new_tokens > 1 else jnp.zeros((0, 2), jnp.uint32)
+        carry = (cache.keys, cache.values, kv_pos, length, tok0)
+        _, rest = jax.lax.scan(step, carry, rngs)
+        toks = jnp.concatenate([tok0[:, None], rest.T], axis=1) \
+            if num_new_tokens > 1 else tok0[:, None]
+        return toks
+
+    sharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def fn(params, prompt_ids, rng):
+        return sharded(params, prompt_ids, rng)
+
+    def checked(params, prompt_ids, rng):
+        b, plen = prompt_ids.shape
+        if plen % sp:
+            raise ValueError(
+                f"prompt_len={plen} not divisible by sp={sp}; pad first")
+        if plen + num_new_tokens > max_seq:
+            raise ValueError(
+                f"prompt {plen} + new {num_new_tokens} > max_seq {max_seq}")
+        return fn(params, prompt_ids, rng)
+
+    return checked
