@@ -113,7 +113,11 @@ class StageSpec:
 class KVCache:
     """Per-stage KV cache: stacked over the stage's layers.
 
-    keys/values: ``[num_layers, batch, max_seq, num_kv_heads, head_dim]``.
+    keys/values: ``[num_layers, batch, num_kv_heads, max_seq, head_dim]``
+    — **head-major**, so each kv head's cache is a contiguous ``[seq, hd]``
+    plane: the layout the Pallas flash kernel streams HBM→VMEM per head,
+    and the one XLA tiles best (the trailing ``[seq, hd]`` dims map onto
+    (sublane, lane) without a relayout).
     ``length`` is a scalar int32 tracking how many positions are filled.
 
     Capacity is NOT checked inside traced code (``dynamic_update_slice``
@@ -130,7 +134,7 @@ class KVCache:
                max_seq: Optional[int] = None, dtype=None) -> "KVCache":
         max_seq = max_seq or cfg.max_seq_len
         dtype = dtype or cfg.dtype
-        shape = (num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+        shape = (num_layers, batch, cfg.num_kv_heads, max_seq, cfg.head_dim)
         return KVCache(
             keys=jnp.zeros(shape, dtype),
             values=jnp.zeros(shape, dtype),
@@ -139,7 +143,7 @@ class KVCache:
 
     @property
     def max_seq(self) -> int:
-        return self.keys.shape[2]
+        return self.keys.shape[3]
 
 
 @partial(jax.tree_util.register_dataclass,

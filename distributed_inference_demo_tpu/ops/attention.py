@@ -39,19 +39,20 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
 
 def attention(
     q: jnp.ndarray,             # [batch, chunk, num_heads, head_dim]
-    k_cache: jnp.ndarray,       # [batch, max_seq, num_kv_heads, head_dim]
-    v_cache: jnp.ndarray,       # [batch, max_seq, num_kv_heads, head_dim]
+    k_cache: jnp.ndarray,       # [batch, num_kv_heads, max_seq, head_dim]
+    v_cache: jnp.ndarray,       # [batch, num_kv_heads, max_seq, head_dim]
     q_positions: jnp.ndarray,   # [batch, chunk] absolute positions of q tokens
     cache_len: jnp.ndarray,     # scalar int32: valid length of the cache
     slopes: Optional[jnp.ndarray] = None,  # [num_heads] ALiBi, or None
 ) -> jnp.ndarray:
     """Causal attention of the current chunk against the full cache.
 
+    Cache layout is head-major (see ``models.base.KVCache``).
     Returns [batch, chunk, num_heads, head_dim].
     """
     b, chunk, nh, hd = q.shape
-    max_seq = k_cache.shape[1]
-    nkv = k_cache.shape[2]
+    nkv = k_cache.shape[1]
+    max_seq = k_cache.shape[2]
     groups = nh // nkv
 
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
@@ -62,7 +63,7 @@ def attention(
     vf = v_cache.astype(jnp.float32)
 
     # scores: [b, nkv, groups, chunk, max_seq]
-    scores = jnp.einsum("bqkgh,bskh->bkgqs", qf, kf)
+    scores = jnp.einsum("bqkgh,bksh->bkgqs", qf, kf)
 
     kv_pos = jnp.arange(max_seq)[None, None, :]                  # [1, 1, s]
     qpos = q_positions[:, :, None]                               # [b, q, 1]
@@ -79,21 +80,28 @@ def attention(
 
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, vf)
+    out = jnp.einsum("bkgqs,bksh->bqkgh", probs, vf)
     return out.reshape(b, chunk, nh, hd).astype(q.dtype)
 
 
 def update_kv_cache(
-    k_cache: jnp.ndarray,  # [batch, max_seq, nkv, hd]
+    k_cache: jnp.ndarray,  # [batch, nkv, max_seq, hd] (head-major)
     v_cache: jnp.ndarray,
-    k_new: jnp.ndarray,    # [batch, chunk, nkv, hd]
+    k_new: jnp.ndarray,    # [batch, chunk, nkv, hd] (projection layout)
     v_new: jnp.ndarray,
     start: jnp.ndarray,    # scalar int32 insert offset
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Insert the chunk's K/V at ``start`` via dynamic_update_slice."""
+    """Insert the chunk's K/V at position ``start`` of every head's plane.
+
+    The chunk arrives in projection layout [b, chunk, nkv, hd] (as produced
+    by the QKV matmuls) and is transposed to the cache's head-major layout
+    here — a [b, chunk, nkv, hd]-sized shuffle, O(chunk), not O(max_seq).
+    """
     zeros = jnp.zeros((), jnp.int32)
+    k_new = k_new.transpose(0, 2, 1, 3).astype(k_cache.dtype)
+    v_new = v_new.transpose(0, 2, 1, 3).astype(v_cache.dtype)
     k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k_new.astype(k_cache.dtype), (zeros, start, zeros, zeros))
+        k_cache, k_new, (zeros, zeros, start, zeros))
     v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v_new.astype(v_cache.dtype), (zeros, start, zeros, zeros))
+        v_cache, v_new, (zeros, zeros, start, zeros))
     return k_cache, v_cache

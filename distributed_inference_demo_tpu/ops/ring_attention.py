@@ -145,8 +145,8 @@ def ring_self_attention(
 
 def sp_decode_attention(
     q: jnp.ndarray,           # [b, lq, nh, hd] (replicated across sp ranks)
-    k_shard: jnp.ndarray,     # [b, s_loc, nkv, hd] local KV-cache shard
-    v_shard: jnp.ndarray,
+    k_shard: jnp.ndarray,     # [b, nkv, s_loc, hd] local cache shard
+    v_shard: jnp.ndarray,     #   (head-major, see models.base.KVCache)
     kv_pos: jnp.ndarray,      # [s_loc] int32 global positions, -1 = empty
     q_positions: jnp.ndarray, # [b, lq] global positions of the queries
     axis_name: str,
@@ -160,7 +160,7 @@ def sp_decode_attention(
     Only O(heads·hd) bytes cross the ICI per step — no KV movement.
     """
     b, lq, nh, hd = q.shape
-    nkv = k_shard.shape[2]
+    nkv = k_shard.shape[1]
     g = nh // nkv
 
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
@@ -168,7 +168,7 @@ def sp_decode_attention(
     kf = k_shard.astype(jnp.float32)
     vf = v_shard.astype(jnp.float32)
 
-    scores = _block_scores(qf, kf)                       # [b,nkv,g,lq,s]
+    scores = jnp.einsum("bqkgh,bksh->bkgqs", qf, kf)     # [b,nkv,g,lq,s]
     kv_valid = kv_pos >= 0
     # causal over global positions, per batch row
     causal = kv_pos[None, None, :] <= q_positions[:, :, None]   # [b, lq, s]
@@ -183,7 +183,7 @@ def sp_decode_attention(
     m_loc = jnp.max(scores, axis=-1)                     # [b,nkv,g,lq]
     p = jnp.where(valid, jnp.exp(scores - m_loc[..., None]), 0.0)
     l_loc = jnp.sum(p, axis=-1)
-    o_loc = jnp.einsum("bkgqs,bskh->bkgqh", p, vf)
+    o_loc = jnp.einsum("bkgqs,bksh->bkgqh", p, vf)
 
     m_glob = jax.lax.pmax(m_loc, axis_name)
     alpha = jnp.exp(m_loc - m_glob)

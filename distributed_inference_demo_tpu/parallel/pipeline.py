@@ -117,8 +117,8 @@ def pipeline_apply(
         nkv_local = params.layers["wk"].shape[-1] // cfg.head_dim
         L_local = jax.tree.leaves(params.layers)[0].shape[0]
         cache = KVCache(
-            keys=jnp.zeros((L_local, b, s, nkv_local, cfg.head_dim), dt),
-            values=jnp.zeros((L_local, b, s, nkv_local, cfg.head_dim), dt),
+            keys=jnp.zeros((L_local, b, nkv_local, s, cfg.head_dim), dt),
+            values=jnp.zeros((L_local, b, nkv_local, s, cfg.head_dim), dt),
             length=jnp.zeros((), jnp.int32))
         mid_params = StageParams(layers=params.layers)
         out, _ = stage_forward(mid_params, cfg, spec_mid, x, cache, positions,

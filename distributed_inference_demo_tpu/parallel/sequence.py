@@ -82,7 +82,7 @@ def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
             out = ring_self_attention(q, k, v, "sp", slopes=slopes)
             return out, kc, vc
 
-        shape = (spec.num_layers, b, s_loc, cfg.num_kv_heads, cfg.head_dim)
+        shape = (spec.num_layers, b, cfg.num_kv_heads, s_loc, cfg.head_dim)
         cache = KVCache(keys=jnp.zeros(shape, cfg.dtype),
                         values=jnp.zeros(shape, cfg.dtype),
                         length=jnp.zeros((), jnp.int32))
@@ -120,14 +120,18 @@ def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
             pos = jnp.broadcast_to(length, (b, 1))
 
             def dec_attn(q, k, v, kc, vc, pos_, cache_start, slopes):
+                # kc/vc: [b, nkv, s_loc, hd] head-major; the new token's
+                # k/v arrive as [b, 1, nkv, hd] — transpose to cache layout
+                k_t = k.transpose(0, 2, 1, 3).astype(kc.dtype)
+                v_t = v.transpose(0, 2, 1, 3).astype(vc.dtype)
                 old_k = jax.lax.dynamic_slice(
-                    kc, (0, slot, 0, 0), (b, 1, kc.shape[2], kc.shape[3]))
+                    kc, (0, 0, slot, 0), (b, kc.shape[1], 1, kc.shape[3]))
                 old_v = jax.lax.dynamic_slice(
-                    vc, (0, slot, 0, 0), (b, 1, vc.shape[2], vc.shape[3]))
-                k_ins = jnp.where(is_owner, k.astype(kc.dtype), old_k)
-                v_ins = jnp.where(is_owner, v.astype(vc.dtype), old_v)
-                kc = jax.lax.dynamic_update_slice(kc, k_ins, (0, slot, 0, 0))
-                vc = jax.lax.dynamic_update_slice(vc, v_ins, (0, slot, 0, 0))
+                    vc, (0, 0, slot, 0), (b, vc.shape[1], 1, vc.shape[3]))
+                k_ins = jnp.where(is_owner, k_t, old_k)
+                v_ins = jnp.where(is_owner, v_t, old_v)
+                kc = jax.lax.dynamic_update_slice(kc, k_ins, (0, 0, slot, 0))
+                vc = jax.lax.dynamic_update_slice(vc, v_ins, (0, 0, slot, 0))
                 out = sp_decode_attention(q, kc, vc, kv_pos_new, pos_, "sp",
                                           slopes=slopes)
                 return out, kc, vc

@@ -162,12 +162,13 @@ def cache_shardings(mesh: Mesh, shard_heads: bool = True,
                     shard_seq: bool = False):
     """NamedShardings for KVCache (keys/values/length).
 
-    [layers, batch, seq, kv_heads, head_dim]: batch over dp, kv heads over
-    tp (requires num_kv_heads % tp == 0), seq over sp for long-context.
+    [layers, batch, kv_heads, seq, head_dim] (head-major): batch over dp,
+    kv heads over tp (requires num_kv_heads % tp == 0), seq over sp for
+    long-context.
     """
     from ..models.base import KVCache
-    kv = P(None, "dp", "sp" if shard_seq else None,
-           "tp" if shard_heads else None, None)
+    kv = P(None, "dp", "tp" if shard_heads else None,
+           "sp" if shard_seq else None, None)
     return KVCache(keys=NamedSharding(mesh, kv),
                    values=NamedSharding(mesh, kv),
                    length=NamedSharding(mesh, P()))

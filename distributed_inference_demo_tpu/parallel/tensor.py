@@ -26,8 +26,9 @@ def _tp_param_specs(params: StageParams, cfg: ModelConfig) -> StageParams:
                                  vocab_parallel_embed=False)
 
 
-_CACHE_SPEC = KVCache(keys=P(None, None, None, "tp", None),
-                      values=P(None, None, None, "tp", None),
+# head-major cache [layers, batch, nkv, seq, hd]: shard the kv-head axis
+_CACHE_SPEC = KVCache(keys=P(None, None, "tp", None, None),
+                      values=P(None, None, "tp", None, None),
                       length=P())
 
 

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..models.decoder import stage_forward
+from ..ops.flash_attention import make_flash_attn_impl
 from ..ops.sampling import SamplingParams, sample_logits
 
 
@@ -52,13 +53,31 @@ class InferenceEngine:
     def __init__(self, cfg: ModelConfig, params: StageParams,
                  max_seq: Optional[int] = None,
                  sampling: SamplingParams = SamplingParams(),
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 attn_backend: str = "auto"):
+        """``attn_backend``: "auto" (Pallas flash kernel on TPU, jnp
+        elsewhere), "flash", "flash-interpret" (testing), or "jnp"."""
         self.cfg = cfg
         self.params = params
         self.max_seq = max_seq or cfg.max_seq_len
         self.sampling = sampling
         self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
+
+        if attn_backend == "auto":
+            attn_backend = ("flash" if jax.default_backend() == "tpu"
+                            else "jnp")
+        self.attn_backend = attn_backend
+        if attn_backend == "flash":
+            attn_impl = make_flash_attn_impl()
+        elif attn_backend == "flash-interpret":
+            attn_impl = make_flash_attn_impl(interpret=True)
+        elif attn_backend == "jnp":
+            attn_impl = None
+        else:
+            raise ValueError(
+                f"unknown attn_backend {attn_backend!r}; expected "
+                "'auto', 'flash', 'flash-interpret', or 'jnp'")
 
         cfg_ = cfg
         spec_ = self.spec
@@ -68,7 +87,8 @@ class InferenceEngine:
         def prefill(params, ids, cache):
             b, s = ids.shape
             pos = jnp.broadcast_to(jnp.arange(s), (b, s))
-            logits, cache = stage_forward(params, cfg_, spec_, ids, cache, pos)
+            logits, cache = stage_forward(params, cfg_, spec_, ids, cache,
+                                          pos, attn_impl=attn_impl)
             return logits[:, -1], cache
 
         @partial(jax.jit, donate_argnums=(2,), static_argnums=(4,))
@@ -81,7 +101,7 @@ class InferenceEngine:
                 b = tok.shape[0]
                 pos = jnp.broadcast_to(cache.length, (b, 1))
                 out, cache = stage_forward(params, cfg_, spec_, tok[:, None],
-                                           cache, pos)
+                                           cache, pos, attn_impl=attn_impl)
                 return (out[:, 0], cache, rng), tok
 
             (_, cache, _), toks = jax.lax.scan(
@@ -95,7 +115,7 @@ class InferenceEngine:
             b = tok.shape[0]
             pos = jnp.broadcast_to(cache.length, (b, 1))
             out, cache = stage_forward(params, cfg_, spec_, tok[:, None],
-                                       cache, pos)
+                                       cache, pos, attn_impl=attn_impl)
             return tok, out[:, 0], cache, rng
 
         self._prefill = prefill

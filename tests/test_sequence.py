@@ -35,10 +35,12 @@ def sp_mesh(devices):
 
 
 def _dense_causal(q, k, v, slopes=None):
-    """Reference: ops.attention with cache == the full sequence."""
+    """Reference: ops.attention with cache == the full sequence (the cache
+    layout is head-major [b, nkv, S, hd], so transpose the fresh K/V)."""
     L = q.shape[1]
     q_pos = jnp.broadcast_to(jnp.arange(L), (q.shape[0], L))
-    return attention(q, k, v, q_pos, jnp.asarray(L, jnp.int32), slopes)
+    return attention(q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+                     q_pos, jnp.asarray(L, jnp.int32), slopes)
 
 
 @pytest.mark.parametrize("alibi", [False, True])
@@ -72,25 +74,26 @@ def test_sp_decode_attention_matches_dense(sp_mesh):
     q = jnp.asarray(rng.randn(b, 1, nh, hd), jnp.float32)
     q_pos = jnp.full((b, 1), L, jnp.int32)       # new token at position L
 
-    expected = attention(q, k_dense, v_dense, q_pos,
+    expected = attention(q, k_dense.transpose(0, 2, 1, 3),
+                         v_dense.transpose(0, 2, 1, 3), q_pos,
                          jnp.asarray(L, jnp.int32), None)
 
-    # scatter the dense cache into the sharded layout: rank r slots [0,5)
-    # hold positions [r*5, r*5+5), slots [5,8) are empty (-1).
-    k_shard = np.zeros((b, SP * s_loc, nkv, hd), np.float32)
+    # scatter the dense cache into the sharded head-major layout: rank r
+    # slots [0,5) hold positions [r*5, r*5+5), slots [5,8) are empty (-1).
+    k_shard = np.zeros((b, nkv, SP * s_loc, hd), np.float32)
     v_shard = np.zeros_like(k_shard)
     kv_pos = np.full((SP * s_loc,), -1, np.int32)
     for r in range(SP):
         for j in range(valid_per_rank):
             slot, pos = r * s_loc + j, r * valid_per_rank + j
-            k_shard[:, slot] = np.asarray(k_dense[:, pos])
-            v_shard[:, slot] = np.asarray(v_dense[:, pos])
+            k_shard[:, :, slot] = np.asarray(k_dense[:, pos])
+            v_shard[:, :, slot] = np.asarray(v_dense[:, pos])
             kv_pos[slot] = pos
 
     dec = jax.shard_map(
         lambda q, k, v, kp: sp_decode_attention(q, k, v, kp, q_pos, "sp"),
         mesh=sp_mesh,
-        in_specs=(P(), P(None, "sp"), P(None, "sp"), P("sp")),
+        in_specs=(P(), P(None, None, "sp"), P(None, None, "sp"), P("sp")),
         out_specs=P(), check_vma=False)
     got = dec(q, jnp.asarray(k_shard), jnp.asarray(v_shard),
               jnp.asarray(kv_pos))
