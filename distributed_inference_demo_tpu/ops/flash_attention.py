@@ -39,66 +39,86 @@ from .attention import update_kv_cache
 _NEG = -1e30
 
 
+def _live_kv_blocks(q_start, kv_len, row_blk_idx, rows_blk, groups, block_k):
+    """Number of kv blocks below this row block's causal frontier (>= 1)."""
+    max_pos = q_start + (row_blk_idx * rows_blk + rows_blk - 1) // groups
+    upper = jnp.minimum(kv_len, max_pos + 1)
+    return (upper + block_k - 1) // block_k
+
+
 def _kernel(scalar_ref, q_ref, k_ref, v_ref, slopes_ref, o_ref,
-            *, block_k: int, groups: int, use_alibi: bool):
-    """One program: q-row block of one (batch, kv-head) pair vs the cache.
+            o_acc, m_acc, l_acc, *, block_k: int, groups: int,
+            use_alibi: bool):
+    """Grid (b, nkv, row_blocks, kv_blocks), kv innermost: one step streams
+    one [block_k, hd] K/V block HBM→VMEM and folds it into the online-
+    softmax accumulators held in VMEM scratch (which persists across the
+    sequential grid on TPU).  KV blocks beyond a row block's causal frontier
+    are neither fetched (index map clamps to the last live block — Mosaic
+    skips the DMA when the block index repeats) nor computed (pl.when), so
+    short-cache decode costs O(kv_len) HBM traffic, not O(max_seq).
 
     scalar_ref (SMEM, int32[2]): [q_start, kv_len].
     q_ref:      [1, 1, rows_blk, hd]   (rows = chunk * groups)
-    k_ref/v_ref:[1, 1, max_seq, hd]    (one kv head's cache plane)
+    k_ref/v_ref:[1, 1, block_k, hd]    (one streamed block of the kv plane)
     slopes_ref: [1, 1, groups] f32     (ALiBi slopes of this head group)
     o_ref:      [1, 1, rows_blk, hd]
+    scratch: o_acc [rows_blk, hd] f32; m_acc/l_acc [rows_blk, 128] f32
+    (lane-broadcast storage).
     """
     q_start = scalar_ref[0]
     kv_len = scalar_ref[1]
     rows_blk, hd = q_ref.shape[2], q_ref.shape[3]
     row_blk_idx = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_ki = pl.num_programs(3)
 
-    q = q_ref[0, 0, :, :].astype(jnp.float32)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-    q = q * scale
+    @pl.when(ki == 0)
+    def _init():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, _NEG)
+        l_acc[:] = jnp.zeros_like(l_acc)
 
-    # absolute position of each q row: q_start + global_row // groups
-    row = (row_blk_idx * rows_blk
-           + jax.lax.broadcasted_iota(jnp.int32, (rows_blk, 1), 0))
-    q_pos = q_start + row // groups                       # [rows_blk, 1]
+    n_live = _live_kv_blocks(q_start, kv_len, row_blk_idx, rows_blk, groups,
+                             block_k)
 
-    if use_alibi:
-        slope = slopes_ref[0, 0, :]                       # [groups]
-        slope_row = jnp.tile(slope, rows_blk // groups)[:, None]
+    @pl.when(ki < n_live)
+    def _step():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        q = q * (1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32)))
+        row = (row_blk_idx * rows_blk
+               + jax.lax.broadcasted_iota(jnp.int32, (rows_blk, 1), 0))
+        q_pos = q_start + row // groups                   # [rows_blk, 1]
 
-    # causal frontier for this row block: no kv beyond its last q position
-    # (and never beyond kv_len).
-    max_pos = q_start + (row_blk_idx * rows_blk + rows_blk - 1) // groups
-    upper = jnp.minimum(kv_len, max_pos + 1)
-    num_kv_blocks = pl.cdiv(upper, block_k)
-
-    def body(i, carry):
-        o, m, l = carry
-        k_blk = k_ref[0, 0, pl.ds(i * block_k, block_k), :]  # [bk, hd]
-        v_blk = v_ref[0, 0, pl.ds(i * block_k, block_k), :]
+        k_blk = k_ref[0, 0, :, :]
+        v_blk = v_ref[0, 0, :, :]
         s = jnp.dot(q, k_blk.astype(jnp.float32).T,
-                    preferred_element_type=jnp.float32)      # [rows, bk]
-        kv_pos = (i * block_k
+                    preferred_element_type=jnp.float32)   # [rows, bk]
+        kv_pos = (ki * block_k
                   + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
-        valid = (kv_pos <= q_pos) & (kv_pos < kv_len)        # [rows, bk]
+        valid = (kv_pos <= q_pos) & (kv_pos < kv_len)     # [rows, bk]
         if use_alibi:
+            slope = slopes_ref[0, 0, :]                   # [groups]
+            slope_row = jnp.tile(slope, rows_blk // groups)[:, None]
             s = s - slope_row * (q_pos - kv_pos).astype(jnp.float32)
         s = jnp.where(valid, s, _NEG)
+
+        m = jnp.max(m_acc[:], axis=-1, keepdims=True)     # [rows, 1]
+        l = jnp.max(l_acc[:], axis=-1, keepdims=True)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        o_new = o * alpha + jnp.dot(p, v_blk.astype(jnp.float32),
-                                    preferred_element_type=jnp.float32)
-        return o_new, m_new, l_new
+        o_acc[:] = o_acc[:] * alpha + jnp.dot(
+            p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m_acc[:] = jnp.broadcast_to(m_new, m_acc.shape)
+        l_acc[:] = jnp.broadcast_to(l_new, l_acc.shape)
 
-    o = jnp.zeros((rows_blk, hd), jnp.float32)
-    m = jnp.full((rows_blk, 1), _NEG, jnp.float32)
-    l = jnp.zeros((rows_blk, 1), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, num_kv_blocks, body, (o, m, l))
-    o = o / jnp.maximum(l, 1e-30)
-    o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
+    @pl.when(ki == num_ki - 1)
+    def _finalize():
+        l = jnp.max(l_acc[:], axis=-1, keepdims=True)
+        o_ref[0, 0, :, :] = (o_acc[:]
+                             / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 def _pick_block(total: int, target: int) -> int:
@@ -116,7 +136,13 @@ def _flash_call(q_g, k_cache, v_cache, scalars, slopes, *, block_k,
     b, nkv, rows, hd = q_g.shape
     max_seq = k_cache.shape[2]
     groups = slopes.shape[2]
-    grid = (b, nkv, rows // block_rows)
+    grid = (b, nkv, rows // block_rows, max_seq // block_k)
+
+    def kv_map(bb, h, r, ki, s):
+        # clamp to the causal frontier: beyond-frontier grid steps re-fetch
+        # the same block (no DMA) and skip compute (pl.when in the kernel).
+        live = _live_kv_blocks(s[0], s[1], r, block_rows, groups, block_k)
+        return (bb, h, jnp.minimum(ki, live - 1), 0)
 
     return pl.pallas_call(
         functools.partial(_kernel, block_k=block_k, groups=groups,
@@ -126,15 +152,19 @@ def _flash_call(q_g, k_cache, v_cache, scalars, slopes, *, block_k,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, block_rows, hd),
-                             lambda bb, h, r, s: (bb, h, r, 0)),
-                pl.BlockSpec((1, 1, max_seq, hd),
-                             lambda bb, h, r, s: (bb, h, 0, 0)),
-                pl.BlockSpec((1, 1, max_seq, hd),
-                             lambda bb, h, r, s: (bb, h, 0, 0)),
-                pl.BlockSpec((1, 1, groups), lambda bb, h, r, s: (h, 0, 0)),
+                             lambda bb, h, r, ki, s: (bb, h, r, 0)),
+                pl.BlockSpec((1, 1, block_k, hd), kv_map),
+                pl.BlockSpec((1, 1, block_k, hd), kv_map),
+                pl.BlockSpec((1, 1, groups),
+                             lambda bb, h, r, ki, s: (h, 0, 0)),
             ],
             out_specs=pl.BlockSpec((1, 1, block_rows, hd),
-                                   lambda bb, h, r, s: (bb, h, r, 0)),
+                                   lambda bb, h, r, ki, s: (bb, h, r, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block_rows, hd), jnp.float32),
+                pltpu.VMEM((block_rows, 128), jnp.float32),
+                pltpu.VMEM((block_rows, 128), jnp.float32),
+            ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, nkv, rows, hd), q_g.dtype),
         interpret=interpret,

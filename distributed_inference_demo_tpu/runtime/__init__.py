@@ -1,3 +1,5 @@
 from .engine import InferenceEngine, GenerationResult
+from .elastic import ElasticHeader, ElasticStageRuntime, ElasticWorker
 
-__all__ = ["InferenceEngine", "GenerationResult"]
+__all__ = ["InferenceEngine", "GenerationResult", "ElasticHeader",
+           "ElasticStageRuntime", "ElasticWorker"]

@@ -128,37 +128,60 @@ class PipelineWorker:
         if self.next_id is not None:
             self.transport.send(self.next_id, tag, b"")
 
+    # tag factories — overridable (the elastic runtime appends a reshard
+    # epoch so stale pre-reshard traffic is identifiable and droppable)
+    def _make_h_tag(self, rid: int, step: int) -> str:
+        return _h_tag(rid, step)
+
+    def _make_tok_tag(self, rid: int, step: int) -> str:
+        return _tok_tag(rid, step)
+
     def serve_forever(self, idle_timeout: Optional[float] = None) -> None:
-        """Loop until a ``stop`` message arrives (or idle_timeout expires
-        with no traffic at all)."""
+        """Loop until a ``stop`` message arrives; returns cleanly if
+        ``idle_timeout``/step_timeout expires with no traffic at all."""
+        from ..comm.transport import TransportTimeout
         while True:
-            tag, payload = self.transport.recv_any(
-                timeout=idle_timeout or self.step_timeout)
-            kind, _, rest = tag.partition(":")
-            if kind == "stop":
-                self._forward_control(tag)
+            try:
+                tag, payload = self.transport.recv_any(
+                    timeout=idle_timeout or self.step_timeout)
+            except TransportTimeout:
+                log.info("worker %s: idle timeout, exiting",
+                         self.transport.device_id)
                 return
-            if kind == "end":
-                self.rt.free(int(rest))
-                self._forward_control(tag)
-                continue
-            if kind != "h":
-                log.warning("worker %s: unexpected tag %r",
-                            self.transport.device_id, tag)
-                continue
-            rid_s, _, step_s = rest.partition(":")
-            rid, step = int(rid_s), int(step_s)
-            [x] = wire.deserialize_tensors(payload).tensors
-            out = self.rt.run_chunk(rid, x)
-            if self.rt.spec.is_last:
-                toks = self.rt.sample_tokens(rid, step, out)
-                self.transport.send(
-                    self.header_id, _tok_tag(rid, step),
-                    wire.serialize_tensors([toks]))
-            else:
-                self.transport.send(
-                    self.next_id, _h_tag(rid, step),
-                    wire.serialize_tensors([np.asarray(out)]))
+            if not self.handle_message(tag, payload):
+                return
+
+    def handle_message(self, tag: str, payload: bytes) -> bool:
+        """Process one message; returns False on ``stop``."""
+        kind, _, rest = tag.partition(":")
+        if kind == "stop":
+            self._forward_control(tag)
+            return False
+        if kind == "end":
+            self.rt.free(int(rest.split(":")[0]))
+            self._forward_control(tag)
+            return True
+        if kind != "h":
+            log.warning("worker %s: unexpected tag %r",
+                        self.transport.device_id, tag)
+            return True
+        fields = rest.split(":")
+        rid, step = int(fields[0]), int(fields[1])
+        self._run_and_forward(rid, step, payload)
+        return True
+
+    def _run_and_forward(self, rid: int, step: int, payload: bytes) -> None:
+        [x] = wire.deserialize_tensors(payload).tensors
+        out = self.rt.run_chunk(rid, x)
+        if self.rt.spec.is_last:
+            toks = self.rt.sample_tokens(rid, step, out)
+            self.transport.send(
+                self.header_id, self._make_tok_tag(rid, step),
+                wire.serialize_tensors([toks]))
+        else:
+            self.transport.send(
+                self.next_id, self._make_h_tag(rid, step),
+                wire.serialize_tensors([np.asarray(out)]))
 
 
 @dataclass
@@ -193,9 +216,12 @@ class PipelineHeader:
 
     # -- single-stage degenerate case is the engine's job, not ours --------
 
+    def _make_h_tag(self, rid: int, step: int) -> str:
+        return _h_tag(rid, step)
+
     def _launch(self, req: _Request) -> None:
         hidden = self.rt.run_chunk(req.rid, req.prompt.astype(np.int32))
-        self.transport.send(self.next_id, _h_tag(req.rid, 0),
+        self.transport.send(self.next_id, self._make_h_tag(req.rid, 0),
                             wire.serialize_tensors([np.asarray(hidden)]))
 
     def _advance(self, req: _Request, toks: np.ndarray) -> None:
@@ -210,7 +236,8 @@ class PipelineHeader:
             self.rt.free(req.rid)
             return
         hidden = self.rt.run_chunk(req.rid, toks[:, None].astype(np.int32))
-        self.transport.send(self.next_id, _h_tag(req.rid, req.step),
+        self.transport.send(self.next_id,
+                            self._make_h_tag(req.rid, req.step),
                             wire.serialize_tensors([np.asarray(hidden)]))
 
     def generate_many(self, prompts: Sequence[np.ndarray],
@@ -246,7 +273,7 @@ class PipelineHeader:
             if kind != "tok":
                 log.warning("header: unexpected tag %r", tag)
                 continue
-            rid = int(rest.partition(":")[0])
+            rid = int(rest.split(":")[0])
             req = in_flight.get(rid)
             if req is None:
                 continue
