@@ -1,0 +1,350 @@
+"""Command-line apps: ``serve`` / ``worker`` / ``plan`` / ``generate`` /
+``bench``.
+
+Replaces the reference's entry points (SURVEY.md §7.9): ``server.py``'s
+``__main__`` block + HTTP stub (``server.py:583-1052``), ``client.py``'s
+argparse worker (``client.py:179-190``), and the Android
+``BackgroundService`` driver — as one console tool:
+
+    python -m distributed_inference_demo_tpu serve --model tinyllama-1.1b
+    python -m distributed_inference_demo_tpu serve --model llama-test \\
+        --chain w1@127.0.0.1:7001,w2@127.0.0.1:7002 --elastic
+    python -m distributed_inference_demo_tpu worker --model llama-test ...
+    python -m distributed_inference_demo_tpu plan --model llama-3-8b \\
+        --devices devices.json --save plan.json
+    python -m distributed_inference_demo_tpu generate --model llama-test \\
+        --prompt-ids 1,2,3 --max-new-tokens 8 --greedy
+    python -m distributed_inference_demo_tpu bench --model tinyllama-1.1b
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+def _load_tokenizer(path: Optional[str]):
+    if not path:
+        return None
+    from .tokenizer import Tokenizer
+    return Tokenizer.from_json(path)
+
+
+def _build_engine(args):
+    import jax
+
+    from .models.decoder import init_full_params
+    from .models.loader import load_or_init
+    from .models.registry import get_model_config
+    from .ops.sampling import SamplingParams
+    from .runtime import InferenceEngine
+
+    cfg = get_model_config(args.model)
+    sampling = SamplingParams(greedy=True) if args.greedy else \
+        SamplingParams(temperature=args.temperature, top_k=args.top_k)
+    if getattr(args, "checkpoint", None):
+        params = load_or_init(args.model, cfg, args.checkpoint,
+                              seed=args.weights_seed)
+    else:
+        params = init_full_params(jax.random.PRNGKey(args.weights_seed), cfg)
+    return cfg, InferenceEngine(cfg, params, max_seq=args.max_seq,
+                                sampling=sampling,
+                                attn_backend=args.attn_backend)
+
+
+# ---------------------------------------------------------------------------
+# serve
+# ---------------------------------------------------------------------------
+
+def cmd_serve(args) -> int:
+    """Single-node engine serving, or pipeline-header serving over a worker
+    chain (start the workers first with the ``worker`` subcommand)."""
+    from .runtime.http_server import HeaderBackend, InferenceHTTPServer
+
+    tokenizer = _load_tokenizer(args.tokenizer)
+
+    if args.chain:
+        import jax
+
+        from .comm.transport import ZmqTransport
+        from .models.base import split_layer_ranges
+        from .models.decoder import init_full_params
+        from .models.registry import get_model_config
+        from .ops.sampling import SamplingParams
+        from .runtime.elastic import ElasticHeader, ElasticStageRuntime
+
+        cfg = get_model_config(args.model)
+        full = init_full_params(jax.random.PRNGKey(args.weights_seed), cfg)
+        sampling = SamplingParams(greedy=True) if args.greedy else \
+            SamplingParams(temperature=args.temperature, top_k=args.top_k)
+
+        peers = [p.split("@", 1) for p in args.chain.split(",")]
+        chain = [args.device_id] + [pid for pid, _ in peers]
+        specs = split_layer_ranges(cfg.num_layers, len(chain))
+        transport = ZmqTransport(args.device_id, bind_host=args.bind_host,
+                                 port=args.port)
+        for pid, addr in peers:
+            transport.connect(pid, addr)
+        rt = ElasticStageRuntime(cfg, specs[0], full, args.max_seq, sampling)
+        header = ElasticHeader(rt, transport, chain,
+                               step_timeout=args.step_timeout)
+        # initial reshard pushes the authoritative layer plan to the chain —
+        # workers may start with any placeholder range (cli worker --elastic
+        # defaults to the full model) and are aligned here.
+        header.reshard(chain)
+        backend = HeaderBackend(header, max_seq=args.max_seq)
+        print(f"SERVE_PIPELINE {chain} ranges="
+              f"{[(s.layer_start, s.layer_end) for s in specs]}", flush=True)
+    else:
+        cfg, engine = _build_engine(args)
+        backend = engine
+        print(f"SERVE_ENGINE {args.model} attn={engine.attn_backend}",
+              flush=True)
+
+    server = InferenceHTTPServer(backend, host=args.http_host,
+                                 port=args.http_port, tokenizer=tokenizer,
+                                 model_name=args.model,
+                                 default_max_new=args.max_new_tokens)
+    print(f"HTTP_READY http://{server.host}:{server.port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+
+def cmd_worker(args) -> int:
+    """One pipeline stage process (see runtime/worker_main.py); ``--elastic``
+    makes it reshard-capable (holds full weights, accepts live migration)."""
+    from .runtime import worker_main
+
+    if not args.elastic:
+        return worker_main.main(args.rest)
+
+    import jax
+
+    from .comm.transport import ZmqTransport
+    from .models.base import StageSpec
+    from .models.decoder import init_full_params
+    from .models.registry import get_model_config
+    from .ops.sampling import SamplingParams
+    from .runtime.elastic import ElasticStageRuntime, ElasticWorker
+
+    ap = argparse.ArgumentParser(prog="worker --elastic")
+    for a in ("--model", "--device-id", "--header"):
+        ap.add_argument(a, required=True)
+    # stage placement is optional: the serving header pushes the real plan
+    # via an initial reshard, so these are placeholders for standalone use.
+    ap.add_argument("--stage-id", type=int, default=1)
+    ap.add_argument("--num-stages", type=int, default=2)
+    ap.add_argument("--layer-start", type=int, default=0)
+    ap.add_argument("--layer-end", type=int, default=-1,
+                    help="-1 = whole model (placeholder until reshard)")
+    ap.add_argument("--bind-host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--next", default="")
+    ap.add_argument("--max-seq", type=int, default=256)
+    ap.add_argument("--weights-seed", type=int, default=0)
+    ap.add_argument("--greedy", action="store_true")
+    ap.add_argument("--temperature", type=float, default=0.7)
+    ap.add_argument("--top-k", type=int, default=7)
+    ap.add_argument("--step-timeout", type=float, default=120.0)
+    a = ap.parse_args(args.rest)
+
+    cfg = get_model_config(a.model)
+    full = init_full_params(jax.random.PRNGKey(a.weights_seed), cfg)
+    sampling = SamplingParams(greedy=True) if a.greedy else \
+        SamplingParams(temperature=a.temperature, top_k=a.top_k)
+    layer_end = a.layer_end if a.layer_end >= 0 else cfg.num_layers
+    spec = StageSpec(a.stage_id, a.num_stages, a.layer_start, layer_end)
+    rt = ElasticStageRuntime(cfg, spec, full, a.max_seq, sampling)
+    transport = ZmqTransport(a.device_id, bind_host=a.bind_host, port=a.port)
+    next_id = None
+    if a.next:
+        next_id, next_addr = a.next.split("@", 1)
+        transport.connect(next_id, next_addr)
+    header_id, header_addr = a.header.split("@", 1)
+    transport.connect(header_id, header_addr)
+    worker = ElasticWorker(rt, transport, next_id=next_id,
+                           header_id=header_id, step_timeout=a.step_timeout)
+    print(f"WORKER_READY {a.device_id} {transport.address}", flush=True)
+    try:
+        worker.serve_forever()
+    finally:
+        transport.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# plan
+# ---------------------------------------------------------------------------
+
+def cmd_plan(args) -> int:
+    """Offline partition planning from device profiles (the planner the
+    reference commented out, ``server.py:879-891``, made a first-class
+    tool)."""
+    from .models.registry import get_model_config
+    from .planner.cost_model import model_cost_profile
+    from .planner.planner import (DeviceProfile, PartitionPlan,
+                                  plan_partition, round_robin_plan,
+                                  save_plan_cache)
+
+    cfg = get_model_config(args.model)
+    if args.load:
+        with open(args.load) as f:
+            plan = PartitionPlan.from_json(json.load(f))   # validates shape
+        if plan.model != args.model:
+            print(f"cached plan is for {plan.model!r}, not {args.model!r}",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(plan.to_json(), indent=2))
+        return 0
+
+    with open(args.devices) as f:
+        dev_json = json.load(f)
+    devs = [DeviceProfile(**d) for d in dev_json]
+    if args.round_robin:
+        plan = round_robin_plan(cfg, args.model, devs)
+    else:
+        plan = plan_partition(cfg, args.model, devs, ctx=args.ctx,
+                              profile=model_cost_profile(cfg, ctx=args.ctx))
+    if args.save:
+        save_plan_cache(args.save, plan)
+    print(json.dumps(plan.to_json(), indent=2))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# generate / bench
+# ---------------------------------------------------------------------------
+
+def cmd_generate(args) -> int:
+    """One-shot local generation (ids in, ids/text out)."""
+    import numpy as np
+
+    tokenizer = _load_tokenizer(args.tokenizer)
+    if args.prompt_ids:
+        ids = np.asarray([[int(t) for t in args.prompt_ids.split(",")]],
+                         dtype=np.int32)
+    elif args.prompt is not None:
+        if tokenizer is None:
+            print("--prompt requires --tokenizer", file=sys.stderr)
+            return 1
+        ids = np.asarray([tokenizer.encode(args.prompt)], dtype=np.int32)
+    else:
+        print("need --prompt-ids or --prompt", file=sys.stderr)
+        return 1
+
+    _, engine = _build_engine(args)
+    res = engine.generate(ids, args.max_new_tokens, seed=args.seed)
+    out = {"tokens": res.tokens.tolist(),
+           "tokens_per_second": res.tokens_per_second}
+    if tokenizer is not None:
+        out["text"] = [tokenizer.decode(r) for r in res.tokens.tolist()]
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    """Engine decode benchmark (same shape as the repo-root bench.py)."""
+    import time
+
+    import numpy as np
+
+    _, engine = _build_engine(args)
+    prompt = np.arange(args.batch * args.prompt_len).reshape(
+        args.batch, args.prompt_len) % 1000
+    engine.generate(prompt, args.max_new_tokens, seed=0)       # compile
+    res = engine.generate(prompt, args.max_new_tokens, seed=0)
+    print(json.dumps({
+        "metric": f"decode tokens/sec ({args.model}, batch={args.batch}, "
+                  f"prompt={args.prompt_len}, new={args.max_new_tokens})",
+        "value": round(res.tokens_per_second, 2),
+        "unit": "tokens/sec",
+    }))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+def _add_engine_args(ap):
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--max-seq", type=int, default=1024)
+    ap.add_argument("--max-new-tokens", type=int, default=128)
+    ap.add_argument("--weights-seed", type=int, default=0)
+    ap.add_argument("--checkpoint", default="",
+                    help="local safetensors dir (else random init)")
+    ap.add_argument("--tokenizer", default="",
+                    help="tokenizer.json path for text in/out")
+    ap.add_argument("--greedy", action="store_true")
+    ap.add_argument("--temperature", type=float, default=0.7)
+    ap.add_argument("--top-k", type=int, default=7)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--attn-backend", default="auto",
+                    choices=["auto", "flash", "flash-interpret", "jnp"])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="distributed_inference_demo_tpu",
+        description="TPU-native distributed LLM inference framework")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("serve", help="HTTP inference server")
+    _add_engine_args(s)
+    s.add_argument("--http-host", default="127.0.0.1")
+    s.add_argument("--http-port", type=int, default=5000)
+    s.add_argument("--chain", default="",
+                   help="pipeline mode: comma list of workerid@host:port")
+    s.add_argument("--device-id", default="header")
+    s.add_argument("--bind-host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=0,
+                   help="data-plane port (pipeline mode)")
+    s.add_argument("--step-timeout", type=float, default=120.0)
+    s.set_defaults(fn=cmd_serve)
+
+    w = sub.add_parser("worker", help="pipeline stage worker",
+                       add_help=False)
+    w.add_argument("--elastic", action="store_true")
+    w.set_defaults(fn=cmd_worker)
+
+    p = sub.add_parser("plan", help="partition planning")
+    p.add_argument("--model", required=True)
+    p.add_argument("--devices", help="JSON file: list of DeviceProfile")
+    p.add_argument("--ctx", type=int, default=1024)
+    p.add_argument("--round-robin", action="store_true",
+                   help="reference-parity round robin instead of the "
+                        "cost-model DP")
+    p.add_argument("--save", default="")
+    p.add_argument("--load", default="")
+    p.set_defaults(fn=cmd_plan)
+
+    g = sub.add_parser("generate", help="one-shot local generation")
+    _add_engine_args(g)
+    g.add_argument("--prompt-ids", default="")
+    g.add_argument("--prompt", default=None)
+    g.set_defaults(fn=cmd_generate)
+
+    b = sub.add_parser("bench", help="decode throughput benchmark")
+    _add_engine_args(b)
+    b.add_argument("--batch", type=int, default=8)
+    b.add_argument("--prompt-len", type=int, default=64)
+    b.set_defaults(fn=cmd_bench)
+
+    args, rest = ap.parse_known_args(argv)
+    args.rest = rest
+    if args.cmd == "plan" and not (args.devices or args.load):
+        ap.error("plan needs --devices or --load")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

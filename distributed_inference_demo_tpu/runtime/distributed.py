@@ -240,13 +240,9 @@ class PipelineHeader:
                             self._make_h_tag(req.rid, req.step),
                             wire.serialize_tensors([np.asarray(hidden)]))
 
-    def generate_many(self, prompts: Sequence[np.ndarray],
-                      max_new_tokens: int,
-                      pool_size: int = 1) -> List[np.ndarray]:
-        """Generate for all prompts with ``pool_size`` requests in flight
-        (the reference's corePoolSize microbatching,
-        ``Communication.java:425-437``).  Returns [b, new_tokens] arrays in
-        prompt order."""
+    def _make_requests(self, prompts: Sequence[np.ndarray],
+                       max_new_tokens: int) -> List[_Request]:
+        """Capacity-check every prompt and mint _Requests with fresh rids."""
         for p in prompts:
             need = p.shape[1] + max_new_tokens
             if need > self.rt.max_seq:
@@ -258,7 +254,16 @@ class PipelineHeader:
                      max_new_tokens=max_new_tokens)
             for i, p in enumerate(prompts)]
         self._next_rid += len(pending)
-        by_rid = {r.rid: r for r in pending}
+        return pending
+
+    def generate_many(self, prompts: Sequence[np.ndarray],
+                      max_new_tokens: int,
+                      pool_size: int = 1) -> List[np.ndarray]:
+        """Generate for all prompts with ``pool_size`` requests in flight
+        (the reference's corePoolSize microbatching,
+        ``Communication.java:425-437``).  Returns [b, new_tokens] arrays in
+        prompt order."""
+        pending = self._make_requests(prompts, max_new_tokens)
         queue = list(pending)
         in_flight: Dict[int, _Request] = {}
 
@@ -282,7 +287,7 @@ class PipelineHeader:
             if req.done:
                 del in_flight[rid]
 
-        return [np.stack(by_rid[r.rid].tokens, axis=1) for r in pending]
+        return [np.stack(r.tokens, axis=1) for r in pending]
 
     def generate(self, prompt_ids: np.ndarray,
                  max_new_tokens: int) -> np.ndarray:

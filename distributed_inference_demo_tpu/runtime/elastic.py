@@ -116,6 +116,17 @@ class ElasticWorker(PipelineWorker):
         kind, _, rest = tag.partition(":")
         if kind == "reshard":
             plan = json.loads(payload.decode("utf-8"))
+            if plan.get("park"):
+                # dropped from the chain but alive: free every cache and
+                # stand by as a spare for a future scale-up.
+                self.rt.caches.clear()
+                self.epoch = plan["epoch"]
+                self.next_id = None
+                self.transport.send(rest,
+                                    f"rack:{self.transport.device_id}", b"")
+                log.info("worker %s: parked (epoch %d)",
+                         self.transport.device_id, self.epoch)
+                return True
             self.rt.reassign(_spec_from(plan["spec"]))
             self.next_id = plan["next_id"]
             self.epoch = plan["epoch"]
@@ -191,11 +202,15 @@ class ElasticHeader(PipelineHeader):
     # -- re-planning + migration ------------------------------------------
 
     def reshard(self, chain: Sequence[str],
-                in_flight: Optional[Dict[int, "_Request"]] = None) -> None:
+                in_flight: Optional[Dict[int, "_Request"]] = None,
+                dead: Sequence[str] = ()) -> None:
         """Re-split layers over ``chain``, push the plan, resume requests.
 
         ``chain`` must start with the header and contain only live workers
-        (longer than before for scale-up, shorter after failures).
+        (longer than before for scale-up, shorter after failures or planned
+        scale-down).  ``dead`` lists devices known unreachable — live
+        workers dropped from the chain but not in ``dead`` are **parked**:
+        told to free their caches and stand by as spares.
         """
         chain = list(chain)
         if chain[0] != self.transport.device_id:
@@ -212,11 +227,18 @@ class ElasticHeader(PipelineHeader):
         # push plans to workers (everyone but us), then collect acks;
         # stray data messages racing the reshard are dropped (their caches
         # are invalid anyway — requests restart below).
-        expected_acks = set(chain[1:])
+        parked = [d for d in self.chain[1:]
+                  if d not in chain and d not in dead]
+        expected_acks = set(chain[1:]) | set(parked)
         for i, dev in enumerate(chain[1:], start=1):
             nxt = chain[i + 1] if i + 1 < len(chain) else None
             plan = {"spec": _spec_payload(specs[i]), "next_id": nxt,
                     "epoch": self.epoch}
+            self.transport.send(
+                dev, f"reshard:{self.transport.device_id}",
+                json.dumps(plan).encode("utf-8"))
+        for dev in parked:      # live but out of the chain: free + stand by
+            plan = {"park": True, "epoch": self.epoch}
             self.transport.send(
                 dev, f"reshard:{self.transport.device_id}",
                 json.dumps(plan).encode("utf-8"))
@@ -259,17 +281,7 @@ class ElasticHeader(PipelineHeader):
     def generate_many(self, prompts: Sequence[np.ndarray],
                       max_new_tokens: int,
                       pool_size: int = 1) -> List[np.ndarray]:
-        for p in prompts:
-            need = p.shape[1] + max_new_tokens
-            if need > self.rt.max_seq:
-                raise ValueError(
-                    f"prompt ({p.shape[1]}) + new ({max_new_tokens}) = "
-                    f"{need} exceeds KV capacity {self.rt.max_seq}")
-        pending = [
-            _Request(rid=self._next_rid + i, prompt=np.asarray(p),
-                     max_new_tokens=max_new_tokens)
-            for i, p in enumerate(prompts)]
-        self._next_rid += len(pending)
+        pending = self._make_requests(prompts, max_new_tokens)
         queue = list(pending)
         in_flight: Dict[int, _Request] = {}
         last_progress = time.monotonic()
@@ -278,13 +290,19 @@ class ElasticHeader(PipelineHeader):
             failed = self._take_failures()
             if failed:
                 alive = [d for d in self.chain if d not in failed]
-                self.reshard(alive, in_flight)
+                self.reshard(alive, in_flight, dead=failed)
                 last_progress = time.monotonic()
 
             while queue and len(in_flight) < pool_size:
                 req = queue.pop(0)
                 in_flight[req.rid] = req
-                self._launch(req)
+                try:
+                    self._launch(req)
+                except TransportError:
+                    # first hop unreachable: hold the request in flight;
+                    # the failure signal will reshard and relaunch it.
+                    log.warning("header: launch of rid=%d failed "
+                                "(next hop down?)", req.rid)
 
             try:
                 tag, payload = self.transport.recv_any(
@@ -307,10 +325,15 @@ class ElasticHeader(PipelineHeader):
             if req is None or step != req.step:
                 continue       # duplicate or out-of-order token
             [toks] = wire.deserialize_tensors(payload).tensors
-            self._advance(req, toks)
+            try:
+                self._advance(req, toks)
+            except TransportError:
+                # token is recorded; the follow-up send failed — the
+                # failure signal will reshard and relaunch from tokens.
+                log.warning("header: advance send for rid=%d failed "
+                            "(next hop down?)", rid)
             last_progress = time.monotonic()
             if req.done:
                 del in_flight[rid]
 
-        by_rid = {r.rid: r for r in pending}
-        return [np.stack(by_rid[r.rid].tokens, axis=1) for r in pending]
+        return [np.stack(r.tokens, axis=1) for r in pending]

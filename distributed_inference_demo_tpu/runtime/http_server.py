@@ -1,0 +1,176 @@
+"""HTTP inference endpoint: the working replacement for the reference's stub.
+
+The reference parses HTTP by hand off a raw socket and answers every
+inference request with ``"Inference not implemented yet"``
+(``server.py:539-678``).  Here: a stdlib ``ThreadingHTTPServer`` exposing
+
+- ``GET  /health``    — model, device, capacity
+- ``POST /generate``  — ``{"prompt_ids": [[...]], "max_new_tokens": N,
+  "stream": false}`` → ``{"tokens": [[...]]}``; with ``"prompt": "text"``
+  when a tokenizer is attached; ``"stream": true`` switches to chunked
+  JSONL, one ``{"step": i, "tokens": [...]}`` line per decoded step (the
+  reference streams partial decodes to its UI via DataRepository,
+  ``Communication.java:629-638`` — this is that capability as an API).
+
+The backend is anything with the engine surface (``generate`` /
+``generate_stream``): the single-chip ``InferenceEngine``, or an
+``ElasticHeader`` via :class:`HeaderBackend`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+class HeaderBackend:
+    """Adapts a PipelineHeader/ElasticHeader to the engine surface used by
+    the HTTP handler (generate + generate_stream)."""
+
+    def __init__(self, header, max_seq: int):
+        self.header = header
+        self.max_seq = max_seq
+        self._lock = threading.Lock()   # one pipeline run at a time
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 seed: int = 0):
+        with self._lock:
+            toks = self.header.generate(np.asarray(prompt_ids),
+                                        max_new_tokens)
+
+        class R:          # minimal GenerationResult shape
+            tokens = toks
+        return R()
+
+    def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                        seed: int = 0):
+        # the pipeline returns tokens all at once; stream them per step
+        res = self.generate(prompt_ids, max_new_tokens, seed)
+        for i in range(res.tokens.shape[1]):
+            yield res.tokens[:, i]
+
+
+class InferenceHTTPServer:
+    """Threaded HTTP server over an engine-like backend."""
+
+    def __init__(self, backend, host: str = "127.0.0.1", port: int = 0,
+                 tokenizer=None, model_name: str = "",
+                 default_max_new: int = 128):
+        self.backend = backend
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.default_max_new = default_max_new
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):   # quiet by default
+                pass
+
+            def _json(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    import jax
+                    self._json(200, {
+                        "status": "ok",
+                        "model": outer.model_name,
+                        "backend": type(outer.backend).__name__,
+                        "device": str(jax.devices()[0]),
+                        "max_seq": getattr(outer.backend, "max_seq", None),
+                    })
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    ids = outer._prompt_ids(req)
+                    max_new = int(req.get("max_new_tokens",
+                                          outer.default_max_new))
+                    seed = int(req.get("seed", 0))
+                except (ValueError, KeyError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                try:
+                    if req.get("stream"):
+                        self._stream(ids, max_new, seed)
+                    else:
+                        res = outer.backend.generate(ids, max_new, seed=seed)
+                        out = {"tokens": res.tokens.tolist()}
+                        if outer.tokenizer is not None:
+                            out["text"] = [outer.tokenizer.decode(row)
+                                           for row in res.tokens.tolist()]
+                        self._json(200, out)
+                except ValueError as e:     # capacity etc.
+                    self._json(400, {"error": str(e)})
+
+            def _stream(self, ids, max_new, seed):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes) -> None:
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+
+                for i, toks in enumerate(outer.backend.generate_stream(
+                        ids, max_new, seed=seed)):
+                    line = {"step": i, "tokens": np.asarray(toks).tolist()}
+                    if outer.tokenizer is not None:
+                        line["text"] = [outer.tokenizer.decode([t])
+                                        for t in np.asarray(toks).tolist()]
+                    chunk((json.dumps(line) + "\n").encode("utf-8"))
+                chunk(b"")      # terminating chunk
+                self.wfile.flush()
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self.httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def _prompt_ids(self, req: dict) -> np.ndarray:
+        if "prompt_ids" in req:
+            ids = np.asarray(req["prompt_ids"], dtype=np.int32)
+            if ids.ndim == 1:
+                ids = ids[None, :]
+            if ids.ndim != 2 or ids.size == 0:
+                raise ValueError("prompt_ids must be a non-empty 1D/2D list")
+            return ids
+        if "prompt" in req:
+            if self.tokenizer is None:
+                raise ValueError(
+                    "text prompt given but no tokenizer is attached; "
+                    "send prompt_ids or start the server with --tokenizer")
+            ids = self.tokenizer.encode(str(req["prompt"]))
+            return np.asarray([ids], dtype=np.int32)
+        raise ValueError("request needs 'prompt_ids' or 'prompt'")
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=10)

@@ -109,18 +109,25 @@ def _stop_all(header, extra_ids=()):
         header.transport.send(dev, "stop", b"")
 
 
-def test_live_migration_scale_down():
-    """Planned migration: 3 stages -> 2; both configurations must match the
-    reference (the ModifySession capability, with a working trigger)."""
+def test_live_migration_scale_down_park_and_rejoin():
+    """Planned migration: 3 stages -> 2 (the dropped live worker is parked:
+    caches freed, standing by) -> back to 3 (the spare rejoins).  Every
+    configuration must match the reference (the ModifySession capability,
+    with a working trigger)."""
     want = reference_tokens(PROMPT, 10)
     header, workers, threads = build_elastic(3)
     got3 = header.generate(PROMPT, 10)
     np.testing.assert_array_equal(got3, want)
 
     header.reshard(["s0", "s1"])          # drop s2, re-split layers
+    assert workers[1].rt.caches == {}     # s2 parked: caches freed
     got2 = header.generate(PROMPT, 10)
     np.testing.assert_array_equal(got2, want)
-    _stop_all(header, extra_ids=["s2"])
+    assert workers[1].rt.caches == {}     # parked spare saw no traffic
+
+    header.reshard(["s0", "s1", "s2"])    # the parked spare rejoins
+    np.testing.assert_array_equal(header.generate(PROMPT, 10), want)
+    _stop_all(header)
     for t in threads:
         t.join(timeout=30)
 
