@@ -1,0 +1,157 @@
+"""Checkpoint/resume: params + train-state persistence (orbax-backed).
+
+The reference has no checkpointing at all (SURVEY.md §5.4) — its closest
+mechanisms are plan caching (``ip_module.json`` reload, ``server.py:805-820``
+→ ours: planner.save_plan_cache/load_cached_plan), on-device model caching
+(``skip_model_transmission``, ``server.py:1009`` → ours: local checkpoint
+dirs), and the live session swap (→ runtime/elastic.py).  This module adds
+the missing piece: durable, versioned model/optimizer state.
+
+- :func:`save_params` / :func:`load_params` — one-shot parameter trees with
+  a JSON metadata sidecar (model name, config echo, user metadata); loading
+  validates the model name and restores onto abstract shapes derived from
+  the config, so dtypes/shapes survive exactly.
+- :class:`TrainCheckpointManager` — step-versioned {params, opt_state}
+  checkpoints with retention (``max_to_keep``), ``latest_step`` discovery
+  and crash-resume semantics.
+
+Works for quantized trees too: QuantizedArray is a registered pytree, so
+int8 weights round-trip without special cases.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+
+from .models.base import ModelConfig, StageParams
+from .models.decoder import init_full_params
+
+_META = "framework_meta.json"
+
+
+def _abstract_params(cfg: ModelConfig, seed: int = 0):
+    """Shape/dtype skeleton of a full parameter tree, no materialization.
+    Mirrors models.loader.load_or_init: int8 configs get the quantized
+    tree structure (QuantizedArray leaves)."""
+    from .ops.quant import maybe_quantize
+    return jax.eval_shape(lambda: maybe_quantize(
+        init_full_params(jax.random.PRNGKey(seed), cfg), cfg))
+
+
+def save_params(path: str, params: StageParams, cfg: ModelConfig,
+                model_name: str, metadata: Optional[dict] = None) -> None:
+    """Write a parameter checkpoint + metadata sidecar at ``path``."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, "params"), params, force=True)
+    meta = {
+        "model": model_name,
+        "quantization": cfg.quantization,
+        "num_layers": cfg.num_layers,
+        "hidden_size": cfg.hidden_size,
+        "vocab_size": cfg.vocab_size,
+        "metadata": metadata or {},
+    }
+    tmp = os.path.join(path, _META + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2)
+    os.replace(tmp, os.path.join(path, _META))
+
+
+def load_params(path: str, cfg: ModelConfig,
+                model_name: Optional[str] = None
+                ) -> Tuple[StageParams, dict]:
+    """Restore a parameter checkpoint; validates model identity when
+    ``model_name`` is given.  Returns (params, metadata dict)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    if model_name is not None and meta["model"] != model_name:
+        raise ValueError(
+            f"checkpoint at {path} is for model {meta['model']!r}, "
+            f"not {model_name!r}")
+    for field, want in (("num_layers", cfg.num_layers),
+                        ("hidden_size", cfg.hidden_size),
+                        ("vocab_size", cfg.vocab_size),
+                        ("quantization", cfg.quantization)):
+        if meta.get(field) != want:
+            raise ValueError(
+                f"checkpoint {field}={meta.get(field)!r} does not match "
+                f"config {field}={want!r}")
+    template = _abstract_params(cfg)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        params = ckptr.restore(os.path.join(path, "params"), item=template)
+    return params, meta
+
+
+class TrainCheckpointManager:
+    """Step-versioned {params, opt_state} checkpoints with retention.
+
+    Usage::
+
+        mgr = TrainCheckpointManager(dir, cfg, optimizer, max_to_keep=3)
+        step0, params, opt_state = mgr.restore_or_init(seed=0)  # resume
+        ...
+        mgr.save(step, params, opt_state)
+    """
+
+    def __init__(self, directory: str, cfg: ModelConfig, optimizer: Any,
+                 max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+        self.cfg = cfg
+        self.optimizer = optimizer
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def save(self, step: int, params: StageParams, opt_state: Any,
+             wait: bool = True) -> None:
+        import orbax.checkpoint as ocp
+        self._mgr.save(step, args=ocp.args.PyTreeSave(
+            {"params": params, "opt_state": opt_state}))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def restore(self, step: Optional[int] = None):
+        """Restore (params, opt_state) at ``step`` (default: latest)."""
+        import orbax.checkpoint as ocp
+        step = step if step is not None else self.latest_step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        skel = _abstract_params(self.cfg)
+        template = {
+            "params": skel,
+            "opt_state": jax.eval_shape(self.optimizer.init, skel),
+        }
+        out = self._mgr.restore(step,
+                                args=ocp.args.PyTreeRestore(item=template))
+        return out["params"], out["opt_state"]
+
+    def restore_or_init(self, seed: int = 0):
+        """Crash-resume entry point: (step, params, opt_state) from the
+        latest checkpoint, or step 0 with fresh init when none exists."""
+        if self.latest_step is not None:
+            params, opt_state = self.restore()
+            return self.latest_step, params, opt_state
+        from .ops.quant import maybe_quantize
+        params = maybe_quantize(
+            init_full_params(jax.random.PRNGKey(seed), self.cfg), self.cfg)
+        return 0, params, self.optimizer.init(params)
+
+    def close(self) -> None:
+        self._mgr.close()

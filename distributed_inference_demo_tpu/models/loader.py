@@ -93,6 +93,14 @@ def load_or_init(model_name: str, cfg: ModelConfig,
     """
     import jax
     if checkpoint_dir and os.path.isdir(checkpoint_dir):
+        from ..checkpoint import _META
+        if os.path.exists(os.path.join(checkpoint_dir, _META)):
+            # our own orbax checkpoint format (checkpoint.save_params) —
+            # already quantized as saved, so return directly.
+            from ..checkpoint import load_params
+            params, _ = load_params(checkpoint_dir, cfg,
+                                    model_name=model_name)
+            return params
         if cfg.family in ("llama",):
             params = load_llama_params(checkpoint_dir, cfg)
         else:
@@ -101,10 +109,5 @@ def load_or_init(model_name: str, cfg: ModelConfig,
                 "model-card subsystem; use random init")
     else:
         params = init_full_params(jax.random.PRNGKey(seed), cfg)
-    if cfg.quantization == "int8":
-        from ..ops.quant import quantize_layer_params
-        params = StageParams(
-            layers=quantize_layer_params(params.layers),
-            embed=params.embed, final_norm=params.final_norm,
-            lm_head=params.lm_head)
-    return params
+    from ..ops.quant import maybe_quantize
+    return maybe_quantize(params, cfg)

@@ -69,6 +69,18 @@ def quantize_layer_params(layers: dict) -> dict:
             for k, v in layers.items()}
 
 
+def maybe_quantize(params, cfg):
+    """Apply the config's quantization mode to a full StageParams tree
+    (no-op for "none").  The one shared site for the int8 rewrap used by
+    loader / checkpoint / tests."""
+    if cfg.quantization != "int8":
+        return params
+    from ..models.base import StageParams
+    return StageParams(layers=quantize_layer_params(params.layers),
+                       embed=params.embed, final_norm=params.final_norm,
+                       lm_head=params.lm_head)
+
+
 def dense(x: jax.Array, w: Union[jax.Array, QuantizedArray],
           eq: str) -> jax.Array:
     """einsum that transparently handles quantized weights.
