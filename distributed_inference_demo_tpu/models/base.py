@@ -34,7 +34,7 @@ import jax.numpy as jnp
                       "max_seq_len", "rope_theta", "norm_eps", "dtype_name",
                       "tie_embeddings", "use_alibi", "use_rope",
                       "attn_layernorm", "num_experts", "experts_per_token",
-                      "quantization"])
+                      "moe_capacity_factor", "quantization"])
 @dataclass(frozen=True)
 class ModelConfig:
     """Static, hashable architecture description shared by all model families.
@@ -66,6 +66,9 @@ class ModelConfig:
     # MoE (mixtral): 0 experts means dense MLP
     num_experts: int = 0
     experts_per_token: int = 2
+    # expert-parallel dispatch capacity: slots per expert =
+    # ceil(tokens * k / num_experts * factor); over-capacity tokens drop
+    moe_capacity_factor: float = 2.0
     # weight-only quantization: "none" | "int8" (ops/quant.py)
     quantization: str = "none"
 

@@ -101,12 +101,16 @@ def init_full_params(rng: jax.Array, cfg: ModelConfig) -> StageParams:
 # ---------------------------------------------------------------------------
 
 def _mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
-         tp_axis: Optional[str] = None) -> jnp.ndarray:
+         tp_axis: Optional[str] = None,
+         ep_axis: Optional[str] = None) -> jnp.ndarray:
     """MLP block.  Under manual TP (``tp_axis`` set inside shard_map),
     w_gate/w_up arrive column-sliced and w_down row-sliced: the partial
     products are summed with an explicit psum (Megatron layout); biases are
-    added once, after the reduction."""
+    added once, after the reduction.  ``ep_axis`` selects the expert-
+    parallel all_to_all dispatch path for MoE layers."""
     if cfg.num_experts > 0:
+        if ep_axis is not None:
+            return _moe_mlp_ep(cfg, lp, x, ep_axis)
         return _moe_mlp(cfg, lp, x, tp_axis)
     if cfg.family == "bloom":
         # under manual TP, b_up arrives column-sliced (P(None, "tp")) to
@@ -174,12 +178,76 @@ def _default_attn(q, k, v, k_cache, v_cache, positions, cache_start, slopes):
     return out, k_cache, v_cache
 
 
+def _moe_mlp_ep(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+                ep_axis: str) -> jnp.ndarray:
+    """Expert-parallel MoE: GShard-style capacity dispatch + all_to_all.
+
+    BASELINE.json config #4 ("per-expert shard placement") done the TPU
+    way: experts live sharded over the ``ep`` mesh axis (this rank holds
+    ``E/n`` experts' weights — ``lp["w_*"]`` arrive E-sliced inside
+    shard_map), tokens are data-parallel over the same axis.  Each rank
+    routes its tokens into per-expert capacity slots
+    (``C = ceil(T*k/E * moe_capacity_factor)``, over-capacity tokens drop
+    — exactness for tests comes from a generous factor), one
+    ``all_to_all`` ships slot buffers to the expert owners, the expert
+    MLPs run batched on the MXU ([e_loc, n*C, H] x [e_loc, H, I]), and a
+    reverse ``all_to_all`` brings outputs home for the weighted combine.
+
+    Dispatch/combine are one-hot einsums (dense [T, E, C] masks): static
+    shapes, no gather/scatter — the XLA-friendly formulation.
+    """
+    import math
+    b, s, H = x.shape
+    T = b * s
+    E, k = cfg.num_experts, cfg.experts_per_token
+    n = jax.lax.axis_size(ep_axis)
+    e_loc = lp["w_gate"].shape[0]       # E-sliced inside shard_map
+    assert e_loc * n == E, (e_loc, n, E)
+    xt = x.reshape(T, H)
+
+    logits = dense(xt, lp["router"], "th,he->te").astype(jnp.float32)
+    topv, topi = jax.lax.top_k(logits, k)                  # [T, k]
+    weights = jax.nn.softmax(topv, axis=-1)                # [T, k]
+
+    C = int(math.ceil(T * k / E * cfg.moe_capacity_factor))
+    onehot = jax.nn.one_hot(topi, E, dtype=jnp.int32)      # [T, k, E]
+    flat = onehot.reshape(T * k, E)
+    pos = jnp.cumsum(flat, axis=0) - 1                     # slot per expert
+    keep = (flat > 0) & (pos < C)
+    slot = jnp.where(keep, pos, C)                         # C -> dropped
+    disp = jax.nn.one_hot(slot, C, dtype=jnp.float32)      # [T*k, E, C]
+    disp_t = disp.reshape(T, k, E, C).sum(1)               # [T, E, C]
+    comb = (disp * weights.reshape(T * k)[:, None, None]
+            ).reshape(T, k, E, C).sum(1)                   # [T, E, C]
+
+    expert_in = jnp.einsum("tec,th->ech", disp_t,
+                           xt.astype(jnp.float32))         # [E, C, H]
+    ein = expert_in.reshape(n, e_loc, C, H)
+    ein = jax.lax.all_to_all(ein, ep_axis, split_axis=0, concat_axis=0)
+    h_in = ein.transpose(1, 0, 2, 3).reshape(e_loc, n * C, H)
+    h_in = h_in.astype(x.dtype)
+
+    gate = dense(h_in, lp["w_gate"], "ech,ehi->eci")
+    up = dense(h_in, lp["w_up"], "ech,ehi->eci")
+    hh = (jax.nn.silu(gate.astype(jnp.float32))
+          * up.astype(jnp.float32)).astype(x.dtype)
+    out = dense(hh, lp["w_down"], "eci,eih->ech")          # [e_loc, n*C, H]
+
+    out = out.reshape(e_loc, n, C, H).transpose(1, 0, 2, 3)
+    out = jax.lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0)
+    expert_out = out.reshape(E, C, H).astype(jnp.float32)
+    y = jnp.einsum("tec,ech->th", comb, expert_out)
+    return y.reshape(b, s, H).astype(x.dtype)
+
+
 def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
            k_cache: jnp.ndarray, v_cache: jnp.ndarray,
            positions: jnp.ndarray, cache_start: jnp.ndarray,
            slopes: Optional[jnp.ndarray],
            tp_axis: Optional[str] = None,
-           attn_impl=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+           attn_impl=None,
+           ep_axis: Optional[str] = None
+           ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decoder block. x: [b, s, H]. Returns (x', k_cache', v_cache').
 
     Head counts derive from the weight shards, not the config, so the same
@@ -226,7 +294,7 @@ def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
         h = layer_norm(x, lp["mlp_norm_w"], lp["mlp_norm_b"], cfg.norm_eps)
     else:
         h = rms_norm(x, lp["mlp_norm_w"], cfg.norm_eps)
-    x = x + _mlp(cfg, lp, h, tp_axis)
+    x = x + _mlp(cfg, lp, h, tp_axis, ep_axis)
     return x, k_cache, v_cache
 
 
@@ -239,6 +307,7 @@ def stage_forward(
     positions: jnp.ndarray,     # [b, s] absolute positions of the chunk
     tp_axis: Optional[str] = None,  # set inside shard_map for manual TP
     attn_impl=None,             # attention hook (see _default_attn)
+    ep_axis: Optional[str] = None,  # expert-parallel MoE axis (shard_map)
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run this stage's layer range. Returns (hidden or logits, updated cache).
 
@@ -267,7 +336,7 @@ def stage_forward(
     def body(x, scanned):
         lp, kc, vc = scanned
         x, kc, vc = _layer(cfg, lp, x, kc, vc, positions, cache_start, slopes,
-                           tp_axis, attn_impl)
+                           tp_axis, attn_impl, ep_axis)
         return x, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(

@@ -30,22 +30,24 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-AXES = ("dp", "pp", "tp", "sp")
+AXES = ("dp", "pp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
     dp: int = 1
     pp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.pp * self.tp * self.sp
+        return self.dp * self.pp * self.ep * self.tp * self.sp
 
     def axis_sizes(self) -> dict:
-        return {"dp": self.dp, "pp": self.pp, "tp": self.tp, "sp": self.sp}
+        return {"dp": self.dp, "pp": self.pp, "ep": self.ep,
+                "tp": self.tp, "sp": self.sp}
 
 
 def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
@@ -58,7 +60,9 @@ def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
             f"mesh {cfg} needs {need} devices, have {len(devices)}")
     # tp innermost: consecutive physical devices are tp-neighbors (the
     # chattiest collectives — per-layer psums — ride adjacent ICI links);
-    # sp next (ring-attention ppermute hops one tp-group over), then pp,
-    # then dp outermost (infrequent gradient/batch collectives, DCN-ok).
-    arr = np.asarray(devices[:need]).reshape(cfg.dp, cfg.pp, cfg.sp, cfg.tp)
-    return Mesh(arr, ("dp", "pp", "sp", "tp"))
+    # sp next (ring-attention ppermute hops one tp-group over), then ep
+    # (per-layer all_to_all, chunky but less frequent), then pp, then dp
+    # outermost (infrequent gradient/batch collectives, DCN-ok).
+    arr = np.asarray(devices[:need]).reshape(cfg.dp, cfg.pp, cfg.ep,
+                                             cfg.sp, cfg.tp)
+    return Mesh(arr, ("dp", "pp", "ep", "sp", "tp"))
