@@ -23,14 +23,20 @@ from .base import ModelConfig, StageParams
 from .decoder import init_full_params
 
 
-# safetensors name -> (our key, transpose?) per family
-_LLAMA_LAYER_MAP = {
+# safetensors name -> (our key, transpose?); attention/norm subset is shared
+# by every rope-family mapper (llama dense MLP adds the mlp.* entries,
+# mixtral swaps them for per-expert blocks).
+_ATTN_NORM_MAP = {
     "input_layernorm.weight": ("attn_norm_w", False),
     "self_attn.q_proj.weight": ("wq", True),
     "self_attn.k_proj.weight": ("wk", True),
     "self_attn.v_proj.weight": ("wv", True),
     "self_attn.o_proj.weight": ("wo", True),
     "post_attention_layernorm.weight": ("mlp_norm_w", False),
+}
+
+_LLAMA_LAYER_MAP = {
+    **_ATTN_NORM_MAP,
     "mlp.gate_proj.weight": ("w_gate", True),
     "mlp.up_proj.weight": ("w_up", True),
     "mlp.down_proj.weight": ("w_down", True),
@@ -51,35 +57,150 @@ def load_safetensors_dir(path: str) -> Dict[str, np.ndarray]:
     return tensors
 
 
-def load_llama_params(path: str, cfg: ModelConfig) -> StageParams:
-    """Assemble a llama-family HF checkpoint into stacked StageParams."""
-    raw = load_safetensors_dir(path)
+def _get(raw: Dict[str, np.ndarray], name: str,
+         prefixes=("model.", "transformer.", "")) -> np.ndarray:
+    for prefix in prefixes:
+        if prefix + name in raw:
+            return np.asarray(raw[prefix + name])
+    raise KeyError(name)
+
+
+def llama_params_from_state_dict(raw: Dict[str, np.ndarray],
+                                 cfg: ModelConfig) -> StageParams:
+    """Map a llama-family HF state dict (``model.layers.{i}.*`` names) onto
+    the stacked layout.  HF stores linears as [out, in]; ours are [in, out]
+    einsum operands, hence the transposes."""
     dt = cfg.dtype
-    L = cfg.num_layers
-
-    def get(name):
-        for prefix in ("model.", ""):
-            if prefix + name in raw:
-                return raw[prefix + name]
-        raise KeyError(name)
-
     layers: Dict[str, list] = {}
-    for i in range(L):
+    for i in range(cfg.num_layers):
         for hf_name, (ours, transpose) in _LLAMA_LAYER_MAP.items():
-            w = get(f"layers.{i}.{hf_name}")
+            w = _get(raw, f"layers.{i}.{hf_name}")
             if transpose:
                 w = w.T
             layers.setdefault(ours, []).append(w)
     stacked = {k: jnp.asarray(np.stack(v), dt) for k, v in layers.items()}
 
-    embed = {"tokens": jnp.asarray(get("embed_tokens.weight"), dt)}
-    final_norm = {"w": jnp.asarray(get("norm.weight"), dt)}
+    embed = {"tokens": jnp.asarray(_get(raw, "embed_tokens.weight"), dt)}
+    final_norm = {"w": jnp.asarray(_get(raw, "norm.weight"), dt)}
     if cfg.tie_embeddings:
         lm_head = {}
     else:
-        lm_head = {"w": jnp.asarray(raw["lm_head.weight"].T, dt)}
+        lm_head = {"w": jnp.asarray(_get(raw, "lm_head.weight", ("",)).T, dt)}
     return StageParams(layers=stacked, embed=embed, final_norm=final_norm,
                        lm_head=lm_head)
+
+
+def bloom_params_from_state_dict(raw: Dict[str, np.ndarray],
+                                 cfg: ModelConfig) -> StageParams:
+    """Map a BloomForCausalLM state dict onto the stacked layout.
+
+    The fused ``query_key_value`` weight is **per-head interleaved**:
+    [nh, 3, hd, H] after reshape (q/k/v planes alternate within each head),
+    not three contiguous blocks — the one genuinely tricky mapping in the
+    family (reference ships pre-exported ONNX instead, SURVEY.md §2.2).
+    """
+    dt = cfg.dtype
+    H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    layers: Dict[str, list] = {}
+
+    def push(key, val):
+        layers.setdefault(key, []).append(val)
+
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        push("attn_norm_w", _get(raw, p + "input_layernorm.weight"))
+        push("attn_norm_b", _get(raw, p + "input_layernorm.bias"))
+        qkv_w = _get(raw, p + "self_attention.query_key_value.weight")
+        qkv_b = _get(raw, p + "self_attention.query_key_value.bias")
+        w = qkv_w.reshape(nh, 3, hd, H)
+        b = qkv_b.reshape(nh, 3, hd)
+        # [H, nh*hd] per projection (transpose of HF's [out, in])
+        push("wq", w[:, 0].reshape(nh * hd, H).T)
+        push("wk", w[:, 1].reshape(nh * hd, H).T)
+        push("wv", w[:, 2].reshape(nh * hd, H).T)
+        push("bq", b[:, 0].reshape(nh * hd))
+        push("bk", b[:, 1].reshape(nh * hd))
+        push("bv", b[:, 2].reshape(nh * hd))
+        push("wo", _get(raw, p + "self_attention.dense.weight").T)
+        push("bo", _get(raw, p + "self_attention.dense.bias"))
+        push("mlp_norm_w", _get(raw, p + "post_attention_layernorm.weight"))
+        push("mlp_norm_b", _get(raw, p + "post_attention_layernorm.bias"))
+        push("w_up", _get(raw, p + "mlp.dense_h_to_4h.weight").T)
+        push("b_up", _get(raw, p + "mlp.dense_h_to_4h.bias"))
+        push("w_down", _get(raw, p + "mlp.dense_4h_to_h.weight").T)
+        push("b_down", _get(raw, p + "mlp.dense_4h_to_h.bias"))
+    stacked = {k: jnp.asarray(np.stack(v), dt) for k, v in layers.items()}
+
+    embed = {
+        "tokens": jnp.asarray(_get(raw, "word_embeddings.weight"), dt),
+        "norm_w": jnp.asarray(
+            _get(raw, "word_embeddings_layernorm.weight"), dt),
+        "norm_b": jnp.asarray(
+            _get(raw, "word_embeddings_layernorm.bias"), dt),
+    }
+    final_norm = {"w": jnp.asarray(_get(raw, "ln_f.weight"), dt),
+                  "b": jnp.asarray(_get(raw, "ln_f.bias"), dt)}
+    return StageParams(layers=stacked, embed=embed, final_norm=final_norm,
+                       lm_head={})  # bloom ties the head to the embedding
+
+
+def mixtral_params_from_state_dict(raw: Dict[str, np.ndarray],
+                                   cfg: ModelConfig) -> StageParams:
+    """Map a MixtralForCausalLM state dict onto the stacked layout.
+
+    Per-expert linears (``block_sparse_moe.experts.{e}.w1/w2/w3``) stack into
+    [L, E, in, out] blocks: w1 -> w_gate, w3 -> w_up, w2 -> w_down.
+    """
+    dt = cfg.dtype
+    E = cfg.num_experts
+    layers: Dict[str, list] = {}
+
+    def push(key, val):
+        layers.setdefault(key, []).append(val)
+
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}."
+        for hf_name, (ours, transpose) in _ATTN_NORM_MAP.items():
+            w = _get(raw, p + hf_name)
+            push(ours, w.T if transpose else w)
+        push("router", _get(raw, p + "block_sparse_moe.gate.weight").T)
+        push("w_gate", np.stack([
+            _get(raw, p + f"block_sparse_moe.experts.{e}.w1.weight").T
+            for e in range(E)]))
+        push("w_up", np.stack([
+            _get(raw, p + f"block_sparse_moe.experts.{e}.w3.weight").T
+            for e in range(E)]))
+        push("w_down", np.stack([
+            _get(raw, p + f"block_sparse_moe.experts.{e}.w2.weight").T
+            for e in range(E)]))
+    stacked = {k: jnp.asarray(np.stack(v), dt) for k, v in layers.items()}
+
+    embed = {"tokens": jnp.asarray(_get(raw, "embed_tokens.weight"), dt)}
+    final_norm = {"w": jnp.asarray(_get(raw, "norm.weight"), dt)}
+    lm_head = ({} if cfg.tie_embeddings else
+               {"w": jnp.asarray(_get(raw, "lm_head.weight", ("",)).T, dt)})
+    return StageParams(layers=stacked, embed=embed, final_norm=final_norm,
+                       lm_head=lm_head)
+
+
+_SD_MAPPERS = {
+    "llama": llama_params_from_state_dict,
+    "bloom": bloom_params_from_state_dict,
+    "mixtral": mixtral_params_from_state_dict,
+}
+
+
+def params_from_state_dict(raw: Dict[str, np.ndarray],
+                           cfg: ModelConfig) -> StageParams:
+    """Family dispatch for HF-layout state dicts (numpy leaves)."""
+    if cfg.family not in _SD_MAPPERS:
+        raise NotImplementedError(f"no state-dict mapper for {cfg.family!r}")
+    return _SD_MAPPERS[cfg.family](raw, cfg)
+
+
+def load_llama_params(path: str, cfg: ModelConfig) -> StageParams:
+    """Assemble a llama-family HF checkpoint into stacked StageParams."""
+    return llama_params_from_state_dict(load_safetensors_dir(path), cfg)
 
 
 def load_or_init(model_name: str, cfg: ModelConfig,
@@ -101,12 +222,8 @@ def load_or_init(model_name: str, cfg: ModelConfig,
             params, _ = load_params(checkpoint_dir, cfg,
                                     model_name=model_name)
             return params
-        if cfg.family in ("llama",):
-            params = load_llama_params(checkpoint_dir, cfg)
-        else:
-            raise NotImplementedError(
-                f"checkpoint loading for family {cfg.family!r} lands with the "
-                "model-card subsystem; use random init")
+        params = params_from_state_dict(load_safetensors_dir(checkpoint_dir),
+                                        cfg)
     else:
         params = init_full_params(jax.random.PRNGKey(seed), cfg)
     from ..ops.quant import maybe_quantize

@@ -471,6 +471,38 @@ class Tokenizer:
         self.backend = backend
 
     @staticmethod
+    def from_sentencepiece(data: Union[bytes, str, Path]) -> "Tokenizer":
+        """Load a sentencepiece ``.model`` protobuf blob (reference:
+        ``FromBlobSentencePiece``, ``tokenizers_cpp.h:52-79``).  Parsing and
+        segmentation are from scratch — see ``sp_tokenizer.py``."""
+        from .sp_tokenizer import SPTokenizer, parse_model_proto
+        if isinstance(data, (str, Path)):
+            data = Path(data).read_bytes()
+        model = parse_model_proto(data)
+        impl = SPTokenizer(model)
+        nb = len(model.pieces)
+        spec = TokenizerSpec(
+            vocab=dict(impl.piece_to_id), merges=[], scheme="metaspace",
+            byte_fallback=model.byte_fallback,
+            prepend=model.add_dummy_prefix, unk_id=model.unk_id,
+            specials=dict(impl.specials),
+            bos_id=model.bos_id if 0 <= model.bos_id < nb else None,
+            eos_id=model.eos_id if 0 <= model.eos_id < nb else None)
+        return Tokenizer(impl, spec, "sentencepiece")
+
+    @staticmethod
+    def from_file(path: Union[str, Path],
+                  backend: str = "native") -> "Tokenizer":
+        """Auto-detect: ``.model`` protobuf -> sentencepiece;
+        otherwise HF tokenizer.json."""
+        p = Path(path)
+        raw = p.read_bytes()
+        text_head = raw.lstrip(b"\xef\xbb\xbf \t\r\n")[:1]
+        if p.suffix == ".model" or text_head != b"{":
+            return Tokenizer.from_sentencepiece(raw)
+        return Tokenizer.from_json(raw.decode("utf-8-sig"), backend=backend)
+
+    @staticmethod
     def from_json(data: Union[str, dict, Path],
                   backend: str = "native") -> "Tokenizer":
         if isinstance(data, Path) or (
