@@ -23,11 +23,17 @@ Message tags (payloads are wire.py tensor messages):
 - ``tok:{rid}:{step}`` sampled [b] token ids, tail → header
 - ``end:{rid}``        free the request's cache, forwarded along the chain
 - ``stop``             shut down the worker loop, forwarded along the chain
+- ``statsreq``         forwarded along the chain; every non-header stage
+  replies to the header with a ``statsrep:{device_id}`` JSON snapshot
+  (the reference's per-device timer dump, ``Communication.java:650-661``,
+  as a pollable message instead of stdout)
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
@@ -40,6 +46,7 @@ from ..comm.transport import BaseTransport
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..models.decoder import stage_forward
 from ..ops.sampling import SamplingParams, sample_logits
+from .stats import StageStats, timer
 
 log = logging.getLogger(__name__)
 
@@ -123,6 +130,8 @@ class PipelineWorker:
         self.next_id = next_id          # None on the tail
         self.header_id = header_id
         self.step_timeout = step_timeout
+        self.stats = StageStats(
+            role="tail" if runtime.spec.is_last else "worker")
 
     def _forward_control(self, tag: str) -> None:
         if self.next_id is not None:
@@ -141,6 +150,7 @@ class PipelineWorker:
         ``idle_timeout``/step_timeout expires with no traffic at all."""
         from ..comm.transport import TransportTimeout
         while True:
+            t0 = time.perf_counter()
             try:
                 tag, payload = self.transport.recv_any(
                     timeout=idle_timeout or self.step_timeout)
@@ -148,6 +158,7 @@ class PipelineWorker:
                 log.info("worker %s: idle timeout, exiting",
                          self.transport.device_id)
                 return
+            self.stats.record_recv(time.perf_counter() - t0, len(payload))
             if not self.handle_message(tag, payload):
                 return
 
@@ -161,6 +172,19 @@ class PipelineWorker:
             self.rt.free(int(rest.split(":")[0]))
             self._forward_control(tag)
             return True
+        if kind == "statsreq":
+            snap = dict(self.stats.snapshot(),
+                        device_id=self.transport.device_id,
+                        seq=rest)  # echo the poll sequence id
+            self.transport.send(
+                self.header_id, f"statsrep:{self.transport.device_id}",
+                json.dumps(snap).encode("utf-8"))
+            self._forward_control(tag)
+            return True
+        if kind == "statsreset":
+            self.stats.reset()
+            self._forward_control(tag)
+            return True
         if kind != "h":
             log.warning("worker %s: unexpected tag %r",
                         self.transport.device_id, tag)
@@ -171,17 +195,20 @@ class PipelineWorker:
         return True
 
     def _run_and_forward(self, rid: int, step: int, payload: bytes) -> None:
-        [x] = wire.deserialize_tensors(payload).tensors
-        out = self.rt.run_chunk(rid, x)
-        if self.rt.spec.is_last:
-            toks = self.rt.sample_tokens(rid, step, out)
-            self.transport.send(
-                self.header_id, self._make_tok_tag(rid, step),
-                wire.serialize_tensors([toks]))
-        else:
-            self.transport.send(
-                self.next_id, self._make_h_tag(rid, step),
-                wire.serialize_tensors([np.asarray(out)]))
+        with timer() as t_c:
+            [x] = wire.deserialize_tensors(payload).tensors
+            out = self.rt.run_chunk(rid, x)
+            if self.rt.spec.is_last:
+                body = wire.serialize_tensors(
+                    [self.rt.sample_tokens(rid, step, out)])
+                dest, tag = self.header_id, self._make_tok_tag(rid, step)
+            else:
+                body = wire.serialize_tensors([np.asarray(out)])
+                dest, tag = self.next_id, self._make_h_tag(rid, step)
+        self.stats.record_compute(t_c.seconds)
+        with timer() as t_s:
+            self.transport.send(dest, tag, body)
+        self.stats.record_send(t_s.seconds, len(body))
 
 
 @dataclass
@@ -213,19 +240,35 @@ class PipelineHeader:
         self.eos_id = eos_id
         self.step_timeout = step_timeout
         self._next_rid = 0
+        self.stats = StageStats(role="header")
+        self._sent_at: Dict[tuple, float] = {}  # (rid, step) -> send time
+        self._next_stats_seq = 0
 
     # -- single-stage degenerate case is the engine's job, not ours --------
 
     def _make_h_tag(self, rid: int, step: int) -> str:
         return _h_tag(rid, step)
 
+    def _send_hidden(self, rid: int, step: int, hidden) -> None:
+        body = wire.serialize_tensors([np.asarray(hidden)])
+        with timer() as t_s:
+            self.transport.send(self.next_id, self._make_h_tag(rid, step),
+                                body)
+        self.stats.record_send(t_s.seconds, len(body))
+        self._sent_at[(rid, step)] = time.perf_counter()
+
     def _launch(self, req: _Request) -> None:
-        hidden = self.rt.run_chunk(req.rid, req.prompt.astype(np.int32))
-        self.transport.send(self.next_id, self._make_h_tag(req.rid, 0),
-                            wire.serialize_tensors([np.asarray(hidden)]))
+        with timer() as t_c:
+            hidden = self.rt.run_chunk(req.rid, req.prompt.astype(np.int32))
+            hidden = np.asarray(hidden)
+        self.stats.record_compute(t_c.seconds)
+        self._send_hidden(req.rid, 0, hidden)
 
     def _advance(self, req: _Request, toks: np.ndarray) -> None:
         """Got step's tokens; either issue the next decode chunk or finish."""
+        sent = self._sent_at.pop((req.rid, req.step), None)
+        if sent is not None:
+            self.stats.record_rtt(time.perf_counter() - sent)
         req.tokens.append(toks)
         req.step += 1
         if req.step >= req.max_new_tokens or (
@@ -234,11 +277,15 @@ class PipelineHeader:
             req.done = True
             self.transport.send(self.next_id, f"end:{req.rid}", b"")
             self.rt.free(req.rid)
+            self._sent_at = {k: v for k, v in self._sent_at.items()
+                             if k[0] != req.rid}
             return
-        hidden = self.rt.run_chunk(req.rid, toks[:, None].astype(np.int32))
-        self.transport.send(self.next_id,
-                            self._make_h_tag(req.rid, req.step),
-                            wire.serialize_tensors([np.asarray(hidden)]))
+        with timer() as t_c:
+            hidden = self.rt.run_chunk(req.rid,
+                                       toks[:, None].astype(np.int32))
+            hidden = np.asarray(hidden)
+        self.stats.record_compute(t_c.seconds)
+        self._send_hidden(req.rid, req.step, hidden)
 
     def _make_requests(self, prompts: Sequence[np.ndarray],
                        max_new_tokens: int) -> List[_Request]:
@@ -272,8 +319,10 @@ class PipelineHeader:
                 req = queue.pop(0)
                 in_flight[req.rid] = req
                 self._launch(req)
+            t0 = time.perf_counter()
             tag, payload = self.transport.recv_any(
                 timeout=self.step_timeout)
+            self.stats.record_recv(time.perf_counter() - t0, len(payload))
             kind, _, rest = tag.partition(":")
             if kind != "tok":
                 log.warning("header: unexpected tag %r", tag)
@@ -293,6 +342,51 @@ class PipelineHeader:
                  max_new_tokens: int) -> np.ndarray:
         """Single request; returns [b, new_tokens]."""
         return self.generate_many([prompt_ids], max_new_tokens)[0]
+
+    def collect_stats(self, num_stages: int,
+                      timeout: float = 10.0) -> List[dict]:
+        """Poll every downstream stage for its stats snapshot.
+
+        Sends ``statsreq`` down the chain; each stage replies directly to
+        the header and forwards the request.  Returns the header's own
+        snapshot first, then one dict per responding stage (may be fewer
+        than ``num_stages - 1`` on timeout).  Call outside of generation —
+        replies share the transport with token traffic.
+        """
+        from ..comm.transport import TransportTimeout
+        seq = str(self._next_stats_seq)
+        self._next_stats_seq += 1
+        self.transport.send(self.next_id, f"statsreq:{seq}", b"")
+        mine = dict(self.stats.snapshot(),
+                    device_id=self.transport.device_id)
+        # keyed by device_id + filtered by seq: a stale reply from an
+        # earlier timed-out poll can neither satisfy nor displace this one
+        replies: Dict[str, dict] = {}
+        deadline = time.monotonic() + timeout
+        want = num_stages - 1
+        while len(replies) < want:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                break
+            try:
+                tag, payload = self.transport.recv_any(timeout=left)
+            except TransportTimeout:
+                break
+            if tag.startswith("statsrep:"):
+                snap = json.loads(payload.decode("utf-8"))
+                if snap.get("seq") == seq:
+                    replies[snap.get("device_id", tag)] = snap
+            else:
+                log.warning("header: unexpected tag %r during stats poll",
+                            tag)
+        return [mine] + list(replies.values())
+
+    def reset_stats(self) -> None:
+        """Zero our counters and every downstream stage's (e.g. after a
+        compile warmup, so benchmarks report steady state only)."""
+        self.stats.reset()
+        self._sent_at.clear()
+        self.transport.send(self.next_id, "statsreset", b"")
 
     def shutdown_pipeline(self) -> None:
         """Send ``stop`` down the chain (Finish→Close analogue for the data

@@ -5,6 +5,10 @@ inference request with ``"Inference not implemented yet"``
 (``server.py:539-678``).  Here: a stdlib ``ThreadingHTTPServer`` exposing
 
 - ``GET  /health``    — model, device, capacity
+- ``GET  /stats``     — hot-loop metrics (per-stage comm/compute split,
+  byte counts, ring-RTT percentiles — the reference's
+  ``commutimeArraySum``/``infertimeArraySum`` dump as an API,
+  ``Communication.java:650-661``)
 - ``POST /generate``  — ``{"prompt_ids": [[...]], "max_new_tokens": N,
   "stream": false}`` → ``{"tokens": [[...]]}``; with ``"prompt": "text"``
   when a tokenizer is attached; ``"stream": true`` switches to chunked
@@ -31,10 +35,17 @@ class HeaderBackend:
     """Adapts a PipelineHeader/ElasticHeader to the engine surface used by
     the HTTP handler (generate + generate_stream)."""
 
-    def __init__(self, header, max_seq: int):
+    def __init__(self, header, max_seq: int, num_stages: int = 2):
         self.header = header
         self.max_seq = max_seq
+        self.num_stages = num_stages
         self._lock = threading.Lock()   # one pipeline run at a time
+
+    def stats(self) -> dict:
+        """Header snapshot + polled downstream stage snapshots."""
+        with self._lock:
+            stages = self.header.collect_stats(self.num_stages)
+        return {"stages": stages}
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0):
@@ -90,6 +101,11 @@ class InferenceHTTPServer:
                         "device": str(jax.devices()[0]),
                         "max_seq": getattr(outer.backend, "max_seq", None),
                     })
+                elif self.path == "/stats":
+                    if hasattr(outer.backend, "stats"):
+                        self._json(200, outer.backend.stats())
+                    else:
+                        self._json(200, {"stages": []})
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
