@@ -1,56 +1,239 @@
-"""Benchmark harness: decode throughput on the flagship model, real TPU.
+"""Benchmark harness: north-star metrics on the real TPU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
-Metric: decode tokens/sec on TinyLlama-1.1B (bf16, KV-cached, fused decode
-scan) — BASELINE.json config #1's model.  ``vs_baseline`` compares against
-the reference-shaped 2-worker CPU pipeline baseline (see CPU_BASELINE_TPS
-provenance note below); the north-star target is >=10x.
+Headline metric (BASELINE.md config #1): decode tokens/sec on
+TinyLlama-1.1B, single chip, vs the measured 2-process CPU socket-pipeline
+baseline of the SAME model/batch (``tools/cpu_baseline.py`` →
+``tools/cpu_baseline.json``).  North-star target: >= 10x.
+
+Extra measurements (reported inside the same JSON object):
+
+- prefill tokens/sec (TinyLlama);
+- Llama-3-8B single-chip decode tok/s at int8 and (HBM permitting) bf16 —
+  BASELINE.md's flagship model;
+- inter-shard activation latency p50/p95 across a live 2-process socket
+  pipeline (device header + CPU worker — BASELINE config #2's
+  heterogeneous shape), derived from the hot-loop stats
+  (``runtime/stats.py``; reference timers ``Communication.java:859-896``).
+
+Each leg is independent: failures are reported as {"error": ...} for that
+leg instead of killing the bench.
 """
 
 import json
 import os
 import sys
 import time
+from pathlib import Path
 
-# Reference-shaped baseline: TinyLlama-1.1B split across 2 localhost CPU
-# worker processes (BASELINE.json config #1), measured with
-# tools/cpu_baseline.py on this machine (see that file for the exact
-# invocation).  Updated whenever the baseline harness is re-run.
-CPU_BASELINE_TPS = 1.0  # placeholder until tools/cpu_baseline.py lands
+REPO = Path(__file__).resolve().parent
+BASELINE_PATH = REPO / "tools" / "cpu_baseline.json"
+
+# Fallback when tools/cpu_baseline.json is absent on the bench host:
+# measured by tools/cpu_baseline.py on the build host (1-core x86_64 VM,
+# see that file's JSON for full provenance).
+FALLBACK_BASELINE = {"tokens_per_sec": None, "source": "missing"}
 
 
-def main():
+def _load_baseline() -> dict:
+    if BASELINE_PATH.exists():
+        data = json.loads(BASELINE_PATH.read_text())
+        data["source"] = "tools/cpu_baseline.json"
+        return data
+    return dict(FALLBACK_BASELINE)
+
+
+def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
+                  quant: bool = False) -> dict:
+    """Single-chip decode + prefill throughput via InferenceEngine."""
     import jax
     import numpy as np
     from distributed_inference_demo_tpu.models import get_model_config
     from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.quant import maybe_quantize
     from distributed_inference_demo_tpu.ops.sampling import SamplingParams
     from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+    name = model + ("-int8" if quant else "")
+    cfg = get_model_config(name)
+    # quantize at creation time: peak HBM stays near the int8 footprint
+    # instead of materializing the bf16 tree first (which would OOM exactly
+    # the chips int8 exists to fit on)
+    params = init_full_params(jax.random.PRNGKey(0), cfg, quantize=quant)
+    params = maybe_quantize(params, cfg)  # no-op for already-wrapped leaves
+    engine = InferenceEngine(
+        cfg, params, max_seq=prompt_len + new_tokens,
+        sampling=SamplingParams(temperature=0.7, top_k=7))  # ref defaults
+
+    prompt = (np.arange(batch * prompt_len).reshape(batch, prompt_len)
+              % 1000).astype(np.int32)
+    engine.generate(prompt, new_tokens, seed=0)           # compile warmup
+    result = engine.generate(prompt, new_tokens, seed=0)  # steady state
+    decode_tps = result.tokens_per_second
+
+    # prefill throughput: time prefill alone on a fresh cache
+    import jax as _jax
+    cache = engine.new_cache(batch)
+    t0 = time.perf_counter()
+    logits, cache = engine._prefill(engine.params, prompt, cache)
+    _jax.block_until_ready(logits)
+    prefill_s = time.perf_counter() - t0
+    prefill_tps = batch * prompt_len / prefill_s
+
+    return {
+        "model": name,
+        "decode_tokens_per_sec": round(decode_tps, 2),
+        "prefill_tokens_per_sec": round(prefill_tps, 2),
+        "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "dtype": "int8" if quant else cfg.dtype_name,
+    }
+
+
+def _bench_pipeline_latency(model: str, batch: int, prompt_len: int,
+                            new_tokens: int) -> dict:
+    """2-process socket pipeline: this process (default backend — the TPU
+    when present) is the header, a spawned CPU process is the tail.
+    Inter-shard activation latency is derived per token as
+    ``(ring RTT - tail compute p50) / 2`` — the RTT covers exactly two
+    socket hops (hidden out, token back) around the tail's compute."""
+    import subprocess
+
+    import numpy as np
+    import jax
+    from distributed_inference_demo_tpu.comm.transport import ZmqTransport
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.base import (
+        slice_stage, split_layer_ranges)
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.distributed import (
+        PipelineHeader, StageRuntime)
+
+    cfg = get_model_config(model)
+    specs = split_layer_ranges(cfg.num_layers, 2)
+    max_seq = prompt_len + new_tokens
+    sampling = SamplingParams(temperature=0.7, top_k=7)
+
+    header_transport = ZmqTransport("header")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributed_inference_demo_tpu.runtime.worker_main",
+         "--model", model, "--stage-id", "1", "--num-stages", "2",
+         "--layer-start", str(specs[1].layer_start),
+         "--layer-end", str(specs[1].layer_end),
+         "--device-id", "w1", "--port", "0",
+         "--header", f"header@{header_transport.address}",
+         "--max-seq", str(max_seq), "--dtype", "float32",
+         "--temperature", "0.7", "--top-k", "7",
+         "--step-timeout", "600"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True, cwd=str(REPO))
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("WORKER_READY w1 "), line
+        header_transport.connect("w1", line.split()[-1])
+
+        full = init_full_params(jax.random.PRNGKey(0), cfg)
+        header = PipelineHeader(
+            StageRuntime(cfg, specs[0], slice_stage(full, cfg, specs[0]),
+                         max_seq, sampling),
+            header_transport, next_id="w1", step_timeout=600)
+        prompt = (np.arange(batch * prompt_len).reshape(batch, prompt_len)
+                  % 1000).astype(np.int32)
+        header.generate(prompt, 4)          # warmup/compile
+        header.reset_stats()
+        t0 = time.perf_counter()
+        header.generate(prompt, new_tokens)
+        dt = time.perf_counter() - t0
+        stats = header.collect_stats(num_stages=2, timeout=30)
+        header.shutdown_pipeline()
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        header_transport.close()
+
+    h = stats[0]
+    tail = stats[1] if len(stats) > 1 else {}
+    tail_p50 = tail.get("compute_p50_ms", 0.0)
+    tail_p95 = tail.get("compute_p95_ms", 0.0)
+    out = {
+        "model": model, "batch": batch, "num_stages": 2,
+        "pipeline_tokens_per_sec": round(batch * new_tokens / dt, 2),
+        "ring_rtt_p50_ms": h.get("ring_rtt_p50_ms"),
+        "ring_rtt_p95_ms": h.get("ring_rtt_p95_ms"),
+        "tail_compute_p50_ms": tail_p50,
+        "stage_stats": stats,
+    }
+    if h.get("ring_rtt_p50_ms") is not None:
+        out["activation_hop_p50_ms"] = round(
+            max(0.0, (h["ring_rtt_p50_ms"] - tail_p50) / 2), 3)
+        out["activation_hop_p95_ms"] = round(
+            max(0.0, (h["ring_rtt_p95_ms"] - tail_p95) / 2), 3)
+    return out
+
+
+def _leg(fn, *args, **kw):
+    try:
+        return fn(*args, **kw)
+    except Exception as e:      # report, don't kill the bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def main() -> None:
+    import jax
 
     model = os.environ.get("BENCH_MODEL", "tinyllama-1.1b")
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     prompt_len = int(os.environ.get("BENCH_PROMPT", "64"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+    flagship = os.environ.get("BENCH_FLAGSHIP", "llama-3-8b")
+    skip_flagship = os.environ.get("BENCH_SKIP_FLAGSHIP", "") == "1"
+    skip_pipeline = os.environ.get("BENCH_SKIP_PIPELINE", "") == "1"
 
-    cfg = get_model_config(model)
-    params = init_full_params(jax.random.PRNGKey(0), cfg)
-    engine = InferenceEngine(
-        cfg, params, max_seq=prompt_len + new_tokens,
-        sampling=SamplingParams(temperature=0.7, top_k=7))  # ref defaults
+    device = jax.devices()[0].device_kind
+    baseline = _load_baseline()
 
-    prompt = np.arange(batch * prompt_len).reshape(batch, prompt_len) % 1000
-    engine.generate(prompt, new_tokens, seed=0)        # compile warmup
-    result = engine.generate(prompt, new_tokens, seed=0)  # steady-state
-    tps = result.tokens_per_second
+    headline = _leg(_bench_engine, model, batch, prompt_len, new_tokens)
+
+    extras = {"device": device, "baseline": {
+        k: baseline.get(k) for k in
+        ("tokens_per_sec", "model", "dtype", "batch", "host", "cpu",
+         "measured_at", "source")}}
+    if not skip_flagship:
+        extras["flagship_int8"] = _leg(
+            _bench_engine, flagship, batch, prompt_len,
+            min(new_tokens, 32), quant=True)
+        extras["flagship_bf16"] = _leg(
+            _bench_engine, flagship, batch, prompt_len,
+            min(new_tokens, 32), quant=False)
+    if not skip_pipeline:
+        extras["pipeline"] = _leg(
+            _bench_pipeline_latency, model, batch, prompt_len,
+            min(new_tokens, 32))
+
+    tps = headline.get("decode_tokens_per_sec")
+    base_tps = baseline.get("tokens_per_sec")
+    # only a same-model/same-batch comparison is meaningful; anything else
+    # reports null rather than a mislabeled multiplier
+    comparable = (baseline.get("model") == model
+                  and baseline.get("batch") == batch)
+    vs = (round(tps / base_tps, 2)
+          if tps is not None and base_tps and comparable else None)
 
     print(json.dumps({
-        "metric": f"decode tokens/sec ({model}, bf16, batch={batch}, "
+        "metric": f"decode tokens/sec ({model}, "
+                  f"{headline.get('dtype', '?')}, batch={batch}, "
                   f"prompt={prompt_len}, new={new_tokens}, "
-                  f"device={jax.devices()[0].device_kind})",
-        "value": round(tps, 2),
+                  f"device={device}) vs measured 2-process CPU "
+                  f"socket-pipeline baseline (same model/batch)",
+        "value": tps,
         "unit": "tokens/sec",
-        "vs_baseline": round(tps / CPU_BASELINE_TPS, 2),
+        "vs_baseline": vs,
+        "headline": headline,
+        "extras": extras,
     }))
 
 

@@ -94,7 +94,8 @@ def cmd_serve(args) -> int:
         # workers may start with any placeholder range (cli worker --elastic
         # defaults to the full model) and are aligned here.
         header.reshard(chain)
-        backend = HeaderBackend(header, max_seq=args.max_seq)
+        backend = HeaderBackend(header, max_seq=args.max_seq,
+                                num_stages=len(chain))
         print(f"SERVE_PIPELINE {chain} ranges="
               f"{[(s.layer_start, s.layer_end) for s in specs]}", flush=True)
     else:
@@ -118,13 +119,55 @@ def cmd_serve(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# server (integrated root-server app)
+# ---------------------------------------------------------------------------
+
+def cmd_server(args) -> int:
+    """The full root-server composition (reference ``server.py:583-1052``):
+    collection window → monitor round → cost-model plan → lifecycle
+    broadcast with weight-artifact distribution → pipeline header + HTTP."""
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    from .server_app import ServerApp
+
+    app = ServerApp(
+        model=args.model, num_workers=args.num_workers,
+        checkpoint=args.checkpoint, weights_seed=args.weights_seed,
+        max_seq=args.max_seq, max_new_tokens=args.max_new_tokens,
+        greedy=args.greedy, temperature=args.temperature, top_k=args.top_k,
+        bind_host=args.bind_host, http_host=args.http_host,
+        http_port=args.http_port, collect_window=args.collect_window,
+        collect_timeout=args.collect_timeout,
+        monitor_timeout=args.monitor_timeout,
+        step_timeout=args.step_timeout)
+    return app.run()
+
+
+# ---------------------------------------------------------------------------
 # worker
 # ---------------------------------------------------------------------------
 
 def cmd_worker(args) -> int:
     """One pipeline stage process (see runtime/worker_main.py); ``--elastic``
-    makes it reshard-capable (holds full weights, accepts live migration)."""
+    makes it reshard-capable (holds full weights, accepts live migration);
+    ``--auto`` connects to a ``server`` app and receives its role, layer
+    range, and weights from the control plane."""
     from .runtime import worker_main
+
+    if args.auto:
+        ap = argparse.ArgumentParser(prog="worker --auto")
+        ap.add_argument("--registry", required=True,
+                        help="server registration address host:port (the "
+                             "only address a bare worker needs)")
+        ap.add_argument("--device-id", required=True)
+        ap.add_argument("--bind-host", default="127.0.0.1")
+        ap.add_argument("--port", type=int, default=0)
+        ap.add_argument("--step-timeout", type=float, default=120.0)
+        a = ap.parse_args(args.rest)
+        from .server_app import run_auto_worker
+        return run_auto_worker(a.registry, a.device_id,
+                               bind_host=a.bind_host,
+                               port=a.port, step_timeout=a.step_timeout)
 
     if not args.elastic:
         return worker_main.main(args.rest)
@@ -311,9 +354,25 @@ def main(argv=None) -> int:
     s.add_argument("--step-timeout", type=float, default=120.0)
     s.set_defaults(fn=cmd_serve)
 
+    sv = sub.add_parser("server", help="integrated root server: collect, "
+                        "profile, plan, distribute, serve")
+    _add_engine_args(sv)
+    sv.add_argument("--num-workers", type=int, default=1)
+    sv.add_argument("--bind-host", default="127.0.0.1")
+    sv.add_argument("--http-host", default="127.0.0.1")
+    sv.add_argument("--http-port", type=int, default=0)
+    sv.add_argument("--collect-window", type=float, default=10.0,
+                    help="quiet window closing device collection (ref 10s)")
+    sv.add_argument("--collect-timeout", type=float, default=120.0)
+    sv.add_argument("--monitor-timeout", type=float, default=60.0)
+    sv.add_argument("--step-timeout", type=float, default=120.0)
+    sv.set_defaults(fn=cmd_server)
+
     w = sub.add_parser("worker", help="pipeline stage worker",
                        add_help=False)
     w.add_argument("--elastic", action="store_true")
+    w.add_argument("--auto", action="store_true",
+                   help="receive role/range/weights from a `server` app")
     w.set_defaults(fn=cmd_worker)
 
     p = sub.add_parser("plan", help="partition planning")

@@ -64,6 +64,7 @@ class RunConfig:
     task_type: str = "generation"          # generation | classification
     num_samples: int = 1
     max_new_tokens: int = 40               # reference max_length=40
+    max_seq: int = 256                     # KV capacity on every stage
     pool_size: int = 1                     # in-flight microbatches
     device_graph: List[str] = field(default_factory=list)   # ring order, addr
     device_ids: List[str] = field(default_factory=list)     # ring order, ids
@@ -82,6 +83,7 @@ class RunConfig:
             "model": self.model, "task_type": self.task_type,
             "num_samples": self.num_samples,
             "max_new_tokens": self.max_new_tokens,
+            "max_seq": self.max_seq,
             "pool_size": self.pool_size,
             "device_graph": self.device_graph,
             "device_ids": self.device_ids,
@@ -98,7 +100,8 @@ class RunConfig:
         return RunConfig(
             model=p["model"], task_type=p["task_type"],
             num_samples=p["num_samples"],
-            max_new_tokens=p["max_new_tokens"], pool_size=p["pool_size"],
+            max_new_tokens=p["max_new_tokens"],
+            max_seq=p.get("max_seq", 256), pool_size=p["pool_size"],
             device_graph=list(p["device_graph"]),
             device_ids=list(p["device_ids"]),
             stage_ranges={k: list(v) for k, v in p["stage_ranges"].items()},

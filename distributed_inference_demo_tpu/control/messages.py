@@ -35,6 +35,11 @@ class MsgType(str, enum.Enum):
     HEARTBEAT_ACK = "heartbeat_ack"
     GET_STATUS = "get_status"
     STATUS = "status"
+    # service discovery: where are the monitor / lifecycle planes?  (the
+    # reference hardcodes its port map — SURVEY.md Appendix A; here one
+    # bootstrap address is enough)
+    GET_ENDPOINTS = "get_endpoints"
+    ENDPOINTS = "endpoints"
     # monitor plane (reference MonitorService.kt:149-225)
     MONITOR_HELLO = "monitor_hello"    # MonitorIP handshake
     MONITOR_GRAPH = "monitor_graph"    # ip graph reply

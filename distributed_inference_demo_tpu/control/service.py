@@ -37,8 +37,21 @@ class RegistrationService(RouterService):
                  ctx: Optional[zmq.Context] = None):
         super().__init__(bind_host=bind_host, port=port, ctx=ctx)
         self.pool = pool
+        self._endpoints: dict = {}
+        self._ep_lock = threading.Lock()
+
+    def publish_endpoint(self, name: str, address: str) -> None:
+        """Advertise another control-plane service (monitor/lifecycle) so
+        devices can bootstrap from this one address (the reference instead
+        hardcodes its whole port map, SURVEY.md Appendix A)."""
+        with self._ep_lock:
+            self._endpoints[name] = address
 
     def handle(self, dev_id: str, msg: Envelope) -> List[bytes]:
+        if msg.type == MsgType.GET_ENDPOINTS:
+            with self._ep_lock:
+                eps = dict(self._endpoints)
+            return [make(MsgType.ENDPOINTS, endpoints=eps)]
         if msg.type == MsgType.REGISTER:
             # reference RegisterIP action, server.py:323-383
             info = DeviceInfo(
@@ -115,6 +128,30 @@ class RegistrationClient:
             role=self.role.value, model=self.model,
             capabilities=self.capabilities))
         return bool(reply.get("ok"))
+
+    def get_endpoints(self) -> dict:
+        """Discover the other control-plane services' addresses."""
+        reply = self._rpc(make(MsgType.GET_ENDPOINTS))
+        return dict(reply.get("endpoints", {}) or {})
+
+    def wait_for_endpoints(self, names, timeout: float = 120.0,
+                           poll: float = 0.25) -> dict:
+        """Poll until every name in ``names`` is advertised (they come up
+        as the server progresses through its bootstrap phases)."""
+        import time
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                eps = self.get_endpoints()
+            except zmq.ZMQError:
+                eps = {}
+            if all(n in eps for n in names):
+                return eps
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"endpoints {names} not advertised within {timeout}s "
+                    f"(have {sorted(eps)})")
+            time.sleep(poll)
 
     def heartbeat_once(self) -> bool:
         try:

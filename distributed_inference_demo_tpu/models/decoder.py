@@ -36,18 +36,32 @@ def _dense_init(rng, shape, dtype, scale=None):
     return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(dtype)
 
 
-def init_layer_params(rng: jax.Array, cfg: ModelConfig, num_layers: int) -> dict:
-    """Stacked per-layer weights, leading dim = num_layers."""
+def init_layer_params(rng: jax.Array, cfg: ModelConfig, num_layers: int,
+                      quantize: bool = False) -> dict:
+    """Stacked per-layer weights, leading dim = num_layers.
+
+    With ``quantize``, each big matmul operand is int8-quantized the moment
+    it is created, so peak memory stays near the int8 footprint instead of
+    materializing the whole model at the float dtype first — this is what
+    lets an int8 8B model be random-initialized on a chip the bf16 variant
+    would not fit on.
+    """
+    from ..ops.quant import quantize_array
+
     H, nh, nkv, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     I, L = cfg.intermediate_size, num_layers
     dt = cfg.dtype
+
+    def q(w):
+        return quantize_array(w, stacked=True) if quantize else w
+
     keys = jax.random.split(rng, 16)
     p = {
         "attn_norm_w": jnp.ones((L, H), dt),
-        "wq": _dense_init(keys[0], (L, H, nh * hd), dt),
-        "wk": _dense_init(keys[1], (L, H, nkv * hd), dt),
-        "wv": _dense_init(keys[2], (L, H, nkv * hd), dt),
-        "wo": _dense_init(keys[3], (L, nh * hd, H), dt),
+        "wq": q(_dense_init(keys[0], (L, H, nh * hd), dt)),
+        "wk": q(_dense_init(keys[1], (L, H, nkv * hd), dt)),
+        "wv": q(_dense_init(keys[2], (L, H, nkv * hd), dt)),
+        "wo": q(_dense_init(keys[3], (L, nh * hd, H), dt)),
         "mlp_norm_w": jnp.ones((L, H), dt),
     }
     if cfg.attn_layernorm:  # bloom: LayerNorm has bias; linears have bias
@@ -60,22 +74,23 @@ def init_layer_params(rng: jax.Array, cfg: ModelConfig, num_layers: int) -> dict
     if cfg.num_experts > 0:  # mixtral MoE
         E = cfg.num_experts
         p["router"] = _dense_init(keys[4], (L, H, E), dt)
-        p["w_gate"] = _dense_init(keys[5], (L, E, H, I), dt)
-        p["w_up"] = _dense_init(keys[6], (L, E, H, I), dt)
-        p["w_down"] = _dense_init(keys[7], (L, E, I, H), dt)
+        p["w_gate"] = q(_dense_init(keys[5], (L, E, H, I), dt))
+        p["w_up"] = q(_dense_init(keys[6], (L, E, H, I), dt))
+        p["w_down"] = q(_dense_init(keys[7], (L, E, I, H), dt))
     elif cfg.family == "bloom":  # dense 4H GELU MLP with bias
-        p["w_up"] = _dense_init(keys[5], (L, H, I), dt)
+        p["w_up"] = q(_dense_init(keys[5], (L, H, I), dt))
         p["b_up"] = jnp.zeros((L, I), dt)
-        p["w_down"] = _dense_init(keys[7], (L, I, H), dt)
+        p["w_down"] = q(_dense_init(keys[7], (L, I, H), dt))
         p["b_down"] = jnp.zeros((L, H), dt)
     else:  # llama SwiGLU
-        p["w_gate"] = _dense_init(keys[5], (L, H, I), dt)
-        p["w_up"] = _dense_init(keys[6], (L, H, I), dt)
-        p["w_down"] = _dense_init(keys[7], (L, I, H), dt)
+        p["w_gate"] = q(_dense_init(keys[5], (L, H, I), dt))
+        p["w_up"] = q(_dense_init(keys[6], (L, H, I), dt))
+        p["w_down"] = q(_dense_init(keys[7], (L, I, H), dt))
     return p
 
 
-def init_full_params(rng: jax.Array, cfg: ModelConfig) -> StageParams:
+def init_full_params(rng: jax.Array, cfg: ModelConfig,
+                     quantize: bool = False) -> StageParams:
     """Random-init full model as a single StageParams (stage 0 of 1)."""
     k_emb, k_layers, k_head = jax.random.split(rng, 3)
     dt = cfg.dtype
@@ -92,7 +107,8 @@ def init_full_params(rng: jax.Array, cfg: ModelConfig) -> StageParams:
     else:
         lm_head = {"w": _dense_init(k_head, (cfg.hidden_size, cfg.vocab_size), dt)}
     return StageParams(
-        layers=init_layer_params(k_layers, cfg, cfg.num_layers),
+        layers=init_layer_params(k_layers, cfg, cfg.num_layers,
+                                 quantize=quantize),
         embed=embed, final_norm=final_norm, lm_head=lm_head)
 
 

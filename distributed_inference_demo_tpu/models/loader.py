@@ -203,14 +203,72 @@ def load_llama_params(path: str, cfg: ModelConfig) -> StageParams:
     return llama_params_from_state_dict(load_safetensors_dir(path), cfg)
 
 
+def stage_params_to_bytes(params: StageParams) -> bytes:
+    """Serialize a StageParams tree for the control plane's artifact channel
+    (the reference ships per-device ONNX zips, ``server.py:910-957``; we
+    ship weight blobs in the versioned wire codec + a JSON manifest).
+    Layout: ``<u32 manifest_len><manifest JSON><wire tensor message>``."""
+    import struct
+
+    from ..comm import wire
+
+    from ..ops.quant import QuantizedArray
+
+    flat = {}
+    for section in ("layers", "embed", "final_norm", "lm_head"):
+        d = getattr(params, section)
+        if d is None:
+            continue
+        for k, v in d.items():
+            if isinstance(v, QuantizedArray):
+                # ship weights pre-quantization; the receiving stage applies
+                # its own config's quantization (ops/quant.maybe_quantize)
+                raise TypeError(
+                    f"{section}/{k} is quantized; serialize the float "
+                    "params and quantize at the consumer")
+            flat[f"{section}/{k}"] = np.asarray(v)
+    names = sorted(flat)
+    manifest = json.dumps({"names": names,
+                           "present": {
+                               s: getattr(params, s) is not None
+                               for s in ("embed", "final_norm", "lm_head")}
+                           }).encode("utf-8")
+    blob = wire.serialize_tensors([flat[n] for n in names])
+    return struct.pack("<I", len(manifest)) + manifest + blob
+
+
+def stage_params_from_bytes(data: bytes) -> StageParams:
+    """Inverse of :func:`stage_params_to_bytes`."""
+    import struct
+
+    from ..comm import wire
+
+    (mlen,) = struct.unpack_from("<I", data, 0)
+    manifest = json.loads(data[4:4 + mlen].decode("utf-8"))
+    tensors = wire.deserialize_tensors(data[4 + mlen:]).tensors
+    sections: Dict[str, dict] = {"layers": {}, "embed": {},
+                                 "final_norm": {}, "lm_head": {}}
+    for name, arr in zip(manifest["names"], tensors):
+        sec, _, key = name.partition("/")
+        sections[sec][key] = jnp.asarray(arr)
+    present = manifest["present"]
+    return StageParams(
+        layers=sections["layers"],
+        embed=sections["embed"] if present["embed"] else None,
+        final_norm=sections["final_norm"] if present["final_norm"] else None,
+        lm_head=sections["lm_head"] if present["lm_head"] else None)
+
+
 def load_or_init(model_name: str, cfg: ModelConfig,
                  checkpoint_dir: Optional[str] = None,
-                 seed: int = 0) -> StageParams:
+                 seed: int = 0, quantize: bool = True) -> StageParams:
     """Load from a local checkpoint if given/found, else random-init.
 
     The random path keeps every test and benchmark runnable with zero
     network egress; the bench harness measures throughput, which is
-    weight-value independent.
+    weight-value independent.  ``quantize=False`` returns the float tree
+    even for ``-int8`` configs — used by the server app, whose artifact
+    channel ships float weights and lets each stage quantize locally.
     """
     import jax
     if checkpoint_dir and os.path.isdir(checkpoint_dir):
@@ -226,5 +284,7 @@ def load_or_init(model_name: str, cfg: ModelConfig,
                                         cfg)
     else:
         params = init_full_params(jax.random.PRNGKey(seed), cfg)
+    if not quantize:
+        return params
     from ..ops.quant import maybe_quantize
     return maybe_quantize(params, cfg)

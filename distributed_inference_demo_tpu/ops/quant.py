@@ -65,7 +65,9 @@ _QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
 def quantize_layer_params(layers: dict) -> dict:
-    return {k: (quantize_array(v, stacked=True) if k in _QUANTIZABLE else v)
+    return {k: (quantize_array(v, stacked=True)
+                if k in _QUANTIZABLE and not isinstance(v, QuantizedArray)
+                else v)
             for k, v in layers.items()}
 
 

@@ -28,6 +28,8 @@ def build_worker(args):
     from .distributed import PipelineWorker, StageRuntime
 
     cfg = get_model_config(args.model)
+    if args.dtype:
+        cfg = cfg.replace(dtype_name=args.dtype)
     spec = StageSpec(args.stage_id, args.num_stages,
                      args.layer_start, args.layer_end)
     full = init_full_params(jax.random.PRNGKey(args.weights_seed), cfg)
@@ -66,6 +68,8 @@ def main(argv=None) -> int:
     ap.add_argument("--header", required=True,
                     help="header as id@host:port (token return edge)")
     ap.add_argument("--max-seq", type=int, default=256)
+    ap.add_argument("--dtype", default="",
+                    help="override model dtype (e.g. float32 for CPU runs)")
     ap.add_argument("--weights-seed", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--greedy", action="store_true")
