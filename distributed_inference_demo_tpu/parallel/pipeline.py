@@ -58,6 +58,37 @@ def _grad_sync_axes(params: StageParams, cfg: ModelConfig, use_tp: bool):
                         is_leaf=lambda x: isinstance(x, P))
 
 
+def _embed(params: StageParams, cfg: ModelConfig,
+           ids: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding (+ bloom's embedding LayerNorm), shared by the
+    training and generation pipelines; every rank holds the replicated
+    embed table and masks its *use* by rank role."""
+    x = params.embed["tokens"][ids]
+    if cfg.family == "bloom":
+        from ..ops.norms import layer_norm
+        x = layer_norm(x, params.embed["norm_w"], params.embed["norm_b"],
+                       cfg.norm_eps)
+    return x.astype(cfg.dtype)
+
+
+def _head(params: StageParams, cfg: ModelConfig, h: jnp.ndarray,
+          tp_axis: Optional[str]) -> jnp.ndarray:
+    """Final norm + LM head on [b, s, H]; gathers vocab-sharded logit
+    shards under TP."""
+    from ..ops.norms import layer_norm, rms_norm
+    if cfg.attn_layernorm:
+        h = layer_norm(h, params.final_norm["w"], params.final_norm["b"],
+                       cfg.norm_eps)
+    else:
+        h = rms_norm(h, params.final_norm["w"], cfg.norm_eps)
+    head = (params.embed["tokens"].T if cfg.tie_embeddings
+            else params.lm_head["w"])
+    logits = jnp.einsum("bsh,hv->bsv", h, head)
+    if tp_axis is not None and logits.shape[-1] != cfg.vocab_size:
+        logits = jax.lax.all_gather(logits, tp_axis, axis=-1, tiled=True)
+    return logits
+
+
 def pipeline_apply(
     cfg: ModelConfig,
     params: StageParams,      # LOCAL shards (inside shard_map)
@@ -90,26 +121,10 @@ def pipeline_apply(
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
     def embed_fn(ids):
-        x = params.embed["tokens"][ids]
-        if cfg.family == "bloom":
-            from ..ops.norms import layer_norm
-            x = layer_norm(x, params.embed["norm_w"], params.embed["norm_b"],
-                           cfg.norm_eps)
-        return x.astype(dt)
+        return _embed(params, cfg, ids)
 
     def head_fn(h):
-        from ..ops.norms import layer_norm, rms_norm
-        if cfg.attn_layernorm:
-            h = layer_norm(h, params.final_norm["w"], params.final_norm["b"],
-                           cfg.norm_eps)
-        else:
-            h = rms_norm(h, params.final_norm["w"], cfg.norm_eps)
-        head = (params.embed["tokens"].T if cfg.tie_embeddings
-                else params.lm_head["w"])
-        logits = jnp.einsum("bsh,hv->bsv", h, head)
-        if tp_axis is not None and logits.shape[-1] != cfg.vocab_size:
-            logits = jax.lax.all_gather(logits, tp_axis, axis=-1, tiled=True)
-        return logits
+        return _head(params, cfg, h, tp_axis)
 
     from ..models.decoder import stage_forward
 
@@ -159,6 +174,172 @@ def pipeline_apply(
     return loss_sum / jnp.maximum(tok_sum, 1)
 
 
+def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh, *,
+                              max_seq: int, num_new_tokens: int,
+                              sampling=None):
+    """SPMD circular-pipeline **decode**: multi-chip pipeline inference in
+    ICI-collective form (VERDICT r1 item 6; the reference's socket token
+    ring, ``Communication.java:621-651``, as one compiled program).
+
+    Microbatches circulate the pp ring round-robin: at ring step ``g``,
+    rank ``s`` works on microbatch ``(g - s) mod M``, every hop is a single
+    ``ppermute`` carrying the hidden row plus a token lane (the sampled
+    token riding last→first — the reference's commu3 leg), and each rank
+    keeps a per-microbatch KV cache for its layer slice.  Pipeline is full
+    whenever ``M >= S``: every rank computes every step, so decode
+    throughput scales with stages instead of being serialized the way the
+    socket ring's request/step loop is.
+
+    Returns ``fn(params, ids_mb, rng) -> tokens``:
+      ids_mb  [M, b, prompt_len] int32 (equal-length prompts; pad first),
+      tokens  [M, b, num_new_tokens] int32, replicated.
+
+    Composes with TP when the mesh has a tp axis > 1 (Megatron shard_map
+    inside each stage).
+    """
+    from ..models.decoder import stage_forward
+    from ..ops.sampling import SamplingParams, sample_logits
+
+    sampling = sampling or SamplingParams(greedy=True)
+    S = mesh.shape["pp"]
+    if S < 2:
+        raise ValueError("pipeline generate needs pp >= 2 (use the "
+                         "engine for a single stage)")
+    use_tp = mesh.shape.get("tp", 1) > 1
+    tp_axis = "tp" if use_tp else None
+    N = num_new_tokens
+    dt = cfg.dtype
+    H = cfg.hidden_size
+    # "not first, not last": raw layer stack only (roles are data
+    # selections in SPMD, not control flow)
+    spec_mid = StageSpec(stage_id=1, num_stages=3, layer_start=0,
+                         layer_end=0)
+
+    def body(params, ids_mb, rng):
+        s = jax.lax.axis_index("pp")
+        is_first = s == 0
+        is_last = s == S - 1
+        M, b, plen = ids_mb.shape
+        if M < S:
+            raise ValueError(f"need microbatches M={M} >= stages S={S} "
+                             "for a full pipeline")
+
+        nkv_loc = params.layers["wk"].shape[-1] // cfg.head_dim
+        L_loc = jax.tree.leaves(params.layers)[0].shape[0]
+        cshape = (M, L_loc, b, nkv_loc, max_seq, cfg.head_dim)
+        K = jnp.zeros(cshape, dt)
+        V = jnp.zeros(cshape, dt)
+        mid_params = StageParams(layers=params.layers)
+
+        def run_local(x, kc, vc, length, positions):
+            cache = KVCache(kc, vc, length)
+            out, newc = stage_forward(mid_params, cfg, spec_mid, x, cache,
+                                      positions, tp_axis=tp_axis)
+            return out, newc.keys, newc.values
+
+        def upd(stack, m, new, active):
+            old = jax.lax.dynamic_index_in_dim(stack, m, 0, keepdims=False)
+            val = jnp.where(active, new, old)
+            return jax.lax.dynamic_update_index_in_dim(stack, val, m, 0)
+
+        ring = [(i, (i + 1) % S) for i in range(S)]
+        pos_pre = jnp.broadcast_to(jnp.arange(plen), (b, plen))
+
+        def rng_for(m, k):
+            return jax.random.fold_in(jax.random.fold_in(rng, m), k)
+
+        # ---- prefill: M + S - 1 ring steps over the prompt chunks -------
+        def pre_step(carry, t):
+            recv_h, K, V, tok0 = carry
+            m = jnp.clip(t - s, 0, M - 1)
+            active = (t >= s) & (t - s < M)
+            ids_t = jax.lax.dynamic_index_in_dim(ids_mb, m, 0,
+                                                 keepdims=False)
+            x = jnp.where(is_first, _embed(params, cfg, ids_t), recv_h)
+            kc = jax.lax.dynamic_index_in_dim(K, m, 0, keepdims=False)
+            vc = jax.lax.dynamic_index_in_dim(V, m, 0, keepdims=False)
+            h, nk, nv = run_local(x, kc, vc, jnp.zeros((), jnp.int32),
+                                  pos_pre)
+            K = upd(K, m, nk, active)
+            V = upd(V, m, nv, active)
+            logits = _head(params, cfg, h[:, -1:, :], tp_axis)[:, 0]
+            tok = sample_logits(logits, rng_for(m, 0), sampling)
+            tok0 = upd(tok0, m, jnp.where(active & is_last, tok, -1),
+                       active & is_last)
+            send = jax.lax.ppermute(h, "pp", ring)
+            return (send, K, V, tok0), None
+
+        tok0 = jnp.full((M, b), -1, jnp.int32)
+        (recv_h, K, V, tok0), _ = jax.lax.scan(
+            pre_step, (jnp.zeros((b, plen, H), dt), K, V, tok0),
+            jnp.arange(M + S - 1))
+        # everyone learns the first sampled token of every microbatch
+        tok0 = jax.lax.pmax(tok0, "pp")
+
+        lengths = jnp.full((M,), plen, jnp.int32)
+        out = jnp.zeros((M, b, N), jnp.int32)
+        out = jnp.where(is_last, out.at[:, :, 0].set(tok0), out)
+
+        # ---- decode: S - 1 + (N - 1) * M ring steps ---------------------
+        def dec_step(carry, g):
+            recv_h, recv_tok, tok_buf, K, V, lengths, out = carry
+            m = jnp.mod(g - s, M)
+            k = (g - s) // M                  # decode pass index
+            active = (g >= s) & (k < N - 1)
+
+            # stage 0: fold the token that arrived on the lane into its
+            # buffer BEFORE consuming (the lane is one hop behind the tail)
+            m_recv = jnp.mod(g - S, M)
+            tok_buf = jnp.where(is_first & (g >= S),
+                                upd(tok_buf, m_recv, recv_tok, True),
+                                tok_buf)
+
+            tok_m = jax.lax.dynamic_index_in_dim(tok_buf, m, 0,
+                                                 keepdims=False)
+            length = jax.lax.dynamic_index_in_dim(lengths, m, 0,
+                                                  keepdims=False)
+            pos = jnp.broadcast_to(length, (b, 1))
+            x = jnp.where(is_first,
+                          _embed(params, cfg, tok_m[:, None]), recv_h)
+            kc = jax.lax.dynamic_index_in_dim(K, m, 0, keepdims=False)
+            vc = jax.lax.dynamic_index_in_dim(V, m, 0, keepdims=False)
+            h, nk, nv = run_local(x, kc, vc, length, pos)
+            K = upd(K, m, nk, active)
+            V = upd(V, m, nv, active)
+            lengths = jnp.where(active, lengths.at[m].set(length + 1),
+                                lengths)
+
+            logits = _head(params, cfg, h, tp_axis)[:, 0]
+            tok_next = sample_logits(logits, rng_for(m, k + 1), sampling)
+            out = jnp.where(active & is_last,
+                            out.at[m, :, jnp.clip(k + 1, 0, N - 1)]
+                            .set(tok_next), out)
+
+            send_h = jax.lax.ppermute(h, "pp", ring)
+            send_tok = jax.lax.ppermute(tok_next, "pp", ring)
+            return (send_h, send_tok, tok_buf, K, V, lengths, out), None
+
+        G = S - 1 + (N - 1) * M
+        carry = (jnp.zeros((b, 1, H), dt), jnp.zeros((b,), jnp.int32),
+                 tok0, K, V, lengths, out)
+        if N > 1:
+            (_, _, _, _, _, _, out), _ = jax.lax.scan(
+                dec_step, carry, jnp.arange(G))
+        # only the last rank holds real tokens; share them
+        out = jax.lax.psum(jnp.where(is_last, out, 0), "pp")
+        return out
+
+    def fn(params, ids_mb, rng):
+        sharded = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(_pp_in_specs(params, cfg, use_tp), P(), P()),
+            out_specs=P(),
+            check_vma=False)
+        return sharded(params, ids_mb, rng)
+
+    return jax.jit(fn)
+
+
 def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh, optimizer,
                              num_microbatches: int):
     """Build a jitted data+pipeline+tensor-parallel training step.
@@ -176,13 +357,22 @@ def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh, optimizer,
         in_specs_params = _pp_in_specs(params_template, cfg, use_tp)
         sync_axes = _grad_sync_axes(params_template, cfg, use_tp)
 
-        # Under check_vma=False the transpose of every forward psum (the
-        # loss reduction over pp, the row-parallel psums over tp) is itself
-        # a psum, so raw grads come back uniformly scaled by pp*tp relative
-        # to the single-device gradient (verified empirically on the virtual
-        # mesh for pp/tp in {1,2}x{1,2}).  Normalize once here so optimizers
-        # that are not scale-invariant (sgd, clipping, weight decay) are
-        # correct.
+        # Derivation of the 1/(pp*tp) normalization.  The loss is made
+        # replicated by forward psums (over pp at the loss reduction; over
+        # tp inside every row-parallel matmul), and under check_vma=False
+        # jax transposes psum to psum — which is exactly the semantics
+        # "every device backpropagates its own replicated copy of the
+        # loss".  The resulting raw gradient for ANY leaf (after
+        # _grad_sync_axes folds in the replicated-copy grads) is therefore
+        #     sum over the pp*tp devices of d(loss copy)/d(leaf)
+        #       = pp * tp * d(loss)/d(leaf),
+        # uniform across leaves because each device's loss copy is the
+        # same full-model function of every leaf (the pipeline threads all
+        # stages through each device's program).  Verified leaf-by-leaf by
+        # tools/grad_scale_probe.py for pp/tp in {1,2,4}x{1,2,4} (property
+        # test: tests/test_parallel.py::test_grad_scaling_rule_at_4x4).
+        # Normalize once here so optimizers that are not scale-invariant
+        # (sgd, clipping, weight decay) are correct.
         grad_norm = 1.0 / (mesh.shape.get("pp", 1) * mesh.shape.get("tp", 1))
 
         def sm_loss_and_grads(params_local, ids_mb, targets_mb):

@@ -196,3 +196,78 @@ def test_pipeline_quantized_params(devices):
     with mesh:
         loss = fwd(qparams, ids_mb, targets_mb)
     assert np.isfinite(float(loss))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("pp,tp", [(4, 1), (4, 4)])
+def test_grad_scaling_rule_at_4x4(pp, tp):
+    """Property test for the derived 1/(pp*tp) gradient rule OUTSIDE the
+    previously verified {1,2} envelope (VERDICT r1 item 5): every leaf's
+    raw pipeline gradient must be exactly pp*tp x the single-device
+    gradient.  Runs tools/grad_scale_probe.py in a subprocess because it
+    needs a 16-device virtual mesh (conftest pins this process to 8)."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    probe = Path(__file__).parent.parent / "tools" / "grad_scale_probe.py"
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="")
+    env.pop("XLA_FLAGS", None)   # probe sets its own device count
+    proc = subprocess.run(
+        [sys.executable, str(probe), "--pp", str(pp), "--tp", str(tp)],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    # "uniform" already encodes the probe's 1%/2% per-leaf tolerance;
+    # exact float equality on the medians would be flaky across backends
+    assert out["uniform"], out
+
+
+@pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2), (4, 1)])
+def test_pipeline_generate_matches_engine(pp, tp, devices):
+    """SPMD circular-pipeline decode (ppermute ring + token lane) must
+    reproduce the single-chip engine's greedy tokens for every microbatch
+    (VERDICT r1 item 6)."""
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.parallel.pipeline import (
+        make_pipeline_generate_fn)
+    from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    greedy = SamplingParams(greedy=True)
+    M, b, plen, new = 4, 2, 8, 6
+    rng = jax.random.PRNGKey(7)
+    ids = jax.random.randint(rng, (M, b, plen), 0, cfg.vocab_size,
+                             jnp.int32)
+
+    engine = InferenceEngine(cfg, params, max_seq=32, sampling=greedy)
+    want = np.stack([engine.generate(np.asarray(ids[m]), new).tokens
+                     for m in range(M)])
+
+    mesh = make_mesh(MeshConfig(pp=pp, tp=tp), devices)
+    gen = make_pipeline_generate_fn(cfg, mesh, max_seq=32,
+                                    num_new_tokens=new, sampling=greedy)
+    with mesh:
+        got = np.asarray(gen(params, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pipeline_generate_rejects_bad_shapes(devices):
+    from distributed_inference_demo_tpu.parallel.pipeline import (
+        make_pipeline_generate_fn)
+
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    mesh1 = make_mesh(MeshConfig(pp=1), devices)
+    with pytest.raises(ValueError, match="pp >= 2"):
+        make_pipeline_generate_fn(cfg, mesh1, max_seq=32, num_new_tokens=4)
+
+    mesh = make_mesh(MeshConfig(pp=4), devices)
+    gen = make_pipeline_generate_fn(cfg, mesh, max_seq=32, num_new_tokens=4)
+    ids = jnp.zeros((2, 1, 8), jnp.int32)   # M=2 < S=4
+    with mesh:
+        with pytest.raises(ValueError, match="microbatches"):
+            gen(params, ids, jax.random.PRNGKey(0))
