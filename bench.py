@@ -7,22 +7,32 @@ TinyLlama-1.1B, single chip, vs the measured 2-process CPU socket-pipeline
 baseline of the SAME model/batch (``tools/cpu_baseline.py`` →
 ``tools/cpu_baseline.json``).  North-star target: >= 10x.
 
-Extra measurements (reported inside the same JSON object):
+Extra legs (each reported inside the same JSON object):
 
-- prefill tokens/sec (TinyLlama);
-- Llama-3-8B single-chip decode tok/s at int8 and (HBM permitting) bf16 —
-  BASELINE.md's flagship model;
-- inter-shard activation latency p50/p95 across a live 2-process socket
-  pipeline (device header + CPU worker — BASELINE config #2's
-  heterogeneous shape), derived from the hot-loop stats
-  (``runtime/stats.py``; reference timers ``Communication.java:859-896``).
+- ``headline_int8``: int8 TinyLlama decode (half the HBM bytes/step —
+  decode is bandwidth-bound, so this is the throughput configuration);
+- ``sweep``: batch sweep 8/32/64 at bf16 and int8, each with achieved
+  HBM GB/s (= weights_bytes x steps/s) so the roofline gap is visible;
+- ``flagship_int8`` / ``flagship_bf16``: Llama-3-8B single-chip decode —
+  BASELINE.md's flagship model (bf16 weights exceed a 16 GB chip: the leg
+  reports "does not fit" from a host-side precheck instead of OOMing);
+- ``pipeline``: inter-shard activation latency p50/p95 across a live
+  2-process socket pipeline (device header + CPU worker — BASELINE
+  config #2's heterogeneous shape), from the hot-loop stats
+  (``runtime/stats.py``; reference timers ``Communication.java:859-896``);
+- ``prefill_long``: long-prompt prefill, Pallas flash kernel vs jnp
+  attention, 2k-8k tokens.
 
-Each leg is independent: failures are reported as {"error": ...} for that
-leg instead of killing the bench.
+**Process isolation:** every leg runs in a fresh subprocess (`--leg` mode)
+with its own TPU context, so one leg's allocations or failure can never
+poison the next (the round-2 bench lost all three flagship legs to exactly
+that).  The parent process never initializes JAX.
 """
 
+import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -30,10 +40,10 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent
 BASELINE_PATH = REPO / "tools" / "cpu_baseline.json"
 
-# Fallback when tools/cpu_baseline.json is absent on the bench host:
-# measured by tools/cpu_baseline.py on the build host (1-core x86_64 VM,
-# see that file's JSON for full provenance).
-FALLBACK_BASELINE = {"tokens_per_sec": None, "source": "missing"}
+# Approximate HBM bandwidth by device kind, for roofline fractions in the
+# report (sources: public TPU specs; v5e ~819 GB/s, v4 ~1228 GB/s).
+HBM_GBS = {"TPU v5 lite": 819.0, "TPU v5": 819.0, "TPU v4": 1228.0,
+           "TPU v5p": 2765.0, "TPU v6 lite": 1640.0}
 
 
 def _load_baseline() -> dict:
@@ -41,7 +51,43 @@ def _load_baseline() -> dict:
         data = json.loads(BASELINE_PATH.read_text())
         data["source"] = "tools/cpu_baseline.json"
         return data
-    return dict(FALLBACK_BASELINE)
+    return {"tokens_per_sec": None, "source": "missing"}
+
+
+def _device_kind():
+    import jax
+    return jax.devices()[0].device_kind
+
+
+def _hbm_limit_bytes():
+    """Per-device HBM capacity if the backend exposes it, else None."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats()
+        return stats.get("bytes_limit")
+    except Exception:
+        return None
+
+
+def _with_bandwidth(result: dict, weights_bytes: int, device: str) -> dict:
+    """Annotate a decode result with achieved HBM GB/s and roofline frac.
+
+    Decode is weight-streaming-bound: every step reads all weights once,
+    so achieved_gbs = weights_bytes * steps/s is a lower bound on HBM
+    traffic actually sustained (cache reads add more)."""
+    tps = result.get("decode_tokens_per_sec")
+    batch = result.get("batch")
+    if not tps or not batch:
+        return result
+    steps_per_sec = tps / batch
+    gbs = weights_bytes * steps_per_sec / 1e9
+    result["weights_gb"] = round(weights_bytes / 1e9, 3)
+    result["achieved_gbs"] = round(gbs, 1)
+    roof = HBM_GBS.get(device)
+    if roof:
+        result["hbm_roofline_frac"] = round(gbs / roof, 3)
+        result["hbm_gbs_assumed"] = roof
+    return result
 
 
 def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
@@ -51,17 +97,15 @@ def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
     import numpy as np
     from distributed_inference_demo_tpu.models import get_model_config
     from distributed_inference_demo_tpu.models.decoder import init_full_params
-    from distributed_inference_demo_tpu.ops.quant import maybe_quantize
     from distributed_inference_demo_tpu.ops.sampling import SamplingParams
     from distributed_inference_demo_tpu.runtime import InferenceEngine
 
     name = model + ("-int8" if quant else "")
     cfg = get_model_config(name)
-    # quantize at creation time: peak HBM stays near the int8 footprint
-    # instead of materializing the bf16 tree first (which would OOM exactly
-    # the chips int8 exists to fit on)
+    # layer-chunked init+quantize: peak HBM stays near the int8 footprint
+    # instead of materializing the float tree first (which would OOM exactly
+    # the chips int8 exists to fit on) — models/decoder.py:_init_quantized
     params = init_full_params(jax.random.PRNGKey(0), cfg, quantize=quant)
-    params = maybe_quantize(params, cfg)  # no-op for already-wrapped leaves
     engine = InferenceEngine(
         cfg, params, max_seq=prompt_len + new_tokens,
         sampling=SamplingParams(temperature=0.7, top_k=7))  # ref defaults
@@ -73,32 +117,128 @@ def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
     decode_tps = result.tokens_per_second
 
     # prefill throughput: time prefill alone on a fresh cache
-    import jax as _jax
     cache = engine.new_cache(batch)
     t0 = time.perf_counter()
     logits, cache = engine._prefill(engine.params, prompt, cache)
-    _jax.block_until_ready(logits)
+    jax.block_until_ready(logits)
     prefill_s = time.perf_counter() - t0
     prefill_tps = batch * prompt_len / prefill_s
 
-    return {
+    out = {
         "model": name,
         "decode_tokens_per_sec": round(decode_tps, 2),
         "prefill_tokens_per_sec": round(prefill_tps, 2),
         "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
         "dtype": "int8" if quant else cfg.dtype_name,
     }
+    return _with_bandwidth(out, params.nbytes(), _device_kind())
 
 
-def _bench_pipeline_latency(model: str, batch: int, prompt_len: int,
-                            new_tokens: int) -> dict:
+def _weights_bytes_estimate(model: str) -> int:
+    """Host-side parameter-count estimate (no device allocation)."""
+    from distributed_inference_demo_tpu.models import get_model_config
+    cfg = get_model_config(model)
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    attn = H * nh * hd + 2 * H * nkv * hd + nh * hd * H
+    mlp = 3 * H * I if cfg.family != "bloom" else 2 * H * I
+    if cfg.num_experts:
+        mlp *= cfg.num_experts
+    per_layer = attn + mlp
+    embed = cfg.vocab_size * H * (1 if cfg.tie_embeddings else 2)
+    bpp = 1 if cfg.quantization == "int8" else jnp_bytes(cfg.dtype_name)
+    # embeddings/head stay at the model dtype even under int8
+    return L * per_layer * bpp + embed * jnp_bytes(cfg.dtype_name)
+
+
+def jnp_bytes(dtype_name: str) -> int:
+    import numpy as np
+    return np.dtype(dtype_name if dtype_name != "bfloat16" else "uint16").itemsize
+
+
+def _leg_flagship(model: str, batch: int, prompt_len: int, new_tokens: int,
+                  quant: bool) -> dict:
+    name = model + ("-int8" if quant else "")
+    need = _weights_bytes_estimate(name)
+    limit = _hbm_limit_bytes()
+    if limit and need > limit * 0.92:  # leave room for cache + compiled code
+        return {"model": name,
+                "skipped": f"does not fit: ~{need / 1e9:.1f} GB weights vs "
+                           f"{limit / 1e9:.1f} GB HBM"}
+    return _bench_engine(model, batch, prompt_len, new_tokens, quant=quant)
+
+
+def _leg_sweep(model: str, prompt_len: int, new_tokens: int) -> dict:
+    """Batch sweep at bf16 and int8 with achieved GB/s per point.
+    Points are isolated: one OOMing batch size must not discard the rest."""
+    points = []
+    for quant in (False, True):
+        for batch in (8, 32, 64):
+            try:
+                points.append(_bench_engine(model, batch, prompt_len,
+                                            new_tokens, quant=quant))
+            except Exception as e:
+                points.append({"model": model, "batch": batch,
+                               "dtype": "int8" if quant else "bf16",
+                               "error": f"{type(e).__name__}: {e}"})
+    return {"points": points}
+
+
+def _leg_prefill_long(model: str) -> dict:
+    """Long-prompt prefill: Pallas flash kernel vs jnp attention.
+
+    >= 100k tokens of work per measurement; this is where the L1 kernel
+    story must show up in an artifact (decode chunks route to the XLA path
+    by design — make_flash_attn_impl min_chunk)."""
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    out = {"model": model, "points": []}
+    for seq in (2048, 4096, 8192):
+        batch = max(1, 131072 // seq)  # >=128k tokens of work per repeat
+        point = {"prompt_len": seq, "batch": batch}
+        for backend in ("flash", "jnp"):
+            try:
+                engine = InferenceEngine(cfg, params, max_seq=seq,
+                                         attn_backend=backend)
+                prompt = (np.arange(batch * seq).reshape(batch, seq)
+                          % 1000).astype(np.int32)
+                cache = engine.new_cache(batch)
+                logits, _ = engine._prefill(engine.params, prompt, cache)
+                jax.block_until_ready(logits)  # compile warmup
+                reps = 3
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    cache = engine.new_cache(batch)
+                    logits, cache = engine._prefill(engine.params, prompt,
+                                                    cache)
+                jax.block_until_ready(logits)
+                dt = (time.perf_counter() - t0) / reps
+                point[backend + "_tokens_per_sec"] = round(
+                    batch * seq / dt, 1)
+            except Exception as e:  # per-point, per-backend isolation
+                point[backend + "_error"] = f"{type(e).__name__}: {e}"
+        if ("flash_tokens_per_sec" in point
+                and "jnp_tokens_per_sec" in point):
+            point["flash_speedup"] = round(
+                point["flash_tokens_per_sec"]
+                / point["jnp_tokens_per_sec"], 3)
+        out["points"].append(point)
+    return out
+
+
+def _leg_pipeline(model: str, batch: int, prompt_len: int,
+                  new_tokens: int) -> dict:
     """2-process socket pipeline: this process (default backend — the TPU
     when present) is the header, a spawned CPU process is the tail.
     Inter-shard activation latency is derived per token as
     ``(ring RTT - tail compute p50) / 2`` — the RTT covers exactly two
     socket hops (hidden out, token back) around the tail's compute."""
-    import subprocess
-
     import numpy as np
     import jax
     from distributed_inference_demo_tpu.comm.transport import ZmqTransport
@@ -175,60 +315,121 @@ def _bench_pipeline_latency(model: str, batch: int, prompt_len: int,
     return out
 
 
-def _leg(fn, *args, **kw):
+# ---------------------------------------------------------------------------
+# Leg dispatch (subprocess entry) + orchestrator
+# ---------------------------------------------------------------------------
+
+def run_leg(name: str, p: dict) -> dict:
+    model, batch = p["model"], p["batch"]
+    prompt_len, new_tokens = p["prompt_len"], p["new_tokens"]
+    flagship = p["flagship"]
+    if name == "headline":
+        out = _bench_engine(model, batch, prompt_len, new_tokens)
+    elif name == "headline_int8":
+        out = _bench_engine(model, batch, prompt_len, new_tokens, quant=True)
+    elif name == "sweep":
+        out = _leg_sweep(model, prompt_len, new_tokens)
+    elif name == "flagship_int8":
+        out = _leg_flagship(flagship, batch, prompt_len,
+                            min(new_tokens, 64), quant=True)
+    elif name == "flagship_bf16":
+        out = _leg_flagship(flagship, batch, prompt_len,
+                            min(new_tokens, 64), quant=False)
+    elif name == "pipeline":
+        out = _leg_pipeline(model, batch, prompt_len, min(new_tokens, 32))
+    elif name == "prefill_long":
+        out = _leg_prefill_long(model)
+    else:
+        raise SystemExit(f"unknown leg {name!r}")
+    out.setdefault("device", _device_kind())
+    return out
+
+
+def _spawn_leg(name: str, params: dict, timeout: int = 1500) -> dict:
+    """Run one leg in a fresh process; parse the last stdout line as JSON."""
     try:
-        return fn(*args, **kw)
-    except Exception as e:      # report, don't kill the bench
-        return {"error": f"{type(e).__name__}: {e}"}
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--leg", name,
+             "--params", json.dumps(params)],
+            capture_output=True, text=True, timeout=timeout, cwd=str(REPO))
+    except subprocess.TimeoutExpired:
+        return {"error": f"leg timed out after {timeout}s"}
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    if proc.returncode != 0 or not lines:
+        tail = (proc.stderr or "").strip().splitlines()[-8:]
+        return {"error": f"leg exited rc={proc.returncode}",
+                "stderr_tail": tail}
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return {"error": f"unparseable leg output: {lines[-1][:200]}"}
 
 
 def main() -> None:
-    import jax
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--leg")
+    ap.add_argument("--params")
+    args = ap.parse_args()
 
-    model = os.environ.get("BENCH_MODEL", "tinyllama-1.1b")
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
-    prompt_len = int(os.environ.get("BENCH_PROMPT", "64"))
-    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
-    flagship = os.environ.get("BENCH_FLAGSHIP", "llama-3-8b")
-    skip_flagship = os.environ.get("BENCH_SKIP_FLAGSHIP", "") == "1"
-    skip_pipeline = os.environ.get("BENCH_SKIP_PIPELINE", "") == "1"
+    params = {
+        "model": os.environ.get("BENCH_MODEL", "tinyllama-1.1b"),
+        "batch": int(os.environ.get("BENCH_BATCH", "8")),
+        "prompt_len": int(os.environ.get("BENCH_PROMPT", "64")),
+        "new_tokens": int(os.environ.get("BENCH_NEW_TOKENS", "128")),
+        "flagship": os.environ.get("BENCH_FLAGSHIP", "llama-3-8b"),
+    }
+    if args.leg:  # subprocess mode: one leg, one JSON line
+        if args.params:
+            params.update(json.loads(args.params))
+        print(json.dumps(run_leg(args.leg, params)))
+        return
 
-    device = jax.devices()[0].device_kind
+    legs = ["headline", "headline_int8", "sweep", "flagship_int8",
+            "flagship_bf16", "pipeline", "prefill_long"]
+    for skip_var, leg_names in (
+            ("BENCH_SKIP_FLAGSHIP", ["flagship_int8", "flagship_bf16"]),
+            ("BENCH_SKIP_PIPELINE", ["pipeline"]),
+            ("BENCH_SKIP_SWEEP", ["sweep"]),
+            ("BENCH_SKIP_PREFILL", ["prefill_long"])):
+        if os.environ.get(skip_var, "") == "1":
+            legs = [l for l in legs if l not in leg_names]
+    only = os.environ.get("BENCH_ONLY")
+    if only:
+        legs = [l for l in legs if l in only.split(",")]
+
+    results = {}
+    for leg in legs:
+        results[leg] = _spawn_leg(leg, params)
+
     baseline = _load_baseline()
-
-    headline = _leg(_bench_engine, model, batch, prompt_len, new_tokens)
+    headline = results.get("headline", {})
+    device = headline.get("device", "unknown")
+    tps = headline.get("decode_tokens_per_sec")
+    base_tps = baseline.get("tokens_per_sec")
+    # only a same-model/batch/prompt/new-tokens comparison is meaningful;
+    # anything else reports null rather than a mislabeled multiplier.  The
+    # one stated asymmetry is dtype: CPU runs f32 (its native dtype — bf16
+    # is emulated and slower there), TPU runs bf16.
+    comparable = all(
+        baseline.get(k) == params[k]
+        for k in ("model", "batch", "prompt_len", "new_tokens"))
+    vs = (round(tps / base_tps, 2)
+          if tps is not None and base_tps and comparable else None)
 
     extras = {"device": device, "baseline": {
         k: baseline.get(k) for k in
         ("tokens_per_sec", "model", "dtype", "batch", "host", "cpu",
          "measured_at", "source")}}
-    if not skip_flagship:
-        extras["flagship_int8"] = _leg(
-            _bench_engine, flagship, batch, prompt_len,
-            min(new_tokens, 32), quant=True)
-        extras["flagship_bf16"] = _leg(
-            _bench_engine, flagship, batch, prompt_len,
-            min(new_tokens, 32), quant=False)
-    if not skip_pipeline:
-        extras["pipeline"] = _leg(
-            _bench_pipeline_latency, model, batch, prompt_len,
-            min(new_tokens, 32))
-
-    tps = headline.get("decode_tokens_per_sec")
-    base_tps = baseline.get("tokens_per_sec")
-    # only a same-model/same-batch comparison is meaningful; anything else
-    # reports null rather than a mislabeled multiplier
-    comparable = (baseline.get("model") == model
-                  and baseline.get("batch") == batch)
-    vs = (round(tps / base_tps, 2)
-          if tps is not None and base_tps and comparable else None)
+    extras.update({k: v for k, v in results.items() if k != "headline"})
 
     print(json.dumps({
-        "metric": f"decode tokens/sec ({model}, "
-                  f"{headline.get('dtype', '?')}, batch={batch}, "
-                  f"prompt={prompt_len}, new={new_tokens}, "
+        "metric": f"decode tokens/sec ({params['model']}, "
+                  f"{headline.get('dtype', '?')}, batch={params['batch']}, "
+                  f"prompt={params['prompt_len']}, "
+                  f"new={params['new_tokens']}, "
                   f"device={device}) vs measured 2-process CPU "
-                  f"socket-pipeline baseline (same model/batch)",
+                  f"socket-pipeline baseline (same model/batch/prompt/new; "
+                  f"CPU at f32, its native dtype)",
         "value": tps,
         "unit": "tokens/sec",
         "vs_baseline": vs,

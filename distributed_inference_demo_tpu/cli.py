@@ -32,11 +32,19 @@ def _load_tokenizer(path: Optional[str]):
     return Tokenizer.from_json(path)
 
 
-def _build_engine(args):
-    import jax
-
-    from .models.decoder import init_full_params
+def _load_full_params(args, cfg):
+    """Resolve the full parameter tree for a CLI invocation: checkpoint if
+    ``--checkpoint`` was given, else seed-init (int8-quantized during init
+    for ``-int8`` configs).  Shared by the single-node and ``--chain``
+    serve paths so a checkpoint can never be silently ignored on one of
+    them."""
     from .models.loader import load_or_init
+
+    return load_or_init(args.model, cfg, getattr(args, "checkpoint", None),
+                        seed=args.weights_seed)
+
+
+def _build_engine(args):
     from .models.registry import get_model_config
     from .ops.sampling import SamplingParams
     from .runtime import InferenceEngine
@@ -44,11 +52,7 @@ def _build_engine(args):
     cfg = get_model_config(args.model)
     sampling = SamplingParams(greedy=True) if args.greedy else \
         SamplingParams(temperature=args.temperature, top_k=args.top_k)
-    if getattr(args, "checkpoint", None):
-        params = load_or_init(args.model, cfg, args.checkpoint,
-                              seed=args.weights_seed)
-    else:
-        params = init_full_params(jax.random.PRNGKey(args.weights_seed), cfg)
+    params = _load_full_params(args, cfg)
     return cfg, InferenceEngine(cfg, params, max_seq=args.max_seq,
                                 sampling=sampling,
                                 attn_backend=args.attn_backend)
@@ -70,13 +74,12 @@ def cmd_serve(args) -> int:
 
         from .comm.transport import ZmqTransport
         from .models.base import split_layer_ranges
-        from .models.decoder import init_full_params
         from .models.registry import get_model_config
         from .ops.sampling import SamplingParams
         from .runtime.elastic import ElasticHeader, ElasticStageRuntime
 
         cfg = get_model_config(args.model)
-        full = init_full_params(jax.random.PRNGKey(args.weights_seed), cfg)
+        full = _load_full_params(args, cfg)
         sampling = SamplingParams(greedy=True) if args.greedy else \
             SamplingParams(temperature=args.temperature, top_k=args.top_k)
 

@@ -11,9 +11,10 @@ slice — and the per-stage forward is a single ``lax.scan`` over the stacked
 layers, which XLA compiles into one fused loop that keeps the MXU busy.
 
 The KV cache is first-class (the reference has none — SURVEY.md §2.7): a
-preallocated ``[layers, batch, max_seq, kv_heads, head_dim]`` pair updated in
-place via ``lax.dynamic_update_slice`` with donated buffers, so decode steps
-are O(1) in allocation and fully jit-compatible (static shapes).
+preallocated head-major ``[layers, batch, kv_heads, max_seq, head_dim]`` pair
+(see ``KVCache`` for why head-major) updated in place via
+``lax.dynamic_update_slice`` with donated buffers, so decode steps are O(1)
+in allocation and fully jit-compatible (static shapes).
 """
 
 from __future__ import annotations

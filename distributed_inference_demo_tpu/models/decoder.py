@@ -14,6 +14,7 @@ depth and the MXU saturated.  The KV cache threads through the scan as
 per-layer xs/ys so each layer updates its slice functionally.
 """
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -30,38 +31,74 @@ from .base import KVCache, ModelConfig, StageParams, StageSpec
 # Initialization
 # ---------------------------------------------------------------------------
 
+@partial(jax.jit, static_argnames=("shape", "dtype"))
+def _dense_init_jit(rng, scale, shape, dtype):
+    # f32 sampling + scale + convert fuse into one XLA kernel under jit:
+    # only the target-dtype output is ever materialized in HBM.
+    return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(dtype)
+
+
 def _dense_init(rng, shape, dtype, scale=None):
     fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
-    scale = scale if scale is not None else 1.0 / jnp.sqrt(fan_in)
-    return (jax.random.normal(rng, shape, jnp.float32) * scale).astype(dtype)
+    scale = scale if scale is not None else float(fan_in) ** -0.5
+    return _dense_init_jit(rng, jnp.float32(scale), tuple(shape),
+                           jnp.dtype(dtype))
+
+
+@partial(jax.jit, static_argnames=("shape", "dtype"))
+def _init_quantized_layer(rng, scale, shape, dtype):
+    from ..ops.quant import quantize_array
+    w = _dense_init_jit(rng, scale, shape, dtype)
+    qa = quantize_array(w, stacked=False)
+    return qa.q, qa.scale
+
+
+def _init_quantized(rng, shape, dtype, scale=None):
+    """Init + int8-quantize one layer slice at a time.
+
+    Peak HBM stays at the accumulating int8 footprint plus ONE layer's
+    float transient — never the full tensor at float width.  This is what
+    lets an int8 Llama-3-8B be random-initialized on a 16 GB chip whose
+    bf16 variant would not fit (the reference ships pre-quantized exports
+    instead, ``data/Data.kt:19-33``).
+    """
+    from ..ops.quant import QuantizedArray
+    L = shape[0]
+    fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+    scale = jnp.float32(scale if scale is not None else float(fan_in) ** -0.5)
+    keys = jax.random.split(rng, L)
+    qs, scales = [], []
+    for i in range(L):
+        q, s = _init_quantized_layer(keys[i], scale, tuple(shape[1:]),
+                                     jnp.dtype(dtype))
+        qs.append(q)
+        scales.append(s)
+    return QuantizedArray(q=jnp.stack(qs), scale=jnp.stack(scales))
 
 
 def init_layer_params(rng: jax.Array, cfg: ModelConfig, num_layers: int,
                       quantize: bool = False) -> dict:
     """Stacked per-layer weights, leading dim = num_layers.
 
-    With ``quantize``, each big matmul operand is int8-quantized the moment
-    it is created, so peak memory stays near the int8 footprint instead of
-    materializing the whole model at the float dtype first — this is what
-    lets an int8 8B model be random-initialized on a chip the bf16 variant
-    would not fit on.
+    With ``quantize``, each big matmul operand is generated and int8-
+    quantized layer-by-layer (``_init_quantized``), so peak memory stays
+    near the int8 footprint instead of materializing the whole tensor at
+    the float dtype first — this is what lets an int8 8B model be
+    random-initialized on a chip the bf16 variant would not fit on.
     """
-    from ..ops.quant import quantize_array
-
     H, nh, nkv, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     I, L = cfg.intermediate_size, num_layers
     dt = cfg.dtype
 
-    def q(w):
-        return quantize_array(w, stacked=True) if quantize else w
+    big = _init_quantized if quantize else _dense_init
 
     keys = jax.random.split(rng, 16)
     p = {
         "attn_norm_w": jnp.ones((L, H), dt),
-        "wq": q(_dense_init(keys[0], (L, H, nh * hd), dt)),
-        "wk": q(_dense_init(keys[1], (L, H, nkv * hd), dt)),
-        "wv": q(_dense_init(keys[2], (L, H, nkv * hd), dt)),
-        "wo": q(_dense_init(keys[3], (L, nh * hd, H), dt)),
+        "wq": big(keys[0], (L, H, nh * hd), dt),
+        "wk": big(keys[1], (L, H, nkv * hd), dt),
+        "wv": big(keys[2], (L, H, nkv * hd), dt),
+        "wo": big(keys[3], (L, nh * hd, H), dt),
         "mlp_norm_w": jnp.ones((L, H), dt),
     }
     if cfg.attn_layernorm:  # bloom: LayerNorm has bias; linears have bias
@@ -74,18 +111,18 @@ def init_layer_params(rng: jax.Array, cfg: ModelConfig, num_layers: int,
     if cfg.num_experts > 0:  # mixtral MoE
         E = cfg.num_experts
         p["router"] = _dense_init(keys[4], (L, H, E), dt)
-        p["w_gate"] = q(_dense_init(keys[5], (L, E, H, I), dt))
-        p["w_up"] = q(_dense_init(keys[6], (L, E, H, I), dt))
-        p["w_down"] = q(_dense_init(keys[7], (L, E, I, H), dt))
+        p["w_gate"] = big(keys[5], (L, E, H, I), dt)
+        p["w_up"] = big(keys[6], (L, E, H, I), dt)
+        p["w_down"] = big(keys[7], (L, E, I, H), dt)
     elif cfg.family == "bloom":  # dense 4H GELU MLP with bias
-        p["w_up"] = q(_dense_init(keys[5], (L, H, I), dt))
+        p["w_up"] = big(keys[5], (L, H, I), dt)
         p["b_up"] = jnp.zeros((L, I), dt)
-        p["w_down"] = q(_dense_init(keys[7], (L, I, H), dt))
+        p["w_down"] = big(keys[7], (L, I, H), dt)
         p["b_down"] = jnp.zeros((L, H), dt)
     else:  # llama SwiGLU
-        p["w_gate"] = q(_dense_init(keys[5], (L, H, I), dt))
-        p["w_up"] = q(_dense_init(keys[6], (L, H, I), dt))
-        p["w_down"] = q(_dense_init(keys[7], (L, I, H), dt))
+        p["w_gate"] = big(keys[5], (L, H, I), dt)
+        p["w_up"] = big(keys[6], (L, H, I), dt)
+        p["w_down"] = big(keys[7], (L, I, H), dt)
     return p
 
 
@@ -324,8 +361,14 @@ def stage_forward(
     tp_axis: Optional[str] = None,  # set inside shard_map for manual TP
     attn_impl=None,             # attention hook (see _default_attn)
     ep_axis: Optional[str] = None,  # expert-parallel MoE axis (shard_map)
+    last_logits_only: bool = False,  # head over the final position only
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run this stage's layer range. Returns (hidden or logits, updated cache).
+
+    ``last_logits_only`` narrows the LM-head matmul to the chunk's final
+    position (shape [b, 1, V]) — prefill only samples from the last token,
+    and a full [b, s, V] logits tensor at long prompts is GBs of HBM for
+    nothing.  Training and scoring paths keep the default (all positions).
 
     The stage seam replaces the reference's ``run_inference`` module boundary
     (``cpp/inference.cpp:145-218``): first stage embeds ids, last stage
@@ -360,6 +403,8 @@ def stage_forward(
     new_cache = KVCache(new_k, new_v, cache_start + inputs.shape[1])
 
     if spec.is_last:
+        if last_logits_only:
+            x = x[:, -1:, :]
         if cfg.attn_layernorm:
             x = layer_norm(x, params.final_norm["w"], params.final_norm["b"],
                            cfg.norm_eps)

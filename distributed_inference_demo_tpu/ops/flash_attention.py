@@ -122,9 +122,22 @@ def _kernel(scalar_ref, q_ref, k_ref, v_ref, slopes_ref, o_ref,
 
 
 def _pick_block(total: int, target: int) -> int:
-    """Largest divisor of ``total`` that is <= target."""
-    b = min(total, target)
-    while total % b:
+    """Largest divisor of ``total`` that is <= target AND a multiple of 8.
+
+    block_k is the sublane (second-to-minor) dimension of the streamed
+    [block_k, hd] K/V tiles, so it must respect the TPU sublane granule of
+    8 — an arbitrary divisor (e.g. 125 for total=1000) would hand Mosaic a
+    misaligned tile.  Raises for totals not divisible by 8: pad max_seq to
+    a multiple of 8 (the engine's KV capacity is caller-chosen) rather than
+    silently running a misaligned kernel.
+    """
+    if total % 8:
+        raise ValueError(
+            f"flash attention requires max_seq divisible by 8, got {total}; "
+            "pad the KV-cache capacity (engine max_seq) to a multiple of 8 "
+            "or use the jnp attention backend")
+    b = min(total, max(8, target - target % 8))
+    while total % b or b % 8:
         b -= 1
     return b
 

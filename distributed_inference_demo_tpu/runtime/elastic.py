@@ -33,8 +33,11 @@ Control tags (data tags are inherited from runtime/distributed.py, with a
 slow-but-not-dead pre-reshard worker is identifiable and dropped instead of
 being run against a fresh cache and producing a wrong token):
 
-- ``reshard:{header_id}``  header → worker, JSON plan {spec, next_id, epoch}
-- ``rack:{device_id}``     worker → header, reshard applied
+- ``reshard:{header_id}``       header → worker, JSON plan {spec, next_id,
+  epoch}
+- ``rack:{device_id}:{epoch}``  worker → header, reshard applied — the ack
+  carries the epoch it acknowledges, so a delayed ack from reshard N can
+  never satisfy reshard N+1's ack-wait
 """
 
 from __future__ import annotations
@@ -122,16 +125,17 @@ class ElasticWorker(PipelineWorker):
                 self.rt.caches.clear()
                 self.epoch = plan["epoch"]
                 self.next_id = None
-                self.transport.send(rest,
-                                    f"rack:{self.transport.device_id}", b"")
+                self.transport.send(
+                    rest, f"rack:{self.transport.device_id}:{self.epoch}",
+                    b"")
                 log.info("worker %s: parked (epoch %d)",
                          self.transport.device_id, self.epoch)
                 return True
             self.rt.reassign(_spec_from(plan["spec"]))
             self.next_id = plan["next_id"]
             self.epoch = plan["epoch"]
-            self.transport.send(rest, f"rack:{self.transport.device_id}",
-                                b"")
+            self.transport.send(
+                rest, f"rack:{self.transport.device_id}:{self.epoch}", b"")
             log.info("worker %s: resharded (epoch %d) to layers [%d,%d) "
                      "of %d stages", self.transport.device_id, self.epoch,
                      self.rt.spec.layer_start, self.rt.spec.layer_end,
@@ -248,10 +252,18 @@ class ElasticHeader(PipelineHeader):
             if left <= 0:
                 raise TransportTimeout(
                     f"reshard acks missing from {sorted(expected_acks)}")
-            tag, _ = self.transport.recv_any(timeout=left)
+            try:
+                tag, _ = self.transport.recv_any(timeout=left)
+            except TransportTimeout:
+                continue  # deadline check above raises the informative error
             kind, _, rest = tag.partition(":")
             if kind == "rack":
-                expected_acks.discard(rest)
+                # rpartition: device ids may themselves contain ':'
+                dev, _, ep = rest.rpartition(":")
+                # epoch-checked: a delayed ack from a previous reshard must
+                # not satisfy this one's ack-wait (ADVICE r1 #3).
+                if dev and ep.isdigit() and int(ep) == self.epoch:
+                    expected_acks.discard(dev)
             # anything else is pre-reshard traffic: drop.
 
         self.rt.reassign(specs[0])

@@ -87,8 +87,12 @@ class InferenceEngine:
         def prefill(params, ids, cache):
             b, s = ids.shape
             pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+            # last_logits_only: the LM head runs on the final position only
+            # ([b, 1, V]) — a full [b, s, V] logits tensor at long prompts
+            # would burn GBs of HBM and head-matmul FLOPs for nothing.
             logits, cache = stage_forward(params, cfg_, spec_, ids, cache,
-                                          pos, attn_impl=attn_impl)
+                                          pos, attn_impl=attn_impl,
+                                          last_logits_only=True)
             return logits[:, -1], cache
 
         @partial(jax.jit, donate_argnums=(2,), static_argnums=(4,))

@@ -147,6 +147,35 @@ def test_cli_plan_and_cache(tmp_path):
     assert json.loads(out) == plan
 
 
+def test_load_full_params_honors_checkpoint(tmp_path):
+    """ADVICE r1 #1: the serve --chain path must load --checkpoint weights,
+    not silently seed-init.  Both serve branches go through
+    _load_full_params; assert it returns the checkpointed tree, which is
+    distinguishable from every seed-init."""
+    import argparse
+
+    from distributed_inference_demo_tpu.checkpoint import save_params
+
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(123), cfg)
+    # perturb so the tree cannot equal ANY seed-init
+    params.embed["tokens"] = params.embed["tokens"] + 1.5
+    ckpt = str(tmp_path / "ckpt")
+    save_params(ckpt, params, cfg, model_name="llama-test")
+
+    args = argparse.Namespace(model="llama-test", checkpoint=ckpt,
+                              weights_seed=0)
+    loaded = cli._load_full_params(args, cfg)
+    np.testing.assert_allclose(np.asarray(loaded.embed["tokens"]),
+                               np.asarray(params.embed["tokens"]))
+
+    args_no = argparse.Namespace(model="llama-test", checkpoint=None,
+                                 weights_seed=0)
+    seeded = cli._load_full_params(args_no, cfg)
+    assert not np.allclose(np.asarray(seeded.embed["tokens"]),
+                           np.asarray(loaded.embed["tokens"]))
+
+
 def test_cli_bench_runs():
     rc, out = _run_cli([
         "bench", "--model", "llama-test", "--batch", "2",

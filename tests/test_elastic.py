@@ -204,3 +204,30 @@ def test_reshard_below_two_devices_raises():
     with pytest.raises(RuntimeError, match="enough devices"):
         header.reshard(["s0"])
     _stop_all(header)
+
+
+def test_stale_epoch_ack_does_not_satisfy_reshard():
+    """ADVICE r1 #3: a delayed ack from reshard N must not satisfy reshard
+    N+1's ack-wait.  No worker threads here — acks are injected by hand."""
+    from distributed_inference_demo_tpu.models.base import split_layer_ranges
+
+    cfg = get_model_config(MODEL)
+    full = init_full_params(jax.random.PRNGKey(0), cfg)
+    specs = split_layer_ranges(cfg.num_layers, 2)
+    net = LoopbackNetwork()
+    t0 = LoopbackTransport("s0", net)
+    t1 = LoopbackTransport("s1", net)
+    header = ElasticHeader(
+        ElasticStageRuntime(cfg, specs[0], full, 64, GREEDY),
+        t0, chain=["s0", "s1"], step_timeout=1.0, poll_interval=0.1)
+
+    # stale ack (epoch 0) already queued when reshard (-> epoch 1) starts:
+    # it must be ignored, so the ack-wait times out.
+    t1.send("s0", "rack:s1:0", b"")
+    with pytest.raises(TransportTimeout, match="reshard acks"):
+        header.reshard(["s0", "s1"])
+
+    # a current-epoch ack (next reshard -> epoch 2) satisfies the wait.
+    t1.send("s0", "rack:s1:2", b"")
+    header.reshard(["s0", "s1"])
+    assert header.epoch == 2

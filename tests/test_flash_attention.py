@@ -17,7 +17,40 @@ from distributed_inference_demo_tpu.models.decoder import (
 from distributed_inference_demo_tpu.ops.attention import (
     alibi_slopes, attention)
 from distributed_inference_demo_tpu.ops.flash_attention import (
-    flash_attention, make_flash_attn_impl)
+    _pick_block, flash_attention, make_flash_attn_impl)
+
+
+def test_pick_block_respects_sublane_alignment():
+    """ADVICE r1 #2: block_k is a sublane dimension — it must be a multiple
+    of 8, never an arbitrary divisor (1000 -> 125 was the bug)."""
+    assert _pick_block(1000, 128) == 40        # not 125
+    assert _pick_block(2048, 128) == 128
+    assert _pick_block(64, 128) == 64
+    assert _pick_block(24, 16) == 16 or _pick_block(24, 16) == 8
+    for total in (8, 16, 40, 128, 1000, 2048):
+        b = _pick_block(total, 128)
+        assert total % b == 0 and b % 8 == 0
+    with pytest.raises(ValueError, match="divisible by 8"):
+        _pick_block(1001, 128)
+
+
+def test_flash_odd_max_seq_multiple_of_8():
+    """A max_seq like 1000 (divisible by 8, not by 128) must pick an
+    aligned block and still match the reference."""
+    rng = np.random.RandomState(0)
+    b, chunk, nh, nkv, hd, max_seq, q_start = 1, 8, 4, 2, 16, 1000, 4
+    kv_len = q_start + chunk
+    q = jnp.asarray(rng.randn(b, chunk, nh, hd), jnp.float32)
+    kc = jnp.asarray(rng.randn(b, nkv, max_seq, hd), jnp.float32)
+    vc = jnp.asarray(rng.randn(b, nkv, max_seq, hd), jnp.float32)
+    mask = (np.arange(max_seq) < kv_len)[None, None, :, None]
+    kc, vc = kc * mask, vc * mask
+    expected = _reference(q, kc, vc, q_start, kv_len, None)
+    got = flash_attention(q, kc, vc, jnp.asarray(q_start, jnp.int32),
+                          jnp.asarray(kv_len, jnp.int32), None,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
 
 
 def _reference(q, kc, vc, q_start, kv_len, slopes):
