@@ -29,7 +29,8 @@ def _load_tokenizer(path: Optional[str]):
     if not path:
         return None
     from .tokenizer import Tokenizer
-    return Tokenizer.from_json(path)
+    # auto-detects sentencepiece .model protobufs vs HF tokenizer.json
+    return Tokenizer.from_file(path)
 
 
 def _load_full_params(args, cfg):
@@ -230,6 +231,105 @@ def cmd_worker(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# chat (streaming REPL client)
+# ---------------------------------------------------------------------------
+
+def _parse_url(url: str):
+    from urllib.parse import urlparse
+    u = urlparse(url if "//" in url else f"http://{url}")
+    return u.hostname or "127.0.0.1", u.port or 5000
+
+
+def stream_generate(host: str, port: int, payload: dict, timeout: float = 600):
+    """POST /generate with stream=true; yield each JSONL line as a dict the
+    moment its chunk arrives (http.client decodes chunked transfer encoding
+    incrementally, so this generator runs concurrently with decoding)."""
+    import http.client
+
+    payload = dict(payload, stream=True)
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/generate", body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"HTTP {resp.status}: {resp.read().decode(errors='replace')}")
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+    finally:
+        conn.close()
+
+
+def cmd_chat(args) -> int:
+    """Terminal chat REPL over the streaming HTTP endpoint — the reference's
+    ChatScreen/DataRepository loop (``ChatScreen.kt:1-353``,
+    ``DataRepository.kt:5-27``: partial decodes pushed to the UI as they
+    stream, ``Communication.java:629-638``) as a console app.
+
+    Reads one message per line, POSTs ``stream: true``, and renders tokens
+    as each chunk arrives.  With ``--ids`` the input line is comma-separated
+    token ids (drives tokenizer-less servers, e.g. in tests); otherwise the
+    message is wrapped in the reference's prompt template
+    (``BackgroundService.java:211``) and tokenized locally (``--tokenizer``)
+    or server-side.
+    """
+    tokenizer = _load_tokenizer(args.tokenizer)
+    host, port = _parse_url(args.url)
+
+    print(f"chat -> http://{host}:{port}  (/quit to exit)", flush=True)
+    while True:
+        sys.stdout.write("> ")
+        sys.stdout.flush()
+        line = sys.stdin.readline()
+        if not line:
+            break                       # EOF
+        line = line.strip()
+        if not line:
+            continue
+        if line in ("/quit", "/exit"):
+            break
+
+        payload = {"max_new_tokens": args.max_new_tokens, "seed": args.seed}
+        if args.ids:
+            try:
+                payload["prompt_ids"] = [[int(t) for t in line.split(",")]]
+            except ValueError:
+                print("[error] --ids mode expects comma-separated ints",
+                      file=sys.stderr)
+                continue
+        else:
+            prompt = args.template.format(msg=line)
+            if tokenizer is not None:
+                payload["prompt_ids"] = [tokenizer.encode(prompt)]
+            else:
+                payload["prompt"] = prompt   # server-side tokenizer
+
+        try:
+            for item in stream_generate(host, port, payload):
+                if "text" in item:
+                    piece = item["text"][0]
+                elif tokenizer is not None:
+                    piece = tokenizer.decode([int(item["tokens"][0])])
+                else:
+                    piece = ("" if item["step"] == 0 else " ") + \
+                        str(item["tokens"][0])
+                sys.stdout.write(piece)
+                sys.stdout.flush()
+        except (ConnectionError, OSError, RuntimeError) as e:
+            print(f"\n[error] {e}", file=sys.stderr)
+            continue
+        sys.stdout.write("\n")
+        sys.stdout.flush()
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # plan
 # ---------------------------------------------------------------------------
 
@@ -296,6 +396,47 @@ def cmd_generate(args) -> int:
     if tokenizer is not None:
         out["text"] = [tokenizer.decode(r) for r in res.tokens.tolist()]
     print(json.dumps(out))
+    return 0
+
+
+def cmd_classify(args) -> int:
+    """Dataset classification accuracy run (the reference's classification
+    task: ``Dataset.java:20-44`` CSV in, accuracy out,
+    ``BackgroundService.java:233-245``).  Rows are ``text,label``; with
+    ``--tokenizer`` the text is encoded, otherwise it must be
+    space-separated token ids."""
+    import numpy as np
+
+    from .tasks import evaluate_classifier, load_csv_dataset
+
+    ds = load_csv_dataset(args.dataset)
+    tokenizer = _load_tokenizer(args.tokenizer)
+    prompts = []
+    for text in ds.texts:
+        if tokenizer is not None:
+            ids = tokenizer.encode(text)
+        else:
+            try:
+                ids = [int(t) for t in text.split()]
+            except ValueError:
+                print("without --tokenizer, dataset text must be "
+                      "space-separated token ids", file=sys.stderr)
+                return 1
+        prompts.append(np.asarray([ids], dtype=np.int32))
+
+    label_ids = [int(t) for t in args.label_token_ids.split(",")]
+    if len(label_ids) != len(ds.label_names):
+        print(f"--label-token-ids has {len(label_ids)} entries but the "
+              f"dataset has {len(ds.label_names)} classes "
+              f"({ds.label_names})", file=sys.stderr)
+        return 1
+
+    _, engine = _build_engine(args)
+    result = evaluate_classifier(
+        lambda batch: engine.classify(batch, label_ids),
+        prompts, ds.labels, batch_size=args.batch)
+    result["label_names"] = ds.label_names
+    print(json.dumps(result))
     return 0
 
 
@@ -389,6 +530,21 @@ def main(argv=None) -> int:
     p.add_argument("--load", default="")
     p.set_defaults(fn=cmd_plan)
 
+    c = sub.add_parser("chat", help="streaming chat REPL against a "
+                       "serve/server HTTP endpoint")
+    c.add_argument("--url", default="http://127.0.0.1:5000")
+    c.add_argument("--max-new-tokens", type=int, default=128)
+    c.add_argument("--tokenizer", default="",
+                   help="local tokenizer.json for encode/decode (else the "
+                        "server's tokenizer handles text)")
+    c.add_argument("--ids", action="store_true",
+                   help="input lines are comma-separated token ids")
+    c.add_argument("--template", default="User: {msg}. Response:",
+                   help="prompt template (reference "
+                        "BackgroundService.java:211)")
+    c.add_argument("--seed", type=int, default=0)
+    c.set_defaults(fn=cmd_chat)
+
     g = sub.add_parser("generate", help="one-shot local generation")
     _add_engine_args(g)
     g.add_argument("--prompt-ids", default="")
@@ -400,6 +556,17 @@ def main(argv=None) -> int:
     b.add_argument("--batch", type=int, default=8)
     b.add_argument("--prompt-len", type=int, default=64)
     b.set_defaults(fn=cmd_bench)
+
+    cl = sub.add_parser("classify", help="CSV dataset classification "
+                        "accuracy run")
+    _add_engine_args(cl)
+    cl.add_argument("--dataset", required=True,
+                    help="CSV file of text,label rows (Dataset.java:20-44)")
+    cl.add_argument("--label-token-ids", required=True,
+                    help="comma list: one verbalizer token id per class, "
+                         "in dataset label-name order")
+    cl.add_argument("--batch", type=int, default=8)
+    cl.set_defaults(fn=cmd_classify)
 
     args, rest = ap.parse_known_args(argv)
     args.rest = rest

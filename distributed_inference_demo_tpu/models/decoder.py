@@ -153,6 +153,17 @@ def init_full_params(rng: jax.Array, cfg: ModelConfig,
 # Forward
 # ---------------------------------------------------------------------------
 
+def embed_tokens(params: StageParams, cfg: ModelConfig,
+                 ids: jnp.ndarray) -> jnp.ndarray:
+    """Token ids -> [b, s, H] through the full embedding pipeline (table
+    lookup + bloom's embedding LayerNorm).  The single source shared by the
+    ids path of ``stage_forward`` and multimodal prefix construction."""
+    x = params.embed["tokens"][ids]
+    if "norm_w" in params.embed:  # bloom embedding LayerNorm
+        x = layer_norm(x, params.embed["norm_w"], params.embed["norm_b"],
+                       cfg.norm_eps)
+    return x
+
 def _mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
          tp_axis: Optional[str] = None,
          ep_axis: Optional[str] = None) -> jnp.ndarray:
@@ -378,10 +389,13 @@ def stage_forward(
     inter-stage tensor is the [b, s, H] hidden state.
     """
     if spec.is_first:
-        x = params.embed["tokens"][inputs]  # [b, s, H]
-        if "norm_w" in params.embed:  # bloom embedding LayerNorm
-            x = layer_norm(x, params.embed["norm_w"], params.embed["norm_b"],
-                           cfg.norm_eps)
+        if jnp.issubdtype(inputs.dtype, jnp.floating):
+            # pre-embedded [b, s, H] prefix (multimodal: projected vision
+            # patches ++ token embeddings — models/vision.py); assumed to
+            # be past the embedding pipeline incl. any bloom embed-norm.
+            x = inputs.astype(cfg.dtype)
+        else:
+            x = embed_tokens(params, cfg, inputs)  # [b, s, H]
     else:
         x = inputs.astype(cfg.dtype)
 

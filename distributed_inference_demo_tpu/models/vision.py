@@ -1,0 +1,143 @@
+"""Vision encoder + projector: the LLaVA-style multimodal stage 0.
+
+BASELINE.json config #5: "LLaVA-1.5 multimodal: vision encoder on an edge
+client, LLM decoder shard on TPU".  The reference's closest concept is
+heterogeneous per-device module placement (``server.py:831-832`` — a
+ModelCard splitting arbitrary HF models into per-device modules); it ships
+no vision path, so this is a from-scratch TPU-first design:
+
+- ViT encoder as pure functions over stacked-layer weights (same design as
+  ``models/decoder.py``): patchify = reshape + one [p*p*c, H] matmul (an
+  MXU-shaped "conv"), learned position embeddings, pre-norm bidirectional
+  attention blocks in a single ``lax.scan``, GELU MLP.
+- A 2-layer projector mapping vision hidden size to the decoder's hidden
+  size (LLaVA-1.5's mlp2x_gelu projector shape).
+
+``vision_forward`` emits ``[batch, num_patches, decoder_hidden]`` ready to
+be concatenated with token embeddings and fed into any decoder stage as a
+pre-embedded prefix (``decoder.stage_forward`` accepts float inputs on the
+first stage).
+"""
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.norms import layer_norm
+from .decoder import _dense_init
+
+
+@partial(jax.tree_util.register_dataclass, data_fields=[],
+         meta_fields=["image_size", "patch_size", "channels", "hidden_size",
+                      "num_layers", "num_heads", "intermediate_size",
+                      "norm_eps", "dtype_name"])
+@dataclass(frozen=True)
+class VisionConfig:
+    """ViT architecture description (defaults ≈ a small CLIP-style tower;
+    llava-1.5 scale would be image 336 / patch 14 / hidden 1024 / 24
+    layers)."""
+
+    image_size: int = 64
+    patch_size: int = 16
+    channels: int = 3
+    hidden_size: int = 128
+    num_layers: int = 4
+    num_heads: int = 4
+    intermediate_size: int = 512
+    norm_eps: float = 1e-5
+    dtype_name: str = "float32"
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.dtype_name)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def init_vision_params(rng: jax.Array, vcfg: VisionConfig,
+                       decoder_hidden: int) -> dict:
+    """Stacked-layer ViT weights + the LLaVA mlp2x projector into the
+    decoder's embedding space."""
+    H, I, L = vcfg.hidden_size, vcfg.intermediate_size, vcfg.num_layers
+    p, c = vcfg.patch_size, vcfg.channels
+    dt = vcfg.dtype
+    ks = jax.random.split(rng, 12)
+    layers = {
+        "norm1_w": jnp.ones((L, H), dt), "norm1_b": jnp.zeros((L, H), dt),
+        "wq": _dense_init(ks[0], (L, H, H), dt),
+        "wk": _dense_init(ks[1], (L, H, H), dt),
+        "wv": _dense_init(ks[2], (L, H, H), dt),
+        "wo": _dense_init(ks[3], (L, H, H), dt),
+        "norm2_w": jnp.ones((L, H), dt), "norm2_b": jnp.zeros((L, H), dt),
+        "w_up": _dense_init(ks[4], (L, H, I), dt),
+        "b_up": jnp.zeros((L, I), dt),
+        "w_down": _dense_init(ks[5], (L, I, H), dt),
+        "b_down": jnp.zeros((L, H), dt),
+    }
+    return {
+        "patch_embed": _dense_init(ks[6], (p * p * c, H), dt),
+        "pos_embed": _dense_init(ks[7], (vcfg.num_patches, H), dt,
+                                 scale=0.02),
+        "layers": layers,
+        "post_norm_w": jnp.ones((H,), dt),
+        "post_norm_b": jnp.zeros((H,), dt),
+        # LLaVA-1.5 projector: Linear -> GELU -> Linear into decoder space
+        "proj_w1": _dense_init(ks[8], (H, decoder_hidden), dt),
+        "proj_b1": jnp.zeros((decoder_hidden,), dt),
+        "proj_w2": _dense_init(ks[9], (decoder_hidden, decoder_hidden), dt),
+        "proj_b2": jnp.zeros((decoder_hidden,), dt),
+    }
+
+
+def _patchify(images: jnp.ndarray, vcfg: VisionConfig) -> jnp.ndarray:
+    """[b, H, W, C] -> [b, num_patches, p*p*C] (row-major patch order)."""
+    b = images.shape[0]
+    p = vcfg.patch_size
+    n = vcfg.image_size // p
+    x = images.reshape(b, n, p, n, p, vcfg.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)          # [b, n, n, p, p, c]
+    return x.reshape(b, n * n, p * p * vcfg.channels)
+
+
+def _encoder_layer(vcfg: VisionConfig, lp: dict, x: jnp.ndarray):
+    b, s, H = x.shape
+    nh, hd = vcfg.num_heads, vcfg.head_dim
+    h = layer_norm(x, lp["norm1_w"], lp["norm1_b"], vcfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, nh, hd)
+    k = (h @ lp["wk"]).reshape(b, s, nh, hd)
+    v = (h @ lp["wv"]).reshape(b, s, nh, hd)
+    # bidirectional attention: no mask, f32 softmax
+    s_ = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
+    s_ = s_ / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    a = jax.nn.softmax(s_, axis=-1).astype(x.dtype)
+    o = jnp.einsum("bnqk,bknd->bqnd", a, v).reshape(b, s, nh * hd)
+    x = x + o @ lp["wo"]
+    h = layer_norm(x, lp["norm2_w"], lp["norm2_b"], vcfg.norm_eps)
+    h = jax.nn.gelu((h @ lp["w_up"] + lp["b_up"]).astype(jnp.float32))
+    return x + (h.astype(x.dtype) @ lp["w_down"] + lp["b_down"]), None
+
+
+def vision_forward(params: dict, vcfg: VisionConfig,
+                   images: jnp.ndarray) -> jnp.ndarray:
+    """ViT + projector: [b, H, W, C] images -> [b, num_patches, decoder_H]
+    hidden states ready for the decoder's pre-embedded input path."""
+    x = _patchify(images.astype(vcfg.dtype), vcfg)
+    x = x @ params["patch_embed"] + params["pos_embed"][None]
+
+    def body(x, lp):
+        return _encoder_layer(vcfg, lp, x)
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["post_norm_w"], params["post_norm_b"],
+                   vcfg.norm_eps)
+    h = jax.nn.gelu((x @ params["proj_w1"] + params["proj_b1"]
+                     ).astype(jnp.float32)).astype(x.dtype)
+    return h @ params["proj_w2"] + params["proj_b2"]

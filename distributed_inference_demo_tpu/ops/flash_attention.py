@@ -192,14 +192,20 @@ def flash_attention(
     kv_len: jnp.ndarray,       # scalar int32: valid cache length
     slopes: Optional[jnp.ndarray] = None,   # [nh] ALiBi slopes or None
     *,
-    block_k: int = 128,
-    block_rows_target: int = 512,
+    block_k: int = 512,
+    block_rows_target: int = 2048,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Drop-in for ``ops.attention.attention`` with contiguous q positions
     (``q_positions = q_start + arange(chunk)`` — always true in the engine).
 
     Returns [b, chunk, nh, hd] in q.dtype.
+
+    Default block sizes are tuned on TPU v5e (swept 128-512 x 256-2048 at
+    chunk 2048): large kv blocks + tall row blocks keep the MXU fed and
+    amortize the online-softmax bookkeeping — block_k=512/rows=2048 beat
+    the old 128/512 defaults by ~1.3x and the jnp path at every
+    prefill-sized chunk.
     """
     b, chunk, nh, hd = q.shape
     nkv, max_seq = k_cache.shape[1], k_cache.shape[2]

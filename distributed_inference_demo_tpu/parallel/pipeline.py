@@ -237,6 +237,22 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh, *,
                                       positions, tp_axis=tp_axis)
             return out, newc.keys, newc.values
 
+        def tail_sample(h_row, m, k):
+            """Head + sampling, gated to the tail rank: non-tail ranks run
+            an empty branch instead of burning the [b,1,H]x[H,V] matmul +
+            TP all-gather S-1 times out of S (VERDICT r2 weak #6).  Safe
+            under SPMD: a tp group lives at ONE pp rank, so every member
+            agrees on ``is_last`` and the branch's collective stays
+            consistent."""
+            def yes(h):
+                logits = _head(params, cfg, h, tp_axis)[:, 0]
+                return sample_logits(logits, rng_for(m, k), sampling)
+
+            def no(h):
+                return jnp.zeros((b,), jnp.int32)
+
+            return jax.lax.cond(is_last, yes, no, h_row)
+
         def upd(stack, m, new, active):
             old = jax.lax.dynamic_index_in_dim(stack, m, 0, keepdims=False)
             val = jnp.where(active, new, old)
@@ -262,8 +278,7 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh, *,
                                   pos_pre)
             K = upd(K, m, nk, active)
             V = upd(V, m, nv, active)
-            logits = _head(params, cfg, h[:, -1:, :], tp_axis)[:, 0]
-            tok = sample_logits(logits, rng_for(m, 0), sampling)
+            tok = tail_sample(h[:, -1:, :], m, 0)
             tok0 = upd(tok0, m, jnp.where(active & is_last, tok, -1),
                        active & is_last)
             send = jax.lax.ppermute(h, "pp", ring)
@@ -309,8 +324,7 @@ def make_pipeline_generate_fn(cfg: ModelConfig, mesh: Mesh, *,
             lengths = jnp.where(active, lengths.at[m].set(length + 1),
                                 lengths)
 
-            logits = _head(params, cfg, h, tp_axis)[:, 0]
-            tok_next = sample_logits(logits, rng_for(m, k + 1), sampling)
+            tok_next = tail_sample(h, m, k + 1)
             out = jnp.where(active & is_last,
                             out.at[m, :, jnp.clip(k + 1, 0, N - 1)]
                             .set(tok_next), out)

@@ -21,6 +21,10 @@ Message tags (payloads are wire.py tensor messages):
 
 - ``h:{rid}:{step}``   hidden chunk (step 0 = prefill, else one token row)
 - ``tok:{rid}:{step}`` sampled [b] token ids, tail → header
+- ``c:{rid}``          classification chunk: [hidden, label_token_ids];
+  the tail answers ``ctok:{rid}`` with argmax-over-labels indices (the
+  reference's binary-classification variant,
+  ``inference.cpp:220-270`` / ``native-lib.cpp:1305-1366``)
 - ``end:{rid}``        free the request's cache, forwarded along the chain
 - ``stop``             shut down the worker loop, forwarded along the chain
 - ``statsreq``         forwarded along the chain; every non-header stage
@@ -173,7 +177,7 @@ class PipelineWorker:
             self._forward_control(tag)
             return True
         if kind == "statsreq":
-            snap = dict(self.stats.snapshot(),
+            snap = dict(self.stats.snapshot(include_samples=True),
                         device_id=self.transport.device_id,
                         seq=rest)  # echo the poll sequence id
             self.transport.send(
@@ -184,6 +188,9 @@ class PipelineWorker:
         if kind == "statsreset":
             self.stats.reset()
             self._forward_control(tag)
+            return True
+        if kind == "c":
+            self._run_classify(int(rest.split(":")[0]), payload)
             return True
         if kind != "h":
             log.warning("worker %s: unexpected tag %r",
@@ -205,6 +212,27 @@ class PipelineWorker:
             else:
                 body = wire.serialize_tensors([np.asarray(out)])
                 dest, tag = self.next_id, self._make_h_tag(rid, step)
+        self.stats.record_compute(t_c.seconds)
+        with timer() as t_s:
+            self.transport.send(dest, tag, body)
+        self.stats.record_send(t_s.seconds, len(body))
+
+    def _run_classify(self, rid: int, payload: bytes) -> None:
+        """Classification hop: payload = [chunk, label_token_ids].  The
+        tail answers the header with argmax-over-label-logits indices
+        (reference ``inference.cpp:220-270``); other stages forward."""
+        with timer() as t_c:
+            x, label_ids = wire.deserialize_tensors(payload).tensors
+            out = self.rt.run_chunk(rid, x)
+            if self.rt.spec.is_last:
+                logits = np.asarray(out)        # [b, V] last position
+                sub = logits[:, label_ids.astype(np.int64)]
+                pred = np.argmax(sub, axis=-1).astype(np.int32)
+                body = wire.serialize_tensors([pred])
+                dest, tag = self.header_id, f"ctok:{rid}"
+            else:
+                body = wire.serialize_tensors([np.asarray(out), label_ids])
+                dest, tag = self.next_id, f"c:{rid}"
         self.stats.record_compute(t_c.seconds)
         with timer() as t_s:
             self.transport.send(dest, tag, body)
@@ -257,9 +285,15 @@ class PipelineHeader:
         self.stats.record_send(t_s.seconds, len(body))
         self._sent_at[(rid, step)] = time.perf_counter()
 
+    def _prefill_array(self, req: _Request) -> np.ndarray:
+        """Stage-0 prefill input for this request — token ids by default;
+        the multimodal header substitutes a pre-embedded prefix
+        (runtime/multimodal.py)."""
+        return req.prompt.astype(np.int32)
+
     def _launch(self, req: _Request) -> None:
         with timer() as t_c:
-            hidden = self.rt.run_chunk(req.rid, req.prompt.astype(np.int32))
+            hidden = self.rt.run_chunk(req.rid, self._prefill_array(req))
             hidden = np.asarray(hidden)
         self.stats.record_compute(t_c.seconds)
         self._send_hidden(req.rid, 0, hidden)
@@ -343,6 +377,69 @@ class PipelineHeader:
         """Single request; returns [b, new_tokens]."""
         return self.generate_many([prompt_ids], max_new_tokens)[0]
 
+    def classify_many(self, prompts: Sequence[np.ndarray],
+                      label_token_ids: Sequence[int],
+                      pool_size: int = 1) -> List[np.ndarray]:
+        """Classify each prompt batch over the pipeline: one prefill hop,
+        the tail argmaxes the last-position logits restricted to
+        ``label_token_ids``, and the predicted label index rides back (the
+        reference's classification run, ``BackgroundService.java:233-245``
+        over ``inference.cpp:220-270``).  Returns [b] int32 label-index
+        arrays, prompt order."""
+        label_ids = np.asarray(label_token_ids, np.int32)
+        if label_ids.ndim != 1 or label_ids.size < 2:
+            raise ValueError("label_token_ids must be >= 2 token ids")
+        if (label_ids < 0).any() or (label_ids
+                                     >= self.rt.cfg.vocab_size).any():
+            # validated HERE: an out-of-range id reaching the tail would
+            # IndexError inside its serve loop and poison the pipeline
+            raise ValueError(
+                f"label_token_ids out of range [0, "
+                f"{self.rt.cfg.vocab_size})")
+        for p in prompts:
+            if p.shape[1] > self.rt.max_seq:
+                raise ValueError(
+                    f"prompt ({p.shape[1]}) exceeds KV capacity "
+                    f"{self.rt.max_seq}")
+        rids = list(range(self._next_rid, self._next_rid + len(prompts)))
+        self._next_rid += len(prompts)
+        results: Dict[int, np.ndarray] = {}
+        queue = list(zip(rids, prompts))
+        in_flight: Dict[int, int] = {}   # rid -> queue index (for order)
+
+        def launch(rid: int, prompt: np.ndarray) -> None:
+            with timer() as t_c:
+                hidden = self.rt.run_chunk(rid, prompt.astype(np.int32))
+                body = wire.serialize_tensors(
+                    [np.asarray(hidden), label_ids])
+            self.stats.record_compute(t_c.seconds)
+            with timer() as t_s:
+                self.transport.send(self.next_id, f"c:{rid}", body)
+            self.stats.record_send(t_s.seconds, len(body))
+
+        while queue or in_flight:
+            while queue and len(in_flight) < pool_size:
+                rid, prompt = queue.pop(0)
+                in_flight[rid] = rid
+                launch(rid, np.asarray(prompt))
+            t0 = time.perf_counter()
+            tag, payload = self.transport.recv_any(timeout=self.step_timeout)
+            self.stats.record_recv(time.perf_counter() - t0, len(payload))
+            kind, _, rest = tag.partition(":")
+            if kind != "ctok":
+                log.warning("header: unexpected tag %r during classify", tag)
+                continue
+            rid = int(rest.split(":")[0])
+            if rid not in in_flight:
+                continue
+            [pred] = wire.deserialize_tensors(payload).tensors
+            results[rid] = pred.astype(np.int32)
+            self.transport.send(self.next_id, f"end:{rid}", b"")
+            self.rt.free(rid)
+            del in_flight[rid]
+
+        return [results[r] for r in rids]
+
     def collect_stats(self, num_stages: int,
                       timeout: float = 10.0) -> List[dict]:
         """Poll every downstream stage for its stats snapshot.
@@ -357,7 +454,7 @@ class PipelineHeader:
         seq = str(self._next_stats_seq)
         self._next_stats_seq += 1
         self.transport.send(self.next_id, f"statsreq:{seq}", b"")
-        mine = dict(self.stats.snapshot(),
+        mine = dict(self.stats.snapshot(include_samples=True),
                     device_id=self.transport.device_id)
         # keyed by device_id + filtered by seq: a stale reply from an
         # earlier timed-out poll can neither satisfy nor displace this one

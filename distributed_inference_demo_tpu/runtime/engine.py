@@ -79,6 +79,8 @@ class InferenceEngine:
                 f"unknown attn_backend {attn_backend!r}; expected "
                 "'auto', 'flash', 'flash-interpret', or 'jnp'")
 
+        self._attn_impl = attn_impl   # shared with MultimodalEngine
+
         cfg_ = cfg
         spec_ = self.spec
         samp_ = sampling
@@ -163,6 +165,25 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         return GenerationResult(tokens=toks, prompt_len=plen,
                                 num_new=max_new_tokens, seconds=dt)
+
+    def classify(self, prompt_ids: np.ndarray,
+                 label_token_ids) -> np.ndarray:
+        """Classify each row: argmax of the last-position logits restricted
+        to ``label_token_ids`` (verbalizer tokens, one per class).  Returns
+        [batch] int32 label indices.  The reference's classification
+        variant (``inference.cpp:220-270``) as a single prefill."""
+        ids = jnp.asarray(prompt_ids, jnp.int32)
+        label_ids = np.asarray(label_token_ids, np.int64)
+        if label_ids.ndim != 1 or label_ids.size < 2:
+            raise ValueError("label_token_ids must be >= 2 token ids")
+        if (label_ids < 0).any() or (label_ids >= self.cfg.vocab_size).any():
+            raise ValueError(
+                f"label_token_ids out of range [0, {self.cfg.vocab_size})")
+        self._check_capacity(ids.shape[1], 0)
+        cache = self.new_cache(ids.shape[0])
+        logits, _ = self._prefill(self.params, ids, cache)
+        sub = np.asarray(logits)[:, label_ids]
+        return np.argmax(sub, axis=-1).astype(np.int32)
 
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         seed: int = 0) -> Iterator[np.ndarray]:

@@ -64,6 +64,16 @@ class HeaderBackend:
         for i in range(res.tokens.shape[1]):
             yield res.tokens[:, i]
 
+    def classify(self, prompt_ids: np.ndarray, label_token_ids):
+        with self._lock:
+            [pred] = self.header.classify_many(
+                [np.asarray(prompt_ids)], label_token_ids)
+        return pred
+
+    def reset_stats(self):
+        with self._lock:
+            self.header.reset_stats()
+
 
 class InferenceHTTPServer:
     """Threaded HTTP server over an engine-like backend."""
@@ -110,6 +120,20 @@ class InferenceHTTPServer:
                     self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                if self.path == "/stats/reset":
+                    # zero hot-loop counters on every stage (benchmarks
+                    # call this after compile warmup for steady-state
+                    # numbers — the statsreset control message as HTTP)
+                    if hasattr(outer.backend, "reset_stats"):
+                        outer.backend.reset_stats()
+                        self._json(200, {"reset": True})
+                    else:
+                        self._json(501, {"error": "backend has no "
+                                                  "reset_stats"})
+                    return
+                if self.path == "/classify":
+                    self._classify()
+                    return
                 if self.path != "/generate":
                     self._json(404, {"error": f"no route {self.path}"})
                     return
@@ -134,6 +158,28 @@ class InferenceHTTPServer:
                                            for row in res.tokens.tolist()]
                         self._json(200, out)
                 except ValueError as e:     # capacity etc.
+                    self._json(400, {"error": str(e)})
+
+            def _classify(self):
+                """``{"prompt_ids"|"prompt", "label_token_ids": [...]}`` →
+                ``{"labels": [...]}`` — the classification task endpoint
+                (reference ``task_type`` classification,
+                ``inference.cpp:220-270``)."""
+                if not hasattr(outer.backend, "classify"):
+                    self._json(501, {"error": "backend has no classify"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    ids = outer._prompt_ids(req)
+                    label_ids = req["label_token_ids"]
+                except (ValueError, KeyError) as e:
+                    self._json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    pred = outer.backend.classify(ids, label_ids)
+                    self._json(200, {"labels": np.asarray(pred).tolist()})
+                except ValueError as e:
                     self._json(400, {"error": str(e)})
 
             def _stream(self, ids, max_new, seed):

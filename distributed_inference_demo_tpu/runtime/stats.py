@@ -87,7 +87,13 @@ class StageStats:
 
     # -- reading -----------------------------------------------------------
 
-    def snapshot(self) -> dict:
+    def snapshot(self, include_samples: bool = False) -> dict:
+        """``include_samples`` adds the raw per-step reservoirs (FIFO
+        order): with one request in flight the header's rtt sample i and
+        the tail's compute sample i belong to the same token step, so a
+        consumer can estimate the per-hop network latency as the PAIRED
+        residual ``(rtt_i - tail_compute_i)/2`` — aggregate percentiles
+        can't (compute variance swamps the hop when the tail is slow)."""
         with self._lock:
             rtt = list(self._rtt_samples)
             comp = list(self._compute_samples)
@@ -109,6 +115,9 @@ class StageStats:
         if rtt:
             out["ring_rtt_p50_ms"] = round(_percentile(rtt, 50) * 1e3, 3)
             out["ring_rtt_p95_ms"] = round(_percentile(rtt, 95) * 1e3, 3)
+        if include_samples:
+            out["compute_samples_ms"] = [round(s * 1e3, 3) for s in comp]
+            out["rtt_samples_ms"] = [round(s * 1e3, 3) for s in rtt]
         return out
 
 

@@ -147,6 +147,39 @@ def test_cli_plan_and_cache(tmp_path):
     assert json.loads(out) == plan
 
 
+def test_chat_repl_streams_incrementally(http_server, monkeypatch):
+    """The chat REPL (L7: the reference's ChatScreen loop as a terminal
+    app) must render tokens chunk by chunk — incremental arrivals, ending
+    with the exact greedy tokens the engine produces."""
+    import time as _time
+
+    server, engine = http_server
+    prompt = [[5, 17, 42, 7]]
+    want = engine.generate(np.asarray(prompt), 6).tokens
+
+    # stream_generate yields one parsed line per arrived chunk
+    arrivals = []
+    lines = []
+    for item in cli.stream_generate(server.host, server.port,
+                                    {"prompt_ids": prompt,
+                                     "max_new_tokens": 6}):
+        arrivals.append(_time.perf_counter())
+        lines.append(item)
+    assert [l["step"] for l in lines] == list(range(6))
+    assert [l["tokens"][0] for l in lines] == want[0].tolist()
+    assert arrivals[0] < arrivals[-1]   # first chunk before completion
+
+    # full REPL e2e: two turns then /quit, token ids rendered in order
+    monkeypatch.setattr(cli.sys, "stdin",
+                        io.StringIO("5,17,42,7\n5,17,42,7\n/quit\n"))
+    rc, out = _run_cli(["chat", "--url",
+                        f"http://{server.host}:{server.port}",
+                        "--max-new-tokens", "6", "--ids"])
+    assert rc == 0
+    rendered = " ".join(str(t) for t in want[0].tolist())
+    assert out.count(rendered) == 2
+
+
 def test_load_full_params_honors_checkpoint(tmp_path):
     """ADVICE r1 #1: the serve --chain path must load --checkpoint weights,
     not silently seed-init.  Both serve branches go through
