@@ -382,31 +382,42 @@ def _leg_pipeline(model: str, batch: int, prompt_len: int,
     return out
 
 
-def _read_until(proc, prefix: str, timeout: float = 300.0) -> str:
-    import select
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        # select before readline: a silent subprocess must hit the
-        # deadline, not block the bench forever on an open pipe
-        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
-        if not ready:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"process died waiting for {prefix!r} "
-                    f"(rc={proc.returncode})")
-            continue
-        line = proc.stdout.readline()
-        if not line:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"process died waiting for {prefix!r} "
-                    f"(rc={proc.returncode})")
-            time.sleep(0.05)
-            continue
-        line = line.strip()
-        if line.startswith(prefix):
-            return line
-    raise RuntimeError(f"{prefix!r} not seen within {timeout}s")
+class _LineReader:
+    """Reads a subprocess's stdout on a daemon thread into a queue, so
+    waits can time out reliably.  (select() on the pipe fd is wrong with a
+    buffered TextIOWrapper: readline() may pull several lines into the
+    Python buffer, leaving the fd empty while the awaited line sits
+    buffered; blocking readline() can't time out at all.)"""
+
+    def __init__(self, proc):
+        import queue
+        import threading
+        self.proc = proc
+        self.q: "queue.Queue" = queue.Queue()
+
+        def pump():
+            for line in proc.stdout:
+                self.q.put(line)
+
+        threading.Thread(target=pump, daemon=True).start()
+
+    def read_until(self, prefix: str, timeout: float = 300.0) -> str:
+        import queue
+        deadline = time.monotonic() + timeout
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise RuntimeError(f"{prefix!r} not seen within {timeout}s")
+            try:
+                line = self.q.get(timeout=min(left, 0.5)).strip()
+            except queue.Empty:
+                if self.proc.poll() is not None and self.q.empty():
+                    raise RuntimeError(
+                        f"process died waiting for {prefix!r} "
+                        f"(rc={self.proc.returncode})")
+                continue
+            if line.startswith(prefix):
+                return line
 
 
 def _paired_hop_percentiles(header_stats: dict, tail_stats: dict,
@@ -453,17 +464,18 @@ def _leg_planner_pipeline(model: str, batch: int, prompt_len: int,
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         cwd=str(REPO))
     worker = None
+    reader = _LineReader(server)
     try:
-        registry = _read_until(server, "SERVER_REGISTRY").split()[1]
+        registry = reader.read_until("SERVER_REGISTRY").split()[1]
         worker = subprocess.Popen(
             [sys.executable, "-m", "distributed_inference_demo_tpu",
              "worker", "--auto", "--registry", registry,
              "--device-id", "w1", "--step-timeout", "600"],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             env=env_worker, text=True, cwd=str(REPO))
-        plan_line = _read_until(server, "SERVER_PLAN", timeout=600)
+        plan_line = reader.read_until("SERVER_PLAN", timeout=600)
         ranges = _json.loads(plan_line.split(" ", 1)[1])
-        http = _read_until(server, "HTTP_READY", timeout=600).split()[1]
+        http = reader.read_until("HTTP_READY", timeout=600).split()[1]
 
         import numpy as np
         prompt = (np.arange(batch * prompt_len).reshape(batch, prompt_len)

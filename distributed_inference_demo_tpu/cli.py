@@ -279,6 +279,8 @@ def cmd_chat(args) -> int:
     (``BackgroundService.java:211``) and tokenized locally (``--tokenizer``)
     or server-side.
     """
+    import http.client
+
     tokenizer = _load_tokenizer(args.tokenizer)
     host, port = _parse_url(args.url)
 
@@ -321,7 +323,10 @@ def cmd_chat(args) -> int:
                         str(item["tokens"][0])
                 sys.stdout.write(piece)
                 sys.stdout.flush()
-        except (ConnectionError, OSError, RuntimeError) as e:
+        except (ConnectionError, OSError, RuntimeError,
+                http.client.HTTPException, json.JSONDecodeError) as e:
+            # a server dying mid-stream (IncompleteRead, truncated JSONL)
+            # must not kill the REPL — report and take the next prompt
             print(f"\n[error] {e}", file=sys.stderr)
             continue
         sys.stdout.write("\n")

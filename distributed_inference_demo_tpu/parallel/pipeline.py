@@ -136,8 +136,10 @@ def pipeline_apply(
             values=jnp.zeros((L_local, b, nkv_local, s, cfg.head_dim), dt),
             length=jnp.zeros((), jnp.int32))
         mid_params = StageParams(layers=params.layers)
+        # ys cache layout: this forward is differentiated (the carry
+        # layout would be saved per-iteration by the scan VJP)
         out, _ = stage_forward(mid_params, cfg, spec_mid, x, cache, positions,
-                               tp_axis=tp_axis)
+                               tp_axis=tp_axis, cache_in_carry=False)
         return out
 
     def step(carry, t):

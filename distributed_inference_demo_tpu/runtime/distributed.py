@@ -416,6 +416,11 @@ class PipelineHeader:
             with timer() as t_s:
                 self.transport.send(self.next_id, f"c:{rid}", body)
             self.stats.record_send(t_s.seconds, len(body))
+            # rtt tracked like generate steps: the tail records one
+            # compute sample per classify hop, so the header must record
+            # one rtt — otherwise mixed classify+generate workloads skew
+            # the index-paired activation-hop estimate (stats.snapshot)
+            self._sent_at[(rid, 0)] = time.perf_counter()
 
         while queue or in_flight:
             while queue and len(in_flight) < pool_size:
@@ -432,6 +437,9 @@ class PipelineHeader:
             rid = int(rest.split(":")[0])
             if rid not in in_flight:
                 continue
+            sent = self._sent_at.pop((rid, 0), None)
+            if sent is not None:
+                self.stats.record_rtt(time.perf_counter() - sent)
             [pred] = wire.deserialize_tensors(payload).tensors
             results[rid] = pred.astype(np.int32)
             self.transport.send(self.next_id, f"end:{rid}", b"")
