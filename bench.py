@@ -389,6 +389,8 @@ class _LineReader:
     Python buffer, leaving the fd empty while the awaited line sits
     buffered; blocking readline() can't time out at all.)"""
 
+    _EOF = object()
+
     def __init__(self, proc):
         import queue
         import threading
@@ -398,6 +400,7 @@ class _LineReader:
         def pump():
             for line in proc.stdout:
                 self.q.put(line)
+            self.q.put(self._EOF)   # death declared only past this marker
 
         threading.Thread(target=pump, daemon=True).start()
 
@@ -409,13 +412,16 @@ class _LineReader:
             if left <= 0:
                 raise RuntimeError(f"{prefix!r} not seen within {timeout}s")
             try:
-                line = self.q.get(timeout=min(left, 0.5)).strip()
+                line = self.q.get(timeout=min(left, 0.5))
             except queue.Empty:
-                if self.proc.poll() is not None and self.q.empty():
-                    raise RuntimeError(
-                        f"process died waiting for {prefix!r} "
-                        f"(rc={self.proc.returncode})")
                 continue
+            if line is self._EOF:
+                # the pump drained every line the process ever wrote (no
+                # poll/queue race): it is gone and the line never came
+                raise RuntimeError(
+                    f"process exited (rc={self.proc.poll()}) without "
+                    f"printing {prefix!r}")
+            line = line.strip()
             if line.startswith(prefix):
                 return line
 

@@ -339,12 +339,19 @@ class PipelineHeader:
 
     def generate_many(self, prompts: Sequence[np.ndarray],
                       max_new_tokens: int,
-                      pool_size: int = 1) -> List[np.ndarray]:
+                      pool_size: int = 1,
+                      on_token=None) -> List[np.ndarray]:
         """Generate for all prompts with ``pool_size`` requests in flight
         (the reference's corePoolSize microbatching,
         ``Communication.java:425-437``).  Returns [b, new_tokens] arrays in
-        prompt order."""
+        prompt order.
+
+        ``on_token(prompt_index, step, tokens)`` fires as each step's
+        tokens arrive — the reference's partial-decode streaming to the UI
+        (``DataRepository``, ``Communication.java:629-638``) as a hook.
+        """
         pending = self._make_requests(prompts, max_new_tokens)
+        rid_to_index = {req.rid: i for i, req in enumerate(pending)}
         queue = list(pending)
         in_flight: Dict[int, _Request] = {}
 
@@ -366,7 +373,10 @@ class PipelineHeader:
             if req is None:
                 continue
             [toks] = wire.deserialize_tensors(payload).tensors
+            step = req.step
             self._advance(req, toks)
+            if on_token is not None:
+                on_token(rid_to_index[rid], step, toks)
             if req.done:
                 del in_flight[rid]
 

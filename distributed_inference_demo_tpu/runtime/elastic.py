@@ -292,8 +292,10 @@ class ElasticHeader(PipelineHeader):
 
     def generate_many(self, prompts: Sequence[np.ndarray],
                       max_new_tokens: int,
-                      pool_size: int = 1) -> List[np.ndarray]:
+                      pool_size: int = 1,
+                      on_token=None) -> List[np.ndarray]:
         pending = self._make_requests(prompts, max_new_tokens)
+        rid_to_index = {req.rid: i for i, req in enumerate(pending)}
         queue = list(pending)
         in_flight: Dict[int, _Request] = {}
         last_progress = time.monotonic()
@@ -337,6 +339,8 @@ class ElasticHeader(PipelineHeader):
             if req is None or step != req.step:
                 continue       # duplicate or out-of-order token
             [toks] = wire.deserialize_tensors(payload).tensors
+            if on_token is not None:
+                on_token(rid_to_index[rid], step, toks)
             try:
                 self._advance(req, toks)
             except TransportError:

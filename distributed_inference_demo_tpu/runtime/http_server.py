@@ -59,10 +59,36 @@ class HeaderBackend:
 
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         seed: int = 0):
-        # the pipeline returns tokens all at once; stream them per step
-        res = self.generate(prompt_ids, max_new_tokens, seed)
-        for i in range(res.tokens.shape[1]):
-            yield res.tokens[:, i]
+        """TRUE streaming over the pipeline: the header's run loop fires
+        ``on_token`` per ring step on a worker thread; tokens are yielded
+        the moment each one returns from the tail (the reference streams
+        partial decodes to its UI the same way, DataRepository)."""
+        import queue as queue_mod
+
+        q: "queue_mod.Queue" = queue_mod.Queue()
+        SENTINEL = object()
+
+        def run():
+            try:
+                with self._lock:
+                    self.header.generate_many(
+                        [np.asarray(prompt_ids)], max_new_tokens,
+                        on_token=lambda i, step, toks: q.put(toks))
+            except BaseException as e:     # surface in the consumer
+                q.put(e)
+            finally:
+                q.put(SENTINEL)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is SENTINEL:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+        t.join(timeout=10)
 
     def classify(self, prompt_ids: np.ndarray, label_token_ids):
         with self._lock:
@@ -183,6 +209,20 @@ class InferenceHTTPServer:
                     self._json(400, {"error": str(e)})
 
             def _stream(self, ids, max_new, seed):
+                # pull the FIRST step before committing to 200 + chunked:
+                # validation errors (capacity etc.) surface on first next()
+                # and must become a clean 400, not a status line spliced
+                # into an already-open chunked body.
+                gen = outer.backend.generate_stream(ids, max_new, seed=seed)
+                first = None
+                try:
+                    first = next(gen)
+                except StopIteration:
+                    pass
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+
                 self.send_response(200)
                 self.send_header("Content-Type", "application/jsonl")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -192,13 +232,23 @@ class InferenceHTTPServer:
                     self.wfile.write(f"{len(data):x}\r\n".encode())
                     self.wfile.write(data + b"\r\n")
 
-                for i, toks in enumerate(outer.backend.generate_stream(
-                        ids, max_new, seed=seed)):
+                def emit(i, toks):
                     line = {"step": i, "tokens": np.asarray(toks).tolist()}
                     if outer.tokenizer is not None:
                         line["text"] = [outer.tokenizer.decode([t])
                                         for t in np.asarray(toks).tolist()]
                     chunk((json.dumps(line) + "\n").encode("utf-8"))
+
+                try:
+                    if first is not None:
+                        emit(0, first)
+                        for i, toks in enumerate(gen, start=1):
+                            emit(i, toks)
+                except Exception as e:
+                    # mid-stream failure: an error JSONL line keeps the
+                    # chunked framing intact for the client
+                    chunk((json.dumps({"error": str(e)}) + "\n")
+                          .encode("utf-8"))
                 chunk(b"")      # terminating chunk
                 self.wfile.flush()
 

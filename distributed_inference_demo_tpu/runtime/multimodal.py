@@ -177,8 +177,9 @@ class MultimodalHeader(PipelineHeader):
             log.warning("header: unexpected tag %r awaiting vision", tag)
 
     def generate_mm(self, images: np.ndarray, text_ids: np.ndarray,
-                    max_new_tokens: int) -> np.ndarray:
-        """Image+text generation over the pipeline; returns [b, new]."""
+                    max_new_tokens: int, on_token=None) -> np.ndarray:
+        """Image+text generation over the pipeline; returns [b, new].
+        ``on_token`` streams steps exactly like ``generate_many``'s."""
         img_h = self._encode_image(images)
         tok = np.asarray(embed_tokens(self.rt.params, self.rt.cfg,
                                       jnp.asarray(text_ids, jnp.int32)))
@@ -190,7 +191,8 @@ class MultimodalHeader(PipelineHeader):
         rid = self._next_rid
         self._mm_prefix[rid] = prefix
         try:
-            return self.generate_many([placeholder], max_new_tokens)[0]
+            return self.generate_many([placeholder], max_new_tokens,
+                                      on_token=on_token)[0]
         finally:
             # if validation raised before _launch consumed the stash, a
             # later unrelated request would inherit this rid and prefill

@@ -83,6 +83,18 @@ def test_generate_endpoint_streaming(http_server):
     assert got == want
 
 
+def test_stream_capacity_error_is_clean_400(http_server):
+    """A capacity error on a stream request must be a clean 400 —
+    surfaced from the generator's first step BEFORE the 200 + chunked
+    headers are committed (a late error would splice a status line into
+    the open chunked body)."""
+    server, _ = http_server
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": [[1, 2, 3]], "max_new_tokens": 1000,
+                         "stream": True})
+    assert status == 400 and b"error" in data
+
+
 def test_generate_endpoint_bad_requests(http_server):
     server, _ = http_server
     status, data = _req(server, "POST", "/generate", {"max_new_tokens": 4})
