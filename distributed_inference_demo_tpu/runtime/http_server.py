@@ -222,6 +222,11 @@ class InferenceHTTPServer:
                 except ValueError as e:
                     self._json(400, {"error": str(e)})
                     return
+                except Exception as e:
+                    # e.g. a TransportTimeout from a stalled pipeline —
+                    # still before headers, so a clean 500 is possible
+                    self._json(500, {"error": str(e)})
+                    return
 
                 self.send_response(200)
                 self.send_header("Content-Type", "application/jsonl")
@@ -244,13 +249,21 @@ class InferenceHTTPServer:
                         emit(0, first)
                         for i, toks in enumerate(gen, start=1):
                             emit(i, toks)
+                except OSError:
+                    return      # client went away; the socket is dead
                 except Exception as e:
-                    # mid-stream failure: an error JSONL line keeps the
-                    # chunked framing intact for the client
-                    chunk((json.dumps({"error": str(e)}) + "\n")
-                          .encode("utf-8"))
-                chunk(b"")      # terminating chunk
-                self.wfile.flush()
+                    # generator failure mid-stream: an error JSONL line
+                    # keeps the chunked framing intact for the client
+                    try:
+                        chunk((json.dumps({"error": str(e)}) + "\n")
+                              .encode("utf-8"))
+                    except OSError:
+                        return
+                try:
+                    chunk(b"")      # terminating chunk
+                    self.wfile.flush()
+                except OSError:
+                    pass
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self.httpd.server_address
