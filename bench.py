@@ -574,18 +574,33 @@ def run_leg(name: str, p: dict) -> dict:
     return out
 
 
-def _spawn_leg(name: str, params: dict, timeout: int = 1500) -> dict:
-    """Run one leg in a fresh process; parse the last stdout line as JSON."""
+def _spawn_leg(name: str, params: dict, timeout: int = 900) -> dict:
+    """Run one leg in a fresh process; parse the last stdout line as JSON.
+
+    The leg runs in its own process GROUP and a timeout kills the whole
+    group: legs spawn grandchildren (the planner leg's server/worker) that
+    hold the exclusive TPU and ports — an orphan would poison every
+    following leg."""
+    import os as _os
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "bench.py"), "--leg", name,
+         "--params", json.dumps(params)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=str(REPO), start_new_session=True)
     try:
-        proc = subprocess.run(
-            [sys.executable, str(REPO / "bench.py"), "--leg", name,
-             "--params", json.dumps(params)],
-            capture_output=True, text=True, timeout=timeout, cwd=str(REPO))
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        try:
+            _os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait(timeout=30)
         return {"error": f"leg timed out after {timeout}s"}
-    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    lines = [l for l in stdout.strip().splitlines() if l.strip()]
     if proc.returncode != 0 or not lines:
-        tail = (proc.stderr or "").strip().splitlines()[-8:]
+        tail = (stderr or "").strip().splitlines()[-8:]
         return {"error": f"leg exited rc={proc.returncode}",
                 "stderr_tail": tail}
     try:
@@ -627,10 +642,21 @@ def main() -> None:
     if only:
         legs = [l for l in legs if l in only.split(",")]
 
+    # global deadline: the tunnel TPU hangs for many minutes at times, and
+    # one JSON line MUST still be printed — remaining legs are skipped,
+    # never the report (a round-3 run lost every number to an outer
+    # timeout exactly this way)
+    deadline = time.monotonic() + int(
+        os.environ.get("BENCH_DEADLINE_S", "2700"))
     results = {}
     for leg in legs:
+        left = deadline - time.monotonic()
+        if left <= 120:    # a leg needs real budget (compiles alone are ~2m)
+            results[leg] = {"error": "skipped: bench deadline reached"}
+            continue
         t0 = time.perf_counter()
-        results[leg] = _spawn_leg(leg, params)
+        results[leg] = _spawn_leg(leg, params,
+                                  timeout=min(900, int(left)))
         if isinstance(results[leg], dict):
             results[leg]["leg_seconds"] = round(time.perf_counter() - t0, 1)
 

@@ -185,6 +185,8 @@ class InferenceHTTPServer:
                         self._json(200, out)
                 except ValueError as e:     # capacity etc.
                     self._json(400, {"error": str(e)})
+                except Exception as e:      # e.g. a stalled pipeline's
+                    self._json(500, {"error": str(e)})  # TransportTimeout
 
             def _classify(self):
                 """``{"prompt_ids"|"prompt", "label_token_ids": [...]}`` →
@@ -207,6 +209,8 @@ class InferenceHTTPServer:
                     self._json(200, {"labels": np.asarray(pred).tolist()})
                 except ValueError as e:
                     self._json(400, {"error": str(e)})
+                except Exception as e:      # stalled pipeline etc. -> 500
+                    self._json(500, {"error": str(e)})
 
             def _stream(self, ids, max_new, seed):
                 # pull the FIRST step before committing to 200 + chunked:
