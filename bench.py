@@ -581,7 +581,6 @@ def _spawn_leg(name: str, params: dict, timeout: int = 900) -> dict:
     group: legs spawn grandchildren (the planner leg's server/worker) that
     hold the exclusive TPU and ports — an orphan would poison every
     following leg."""
-    import os as _os
     import signal
 
     proc = subprocess.Popen(
@@ -593,10 +592,13 @@ def _spawn_leg(name: str, params: dict, timeout: int = 900) -> dict:
         stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         try:
-            _os.killpg(proc.pid, signal.SIGKILL)
+            os.killpg(proc.pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
-        proc.wait(timeout=30)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass   # D-state on a wedged tunnel: report and move on anyway
         return {"error": f"leg timed out after {timeout}s"}
     lines = [l for l in stdout.strip().splitlines() if l.strip()]
     if proc.returncode != 0 or not lines:

@@ -1,0 +1,163 @@
+"""Ulysses-style all-to-all sequence parallelism over the ``sp`` axis.
+
+Complements the ring-attention path (``parallel/sequence.py``) — the task's
+long-context requirement names both strategies ("ring attention or
+all-to-all sequence/context parallelism").  Here activations and QKV/MLP
+projections stay SEQUENCE-sharded, and attention itself runs HEAD-sharded
+over the full sequence after one ``all_to_all`` each way per layer
+(DeepSpeed-Ulysses; PAPERS.md):
+
+- prefill: q/k/v ``[b, s/n, heads, hd]`` → all_to_all (split heads, concat
+  seq) → ``[b, s, heads/n, hd]``; plain causal attention per head block;
+  reverse all_to_all on the output.
+- the KV cache shards by HEAD (``[L, b, nkv/n, max_seq, hd]``) — an n-fold
+  cache-memory saving, same as the TP layout.
+- decode: the single replicated token needs no seq all_to_all; each rank
+  slices its head block, attends over its cache shard, and the head
+  outputs are all-gathered — 1 collective per layer per step.
+
+vs ring attention: Ulysses moves activations (2 all_to_alls/layer) instead
+of KV blocks around a ring; its comm volume is independent of context
+length, at the cost of requiring ``num_heads % sp == 0`` (ring has no head
+constraint and keeps the cache sequence-sharded).  Absent entirely in the
+reference (SURVEY.md §5.7: max_length=40, no cache).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.base import KVCache, ModelConfig, StageSpec
+from ..models.decoder import stage_forward
+from ..ops.attention import attention, update_kv_cache
+from ..ops.sampling import SamplingParams, sample_logits
+from .sequence import _final_logits
+
+
+def make_ulysses_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
+                             num_new_tokens: int,
+                             sampling: Optional[SamplingParams] = None):
+    """Build a jitted ``fn(params, prompt_ids, rng) -> tokens``: Ulysses
+    prefill + head-sharded-cache decode over ``mesh``'s sp axis.
+
+    Constraints (checked host-side): ``prompt_len % sp == 0``,
+    ``num_heads % sp == 0``, ``num_kv_heads % sp == 0``,
+    ``prompt_len + num_new_tokens <= max_seq``.  Greedy when ``sampling``
+    is None; returns [batch, num_new_tokens] int32.
+    """
+    sp = mesh.shape["sp"]
+    if cfg.num_heads % sp or cfg.num_kv_heads % sp:
+        raise ValueError(
+            f"ulysses needs num_heads ({cfg.num_heads}) and num_kv_heads "
+            f"({cfg.num_kv_heads}) divisible by sp={sp}")
+    spec = StageSpec(0, 1, 0, cfg.num_layers)
+    body_spec = StageSpec(0, 2, 0, cfg.num_layers)  # no head at prefill
+    sampling = sampling or SamplingParams(greedy=True)
+    nh_loc = cfg.num_heads // sp
+    nkv_loc = cfg.num_kv_heads // sp
+    hd = cfg.head_dim
+
+    def body(params, ids, rng):
+        n = jax.lax.axis_size("sp")
+        idx = jax.lax.axis_index("sp")
+        b, chunk = ids.shape            # local contiguous prompt chunk
+        S = n * chunk
+
+        def slice_heads(x, loc):
+            return jax.lax.dynamic_slice_in_dim(x, idx * loc, loc, axis=2)
+
+        def slice_slopes(slopes):
+            if slopes is None:
+                return None
+            return jax.lax.dynamic_slice_in_dim(slopes, idx * nh_loc,
+                                                nh_loc, axis=0)
+
+        # ---- prefill: all_to_all to head-sharded full-sequence attention
+        def prefill_attn(q, k, v, kc, vc, pos, cache_start, slopes):
+            # [b, chunk, heads, hd] -> [b, S, heads/n, hd]: split the head
+            # axis across ranks, gather every rank's seq chunk (rank order
+            # == sequence order — contiguous prompt sharding)
+            qf = jax.lax.all_to_all(q, "sp", split_axis=2, concat_axis=1,
+                                    tiled=True)
+            kf = jax.lax.all_to_all(k, "sp", split_axis=2, concat_axis=1,
+                                    tiled=True)
+            vf = jax.lax.all_to_all(v, "sp", split_axis=2, concat_axis=1,
+                                    tiled=True)
+            kc, vc = update_kv_cache(kc, vc, kf, vf, cache_start)
+            qpos = jnp.broadcast_to(cache_start + jnp.arange(S), (b, S))
+            out = attention(qf, kc, vc, qpos, cache_start + S,
+                            slice_slopes(slopes))
+            # back to seq-sharded all-heads for the output projection
+            out = jax.lax.all_to_all(out, "sp", split_axis=1, concat_axis=2,
+                                     tiled=True)
+            return out, kc, vc
+
+        shape = (spec.num_layers, b, nkv_loc, max_seq, hd)
+        cache = KVCache(keys=jnp.zeros(shape, cfg.dtype),
+                        values=jnp.zeros(shape, cfg.dtype),
+                        length=jnp.zeros((), jnp.int32))
+        positions = jnp.broadcast_to(idx * chunk + jnp.arange(chunk),
+                                     (b, chunk))
+        hidden, cache = stage_forward(params, cfg, body_spec, ids, cache,
+                                      positions, attn_impl=prefill_attn)
+        cache = KVCache(cache.keys, cache.values,
+                        jnp.asarray(S, jnp.int32))
+
+        # the global last token lives on rank n-1; broadcast via psum
+        h_last = jnp.where(idx == n - 1,
+                           hidden[:, -1:, :].astype(jnp.float32), 0.0)
+        h_last = jax.lax.psum(h_last, "sp").astype(cfg.dtype)
+        last = _final_logits(params, cfg, h_last)[:, 0, :]
+        rng, r0 = jax.random.split(rng)
+        tok0 = sample_logits(last, r0, sampling)
+
+        # ---- decode: head-sharded cache, all_gather the head outputs ----
+        def dec_attn(q, k, v, kc, vc, pos_, cache_start, slopes):
+            q_loc = slice_heads(q, nh_loc)     # [b, 1, nh_loc, hd]
+            k_loc = slice_heads(k, nkv_loc)
+            v_loc = slice_heads(v, nkv_loc)
+            kc, vc = update_kv_cache(kc, vc, k_loc, v_loc, cache_start)
+            out = attention(q_loc, kc, vc, pos_, cache_start + 1,
+                            slice_slopes(slopes))
+            out = jax.lax.all_gather(out, "sp", axis=2, tiled=True)
+            return out, kc, vc
+
+        def step(carry, step_rng):
+            cache, tok = carry
+            pos = jnp.broadcast_to(cache.length, (b, 1))
+            logits, cache = stage_forward(params, cfg, spec, tok[:, None],
+                                          cache, pos, attn_impl=dec_attn)
+            nxt = sample_logits(logits[:, -1, :], step_rng, sampling)
+            return (cache, nxt), nxt
+
+        rngs = jax.random.split(rng, num_new_tokens - 1) \
+            if num_new_tokens > 1 else jnp.zeros((0, 2), jnp.uint32)
+        _, rest = jax.lax.scan(step, (cache, tok0), rngs)
+        toks = jnp.concatenate([tok0[:, None], rest.T], axis=1) \
+            if num_new_tokens > 1 else tok0[:, None]
+        return toks
+
+    sharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def fn(params, prompt_ids, rng):
+        return sharded(params, prompt_ids, rng)
+
+    def checked(params, prompt_ids, rng):
+        b, plen = prompt_ids.shape
+        if plen % sp:
+            raise ValueError(
+                f"prompt_len={plen} not divisible by sp={sp}; pad first")
+        if plen + num_new_tokens > max_seq:
+            raise ValueError(
+                f"prompt {plen} + new {num_new_tokens} > max_seq {max_seq}")
+        return fn(params, prompt_ids, rng)
+
+    return checked

@@ -395,7 +395,13 @@ class PipelineHeader:
         ``label_token_ids``, and the predicted label index rides back (the
         reference's classification run, ``BackgroundService.java:233-245``
         over ``inference.cpp:220-270``).  Returns [b] int32 label-index
-        arrays, prompt order."""
+        arrays, prompt order.
+
+        Unlike ``generate_many`` on the elastic header, this loop does NOT
+        reshard on failure — a dead worker surfaces as a TransportTimeout
+        after ``step_timeout`` and the caller retries.  Classification is
+        a single stateless hop per request, so retry-from-outside loses
+        nothing (no partial tokens to preserve)."""
         label_ids = np.asarray(label_token_ids, np.int32)
         if label_ids.ndim != 1 or label_ids.size < 2:
             raise ValueError("label_token_ids must be >= 2 token ids")
