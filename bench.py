@@ -644,6 +644,41 @@ def main() -> None:
     if only:
         legs = [l for l in legs if l in only.split(",")]
 
+    # fast health probe: when the tunnel TPU is wedged (it hangs for long
+    # stretches), fail every leg in ~2 minutes with a clear reason instead
+    # of burning the whole deadline discovering it leg by leg
+    import signal
+    probe = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].device_kind)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=str(REPO), start_new_session=True)
+    try:
+        p_out, p_err = probe.communicate(timeout=180)
+        backend_ok = probe.returncode == 0
+        reason = (f"device probe exited rc={probe.returncode}: "
+                  f"{(p_err or '').strip().splitlines()[-1:] or ['?']}"
+                  if not backend_ok else "")
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(probe.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            probe.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass   # D-state child on a wedged tunnel: report regardless
+        backend_ok = False
+        reason = "the device backend did not answer a 180s probe (hung?)"
+    if not backend_ok:
+        print(json.dumps({
+            "metric": "decode tokens/sec (backend unreachable)",
+            "value": None, "unit": "tokens/sec", "vs_baseline": None,
+            "headline": {},
+            "extras": {"error": f"backend unreachable, no leg attempted: "
+                                f"{reason}"}}))
+        return
+
     # global deadline: the tunnel TPU hangs for many minutes at times, and
     # one JSON line MUST still be printed — remaining legs are skipped,
     # never the report (a round-3 run lost every number to an outer

@@ -53,6 +53,55 @@ def _final_logits(params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("blh,hv->blv", h, head)
 
 
+def _sample_first_token(params, cfg, hidden, idx, n, rng, sampling):
+    """The global last prompt token lives on rank n-1: broadcast its hidden
+    row via psum, run the head ONCE on that single position, sample token 0.
+    Shared by the ring (make_sp_generate_fn) and Ulysses generate paths."""
+    h_last = jnp.where(idx == n - 1, hidden[:, -1:, :].astype(jnp.float32),
+                       0.0)
+    h_last = jax.lax.psum(h_last, "sp").astype(cfg.dtype)
+    last = _final_logits(params, cfg, h_last)[:, 0, :]
+    rng, r0 = jax.random.split(rng)
+    return sample_logits(last, r0, sampling), rng
+
+
+def _decode_scan(step, carry, rng, num_new_tokens, tok0):
+    """Scan ``step`` over per-step rngs and assemble [b, num_new] tokens."""
+    rngs = jax.random.split(rng, num_new_tokens - 1) \
+        if num_new_tokens > 1 else jnp.zeros((0, 2), jnp.uint32)
+    _, rest = jax.lax.scan(step, carry, rngs)
+    return jnp.concatenate([tok0[:, None], rest.T], axis=1) \
+        if num_new_tokens > 1 else tok0[:, None]
+
+
+def _wrap_sp_body(body, mesh: Mesh, sp: int, max_seq: int,
+                  num_new_tokens: int):
+    """shard_map + jit + host-side shape validation, shared by both
+    sequence-parallel strategies (prompt sharded over sp's seq axis)."""
+    sharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def fn(params, prompt_ids, rng):
+        return sharded(params, prompt_ids, rng)
+
+    def checked(params, prompt_ids, rng):
+        b, plen = prompt_ids.shape
+        if plen % sp:
+            raise ValueError(
+                f"prompt_len={plen} not divisible by sp={sp}; pad first")
+        if plen + num_new_tokens > max_seq:
+            raise ValueError(
+                f"prompt {plen} + new {num_new_tokens} > max_seq {max_seq}")
+        return fn(params, prompt_ids, rng)
+
+    return checked
+
+
 def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
                         num_new_tokens: int,
                         sampling: Optional[SamplingParams] = None):
@@ -98,13 +147,8 @@ def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
                            idx * chunk + jnp.arange(s_loc), -1).astype(jnp.int32)
         length = jnp.asarray(n * chunk, jnp.int32)
 
-        # the global last token lives on rank n-1; broadcast via psum.
-        h_last = jnp.where(idx == n - 1, hidden[:, -1:, :].astype(jnp.float32),
-                           0.0)
-        h_last = jax.lax.psum(h_last, "sp").astype(cfg.dtype)
-        last = _final_logits(params, cfg, h_last)[:, 0, :]
-        rng, r0 = jax.random.split(rng)
-        tok0 = sample_logits(last, r0, sampling)
+        tok0, rng = _sample_first_token(params, cfg, hidden, idx, n, rng,
+                                        sampling)
 
         # ---- decode: sharded cache + lse-combined partial attention -----
         def step(carry, step_rng):
@@ -143,33 +187,7 @@ def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
             return ((cache.keys, cache.values, kv_pos_new, length + 1, nxt),
                     nxt)
 
-        rngs = jax.random.split(rng, num_new_tokens - 1) \
-            if num_new_tokens > 1 else jnp.zeros((0, 2), jnp.uint32)
         carry = (cache.keys, cache.values, kv_pos, length, tok0)
-        _, rest = jax.lax.scan(step, carry, rngs)
-        toks = jnp.concatenate([tok0[:, None], rest.T], axis=1) \
-            if num_new_tokens > 1 else tok0[:, None]
-        return toks
+        return _decode_scan(step, carry, rng, num_new_tokens, tok0)
 
-    sharded = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(), P(None, "sp"), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-
-    @jax.jit
-    def fn(params, prompt_ids, rng):
-        return sharded(params, prompt_ids, rng)
-
-    def checked(params, prompt_ids, rng):
-        b, plen = prompt_ids.shape
-        if plen % sp:
-            raise ValueError(
-                f"prompt_len={plen} not divisible by sp={sp}; pad first")
-        if plen + num_new_tokens > max_seq:
-            raise ValueError(
-                f"prompt {plen} + new {num_new_tokens} > max_seq {max_seq}")
-        return fn(params, prompt_ids, rng)
-
-    return checked
+    return _wrap_sp_body(body, mesh, sp, max_seq, num_new_tokens)

@@ -27,13 +27,13 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..models.base import KVCache, ModelConfig, StageSpec
 from ..models.decoder import stage_forward
 from ..ops.attention import attention, update_kv_cache
 from ..ops.sampling import SamplingParams, sample_logits
-from .sequence import _final_logits
+from .sequence import _decode_scan, _sample_first_token, _wrap_sp_body
 
 
 def make_ulysses_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
@@ -105,13 +105,8 @@ def make_ulysses_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
         cache = KVCache(cache.keys, cache.values,
                         jnp.asarray(S, jnp.int32))
 
-        # the global last token lives on rank n-1; broadcast via psum
-        h_last = jnp.where(idx == n - 1,
-                           hidden[:, -1:, :].astype(jnp.float32), 0.0)
-        h_last = jax.lax.psum(h_last, "sp").astype(cfg.dtype)
-        last = _final_logits(params, cfg, h_last)[:, 0, :]
-        rng, r0 = jax.random.split(rng)
-        tok0 = sample_logits(last, r0, sampling)
+        tok0, rng = _sample_first_token(params, cfg, hidden, idx, n, rng,
+                                        sampling)
 
         # ---- decode: head-sharded cache, all_gather the head outputs ----
         def dec_attn(q, k, v, kc, vc, pos_, cache_start, slopes):
@@ -132,32 +127,6 @@ def make_ulysses_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
             nxt = sample_logits(logits[:, -1, :], step_rng, sampling)
             return (cache, nxt), nxt
 
-        rngs = jax.random.split(rng, num_new_tokens - 1) \
-            if num_new_tokens > 1 else jnp.zeros((0, 2), jnp.uint32)
-        _, rest = jax.lax.scan(step, (cache, tok0), rngs)
-        toks = jnp.concatenate([tok0[:, None], rest.T], axis=1) \
-            if num_new_tokens > 1 else tok0[:, None]
-        return toks
+        return _decode_scan(step, (cache, tok0), rng, num_new_tokens, tok0)
 
-    sharded = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(), P(None, "sp"), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-
-    @jax.jit
-    def fn(params, prompt_ids, rng):
-        return sharded(params, prompt_ids, rng)
-
-    def checked(params, prompt_ids, rng):
-        b, plen = prompt_ids.shape
-        if plen % sp:
-            raise ValueError(
-                f"prompt_len={plen} not divisible by sp={sp}; pad first")
-        if plen + num_new_tokens > max_seq:
-            raise ValueError(
-                f"prompt {plen} + new {num_new_tokens} > max_seq {max_seq}")
-        return fn(params, prompt_ids, rng)
-
-    return checked
+    return _wrap_sp_body(body, mesh, sp, max_seq, num_new_tokens)
