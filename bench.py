@@ -574,22 +574,19 @@ def run_leg(name: str, p: dict) -> dict:
     return out
 
 
-def _spawn_leg(name: str, params: dict, timeout: int = 900) -> dict:
-    """Run one leg in a fresh process; parse the last stdout line as JSON.
-
-    The leg runs in its own process GROUP and a timeout kills the whole
-    group: legs spawn grandchildren (the planner leg's server/worker) that
-    hold the exclusive TPU and ports — an orphan would poison every
-    following leg."""
+def _run_group_killable(cmd, timeout: int):
+    """Run ``cmd`` in its own process GROUP; on timeout kill the whole
+    group (children included — e.g. the planner leg's server/worker hold
+    the exclusive TPU and ports) and survive a D-state child on a wedged
+    tunnel.  Returns (returncode_or_None_on_timeout, stdout, stderr)."""
     import signal
 
-    proc = subprocess.Popen(
-        [sys.executable, str(REPO / "bench.py"), "--leg", name,
-         "--params", json.dumps(params)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        cwd=str(REPO), start_new_session=True)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            cwd=str(REPO), start_new_session=True)
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
+        return proc.returncode, stdout, stderr
     except subprocess.TimeoutExpired:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
@@ -599,12 +596,20 @@ def _spawn_leg(name: str, params: dict, timeout: int = 900) -> dict:
             proc.wait(timeout=30)
         except subprocess.TimeoutExpired:
             pass   # D-state on a wedged tunnel: report and move on anyway
+        return None, "", ""
+
+
+def _spawn_leg(name: str, params: dict, timeout: int = 900) -> dict:
+    """Run one leg in a fresh process; parse the last stdout line as JSON."""
+    rc, stdout, stderr = _run_group_killable(
+        [sys.executable, str(REPO / "bench.py"), "--leg", name,
+         "--params", json.dumps(params)], timeout)
+    if rc is None:
         return {"error": f"leg timed out after {timeout}s"}
     lines = [l for l in stdout.strip().splitlines() if l.strip()]
-    if proc.returncode != 0 or not lines:
+    if rc != 0 or not lines:
         tail = (stderr or "").strip().splitlines()[-8:]
-        return {"error": f"leg exited rc={proc.returncode}",
-                "stderr_tail": tail}
+        return {"error": f"leg exited rc={rc}", "stderr_tail": tail}
     try:
         return json.loads(lines[-1])
     except json.JSONDecodeError:
@@ -647,29 +652,15 @@ def main() -> None:
     # fast health probe: when the tunnel TPU is wedged (it hangs for long
     # stretches), fail every leg in ~2 minutes with a clear reason instead
     # of burning the whole deadline discovering it leg by leg
-    import signal
-    probe = subprocess.Popen(
+    rc, p_out, p_err = _run_group_killable(
         [sys.executable, "-c",
-         "import jax; print(jax.devices()[0].device_kind)"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        cwd=str(REPO), start_new_session=True)
-    try:
-        p_out, p_err = probe.communicate(timeout=180)
-        backend_ok = probe.returncode == 0
-        reason = (f"device probe exited rc={probe.returncode}: "
-                  f"{(p_err or '').strip().splitlines()[-1:] or ['?']}"
-                  if not backend_ok else "")
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(probe.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        try:
-            probe.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            pass   # D-state child on a wedged tunnel: report regardless
-        backend_ok = False
+         "import jax; print(jax.devices()[0].device_kind)"], timeout=180)
+    backend_ok = rc == 0
+    if rc is None:
         reason = "the device backend did not answer a 180s probe (hung?)"
+    elif rc != 0:
+        last = ((p_err or "").strip().splitlines() or ["?"])[-1]
+        reason = f"device probe exited rc={rc}: {last}"
     if not backend_ok:
         print(json.dumps({
             "metric": "decode tokens/sec (backend unreachable)",

@@ -447,8 +447,6 @@ def cmd_classify(args) -> int:
 
 def cmd_bench(args) -> int:
     """Engine decode benchmark (same shape as the repo-root bench.py)."""
-    import time
-
     import numpy as np
 
     _, engine = _build_engine(args)

@@ -14,7 +14,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from .wire import (_FROM_NP, _TO_NP, DType, TensorMessage, WireError,
+from .wire import (_TO_NP, DType, TensorMessage, WireError,
                    _np_dtype_to_wire)
 
 _lib: Optional[ctypes.CDLL] = None

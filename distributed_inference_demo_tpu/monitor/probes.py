@@ -20,7 +20,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 
 def memory_info() -> Dict[str, int]:

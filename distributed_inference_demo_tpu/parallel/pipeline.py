@@ -21,8 +21,7 @@ over pp and tp, norm grads over tp) — sharded leaves are already exact.
 ``_grad_sync_axes`` encodes this from the sharding specs.
 """
 
-from functools import partial
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -18,7 +18,6 @@ The decoder block itself is shared with every other path via the
 swaps the attention/cache strategy, not the model math.
 """
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -16,8 +16,6 @@ Megatron-style TP layout:
 - KV cache [Ls, B, S, nkv, hd] -> batch over dp, kv heads over tp, seq over sp
 """
 
-from typing import Optional
-
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

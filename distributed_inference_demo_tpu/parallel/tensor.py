@@ -8,9 +8,6 @@ sliced weights and explicit psum/all-gather collectives (see
 each chip only touches its heads' cache lines.
 """
 
-from functools import partial
-from typing import Optional
-
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 

@@ -21,7 +21,7 @@ max_seq) — the traced path cannot (dynamic_update_slice clamps silently).
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Iterator, List, Optional
+from typing import Iterator, Optional
 
 import jax
 import jax.numpy as jnp

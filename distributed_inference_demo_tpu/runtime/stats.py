@@ -18,7 +18,6 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, Optional
 
 _MAX_SAMPLES = 4096
 
