@@ -54,9 +54,10 @@ def _build_engine(args):
     sampling = SamplingParams(greedy=True) if args.greedy else \
         SamplingParams(temperature=args.temperature, top_k=args.top_k)
     params = _load_full_params(args, cfg)
-    return cfg, InferenceEngine(cfg, params, max_seq=args.max_seq,
-                                sampling=sampling,
-                                attn_backend=args.attn_backend)
+    return cfg, InferenceEngine(
+        cfg, params, max_seq=args.max_seq, sampling=sampling,
+        attn_backend=args.attn_backend,
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
 
 
 # ---------------------------------------------------------------------------
@@ -80,6 +81,12 @@ def cmd_serve(args) -> int:
         from .runtime.elastic import ElasticHeader, ElasticStageRuntime
 
         cfg = get_model_config(args.model)
+        if getattr(args, "kv_cache_dtype", ""):
+            # StageRuntime caches don't take a dtype override yet: reject
+            # rather than silently serving full-precision caches
+            print("--kv-cache-dtype is not supported with --chain",
+                  file=sys.stderr)
+            return 1
         full = _load_full_params(args, cfg)
         sampling = SamplingParams(greedy=True) if args.greedy else \
             SamplingParams(temperature=args.temperature, top_k=args.top_k)
@@ -480,6 +487,10 @@ def _add_engine_args(ap):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--attn-backend", default="auto",
                     choices=["auto", "flash", "flash-interpret", "jnp"])
+    ap.add_argument("--kv-cache-dtype", default="",
+                    help="reduced-precision KV cache storage, e.g. "
+                         "float8_e4m3fn (half the cache bytes; small "
+                         "accuracy cost)")
 
 
 def main(argv=None) -> int:

@@ -54,16 +54,37 @@ class InferenceEngine:
                  max_seq: Optional[int] = None,
                  sampling: SamplingParams = SamplingParams(),
                  eos_id: Optional[int] = None,
-                 attn_backend: str = "auto"):
+                 attn_backend: str = "auto",
+                 kv_cache_dtype: Optional[str] = None):
         """``attn_backend``: "auto" (Pallas flash kernel on TPU, jnp
-        elsewhere), "flash", "flash-interpret" (testing), or "jnp"."""
+        elsewhere), "flash", "flash-interpret" (testing), or "jnp".
+
+        ``kv_cache_dtype``: store the KV cache at a reduced precision,
+        e.g. "float8_e4m3fn" — HALF the cache bytes (and cache-read
+        traffic, which rivals the weight stream at large batch x long
+        context) with no scale bookkeeping, at a small accuracy cost.
+        Attention math stays f32 (``ops.attention`` upcasts whatever the
+        cache holds); inserts round via ``update_kv_cache``'s cast.
+        Forces the jnp attention path (the Pallas kernel is not exercised
+        on f8 loads)."""
         self.cfg = cfg
         self.params = params
         self.max_seq = max_seq or cfg.max_seq_len
         self.sampling = sampling
         self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
+        self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
+                               if kv_cache_dtype else None)
 
+        if self.kv_cache_dtype is not None:
+            if attn_backend not in ("auto", "jnp"):
+                # never silently downgrade an explicit kernel request
+                raise ValueError(
+                    f"attn_backend={attn_backend!r} is incompatible with "
+                    "kv_cache_dtype (the Pallas kernel is not exercised "
+                    "on reduced-precision cache loads); use 'auto' or "
+                    "'jnp'")
+            attn_backend = "jnp"
         if attn_backend == "auto":
             attn_backend = ("flash" if jax.default_backend() == "tpu"
                             else "jnp")
@@ -139,7 +160,7 @@ class InferenceEngine:
 
     def new_cache(self, batch: int) -> KVCache:
         return KVCache.create(self.cfg, self.cfg.num_layers, batch,
-                              self.max_seq)
+                              self.max_seq, dtype=self.kv_cache_dtype)
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0) -> GenerationResult:

@@ -74,3 +74,43 @@ def test_attn_backend_rejects_unknown():
     params = init_full_params(jax.random.PRNGKey(0), cfg)
     with pytest.raises(ValueError, match="attn_backend"):
         InferenceEngine(cfg, params, attn_backend="pallas")
+
+
+def test_fp8_kv_cache():
+    """Opt-in reduced-precision cache: half the cache bytes, f32 attention
+    math on upcast values, logits that track the full-precision cache."""
+    import jax.numpy as jnp
+
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    full = InferenceEngine(cfg, params, max_seq=64,
+                           sampling=SamplingParams(greedy=True))
+    fp8 = InferenceEngine(cfg, params, max_seq=64,
+                          sampling=SamplingParams(greedy=True),
+                          kv_cache_dtype="float8_e4m3fn")
+    cache = fp8.new_cache(2)
+    assert cache.keys.dtype == jnp.float8_e4m3fn
+    assert cache.keys.nbytes * 4 == full.new_cache(2).keys.nbytes  # vs f32
+
+    prompt = np.asarray(
+        np.random.RandomState(11).randint(0, cfg.vocab_size, (2, 8)),
+        np.int32)
+    l_full, _ = full._prefill(full.params, prompt, full.new_cache(2))
+    l_fp8, _ = fp8._prefill(fp8.params, prompt, fp8.new_cache(2))
+    a, b = np.asarray(l_full, np.float64), np.asarray(l_fp8, np.float64)
+    # prefill logits stay directionally faithful (cosine per row)
+    cos = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1)
+                             * np.linalg.norm(b, axis=-1))
+    assert (cos > 0.98).all(), cos
+
+    res = fp8.generate(prompt, 8)
+    assert res.tokens.shape == (2, 8)
+    assert ((res.tokens >= 0) & (res.tokens < cfg.vocab_size)).all()
+
+
+def test_fp8_kv_cache_rejects_explicit_flash():
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="incompatible"):
+        InferenceEngine(cfg, params, max_seq=64, attn_backend="flash",
+                        kv_cache_dtype="float8_e4m3fn")
