@@ -141,6 +141,12 @@ def cmd_server(args) -> int:
     logging.basicConfig(level=logging.INFO)
     from .server_app import ServerApp
 
+    if getattr(args, "kv_cache_dtype", ""):
+        # pipeline StageRuntime caches don't take a dtype override yet
+        print("--kv-cache-dtype is not supported by the server app",
+              file=sys.stderr)
+        return 1
+
     app = ServerApp(
         model=args.model, num_workers=args.num_workers,
         checkpoint=args.checkpoint, weights_seed=args.weights_seed,
