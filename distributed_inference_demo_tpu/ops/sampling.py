@@ -26,12 +26,17 @@ class SamplingParams:
     greedy: bool = False
 
 
-def sample_logits(logits: jnp.ndarray, rng: jax.Array,
-                  params: SamplingParams) -> jnp.ndarray:
-    """Sample next-token ids from [batch, vocab] logits -> [batch] int32."""
-    if params.greedy:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def filtered_logits(logits: jnp.ndarray,
+                    params: SamplingParams) -> jnp.ndarray:
+    """Apply temperature / top-k / top-p to [..., vocab] logits.
 
+    ``softmax(filtered_logits(l, p))`` IS the sampling distribution of
+    ``sample_logits(l, rng, p)`` — speculative decoding's accept/resample
+    rule (runtime/speculative.py) needs that distribution explicitly for
+    both the draft and the target, so the filter lives here, next to the
+    sampler it must stay consistent with.  Not meaningful for greedy
+    (argmax needs no distribution).
+    """
     logits = logits.astype(jnp.float32)
     if params.temperature != 1.0:
         logits = logits / jnp.maximum(params.temperature, 1e-6)
@@ -50,5 +55,13 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array,
         threshold = jnp.min(jnp.where(jnp.isfinite(cutoff), cutoff, jnp.inf),
                             axis=-1, keepdims=True)
         logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return logits
 
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+def sample_logits(logits: jnp.ndarray, rng: jax.Array,
+                  params: SamplingParams) -> jnp.ndarray:
+    """Sample next-token ids from [batch, vocab] logits -> [batch] int32."""
+    if params.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, filtered_logits(logits, params), axis=-1).astype(jnp.int32)

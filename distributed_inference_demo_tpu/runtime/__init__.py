@@ -1,5 +1,7 @@
 from .engine import InferenceEngine, GenerationResult
 from .elastic import ElasticHeader, ElasticStageRuntime, ElasticWorker
+from .speculative import SpeculativeEngine, SpecStats
 
 __all__ = ["InferenceEngine", "GenerationResult", "ElasticHeader",
-           "ElasticStageRuntime", "ElasticWorker"]
+           "ElasticStageRuntime", "ElasticWorker", "SpeculativeEngine",
+           "SpecStats"]

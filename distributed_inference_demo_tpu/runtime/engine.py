@@ -33,6 +33,16 @@ from ..ops.flash_attention import make_flash_attn_impl
 from ..ops.sampling import SamplingParams, sample_logits
 
 
+def check_capacity(max_seq: int, prompt_len: int, max_new_tokens: int):
+    """Host-side KV capacity bound shared by all engines (the traced path
+    cannot enforce it — ``dynamic_update_slice`` clamps silently)."""
+    need = prompt_len + max_new_tokens
+    if need > max_seq:
+        raise ValueError(
+            f"prompt ({prompt_len}) + new tokens ({max_new_tokens}) = "
+            f"{need} exceeds KV-cache capacity {max_seq}")
+
+
 @dataclass
 class GenerationResult:
     tokens: np.ndarray          # [batch, max_new_tokens] int32
@@ -152,11 +162,7 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _check_capacity(self, prompt_len: int, max_new_tokens: int):
-        need = prompt_len + max_new_tokens
-        if need > self.max_seq:
-            raise ValueError(
-                f"prompt ({prompt_len}) + new tokens ({max_new_tokens}) = "
-                f"{need} exceeds KV-cache capacity {self.max_seq}")
+        check_capacity(self.max_seq, prompt_len, max_new_tokens)
 
     def new_cache(self, batch: int) -> KVCache:
         return KVCache.create(self.cfg, self.cfg.num_layers, batch,

@@ -1,0 +1,301 @@
+"""Speculative decoding: a draft model proposes, the target verifies.
+
+Decode is HBM-bandwidth-bound — every step streams all target weights for
+one token's worth of MXU work (see bench.py roofline legs).  Speculative
+decoding converts that stream into several tokens: a small DRAFT model
+autoregressively proposes ``num_draft`` tokens (cheap — its weights are a
+fraction of the target's), then the TARGET verifies all of them in ONE
+prefill-shaped forward ([batch, K+1] positions — the MXU-friendly shape),
+and the standard rejection rule keeps a prefix that is distributed exactly
+as target-only sampling (Leviathan et al., 2023; PAPERS.md).
+
+Everything per round is ONE compiled program (`_rounds`): draft scan →
+target verify → accept/resample → cache rollback, with ``R`` rounds fused
+in a ``lax.scan`` so one dispatch yields up to ``R*(K+1)`` tokens — on the
+tunneled bench device a dispatch costs ~10 ms, so fusing rounds matters as
+much as the algorithm.
+
+TPU-first design points (vs the CUDA/torch implementations of this idea):
+
+- **Static shapes throughout**: every round emits a fixed ``[b, K+1]``
+  token block plus a count; the host trims.  No dynamic-length tensors,
+  no recompiles.
+- **Cache rollback is a length reset.**  ``KVCache.length`` is a traced
+  scalar; rejected tokens' KV simply stays as stale slots ABOVE the valid
+  length.  The causal mask (kv_pos <= q_pos) guarantees a stale slot is
+  never attended before the next round overwrites it — no scatter, no
+  copy.
+- **Batch rows advance in lockstep** by ``m = min_b(accepted_b + 1)``
+  (the per-round emit count must be one scalar for static shapes).  Each
+  row's kept prefix is its own exactly-distributed sample; rows that
+  accepted more than ``m`` tokens just re-propose them next round, so
+  batch skew costs throughput, never correctness.  The reference has no
+  analog (one token per ring trip, ``Communication.java:682-928``); this
+  is a pure capability add on top of engine.py's fused decode.
+
+The draft and target must share a vocabulary (checked).  Greedy mode
+(``SamplingParams(greedy=True)``) verifies by argmax equality and is
+bit-exact vs target-only greedy decode — the property the tests pin.
+"""
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
+from ..models.decoder import stage_forward
+from ..ops.flash_attention import make_flash_attn_impl
+from ..ops.sampling import SamplingParams, filtered_logits, sample_logits
+from .engine import GenerationResult, check_capacity
+
+
+@dataclass
+class SpecStats:
+    """Acceptance diagnostics for one generate() call."""
+    rounds: int = 0
+    drafted: int = 0            # num_draft * rounds
+    accepted: int = 0           # draft tokens accepted (excl. bonus/resample)
+    emitted: int = 0            # tokens actually kept (after min + trim)
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.drafted if self.drafted else float("nan")
+
+    @property
+    def tokens_per_round(self) -> float:
+        return self.emitted / self.rounds if self.rounds else float("nan")
+
+
+class SpeculativeEngine:
+    """Draft/verify generation over two full single-stage models."""
+
+    def __init__(self, cfg: ModelConfig, params: StageParams,
+                 draft_cfg: ModelConfig, draft_params: StageParams,
+                 max_seq: Optional[int] = None,
+                 sampling: SamplingParams = SamplingParams(),
+                 num_draft: int = 4,
+                 attn_backend: str = "auto"):
+        if cfg.vocab_size != draft_cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab ({draft_cfg.vocab_size}) != target vocab "
+                f"({cfg.vocab_size}); speculative decoding needs a shared "
+                "token space")
+        if num_draft < 1:
+            raise ValueError("num_draft must be >= 1")
+        self.cfg, self.params = cfg, params
+        self.draft_cfg, self.draft_params = draft_cfg, draft_params
+        self.max_seq = max_seq or cfg.max_seq_len
+        self.sampling = sampling
+        self.num_draft = num_draft
+        self.spec = StageSpec(0, 1, 0, cfg.num_layers)
+        self.draft_spec = StageSpec(0, 1, 0, draft_cfg.num_layers)
+
+        if attn_backend == "auto":
+            attn_backend = ("flash" if jax.default_backend() == "tpu"
+                            else "jnp")
+        attn_impl = (make_flash_attn_impl() if attn_backend == "flash"
+                     else None)
+
+        cfg_, spec_ = cfg, self.spec
+        dcfg_, dspec_ = draft_cfg, self.draft_spec
+        samp_, K = sampling, num_draft
+
+        @jax.jit
+        def prefill_both(tparams, dparams, ids, tcache, dcache):
+            b, s = ids.shape
+            pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+            t_logits, tcache = stage_forward(
+                tparams, cfg_, spec_, ids, tcache, pos,
+                attn_impl=attn_impl, last_logits_only=True)
+            _, dcache = stage_forward(
+                dparams, dcfg_, dspec_, ids, dcache, pos,
+                attn_impl=attn_impl, last_logits_only=True)
+            return t_logits[:, -1], tcache, dcache
+
+        def one_round(tparams, dparams, last_tok, tcache, dcache, rng):
+            """Draft K, verify K+1 in one target forward, accept/resample.
+
+            Returns (emitted [b, K+1], m scalar, accepted [b], new state).
+            ``last_tok`` sits at position tcache.length and is not yet in
+            either cache.
+            """
+            b = last_tok.shape[0]
+            n = tcache.length
+
+            # --- draft phase: K+1 autoregressive steps --------------------
+            # K proposals, plus ONE extra step whose proposal is discarded:
+            # the extra step exists to insert d_K's KV into the draft cache
+            # (the scan inserts each step's INPUT token), so that after an
+            # all-accept round (m = K+1) the rolled-forward draft cache is
+            # fully populated — without it, position n+K would be a stale
+            # zero slot that silently derails the next round's first draft.
+            def dstep(carry, _):
+                tok, dc, rng = carry
+                pos = jnp.broadcast_to(dc.length, (b, 1))
+                logits, dc = stage_forward(
+                    dparams, dcfg_, dspec_, tok[:, None], dc, pos,
+                    attn_impl=attn_impl, last_logits_only=True)
+                logits = logits[:, 0]
+                rng, sub = jax.random.split(rng)
+                if samp_.greedy:
+                    d = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    q = logits  # unused in greedy verify
+                else:
+                    q = filtered_logits(logits, samp_)
+                    d = jax.random.categorical(sub, q, axis=-1)
+                    d = d.astype(jnp.int32)
+                return (d, dc, rng), (d, q)
+
+            (_, dcache, rng), (drafts, q_logits) = jax.lax.scan(
+                dstep, (last_tok, dcache, rng), None, length=K + 1)
+            drafts = drafts[:K].T                  # [b, K]
+            q_logits = jnp.swapaxes(q_logits[:K], 0, 1)  # [b, K, V]
+
+            # --- target verify: ONE forward over K+1 tokens ---------------
+            verify_in = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+            pos = n + jnp.broadcast_to(jnp.arange(K + 1), (b, K + 1))
+            t_logits, tcache = stage_forward(
+                tparams, cfg_, spec_, verify_in, tcache, pos,
+                attn_impl=attn_impl)               # [b, K+1, V]
+
+            # --- accept / resample ----------------------------------------
+            rng, sub_u, sub_x = jax.random.split(rng, 3)
+            if samp_.greedy:
+                t_arg = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+                accept = drafts == t_arg[:, :K]            # [b, K] bool
+                acc_prefix = jnp.cumprod(accept, axis=1)
+                a = jnp.sum(acc_prefix, axis=1)            # [b] in [0, K]
+                # rejected at a -> the target's own argmax; all accepted ->
+                # bonus argmax after d_K.  Both are t_arg[:, a].
+                extra = jnp.take_along_axis(
+                    t_arg, a[:, None], axis=1)[:, 0]
+            else:
+                p_logits = filtered_logits(t_logits, samp_)  # [b, K+1, V]
+                p = jax.nn.softmax(p_logits[:, :K], axis=-1)
+                q = jax.nn.softmax(q_logits, axis=-1)
+                p_d = jnp.take_along_axis(
+                    p, drafts[..., None], axis=-1)[..., 0]   # [b, K]
+                q_d = jnp.take_along_axis(
+                    q, drafts[..., None], axis=-1)[..., 0]
+                u = jax.random.uniform(sub_u, p_d.shape)
+                accept = u * jnp.maximum(q_d, 1e-20) < p_d
+                acc_prefix = jnp.cumprod(accept, axis=1)
+                a = jnp.sum(acc_prefix, axis=1)            # [b] in [0, K]
+                # resample dist at the rejection point: norm(max(p - q, 0));
+                # if all K accepted, the bonus position samples from p_{K+1}
+                resid = jnp.maximum(p - q, 0.0)            # [b, K, V]
+                resid_a = jnp.take_along_axis(
+                    resid, jnp.minimum(a, K - 1)[:, None, None], axis=1
+                )[:, 0]                                    # [b, V]
+                # p == q exactly => resid is all-zero; fall back to p_a
+                # (accept/resample then reduces to plain sampling from p)
+                p_a = jnp.take_along_axis(
+                    p, jnp.minimum(a, K - 1)[:, None, None], axis=1)[:, 0]
+                resid_sum = jnp.sum(resid_a, axis=-1, keepdims=True)
+                resid_a = jnp.where(resid_sum > 0, resid_a, p_a)
+                bonus = jax.nn.softmax(p_logits[:, K], axis=-1)
+                extra_probs = jnp.where((a == K)[:, None], bonus, resid_a)
+                extra = jax.random.categorical(
+                    sub_x, jnp.log(extra_probs + 1e-30), axis=-1)
+                extra = extra.astype(jnp.int32)
+
+            # --- assemble emitted block [b, K+1] --------------------------
+            idx = jnp.arange(K + 1)[None, :]
+            drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+            emitted = jnp.where(idx < a[:, None], drafts_pad,
+                                jnp.where(idx == a[:, None], extra[:, None],
+                                          0))
+
+            # --- lockstep advance + rollback ------------------------------
+            m = jnp.min(a) + 1                     # scalar, in [1, K+1]
+            new_last = jnp.take_along_axis(
+                emitted, (m - 1)[None, None].astype(jnp.int32)
+                .repeat(b, axis=0), axis=1)[:, 0]
+            tcache = KVCache(tcache.keys, tcache.values, n + m)
+            dcache = KVCache(dcache.keys, dcache.values, n + m)
+            return emitted, m, new_last, tcache, dcache, rng
+
+        @partial(jax.jit, donate_argnums=(3, 4), static_argnums=(6,))
+        def rounds(tparams, dparams, last_tok, tcache, dcache, rng,
+                   num_rounds):
+            def body(carry, _):
+                last_tok, tc, dc, rng = carry
+                emitted, m, last_tok, tc, dc, rng = one_round(
+                    tparams, dparams, last_tok, tc, dc, rng)
+                return (last_tok, tc, dc, rng), (emitted, m)
+
+            (last_tok, tcache, dcache, rng), (em, ms) = jax.lax.scan(
+                body, (last_tok, tcache, dcache, rng), None,
+                length=num_rounds)
+            return em, ms, last_tok, tcache, dcache, rng
+
+        self._prefill_both = prefill_both
+        self._rounds = rounds
+
+    # ------------------------------------------------------------------
+
+    def new_caches(self, batch: int):
+        # +num_draft+1 slack: a round may write K+1 positions past the
+        # valid length before the rollback trims it
+        cap = self.max_seq + self.num_draft + 1
+        return (KVCache.create(self.cfg, self.cfg.num_layers, batch, cap),
+                KVCache.create(self.draft_cfg, self.draft_cfg.num_layers,
+                               batch, cap))
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 seed: int = 0,
+                 rounds_per_dispatch: Optional[int] = None
+                 ) -> "tuple[GenerationResult, SpecStats]":
+        """Generate with draft/verify rounds; returns (result, stats).
+
+        ``rounds_per_dispatch``: how many rounds to fuse per device call
+        (default 8, capped by the rounds max_new_tokens could possibly
+        need — overshoot is trimmed; each extra round costs one wasted
+        draft block, each missing round costs a full dispatch, and on the
+        tunneled bench device a dispatch is ~10 ms).
+        """
+        ids = jnp.asarray(prompt_ids, jnp.int32)
+        b, plen = ids.shape
+        check_capacity(self.max_seq, plen, max_new_tokens)
+        R = rounds_per_dispatch or min(8, max(1, max_new_tokens))
+        rng = jax.random.PRNGKey(seed)
+
+        t0 = time.perf_counter()
+        tcache, dcache = self.new_caches(b)
+        last_logits, tcache, dcache = self._prefill_both(
+            self.params, self.draft_params, ids, tcache, dcache)
+        # first token comes from the target's prefill logits (the draft
+        # never gets to choose a token unchecked)
+        rng, sub = jax.random.split(rng)
+        last_tok = sample_logits(last_logits, sub, self.sampling)
+
+        stats = SpecStats()
+        out = [np.asarray(last_tok)[:, None]]
+        total = 1
+        while total < max_new_tokens:
+            em, ms, last_tok, tcache, dcache, rng = self._rounds(
+                self.params, self.draft_params, last_tok, tcache, dcache,
+                rng, R)
+            em, ms = np.asarray(em), np.asarray(ms)
+            for r in range(R):
+                m = int(ms[r])
+                out.append(em[r][:, :m])
+                stats.rounds += 1
+                stats.drafted += self.num_draft
+                stats.accepted += m - 1   # lockstep: min_b(accepted) used
+                total += m
+                if total >= max_new_tokens:
+                    break
+
+        toks = np.concatenate(out, axis=1)[:, :max_new_tokens]
+        dt = time.perf_counter() - t0
+        stats.emitted = toks.shape[1]
+        return (GenerationResult(tokens=toks.astype(np.int32),
+                                 prompt_len=plen,
+                                 num_new=toks.shape[1], seconds=dt),
+                stats)

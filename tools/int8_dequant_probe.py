@@ -1,0 +1,117 @@
+"""Probe: where does int8 decode lose bandwidth vs bf16?
+
+Runs a decode-shaped workload (scan over stacked layers, matvec per layer,
+repeated token steps inside one dispatch) on the real device and compares:
+
+- bf16 weights (reference traffic)
+- int8 via f32 intermediate dequant (current ops/quant.py dense())
+- int8 via direct-to-bf16 dequant (q.astype(bf16) * scale.astype(bf16))
+
+Prints GB/s achieved per variant counting each variant's true weight bytes.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+L, H, I = 8, 2048, 5632
+B = 8
+STEPS = 24
+
+
+def bench(fn, *args):
+    out = fn(*args)
+    np.asarray(out)                       # compile + hard sync
+    t0 = time.perf_counter()
+    out = fn(*args)
+    np.asarray(out)
+    return time.perf_counter() - t0
+
+
+def main():
+    rng = np.random.default_rng(0)
+    w_up = jnp.asarray(rng.standard_normal((L, H, I), dtype=np.float32),
+                       jnp.bfloat16)
+    w_dn = jnp.asarray(rng.standard_normal((L, I, H), dtype=np.float32),
+                       jnp.bfloat16)
+    x0 = jnp.asarray(rng.standard_normal((B, H), dtype=np.float32),
+                     jnp.bfloat16)
+
+    def tok_scan(layer_fn, weights):
+        @jax.jit
+        def run(x):
+            def tok(x, _):
+                def lay(x, ws):
+                    return layer_fn(x, ws), None
+                x, _ = jax.lax.scan(lay, x, weights)
+                return x, None
+            x, _ = jax.lax.scan(tok, x, None, length=STEPS)
+            return x
+        return run
+
+    # bf16 reference
+    def lay_bf16(x, ws):
+        wu, wd = ws
+        h = jnp.maximum(x @ wu, 0)
+        return (h @ wd).astype(jnp.bfloat16)
+
+    dt = bench(tok_scan(lay_bf16, (w_up, w_dn)), x0)
+    nbytes = (w_up.nbytes + w_dn.nbytes)
+    print(f"bf16:        {dt*1e3/STEPS:7.2f} ms/step  "
+          f"{nbytes*STEPS/dt/1e9:7.1f} GB/s")
+
+    # int8 quantize
+    def q(w):
+        a = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1, keepdims=True)
+        s = jnp.maximum(a, 1e-8) / 127.0
+        qq = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127,
+                      127).astype(jnp.int8)
+        return qq, s.astype(jnp.float32)
+
+    qu, su = q(w_up)
+    qd, sd = q(w_dn)
+    q_bytes = qu.nbytes + qd.nbytes + su.nbytes + sd.nbytes
+
+    def lay_f32(x, ws):
+        qu, su, qd, sd = ws
+        wu = (qu.astype(jnp.float32) * su).astype(jnp.bfloat16)
+        wd = (qd.astype(jnp.float32) * sd).astype(jnp.bfloat16)
+        h = jnp.maximum(x @ wu, 0)
+        return (h @ wd).astype(jnp.bfloat16)
+
+    dt = bench(tok_scan(lay_f32, (qu, su, qd, sd)), x0)
+    print(f"int8 f32-deq:{dt*1e3/STEPS:7.2f} ms/step  "
+          f"{q_bytes*STEPS/dt/1e9:7.1f} GB/s")
+
+    def lay_bf(x, ws):
+        qu, su, qd, sd = ws
+        wu = qu.astype(jnp.bfloat16) * su.astype(jnp.bfloat16)
+        wd = qd.astype(jnp.bfloat16) * sd.astype(jnp.bfloat16)
+        h = jnp.maximum(x @ wu, 0)
+        return (h @ wd).astype(jnp.bfloat16)
+
+    dt = bench(tok_scan(lay_bf, (qu, su, qd, sd)), x0)
+    print(f"int8 bf-deq: {dt*1e3/STEPS:7.2f} ms/step  "
+          f"{q_bytes*STEPS/dt/1e9:7.1f} GB/s")
+
+    # int8 with dot_general on raw int8 then scale the [B, I] result
+    # (per-output-channel scale commutes past the contraction)
+    def lay_post(x, ws):
+        qu, su, qd, sd = ws
+        h = jax.lax.dot_general(
+            x.astype(jnp.bfloat16), qu.astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())))
+        h = jnp.maximum(h * su[0].astype(jnp.bfloat16), 0)
+        o = jax.lax.dot_general(
+            h, qd.astype(jnp.bfloat16), (((1,), (0,)), ((), ())))
+        return (o * sd[0].astype(jnp.bfloat16)).astype(jnp.bfloat16)
+
+    dt = bench(tok_scan(lay_post, (qu, su, qd, sd)), x0)
+    print(f"int8 post-sc:{dt*1e3/STEPS:7.2f} ms/step  "
+          f"{q_bytes*STEPS/dt/1e9:7.1f} GB/s")
+
+
+if __name__ == "__main__":
+    main()
