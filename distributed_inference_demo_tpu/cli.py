@@ -45,14 +45,22 @@ def _load_full_params(args, cfg):
                         seed=args.weights_seed)
 
 
+def _sampling_from_args(args):
+    """The one mapping from CLI flags to SamplingParams — shared by every
+    serve mode so a new sampling flag cannot silently diverge between
+    single-node, --chain, and --batch-slots."""
+    from .ops.sampling import SamplingParams
+    if args.greedy:
+        return SamplingParams(greedy=True)
+    return SamplingParams(temperature=args.temperature, top_k=args.top_k)
+
+
 def _build_engine(args):
     from .models.registry import get_model_config
-    from .ops.sampling import SamplingParams
     from .runtime import InferenceEngine
 
     cfg = get_model_config(args.model)
-    sampling = SamplingParams(greedy=True) if args.greedy else \
-        SamplingParams(temperature=args.temperature, top_k=args.top_k)
+    sampling = _sampling_from_args(args)
     params = _load_full_params(args, cfg)
     return cfg, InferenceEngine(
         cfg, params, max_seq=args.max_seq, sampling=sampling,
@@ -77,7 +85,6 @@ def cmd_serve(args) -> int:
         from .comm.transport import ZmqTransport
         from .models.base import split_layer_ranges
         from .models.registry import get_model_config
-        from .ops.sampling import SamplingParams
         from .runtime.elastic import ElasticHeader, ElasticStageRuntime
 
         cfg = get_model_config(args.model)
@@ -88,8 +95,7 @@ def cmd_serve(args) -> int:
                   file=sys.stderr)
             return 1
         full = _load_full_params(args, cfg)
-        sampling = SamplingParams(greedy=True) if args.greedy else \
-            SamplingParams(temperature=args.temperature, top_k=args.top_k)
+        sampling = _sampling_from_args(args)
 
         peers = [p.split("@", 1) for p in args.chain.split(",")]
         chain = [args.device_id] + [pid for pid, _ in peers]
@@ -109,6 +115,21 @@ def cmd_serve(args) -> int:
                                 num_stages=len(chain))
         print(f"SERVE_PIPELINE {chain} ranges="
               f"{[(s.layer_start, s.layer_end) for s in specs]}", flush=True)
+    elif getattr(args, "batch_slots", 0):
+        from .models.registry import get_model_config
+        from .runtime.batching import ContinuousBatchingEngine
+
+        if getattr(args, "kv_cache_dtype", ""):
+            print("--kv-cache-dtype is not supported with --batch-slots",
+                  file=sys.stderr)
+            return 1
+        cfg = get_model_config(args.model)
+        sampling = _sampling_from_args(args)
+        backend = ContinuousBatchingEngine(
+            cfg, _load_full_params(args, cfg), max_seq=args.max_seq,
+            max_batch=args.batch_slots, sampling=sampling, seed=args.seed)
+        print(f"SERVE_BATCHING {args.model} slots={args.batch_slots}",
+              flush=True)
     else:
         cfg, engine = _build_engine(args)
         backend = engine
@@ -516,6 +537,10 @@ def main(argv=None) -> int:
     s.add_argument("--port", type=int, default=0,
                    help="data-plane port (pipeline mode)")
     s.add_argument("--step-timeout", type=float, default=120.0)
+    s.add_argument("--batch-slots", type=int, default=0,
+                   help="continuous batching with N slots: concurrent "
+                        "requests join the running decode batch between "
+                        "steps (single-node mode only)")
     s.set_defaults(fn=cmd_serve)
 
     sv = sub.add_parser("server", help="integrated root server: collect, "

@@ -1,0 +1,365 @@
+"""Continuous batching: requests join and leave a running decode batch.
+
+The plain :class:`InferenceEngine` serves one ``generate()`` at a time; under
+concurrent load each request waits for the whole previous batch.  Serving
+systems want *continuous* (in-flight) batching: a fixed pool of batch SLOTS
+decodes in lockstep, and a new request is admitted into a free slot between
+two decode steps — it never waits for the others to finish, and the chip
+always steps the full batch.  The reference's closest concept is
+``core_pool_size`` samples in flight over socket sets
+(``Communication.java:425-437``); this is that idea rebuilt for a single
+accelerator where batching, not sockets, is the concurrency mechanism.
+
+TPU-first design:
+
+- **One compiled step, static shapes.**  Every decode step runs the full
+  ``[max_batch]`` slot array through one donated-cache jit; an ``active``
+  mask keeps finished/empty slots harmless (their writes land on their own
+  stale positions, which the causal mask hides — see below).  Admission
+  never recompiles the step.
+- **Per-slot cache positions, no per-slot programs.**  Each slot fills its
+  cache row from position 0 independently.  The attention mask is already
+  per-row (``kv_pos <= q_position`` — ops/attention.py), so ragged slot
+  lengths need no extra masking; a custom ``attn_impl`` scatters the
+  chunk's K/V at per-row positions (``cache.at[rows, :, positions]``)
+  instead of the engines' scalar-offset ``dynamic_update_slice``.
+- **Admission = batch-1 prefill + row copy.**  The prompt is padded to a
+  small set of bucket lengths (one compile per bucket, reused), prefilled
+  into a temp cache, and copied into the slot's row of the shared cache —
+  two dispatches, between steps, while the other slots' state stays on
+  device.
+- **Stale-slot safety** is the same invariant speculative decoding relies
+  on: garbage KV only ever sits at positions >= a row's valid length, a
+  query at position p attends only kv_pos <= p, and position p is always
+  rewritten before any query reaches it.
+
+Per-request ``seed`` is not honored (slots share one RNG stream — the
+batch's sampling order depends on who else is in flight); the engine-level
+seed makes single-request runs reproducible, and greedy decoding is
+bit-exact vs InferenceEngine (pinned by tests).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
+from ..models.decoder import stage_forward
+from ..ops.sampling import SamplingParams, sample_logits
+from .engine import GenerationResult, check_capacity
+
+
+def slot_attention_impl(q, k, v, k_cache, v_cache, positions, cache_start,
+                        slopes):
+    """Attention hook for ragged per-slot cache offsets.
+
+    Ignores the scalar ``cache_start``; ``positions`` [b, s] carries each
+    row's true insert offsets.  K/V land via advanced-index scatter (the
+    two index arrays broadcast to [b, s] and the indexed result layout
+    [b, s, nkv, hd] is exactly the projection layout ``k``/``v`` arrive
+    in).  The mask side needs nothing: ``attention`` already bounds each
+    row by its own q positions.
+    """
+    from ..ops.attention import attention
+    b, s = positions.shape
+    rows = jnp.arange(b)[:, None]
+    k_cache = k_cache.at[rows, :, positions].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[rows, :, positions].set(v.astype(v_cache.dtype))
+    max_seq = k_cache.shape[2]
+    out = attention(q, k_cache, v_cache, positions,
+                    jnp.asarray(max_seq, jnp.int32), slopes)
+    return out, k_cache, v_cache
+
+
+@dataclass
+class Request:
+    """One in-flight generation request (row-level)."""
+    prompt: np.ndarray                 # [s] int32
+    max_new: int
+    tokens: List[int] = field(default_factory=list)
+    stream: "queue.Queue" = field(default_factory=queue.Queue)
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Optional[BaseException] = None
+    cancelled: bool = False
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError("request did not complete in time")
+        if self.error is not None:
+            raise self.error
+        return np.asarray(self.tokens, np.int32)
+
+    def cancel(self) -> None:
+        """Ask the scheduler to drop this request: a queued request is
+        skipped at admission; an in-flight one frees its slot after the
+        current step.  Tokens already produced stay in ``tokens``."""
+        self.cancelled = True
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over a single-stage model."""
+
+    def __init__(self, cfg: ModelConfig, params: StageParams,
+                 max_seq: Optional[int] = None, max_batch: int = 8,
+                 sampling: SamplingParams = SamplingParams(),
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 prompt_buckets: tuple = (32, 128, 512, 2048)):
+        self.cfg, self.params = cfg, params
+        self.max_seq = max_seq or cfg.max_seq_len
+        self.max_batch = max_batch
+        self.sampling = sampling
+        self.eos_id = eos_id
+        self.spec = StageSpec(0, 1, 0, cfg.num_layers)
+        self.prompt_buckets = tuple(
+            b for b in sorted(prompt_buckets) if b <= self.max_seq
+        ) or (self.max_seq,)
+
+        cfg_, spec_, samp_ = cfg, self.spec, sampling
+        B, S = max_batch, self.max_seq
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, ck, cv, lengths, last_tok, active, rng):
+            """One lockstep decode step over all slots."""
+            cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
+            pos = lengths[:, None]
+            logits, cache = stage_forward(
+                params, cfg_, spec_, last_tok[:, None], cache, pos,
+                attn_impl=slot_attention_impl, last_logits_only=True)
+            tok = sample_logits(logits[:, 0], rng, samp_)
+            tok = jnp.where(active, tok, last_tok)
+            lengths = lengths + active.astype(jnp.int32)
+            return cache.keys, cache.values, lengths, tok
+
+        @jax.jit
+        def prefill(params, ids, real_len, rng):
+            """Batch-1 prefill over a padded bucket; samples token #1.
+
+            Padded tail tokens do write garbage K/V past ``real_len``, but
+            those positions are exactly the ones decode overwrites before
+            any query can attend them (stale-slot invariant above)."""
+            b, s = ids.shape
+            pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+            cache = KVCache.create(cfg_, cfg_.num_layers, 1, S)
+            logits, cache = stage_forward(
+                params, cfg_, spec_, ids, cache, pos,
+                attn_impl=slot_attention_impl)
+            last = jax.lax.dynamic_index_in_dim(
+                logits, real_len - 1, axis=1, keepdims=False)  # [1, V]
+            tok = sample_logits(last, rng, samp_)
+            return cache.keys, cache.values, tok[0]
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def admit(ck, cv, row_k, row_v, slot, lengths, last_tok,
+                  new_len, new_tok):
+            zero = jnp.zeros((), jnp.int32)
+            ck = jax.lax.dynamic_update_slice(
+                ck, row_k, (zero, slot, zero, zero, zero))
+            cv = jax.lax.dynamic_update_slice(
+                cv, row_v, (zero, slot, zero, zero, zero))
+            lengths = lengths.at[slot].set(new_len)
+            last_tok = last_tok.at[slot].set(new_tok)
+            return ck, cv, lengths, last_tok
+
+        self._step, self._prefill, self._admit = step, prefill, admit
+
+        cache = KVCache.create(cfg, cfg.num_layers, B, S)
+        self._ck, self._cv = cache.keys, cache.values
+        self._lengths = jnp.zeros((B,), jnp.int32)
+        self._last_tok = jnp.zeros((B,), jnp.int32)
+        self._rng = jax.random.PRNGKey(seed)
+        self._step_count = 0
+
+        self._slots: List[Optional[Request]] = [None] * B
+        self._queue: "queue.Queue" = queue.Queue()
+        self._running = True
+        # serializes submit() against close(): no request can be enqueued
+        # after close() returns, so none can slip past the shutdown drain
+        self._submit_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # public API
+
+    def submit(self, prompt_ids, max_new_tokens: int) -> Request:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        check_capacity(self.max_seq, len(prompt), max_new_tokens)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        req = Request(prompt=prompt, max_new=max_new_tokens)
+        with self._submit_lock:
+            if not self._running:
+                raise RuntimeError("engine is closed")
+            self._queue.put(req)
+        return req
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 seed: int = 0,
+                 timeout: Optional[float] = None) -> GenerationResult:
+        """Engine-surface convenience: submit each row as its own request
+        (they batch with whatever else is in flight) and wait for all.
+        ``seed`` is accepted for surface compatibility but not honored —
+        see the module docstring.  On ``timeout`` the requests are
+        cancelled (slots freed) before TimeoutError propagates."""
+        ids = np.asarray(prompt_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        t0 = time.perf_counter()
+        reqs = [self.submit(row, max_new_tokens) for row in ids]
+        try:
+            rows = [r.wait(timeout=timeout) for r in reqs]
+        except TimeoutError:
+            for r in reqs:
+                r.cancel()
+            raise
+        width = max(len(r) for r in rows)
+        pad_id = self.eos_id if self.eos_id is not None else 0
+        toks = np.full((len(rows), width), pad_id, np.int32)
+        for i, r in enumerate(rows):
+            toks[i, :len(r)] = r
+        return GenerationResult(tokens=toks, prompt_len=ids.shape[1],
+                                num_new=width,
+                                seconds=time.perf_counter() - t0)
+
+    def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                        seed: int = 0):
+        """Yield [batch] token arrays per step (HTTP streaming surface).
+        Single-row streaming only batches trivially; multi-row prompts
+        stream in lockstep of the slowest admitted row."""
+        ids = np.asarray(prompt_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        reqs = [self.submit(row, max_new_tokens) for row in ids]
+        fetched = [[] for _ in reqs]
+        finished = [False] * len(reqs)   # row's None sentinel was consumed
+        for step_i in range(max_new_tokens):
+            out = []
+            for i, r in enumerate(reqs):
+                while not finished[i] and len(fetched[i]) <= step_i:
+                    item = r.stream.get()
+                    if item is None:          # finished early (EOS)
+                        finished[i] = True
+                    else:
+                        fetched[i].append(item)
+                out.append(fetched[i][step_i]
+                           if step_i < len(fetched[i]) else None)
+            if all(o is None for o in out):
+                return
+            pad = self.eos_id if self.eos_id is not None else 0
+            yield np.asarray([pad if o is None else o for o in out],
+                             np.int32)
+
+    def close(self):
+        self._running = False
+        self._queue.put(None)              # wake the scheduler
+        self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+    # scheduler
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        return self.max_seq
+
+    def _admit_request(self, slot: int, req: Request):
+        plen = len(req.prompt)
+        bucket = self._bucket(plen)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = req.prompt
+        self._rng, sub = jax.random.split(self._rng)
+        row_k, row_v, tok = self._prefill(
+            self.params, jnp.asarray(padded), plen, sub)
+        self._ck, self._cv, self._lengths, self._last_tok = self._admit(
+            self._ck, self._cv, row_k, row_v, jnp.int32(slot),
+            self._lengths, self._last_tok, jnp.int32(plen),
+            tok.astype(jnp.int32))
+        self._slots[slot] = req
+        self._record_token(slot, req, int(tok))
+
+    def _record_token(self, slot: int, req: Request, tok: int):
+        req.tokens.append(tok)
+        req.stream.put(tok)
+        hit_eos = self.eos_id is not None and tok == self.eos_id
+        if len(req.tokens) >= req.max_new or hit_eos:
+            req.stream.put(None)
+            req.done.set()
+            self._slots[slot] = None
+
+    def _loop(self):
+        while self._running:
+            # admit as many queued requests as there are free slots
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            timeout = None if not any(self._slots) else 0.0
+            while free:
+                try:
+                    req = self._queue.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if req is None:            # close() sentinel
+                    break
+                timeout = 0.0
+                if req.cancelled:          # dropped while queued
+                    req.stream.put(None)
+                    req.done.set()
+                    continue
+                try:
+                    self._admit_request(free.pop(0), req)
+                except BaseException as e:  # surface to the waiter
+                    req.error = e
+                    req.stream.put(None)
+                    req.done.set()
+            # free the slots of requests cancelled mid-flight
+            for i, req in enumerate(self._slots):
+                if req is not None and req.cancelled:
+                    req.stream.put(None)
+                    req.done.set()
+                    self._slots[i] = None
+            if not any(self._slots):
+                continue
+
+            active_mask = np.array([s is not None for s in self._slots])
+            self._rng, sub = jax.random.split(self._rng)
+            self._ck, self._cv, self._lengths, tok = self._step(
+                self.params, self._ck, self._cv, self._lengths,
+                self._last_tok, jnp.asarray(active_mask), sub)
+            self._last_tok = tok
+            tok_np = np.asarray(tok)
+            self._step_count += 1
+            for i, req in enumerate(self._slots):
+                if req is not None:
+                    self._record_token(i, req, int(tok_np[i]))
+
+        # drain: fail anything still queued or in flight
+        err = RuntimeError("engine closed while request in flight")
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.error = err
+                req.stream.put(None)
+                req.done.set()
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                req.error = err
+                req.stream.put(None)
+                req.done.set()
+                self._slots[i] = None
