@@ -428,10 +428,44 @@ def cmd_generate(args) -> int:
         print("need --prompt-ids or --prompt", file=sys.stderr)
         return 1
 
-    _, engine = _build_engine(args)
-    res = engine.generate(ids, args.max_new_tokens, seed=args.seed)
+    stats = None
+    if getattr(args, "draft_model", ""):
+        # speculative decoding: the draft model proposes, the target
+        # verifies (runtime/speculative.py); shares every engine flag
+        from .models.registry import get_model_config
+        from .runtime import SpeculativeEngine
+
+        if getattr(args, "kv_cache_dtype", ""):
+            # SpeculativeEngine caches don't take a dtype override yet:
+            # reject rather than silently serving full-precision caches
+            print("--kv-cache-dtype is not supported with --draft-model",
+                  file=sys.stderr)
+            return 1
+        cfg = get_model_config(args.model)
+        draft_cfg = get_model_config(args.draft_model)
+        spec = SpeculativeEngine(
+            cfg, _load_full_params(args, cfg),
+            draft_cfg, _load_full_params(
+                argparse.Namespace(**{**vars(args),
+                                      "model": args.draft_model,
+                                      "checkpoint": args.draft_checkpoint}),
+                draft_cfg),
+            max_seq=args.max_seq, sampling=_sampling_from_args(args),
+            num_draft=args.num_draft, attn_backend=args.attn_backend)
+        res, stats = spec.generate(ids, args.max_new_tokens, seed=args.seed)
+    else:
+        _, engine = _build_engine(args)
+        res = engine.generate(ids, args.max_new_tokens, seed=args.seed)
     out = {"tokens": res.tokens.tolist(),
            "tokens_per_second": res.tokens_per_second}
+    if stats is not None:
+        def finite(x, nd):          # 0 rounds => NaN rates; JSON has no NaN
+            return round(x, nd) if x == x else None
+        out["speculative"] = {
+            "num_draft": args.num_draft,
+            "acceptance_rate": finite(stats.acceptance_rate, 4),
+            "tokens_per_round": finite(stats.tokens_per_round, 3),
+            "rounds": stats.rounds}
     if tokenizer is not None:
         out["text"] = [tokenizer.decode(r) for r in res.tokens.tolist()]
     print(json.dumps(out))
@@ -594,6 +628,13 @@ def main(argv=None) -> int:
     _add_engine_args(g)
     g.add_argument("--prompt-ids", default="")
     g.add_argument("--prompt", default=None)
+    g.add_argument("--draft-model", default="",
+                   help="speculative decoding: draft model name (must "
+                        "share the target's vocab)")
+    g.add_argument("--draft-checkpoint", default="",
+                   help="checkpoint for the draft model weights")
+    g.add_argument("--num-draft", type=int, default=4,
+                   help="draft tokens proposed per verify round")
     g.set_defaults(fn=cmd_generate)
 
     b = sub.add_parser("bench", help="decode throughput benchmark")

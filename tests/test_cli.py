@@ -129,6 +129,23 @@ def test_cli_generate_greedy():
     assert body["tokens"] == want
 
 
+def test_cli_generate_speculative_self_draft():
+    """generate --draft-model with draft == target (same seed-init) must
+    reproduce plain greedy output exactly with 100% acceptance."""
+    argv_tail = ["--model", "llama-test", "--prompt-ids", "5,17,42,7",
+                 "--max-new-tokens", "6", "--greedy", "--max-seq", "64",
+                 "--attn-backend", "jnp"]
+    rc, plain = _run_cli(["generate"] + argv_tail)
+    assert rc == 0
+    rc, spec = _run_cli(["generate"] + argv_tail +
+                        ["--draft-model", "llama-test", "--num-draft", "3"])
+    assert rc == 0
+    plain, spec = json.loads(plain), json.loads(spec)
+    assert spec["tokens"] == plain["tokens"]
+    assert spec["speculative"]["acceptance_rate"] == 1.0
+    assert spec["speculative"]["tokens_per_round"] > 1.0
+
+
 def test_cli_plan_and_cache(tmp_path):
     devices = [
         {"device_id": "cpu0", "address": "127.0.0.1:7000",
