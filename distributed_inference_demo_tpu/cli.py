@@ -127,9 +127,10 @@ def cmd_serve(args) -> int:
         sampling = _sampling_from_args(args)
         backend = ContinuousBatchingEngine(
             cfg, _load_full_params(args, cfg), max_seq=args.max_seq,
-            max_batch=args.batch_slots, sampling=sampling, seed=args.seed)
-        print(f"SERVE_BATCHING {args.model} slots={args.batch_slots}",
-              flush=True)
+            max_batch=args.batch_slots, sampling=sampling, seed=args.seed,
+            prefix_cache_size=args.prefix_cache_size)
+        print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
+              f"prefix_cache={args.prefix_cache_size}", flush=True)
     else:
         cfg, engine = _build_engine(args)
         backend = engine
@@ -575,6 +576,11 @@ def main(argv=None) -> int:
                    help="continuous batching with N slots: concurrent "
                         "requests join the running decode batch between "
                         "steps (single-node mode only)")
+    s.add_argument("--prefix-cache-size", type=int, default=8,
+                   help="with --batch-slots: LRU entries of full-prompt "
+                        "KV kept on device for automatic prefix reuse "
+                        "(0 disables; each entry costs up to a "
+                        "prompt-bucket of KV in HBM)")
     s.set_defaults(fn=cmd_serve)
 
     sv = sub.add_parser("server", help="integrated root server: collect, "

@@ -25,8 +25,10 @@ TPU-first design:
   instead of the engines' scalar-offset ``dynamic_update_slice``.
 - **Admission = batch-1 prefill + row copy.**  The prompt is padded to a
   small set of bucket lengths (one compile per bucket, reused), prefilled
-  into a temp cache, and copied into the slot's row of the shared cache —
-  two dispatches, between steps, while the other slots' state stays on
+  into a temp row (zeroed, or preloaded with a cached prefix block), and
+  copied into the slot's row of the shared cache — a handful of
+  dispatches (3 cold, plus the prefix load / store copies when the prefix
+  cache engages), between steps, while the other slots' state stays on
   device.
 - **Stale-slot safety** is the same invariant speculative decoding relies
   on: garbage KV only ever sits at positions >= a row's valid length, a
@@ -112,7 +114,14 @@ class ContinuousBatchingEngine:
                  max_seq: Optional[int] = None, max_batch: int = 8,
                  sampling: SamplingParams = SamplingParams(),
                  eos_id: Optional[int] = None, seed: int = 0,
-                 prompt_buckets: tuple = (32, 128, 512, 2048)):
+                 prompt_buckets: tuple = (32, 128, 512, 2048),
+                 prefix_cache_size: int = 8, min_prefix_len: int = 16):
+        """``prefix_cache_size``: LRU entries of full-prompt KV kept on
+        device for automatic prefix reuse (0 disables).  A new prompt
+        sharing >= ``min_prefix_len`` leading tokens with a cached one
+        skips prefill for the shared part: the cached K/V block is copied
+        into the slot row and only the suffix runs (causality makes a
+        prefix's KV independent of what follows, so the reuse is exact)."""
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -139,16 +148,19 @@ class ContinuousBatchingEngine:
             lengths = lengths + active.astype(jnp.int32)
             return cache.keys, cache.values, lengths, tok
 
-        @jax.jit
-        def prefill(params, ids, real_len, rng):
-            """Batch-1 prefill over a padded bucket; samples token #1.
+        @partial(jax.jit, donate_argnums=(3, 4))
+        def prefill(params, ids, start, row_k, row_v, real_len, rng):
+            """Batch-1 (suffix) prefill over a padded bucket at offset
+            ``start`` of a caller-provided row cache; samples token #1.
 
-            Padded tail tokens do write garbage K/V past ``real_len``, but
+            Cold path: start=0 and a zero row.  Prefix-reuse path: start=m
+            and a row preloaded with the shared prefix's K/V.  Padded tail
+            tokens do write garbage K/V past ``start + real_len``, but
             those positions are exactly the ones decode overwrites before
             any query can attend them (stale-slot invariant above)."""
             b, s = ids.shape
-            pos = jnp.broadcast_to(jnp.arange(s), (b, s))
-            cache = KVCache.create(cfg_, cfg_.num_layers, 1, S)
+            pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
+            cache = KVCache(row_k, row_v, jnp.zeros((), jnp.int32))
             logits, cache = stage_forward(
                 params, cfg_, spec_, ids, cache, pos,
                 attn_impl=slot_attention_impl)
@@ -156,6 +168,22 @@ class ContinuousBatchingEngine:
                 logits, real_len - 1, axis=1, keepdims=False)  # [1, V]
             tok = sample_logits(last, rng, samp_)
             return cache.keys, cache.values, tok[0]
+
+        @jax.jit
+        def zero_row():
+            """Fresh zero row for the cold prefill path (prefill donates
+            its row buffers, so the row must be new each admission)."""
+            row = KVCache.create(cfg_, cfg_.num_layers, 1, S)
+            return row.keys, row.values
+
+        @jax.jit
+        def load_prefix(prefix_k, prefix_v):
+            """Zero row with a cached prefix K/V block at columns [0, m)."""
+            row = KVCache.create(cfg_, cfg_.num_layers, 1, S)
+            zero = jnp.zeros((), jnp.int32)
+            idx = (zero, zero, zero, zero, zero)
+            return (jax.lax.dynamic_update_slice(row.keys, prefix_k, idx),
+                    jax.lax.dynamic_update_slice(row.values, prefix_v, idx))
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def admit(ck, cv, row_k, row_v, slot, lengths, last_tok,
@@ -170,6 +198,7 @@ class ContinuousBatchingEngine:
             return ck, cv, lengths, last_tok
 
         self._step, self._prefill, self._admit = step, prefill, admit
+        self._load_prefix, self._zero_row = load_prefix, zero_row
 
         cache = KVCache.create(cfg, cfg.num_layers, B, S)
         self._ck, self._cv = cache.keys, cache.values
@@ -177,6 +206,15 @@ class ContinuousBatchingEngine:
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._rng = jax.random.PRNGKey(seed)
         self._step_count = 0
+
+        # automatic prefix cache: full-prompt tuple -> (k, v, plen); the
+        # K/V blocks are bucket-width device arrays.  Touched only by the
+        # scheduler thread.
+        from collections import OrderedDict
+        self._prefix_cache_size = max(0, prefix_cache_size)
+        self._min_prefix_len = max(1, min_prefix_len)
+        self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
 
         self._slots: List[Optional[Request]] = [None] * B
         self._queue: "queue.Queue" = queue.Queue()
@@ -278,14 +316,66 @@ class ContinuousBatchingEngine:
                 return b
         return self.max_seq
 
+    def _longest_cached_prefix(self, prompt: np.ndarray):
+        """Best (lcp_len, key) over the prefix cache, or (0, None).
+        The reusable length is capped at plen-1 so the suffix forward is
+        never empty (its last position produces the first sampled token)."""
+        best_m, best_key = 0, None
+        cap = len(prompt) - 1
+        for key in self._prefix_cache:
+            n = min(len(key), cap)
+            if n <= best_m:
+                continue
+            eq = np.asarray(key[:n], np.int32) == prompt[:n]
+            m = int(np.cumprod(eq).sum())
+            if m > best_m:
+                best_m, best_key = m, key
+        return best_m, best_key
+
+    def _prefix_store(self, prompt: np.ndarray, row_k, row_v):
+        # don't thrash the LRU with entries that can never produce a hit
+        # (a match is capped at len(key), which would stay below the
+        # threshold), and don't re-copy on an exact-repeat hit
+        if (not self._prefix_cache_size
+                or len(prompt) < self._min_prefix_len):
+            return
+        key = tuple(int(t) for t in prompt)
+        if key in self._prefix_cache:
+            self._prefix_cache.move_to_end(key)
+            return
+        cols = self._bucket(len(prompt))
+        # slices copy in jax: the stored block does not pin the whole row
+        self._prefix_cache[key] = (row_k[:, :, :, :cols, :],
+                                   row_v[:, :, :, :cols, :])
+        while len(self._prefix_cache) > self._prefix_cache_size:
+            self._prefix_cache.popitem(last=False)
+
     def _admit_request(self, slot: int, req: Request):
         plen = len(req.prompt)
-        bucket = self._bucket(plen)
+
+        start = 0
+        if self._prefix_cache_size:
+            m, key = self._longest_cached_prefix(req.prompt)
+            if m >= self._min_prefix_len:
+                pk, pv = self._prefix_cache[key]
+                self._prefix_cache.move_to_end(key)   # LRU touch
+                row_k, row_v = self._load_prefix(pk, pv)
+                start = m
+                self.prefix_stats["hits"] += 1
+                self.prefix_stats["tokens_reused"] += m
+        if start == 0:
+            row_k, row_v = self._zero_row()
+            self.prefix_stats["misses"] += 1
+
+        suffix = req.prompt[start:]
+        bucket = self._bucket(len(suffix))
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = req.prompt
+        padded[0, :len(suffix)] = suffix
         self._rng, sub = jax.random.split(self._rng)
         row_k, row_v, tok = self._prefill(
-            self.params, jnp.asarray(padded), plen, sub)
+            self.params, jnp.asarray(padded), jnp.int32(start),
+            row_k, row_v, jnp.int32(len(suffix)), sub)
+        self._prefix_store(req.prompt, row_k, row_v)
         self._ck, self._cv, self._lengths, self._last_tok = self._admit(
             self._ck, self._cv, row_k, row_v, jnp.int32(slot),
             self._lengths, self._last_tok, jnp.int32(plen),

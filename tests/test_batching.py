@@ -165,6 +165,67 @@ def test_cancel_frees_slot(params, oracle):
         long.cancel()
 
 
+def test_prefix_cache_exact_repeat(params, oracle):
+    """A repeated prompt reuses all but the last prefix token and still
+    decodes greedy-exact."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  min_prefix_len=1) as eng:
+        prompt = [3, 14, 15, 92, 65, 35, 89]
+        want = expected(oracle, prompt, 10)
+        first = eng.submit(prompt, 10).wait(timeout=300)
+        second = eng.submit(prompt, 10).wait(timeout=300)
+        np.testing.assert_array_equal(first, want)
+        np.testing.assert_array_equal(second, want)
+        assert eng.prefix_stats["hits"] == 1
+        assert eng.prefix_stats["tokens_reused"] == len(prompt) - 1
+
+
+def test_prefix_cache_shared_prefix_divergent_tail(params, oracle):
+    """Two prompts sharing a long prefix: the second reuses the shared
+    part only and its full output stays greedy-exact."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  min_prefix_len=4) as eng:
+        shared = [7, 3, 9, 1, 4, 6]
+        a, b = shared + [11, 12], shared + [20, 21, 22]
+        got_a = eng.submit(a, 8).wait(timeout=300)
+        got_b = eng.submit(b, 8).wait(timeout=300)
+        np.testing.assert_array_equal(got_a, expected(oracle, a, 8))
+        np.testing.assert_array_equal(got_b, expected(oracle, b, 8))
+        assert eng.prefix_stats["hits"] == 1
+        assert eng.prefix_stats["tokens_reused"] == len(shared)
+
+
+def test_prefix_cache_below_threshold_and_lru(params, oracle):
+    """Short overlaps don't trigger reuse; the LRU stays bounded."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  min_prefix_len=5,
+                                  prefix_cache_size=2) as eng:
+        p1 = [1, 2, 3, 4, 9, 9]
+        p2 = [1, 2, 3, 8, 8, 8]     # lcp=3 < threshold 5
+        eng.submit(p1, 6).wait(timeout=300)
+        got = eng.submit(p2, 6).wait(timeout=300)
+        np.testing.assert_array_equal(got, expected(oracle, p2, 6))
+        assert eng.prefix_stats["hits"] == 0
+        for extra in ([5, 5, 5, 5, 5, 5], [6, 6, 6, 6, 6, 6]):
+            eng.submit(extra, 4).wait(timeout=300)
+        assert len(eng._prefix_cache) == 2   # size bound enforced
+
+
+def test_prefix_cache_disabled(params, oracle):
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  prefix_cache_size=0) as eng:
+        prompt = [3, 1, 4, 1, 5]
+        for _ in range(2):
+            got = eng.submit(prompt, 6).wait(timeout=300)
+            np.testing.assert_array_equal(got, expected(oracle, prompt, 6))
+        assert eng.prefix_stats["hits"] == 0
+        assert len(eng._prefix_cache) == 0
+
+
 def test_submit_validation(params):
     with ContinuousBatchingEngine(CFG, params, max_seq=32, max_batch=2,
                                   sampling=GREEDY,
