@@ -55,6 +55,33 @@ def _sampling_from_args(args):
     return SamplingParams(temperature=args.temperature, top_k=args.top_k)
 
 
+def _build_spec_engine(args):
+    """Construct the draft/verify SpeculativeEngine from CLI flags — the
+    one site shared by ``generate --draft-model`` and
+    ``serve --draft-model``.  Returns None (after printing the error) for
+    flag combinations the speculative caches don't support."""
+    from .models.registry import get_model_config
+    from .runtime import SpeculativeEngine
+
+    if getattr(args, "kv_cache_dtype", ""):
+        # SpeculativeEngine caches don't take a dtype override yet:
+        # reject rather than silently serving full-precision caches
+        print("--kv-cache-dtype is not supported with --draft-model",
+              file=sys.stderr)
+        return None
+    cfg = get_model_config(args.model)
+    draft_cfg = get_model_config(args.draft_model)
+    return SpeculativeEngine(
+        cfg, _load_full_params(args, cfg),
+        draft_cfg, _load_full_params(
+            argparse.Namespace(**{**vars(args),
+                                  "model": args.draft_model,
+                                  "checkpoint": args.draft_checkpoint}),
+            draft_cfg),
+        max_seq=args.max_seq, sampling=_sampling_from_args(args),
+        num_draft=args.num_draft, attn_backend=args.attn_backend)
+
+
 def _build_engine(args):
     from .models.registry import get_model_config
     from .runtime import InferenceEngine
@@ -76,6 +103,16 @@ def cmd_serve(args) -> int:
     """Single-node engine serving, or pipeline-header serving over a worker
     chain (start the workers first with the ``worker`` subcommand)."""
     from .runtime.http_server import HeaderBackend, InferenceHTTPServer
+
+    modes = [name for name, on in [("--chain", args.chain),
+                                   ("--draft-model",
+                                    getattr(args, "draft_model", "")),
+                                   ("--batch-slots",
+                                    getattr(args, "batch_slots", 0))] if on]
+    if len(modes) > 1:
+        print(f"choose one serve mode, got {' + '.join(modes)}",
+              file=sys.stderr)
+        return 1
 
     tokenizer = _load_tokenizer(args.tokenizer)
 
@@ -115,6 +152,15 @@ def cmd_serve(args) -> int:
                                 num_stages=len(chain))
         print(f"SERVE_PIPELINE {chain} ranges="
               f"{[(s.layer_start, s.layer_end) for s in specs]}", flush=True)
+    elif getattr(args, "draft_model", ""):
+        from .runtime.speculative import SpeculativeBackend
+
+        engine = _build_spec_engine(args)
+        if engine is None:
+            return 1
+        backend = SpeculativeBackend(engine)
+        print(f"SERVE_SPECULATIVE {args.model} draft={args.draft_model} "
+              f"k={args.num_draft}", flush=True)
     elif getattr(args, "batch_slots", 0):
         from .models.registry import get_model_config
         from .runtime.batching import ContinuousBatchingEngine
@@ -433,26 +479,9 @@ def cmd_generate(args) -> int:
     if getattr(args, "draft_model", ""):
         # speculative decoding: the draft model proposes, the target
         # verifies (runtime/speculative.py); shares every engine flag
-        from .models.registry import get_model_config
-        from .runtime import SpeculativeEngine
-
-        if getattr(args, "kv_cache_dtype", ""):
-            # SpeculativeEngine caches don't take a dtype override yet:
-            # reject rather than silently serving full-precision caches
-            print("--kv-cache-dtype is not supported with --draft-model",
-                  file=sys.stderr)
+        spec = _build_spec_engine(args)
+        if spec is None:
             return 1
-        cfg = get_model_config(args.model)
-        draft_cfg = get_model_config(args.draft_model)
-        spec = SpeculativeEngine(
-            cfg, _load_full_params(args, cfg),
-            draft_cfg, _load_full_params(
-                argparse.Namespace(**{**vars(args),
-                                      "model": args.draft_model,
-                                      "checkpoint": args.draft_checkpoint}),
-                draft_cfg),
-            max_seq=args.max_seq, sampling=_sampling_from_args(args),
-            num_draft=args.num_draft, attn_backend=args.attn_backend)
         res, stats = spec.generate(ids, args.max_new_tokens, seed=args.seed)
     else:
         _, engine = _build_engine(args)
@@ -460,13 +489,8 @@ def cmd_generate(args) -> int:
     out = {"tokens": res.tokens.tolist(),
            "tokens_per_second": res.tokens_per_second}
     if stats is not None:
-        def finite(x, nd):          # 0 rounds => NaN rates; JSON has no NaN
-            return round(x, nd) if x == x else None
-        out["speculative"] = {
-            "num_draft": args.num_draft,
-            "acceptance_rate": finite(stats.acceptance_rate, 4),
-            "tokens_per_round": finite(stats.tokens_per_round, 3),
-            "rounds": stats.rounds}
+        from .runtime.speculative import stats_json
+        out["speculative"] = stats_json(stats, args.num_draft)
     if tokenizer is not None:
         out["text"] = [tokenizer.decode(r) for r in res.tokens.tolist()]
     print(json.dumps(out))
@@ -555,6 +579,17 @@ def _add_engine_args(ap):
                          "accuracy cost)")
 
 
+def _add_draft_args(p) -> None:
+    """Speculative-decoding flags, shared by generate and serve."""
+    p.add_argument("--draft-model", default="",
+                   help="speculative decoding: draft model name (must "
+                        "share the target's vocab)")
+    p.add_argument("--draft-checkpoint", default="",
+                   help="checkpoint for the draft model weights")
+    p.add_argument("--num-draft", type=int, default=4,
+                   help="draft tokens proposed per verify round")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="distributed_inference_demo_tpu",
@@ -581,6 +616,7 @@ def main(argv=None) -> int:
                         "KV kept on device for automatic prefix reuse "
                         "(0 disables; each entry costs up to a "
                         "prompt-bucket of KV in HBM)")
+    _add_draft_args(s)
     s.set_defaults(fn=cmd_serve)
 
     sv = sub.add_parser("server", help="integrated root server: collect, "
@@ -634,13 +670,7 @@ def main(argv=None) -> int:
     _add_engine_args(g)
     g.add_argument("--prompt-ids", default="")
     g.add_argument("--prompt", default=None)
-    g.add_argument("--draft-model", default="",
-                   help="speculative decoding: draft model name (must "
-                        "share the target's vocab)")
-    g.add_argument("--draft-checkpoint", default="",
-                   help="checkpoint for the draft model weights")
-    g.add_argument("--num-draft", type=int, default=4,
-                   help="draft tokens proposed per verify round")
+    _add_draft_args(g)
     g.set_defaults(fn=cmd_generate)
 
     b = sub.add_parser("bench", help="decode throughput benchmark")

@@ -38,6 +38,7 @@ The draft and target must share a vocabulary (checked).  Greedy mode
 bit-exact vs target-only greedy decode — the property the tests pin.
 """
 
+import threading
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -299,3 +300,114 @@ class SpeculativeEngine:
                                  prompt_len=plen,
                                  num_new=toks.shape[1], seconds=dt),
                 stats)
+
+    def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                        seed: int = 0,
+                        stats_out: Optional[SpecStats] = None):
+        """Yield [batch] token arrays per emitted token (UI streaming
+        surface).  Tokens arrive in bursts — one verify round emits up to
+        num_draft+1 at once — which is exactly speculative decoding's
+        latency win showing through the stream.  ``stats_out``, if given,
+        is updated in place per round (a generator can't return stats)."""
+        if max_new_tokens <= 0:
+            return
+        ids = jnp.asarray(prompt_ids, jnp.int32)
+        b, plen = ids.shape
+        check_capacity(self.max_seq, plen, max_new_tokens)
+        rng = jax.random.PRNGKey(seed)
+        stats = stats_out if stats_out is not None else SpecStats()
+
+        tcache, dcache = self.new_caches(b)
+        last_logits, tcache, dcache = self._prefill_both(
+            self.params, self.draft_params, ids, tcache, dcache)
+        rng, sub = jax.random.split(rng)
+        last_tok = sample_logits(last_logits, sub, self.sampling)
+        yield np.asarray(last_tok)
+        total = stats.emitted = 1
+        while total < max_new_tokens:
+            em, ms, last_tok, tcache, dcache, rng = self._rounds(
+                self.params, self.draft_params, last_tok, tcache, dcache,
+                rng, 1)
+            m = int(np.asarray(ms)[0])
+            block = np.asarray(em)[0]
+            stats.rounds += 1
+            stats.drafted += self.num_draft
+            stats.accepted += m - 1
+            for j in range(min(m, max_new_tokens - total)):
+                yield block[:, j]
+            total += m
+            stats.emitted = min(total, max_new_tokens)
+
+
+def stats_json(stats: Optional[SpecStats], num_draft: int) -> Optional[dict]:
+    """SpecStats → JSON-safe dict (0 rounds yields NaN rates; JSON has no
+    NaN).  The one shaping shared by the CLI and the HTTP backend."""
+    if stats is None:
+        return None
+
+    def finite(x, nd):
+        return round(x, nd) if x == x else None
+
+    return {"num_draft": num_draft,
+            "rounds": stats.rounds,
+            "acceptance_rate": finite(stats.acceptance_rate, 4),
+            "tokens_per_round": finite(stats.tokens_per_round, 3)}
+
+
+class SpeculativeBackend:
+    """Adapts SpeculativeEngine to the HTTP backend surface (engine-style
+    ``generate`` returning a result object, plus acceptance stats on
+    ``/stats``).  Follows HeaderBackend's streaming discipline
+    (http_server.py): the device runs on a worker thread that holds the
+    lock only at device pace, tokens cross to the client-paced generator
+    over a queue — a stalled client can't wedge the server."""
+
+    def __init__(self, engine: SpeculativeEngine):
+        self.engine = engine
+        self.max_seq = engine.max_seq
+        self.last_stats: Optional[SpecStats] = None
+        self._lock = threading.Lock()   # one generation at a time
+
+    def generate(self, prompt_ids, max_new_tokens: int, seed: int = 0):
+        with self._lock:
+            res, stats = self.engine.generate(prompt_ids, max_new_tokens,
+                                              seed=seed)
+            self.last_stats = stats
+        return res
+
+    def generate_stream(self, prompt_ids, max_new_tokens: int,
+                        seed: int = 0):
+        import queue as queue_mod
+
+        q: "queue_mod.Queue" = queue_mod.Queue()
+        SENTINEL = object()
+        stats = SpecStats()
+
+        def run():
+            try:
+                with self._lock:
+                    for toks in self.engine.generate_stream(
+                            prompt_ids, max_new_tokens, seed=seed,
+                            stats_out=stats):
+                        q.put(toks)
+                    self.last_stats = stats
+            except BaseException as e:     # surface in the consumer
+                q.put(e)
+            finally:
+                q.put(SENTINEL)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is SENTINEL:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+        t.join(timeout=10)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"speculative": stats_json(self.last_stats,
+                                              self.engine.num_draft)}

@@ -132,6 +132,70 @@ def test_topk_sampling_respects_support(params, draft_params):
     np.testing.assert_array_equal(res.tokens, b.tokens)
 
 
+def test_stream_matches_generate(params, draft_params):
+    """Streamed tokens (burst-per-round) must equal the blocking path's."""
+    sampling = SamplingParams(greedy=True)
+    spec = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                             max_seq=96, sampling=sampling, num_draft=3)
+    prompt = np.asarray([[3, 14, 15], [9, 2, 6]])
+    blocking, _ = spec.generate(prompt, 15)
+    streamed = np.stack(list(spec.generate_stream(prompt, 15)), axis=1)
+    np.testing.assert_array_equal(blocking.tokens, streamed)
+    assert streamed.shape == (2, 15)
+
+
+def test_http_backend_surface(params, draft_params):
+    """serve --draft-model's backend: /generate, streaming, and /stats
+    acceptance diagnostics over the HTTP server."""
+    import http.client
+    import json
+
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        InferenceHTTPServer)
+    from distributed_inference_demo_tpu.runtime.speculative import (
+        SpeculativeBackend)
+
+    sampling = SamplingParams(greedy=True)
+    backend = SpeculativeBackend(SpeculativeEngine(
+        CFG, params, CFG, params,   # self-draft: 100% acceptance
+        max_seq=96, sampling=sampling, num_draft=3))
+    server = InferenceHTTPServer(backend, port=0, model_name="llama-test")
+    server.start()
+    try:
+        conn = http.client.HTTPConnection(server.host, server.port,
+                                          timeout=300)
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt_ids": [[5, 17, 42]],
+                                 "max_new_tokens": 9}),
+                     {"Content-Type": "application/json"})
+        out = json.loads(conn.getresponse().read())
+        assert len(out["tokens"][0]) == 9
+        conn.request("GET", "/stats", headers={})
+        stats = json.loads(conn.getresponse().read())
+        assert stats["speculative"]["acceptance_rate"] == 1.0
+        assert stats["speculative"]["num_draft"] == 3
+        # streaming also feeds /stats (regression: it used to stay stale)
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt_ids": [[9, 9]],
+                                 "max_new_tokens": 7, "stream": True}),
+                     {"Content-Type": "application/json"})
+        lines = [l for l in conn.getresponse().read().decode().splitlines()
+                 if l.strip()]
+        assert len(lines) == 7
+        conn.request("GET", "/stats", headers={})
+        stats = json.loads(conn.getresponse().read())
+        assert stats["speculative"]["rounds"] >= 1
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def test_stream_zero_tokens(params, draft_params):
+    spec = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                             max_seq=96, sampling=SamplingParams(greedy=True))
+    assert list(spec.generate_stream(np.asarray([[1, 2]]), 0)) == []
+
+
 def test_vocab_mismatch_rejected(params):
     other = dataclasses.replace(CFG, vocab_size=128)
     other_params = init_full_params(jax.random.PRNGKey(2), other)
