@@ -69,6 +69,12 @@ def _build_spec_engine(args):
         print("--kv-cache-dtype is not supported with --draft-model",
               file=sys.stderr)
         return None
+    if getattr(args, "prefill_chunk", 0):
+        # the draft/verify engines run whole-prompt prefill; silently
+        # ignoring the flag would defeat its memory-bounding purpose
+        print("--prefill-chunk is not supported with --draft-model",
+              file=sys.stderr)
+        return None
     cfg = get_model_config(args.model)
     draft_cfg = get_model_config(args.draft_model)
     return SpeculativeEngine(
@@ -92,7 +98,8 @@ def _build_engine(args):
     return cfg, InferenceEngine(
         cfg, params, max_seq=args.max_seq, sampling=sampling,
         attn_backend=args.attn_backend,
-        kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
+        prefill_chunk=getattr(args, "prefill_chunk", 0) or None)
 
 
 # ---------------------------------------------------------------------------
@@ -129,6 +136,10 @@ def cmd_serve(args) -> int:
             # StageRuntime caches don't take a dtype override yet: reject
             # rather than silently serving full-precision caches
             print("--kv-cache-dtype is not supported with --chain",
+                  file=sys.stderr)
+            return 1
+        if getattr(args, "prefill_chunk", 0):
+            print("--prefill-chunk is not supported with --chain",
                   file=sys.stderr)
             return 1
         full = _load_full_params(args, cfg)
@@ -168,6 +179,11 @@ def cmd_serve(args) -> int:
         if getattr(args, "kv_cache_dtype", ""):
             print("--kv-cache-dtype is not supported with --batch-slots",
                   file=sys.stderr)
+            return 1
+        if getattr(args, "prefill_chunk", 0):
+            # the batching engine buckets prompts itself (prompt_buckets)
+            print("--prefill-chunk is not supported with --batch-slots "
+                  "(admission already buckets prompts)", file=sys.stderr)
             return 1
         cfg = get_model_config(args.model)
         sampling = _sampling_from_args(args)
@@ -577,6 +593,10 @@ def _add_engine_args(ap):
                     help="reduced-precision KV cache storage, e.g. "
                          "float8_e4m3fn (half the cache bytes; small "
                          "accuracy cost)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="process prompts in fixed chunks of N tokens "
+                         "(bounds prefill activation memory on long "
+                         "prompts; 0 = whole-prompt prefill)")
 
 
 def _add_draft_args(p) -> None:

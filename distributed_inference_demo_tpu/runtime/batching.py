@@ -392,7 +392,42 @@ class ContinuousBatchingEngine:
             req.done.set()
             self._slots[slot] = None
 
+    @staticmethod
+    def _fail_request(req: Request, err: Optional[BaseException]):
+        """Finish a request (with an error, or cleanly for err=None)."""
+        req.error = err
+        req.stream.put(None)
+        req.done.set()
+
+    def _drain_all(self, err: BaseException):
+        """Fail every in-flight slot and queued request with ``err``."""
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                self._fail_request(req, err)
+                self._slots[i] = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if req is not None:
+                self._fail_request(req, err)
+
     def _loop(self):
+        try:
+            self._loop_body()
+        except BaseException as e:
+            # a failed decode step (device lost, OOM, ...) must not strand
+            # every waiter on a dead thread: fail all in-flight and queued
+            # requests with the underlying error, then refuse new work.
+            # The submit lock orders the drain after any submit that
+            # already saw _running True — its request lands before the
+            # drain runs, so none can slip past onto the dead thread.
+            with self._submit_lock:
+                self._running = False
+                self._drain_all(e)
+
+    def _loop_body(self):
         while self._running:
             # admit as many queued requests as there are free slots
             free = [i for i, s in enumerate(self._slots) if s is None]
@@ -406,20 +441,16 @@ class ContinuousBatchingEngine:
                     break
                 timeout = 0.0
                 if req.cancelled:          # dropped while queued
-                    req.stream.put(None)
-                    req.done.set()
+                    self._fail_request(req, None)
                     continue
                 try:
                     self._admit_request(free.pop(0), req)
                 except BaseException as e:  # surface to the waiter
-                    req.error = e
-                    req.stream.put(None)
-                    req.done.set()
+                    self._fail_request(req, e)
             # free the slots of requests cancelled mid-flight
             for i, req in enumerate(self._slots):
                 if req is not None and req.cancelled:
-                    req.stream.put(None)
-                    req.done.set()
+                    self._fail_request(req, None)
                     self._slots[i] = None
             if not any(self._slots):
                 continue
@@ -437,19 +468,4 @@ class ContinuousBatchingEngine:
                     self._record_token(i, req, int(tok_np[i]))
 
         # drain: fail anything still queued or in flight
-        err = RuntimeError("engine closed while request in flight")
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if req is not None:
-                req.error = err
-                req.stream.put(None)
-                req.done.set()
-        for i, req in enumerate(self._slots):
-            if req is not None:
-                req.error = err
-                req.stream.put(None)
-                req.done.set()
-                self._slots[i] = None
+        self._drain_all(RuntimeError("engine closed while request in flight"))

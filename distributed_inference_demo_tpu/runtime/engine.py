@@ -65,9 +65,19 @@ class InferenceEngine:
                  sampling: SamplingParams = SamplingParams(),
                  eos_id: Optional[int] = None,
                  attn_backend: str = "auto",
-                 kv_cache_dtype: Optional[str] = None):
+                 kv_cache_dtype: Optional[str] = None,
+                 prefill_chunk: Optional[int] = None):
         """``attn_backend``: "auto" (Pallas flash kernel on TPU, jnp
         elsewhere), "flash", "flash-interpret" (testing), or "jnp".
+
+        ``prefill_chunk``: process prompts in fixed chunks of this many
+        tokens instead of one whole-prompt program.  Bounds prefill
+        activation memory (a 32k-token prompt's [b, s, I] MLP
+        intermediates dwarf the weights) and keeps ONE compiled chunk
+        shape regardless of prompt length — the prompt is padded up to a
+        chunk multiple and the pad positions are overwritten by decode
+        before anything can attend them (same stale-slot invariant as
+        speculative rollback / batching admission).
 
         ``kv_cache_dtype``: store the KV cache at a reduced precision,
         e.g. "float8_e4m3fn" — HALF the cache bytes (and cache-read
@@ -85,6 +95,11 @@ class InferenceEngine:
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
                                if kv_cache_dtype else None)
+        if prefill_chunk is not None and not (
+                1 <= prefill_chunk <= self.max_seq):
+            raise ValueError(
+                f"prefill_chunk must be in [1, max_seq={self.max_seq}]")
+        self.prefill_chunk = prefill_chunk
 
         if self.kv_cache_dtype is not None:
             if attn_backend not in ("auto", "jnp"):
@@ -128,6 +143,31 @@ class InferenceEngine:
                                           last_logits_only=True)
             return logits[:, -1], cache
 
+        @partial(jax.jit, donate_argnums=(2,))
+        def prefill_chunk_mid(params, ids, cache, start):
+            """One non-final prompt chunk: extend the cache, drop logits."""
+            b, s = ids.shape
+            pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
+            _, cache = stage_forward(params, cfg_, spec_, ids, cache, pos,
+                                     attn_impl=attn_impl,
+                                     last_logits_only=True)
+            return cache
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def prefill_chunk_last(params, ids, cache, start, gather_idx):
+            """Final (possibly pad-tailed) chunk: logits at the prompt's
+            true last position."""
+            b, s = ids.shape
+            pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
+            logits, cache = stage_forward(params, cfg_, spec_, ids, cache,
+                                          pos, attn_impl=attn_impl)
+            last = jax.lax.dynamic_index_in_dim(logits, gather_idx, axis=1,
+                                                keepdims=False)
+            return last, cache
+
+        self._prefill_chunk_mid = prefill_chunk_mid
+        self._prefill_chunk_last = prefill_chunk_last
+
         @partial(jax.jit, donate_argnums=(2,), static_argnums=(4,))
         def decode(params, last_logits, cache, rng, num_steps):
             """Fused sample+forward scan for ``num_steps`` tokens."""
@@ -168,6 +208,41 @@ class InferenceEngine:
         return KVCache.create(self.cfg, self.cfg.num_layers, batch,
                               self.max_seq, dtype=self.kv_cache_dtype)
 
+    def _run_prefill(self, ids: jnp.ndarray, cache: KVCache):
+        """Whole-prompt or chunked prefill → (last_logits [b, V], cache).
+
+        Chunked: the prompt is zero-padded to a chunk multiple and every
+        chunk runs through the same two compiled programs (mid + last) —
+        one chunk shape for ALL prompt lengths, short ones included.  The
+        final chunk is left-shifted when the padded length would spill
+        past the cache capacity ("aligned last window"): the overlapped
+        real tokens are recomputed and rewritten at their own positions
+        (same values — K/V depend only on the prefix), so no pad slot is
+        ever written beyond max_seq and ``dynamic_update_slice`` can
+        never clamp into valid entries.  The cache's valid length is
+        rewound to the true prompt length afterwards so decode's first
+        insert overwrites the first pad slot (pads beyond it stay masked
+        until overwritten — stale-slot invariant)."""
+        b, plen = ids.shape
+        C = self.prefill_chunk
+        if C is None:
+            return self._prefill(self.params, ids, cache)
+        n_chunks = -(-plen // C)
+        padded = jnp.zeros((b, n_chunks * C), jnp.int32)
+        padded = jax.lax.dynamic_update_slice(padded, ids, (0, 0))
+        for i in range(n_chunks - 1):
+            cache = self._prefill_chunk_mid(
+                self.params, jax.lax.dynamic_slice_in_dim(
+                    padded, i * C, C, axis=1),
+                cache, jnp.int32(i * C))
+        start = min((n_chunks - 1) * C, self.max_seq - C)
+        last_logits, cache = self._prefill_chunk_last(
+            self.params, jax.lax.dynamic_slice_in_dim(
+                padded, start, C, axis=1),
+            cache, jnp.int32(start), jnp.int32(plen - 1 - start))
+        cache = KVCache(cache.keys, cache.values, jnp.int32(plen))
+        return last_logits, cache
+
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0) -> GenerationResult:
         """Batch generation, fused decode scan (the throughput path).
@@ -185,7 +260,7 @@ class InferenceEngine:
 
         t0 = time.perf_counter()
         cache = self.new_cache(b)
-        last_logits, cache = self._prefill(self.params, ids, cache)
+        last_logits, cache = self._run_prefill(ids, cache)
         toks, _ = self._decode(self.params, last_logits, cache, rng,
                                max_new_tokens)
         toks = np.asarray(toks)
@@ -208,7 +283,7 @@ class InferenceEngine:
                 f"label_token_ids out of range [0, {self.cfg.vocab_size})")
         self._check_capacity(ids.shape[1], 0)
         cache = self.new_cache(ids.shape[0])
-        logits, _ = self._prefill(self.params, ids, cache)
+        logits, _ = self._run_prefill(ids, cache)
         sub = np.asarray(logits)[:, label_ids]
         return np.argmax(sub, axis=-1).astype(np.int32)
 
@@ -220,7 +295,7 @@ class InferenceEngine:
         self._check_capacity(plen, max_new_tokens)
         cache = self.new_cache(b)
         rng = jax.random.PRNGKey(seed)
-        logits, cache = self._prefill(self.params, ids, cache)
+        logits, cache = self._run_prefill(ids, cache)
         done = np.zeros(b, bool)
         for _ in range(max_new_tokens):
             tok, logits, cache, rng = self._decode_one(

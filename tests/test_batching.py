@@ -281,6 +281,24 @@ def test_http_server_over_batching_backend(params, oracle):
             server.shutdown()
 
 
+def test_scheduler_crash_fails_waiters(params):
+    """A decode-step failure (device lost, OOM, ...) must surface to every
+    waiter instead of stranding them on a dead scheduler thread."""
+    eng = ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                   sampling=GREEDY, prompt_buckets=(16,))
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("injected device failure")
+        eng._step = boom
+        req = eng.submit([1, 2, 3], 20)
+        with pytest.raises(RuntimeError, match="injected device failure"):
+            req.wait(timeout=120)
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit([4, 5], 5)
+    finally:
+        eng.close()
+
+
 def test_close_fails_inflight(params):
     eng = ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                    sampling=GREEDY, prompt_buckets=(16,))

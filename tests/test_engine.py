@@ -35,6 +35,61 @@ def test_stream_matches_fused_scan(engine):
     np.testing.assert_array_equal(fused, streamed)
 
 
+@pytest.mark.parametrize("plen", [7, 8, 9, 17])
+def test_chunked_prefill_matches_whole(engine, plen):
+    """Chunked prefill (C=8) must produce the same greedy tokens as
+    whole-prompt prefill for every remainder shape: plen < C, == C,
+    == C+1, and spanning 3 chunks."""
+    cfg = engine.cfg
+    chunked = InferenceEngine(cfg, engine.params, max_seq=64,
+                              sampling=SamplingParams(greedy=True),
+                              prefill_chunk=8)
+    prompt = (np.arange(2 * plen).reshape(2, plen) % 199).astype(np.int32)
+    want = engine.generate(prompt, 10).tokens
+    got = chunked.generate(prompt, 10).tokens
+    np.testing.assert_array_equal(want, got)
+
+
+def test_chunked_prefill_stream_and_classify(engine):
+    cfg = engine.cfg
+    chunked = InferenceEngine(cfg, engine.params, max_seq=64,
+                              sampling=SamplingParams(greedy=True),
+                              prefill_chunk=4)
+    prompt = np.asarray([[3, 14, 15, 92, 65, 35, 89, 79, 3]])
+    fused = chunked.generate(prompt, 6).tokens
+    streamed = np.stack(list(chunked.generate_stream(prompt, 6)), 1)
+    np.testing.assert_array_equal(fused, streamed)
+    labels = engine.classify(prompt, [5, 9])
+    labels_chunked = chunked.classify(prompt, [5, 9])
+    np.testing.assert_array_equal(labels, labels_chunked)
+
+
+def test_prefill_chunk_validation(engine):
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        InferenceEngine(engine.cfg, engine.params, max_seq=64,
+                        prefill_chunk=0)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        InferenceEngine(engine.cfg, engine.params, max_seq=64,
+                        prefill_chunk=65)
+
+
+def test_chunked_prefill_padded_past_capacity(engine):
+    """Regression: prompt whose chunk-padded length exceeds max_seq.
+    The final chunk must left-shift (aligned last window) instead of
+    letting dynamic_update_slice clamp into — and corrupt — valid KV.
+    max_seq=30, C=8, plen=26: padding would want slot 31."""
+    cfg = engine.cfg
+    whole = InferenceEngine(cfg, engine.params, max_seq=30,
+                            sampling=SamplingParams(greedy=True))
+    chunked = InferenceEngine(cfg, engine.params, max_seq=30,
+                              sampling=SamplingParams(greedy=True),
+                              prefill_chunk=8)
+    prompt = (np.arange(2 * 26).reshape(2, 26) % 199).astype(np.int32)
+    want = whole.generate(prompt, 4).tokens
+    got = chunked.generate(prompt, 4).tokens
+    np.testing.assert_array_equal(want, got)
+
+
 def test_capacity_guard(engine):
     prompt = np.zeros((1, 60), np.int64)
     with pytest.raises(ValueError, match="exceeds KV-cache capacity"):
