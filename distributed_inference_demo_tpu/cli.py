@@ -614,6 +614,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="distributed_inference_demo_tpu",
         description="TPU-native distributed LLM inference framework")
+    # multi-host SPMD: join JAX's distributed runtime before any command
+    # touches a backend; afterwards jax.devices() spans every host and the
+    # parallel/ meshes run cross-host with collectives on ICI/DCN
+    ap.add_argument("--jax-coordinator", default="",
+                    help="host:port of process 0, enables multi-host JAX")
+    ap.add_argument("--jax-num-processes", type=int, default=1)
+    ap.add_argument("--jax-process-id", type=int, default=0)
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     s = sub.add_parser("serve", help="HTTP inference server")
@@ -714,6 +721,14 @@ def main(argv=None) -> int:
     args.rest = rest
     if args.cmd == "plan" and not (args.devices or args.load):
         ap.error("plan needs --devices or --load")
+    if args.jax_coordinator:
+        from .parallel.mesh import init_multihost
+        init_multihost(args.jax_coordinator, args.jax_num_processes,
+                       args.jax_process_id)
+    elif args.jax_num_processes != 1 or args.jax_process_id != 0:
+        # a forgotten coordinator must not silently run single-host
+        ap.error("--jax-num-processes/--jax-process-id require "
+                 "--jax-coordinator")
     return args.fn(args)
 
 

@@ -50,6 +50,33 @@ class MeshConfig:
                 "tp": self.tp, "sp": self.sp}
 
 
+def init_multihost(coordinator: str, num_processes: int, process_id: int,
+                   local_device_count: Optional[int] = None) -> None:
+    """Join this process to a multi-host JAX runtime (DCN control plane).
+
+    The reference scales across hosts with hand-wired ZMQ sockets and
+    port arithmetic (``Communication.java:937-961``); the TPU-native
+    equivalent is JAX's distributed runtime: after this call
+    ``jax.devices()`` spans every host's chips, ``make_mesh`` builds
+    cross-host meshes unchanged, and XLA routes in-mesh collectives over
+    ICI within a slice and DCN across slices.  Call before any other JAX
+    API touches a backend.  Idempotent-unsafe by JAX design (a second
+    call raises) — the CLI invokes it once at startup.
+    """
+    if num_processes < 1 or not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"bad process topology: id {process_id} of {num_processes}")
+    if local_device_count is not None and local_device_count < 1:
+        raise ValueError(
+            f"local_device_count must be >= 1, got {local_device_count}")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=(list(range(local_device_count))
+                          if local_device_count is not None else None))
+
+
 def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
     """Build the named mesh.  dp is outermost (DCN-friendly: gradient/batch
     collectives are infrequent), tp innermost (ICI-neighbor heavy)."""

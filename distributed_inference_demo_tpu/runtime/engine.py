@@ -168,21 +168,33 @@ class InferenceEngine:
         self._prefill_chunk_mid = prefill_chunk_mid
         self._prefill_chunk_last = prefill_chunk_last
 
+        eos_ = eos_id
+
         @partial(jax.jit, donate_argnums=(2,), static_argnums=(4,))
         def decode(params, last_logits, cache, rng, num_steps):
-            """Fused sample+forward scan for ``num_steps`` tokens."""
+            """Fused sample+forward scan for ``num_steps`` tokens.
+
+            With an ``eos_id``, rows that emitted it keep emitting it
+            (static shapes can't shorten the scan, but a finished row's
+            suffix is deterministic eos padding, matching the streaming
+            path's early stop semantics row-wise)."""
+            b = last_logits.shape[0]
+
             def step(carry, _):
-                logits, cache, rng = carry
+                logits, cache, rng, done = carry
                 rng, sub = jax.random.split(rng)
                 tok = sample_logits(logits, sub, samp_)
-                b = tok.shape[0]
+                if eos_ is not None:
+                    tok = jnp.where(done, jnp.int32(eos_), tok)
+                    done = done | (tok == eos_)
                 pos = jnp.broadcast_to(cache.length, (b, 1))
                 out, cache = stage_forward(params, cfg_, spec_, tok[:, None],
                                            cache, pos, attn_impl=attn_impl)
-                return (out[:, 0], cache, rng), tok
+                return (out[:, 0], cache, rng, done), tok
 
-            (_, cache, _), toks = jax.lax.scan(
-                step, (last_logits, cache, rng), None, length=num_steps)
+            (_, cache, _, _), toks = jax.lax.scan(
+                step, (last_logits, cache, rng, jnp.zeros((b,), bool)),
+                None, length=num_steps)
             return jnp.swapaxes(toks, 0, 1), cache  # [batch, steps]
 
         @partial(jax.jit, donate_argnums=(2,))
@@ -301,8 +313,11 @@ class InferenceEngine:
             tok, logits, cache, rng = self._decode_one(
                 self.params, logits, cache, rng)
             tok_np = np.asarray(tok)
-            yield tok_np
             if self.eos_id is not None:
+                # finished rows pad with eos — matches the fused scan's
+                # row-wise semantics, so both paths emit identical tokens
+                tok_np = np.where(done, self.eos_id, tok_np)
                 done |= tok_np == self.eos_id
-                if done.all():
-                    return
+            yield tok_np
+            if self.eos_id is not None and done.all():
+                return

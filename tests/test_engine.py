@@ -90,6 +90,40 @@ def test_chunked_prefill_padded_past_capacity(engine):
     np.testing.assert_array_equal(want, got)
 
 
+def test_eos_padding_in_fused_scan(engine):
+    """Once a row emits eos_id, the fused scan pads its remaining steps
+    with eos (mirrors the streaming path's early stop, row-wise)."""
+    prompt = np.asarray([[3, 14, 15, 92]])
+    first = engine.generate(prompt, 1).tokens[0, 0]
+    eos_engine = InferenceEngine(engine.cfg, engine.params, max_seq=64,
+                                 sampling=SamplingParams(greedy=True),
+                                 eos_id=int(first))
+    toks = eos_engine.generate(prompt, 8).tokens[0]
+    assert (toks == int(first)).all()
+    # and a non-eos run is unaffected by the flag
+    other = InferenceEngine(engine.cfg, engine.params, max_seq=64,
+                            sampling=SamplingParams(greedy=True),
+                            eos_id=999999 % engine.cfg.vocab_size)
+    base = engine.generate(prompt, 8).tokens
+    if not (base == 999999 % engine.cfg.vocab_size).any():
+        np.testing.assert_array_equal(other.generate(prompt, 8).tokens,
+                                      base)
+
+
+def test_eos_stream_matches_fused_scan_batch2(engine):
+    """With eos_id set and batch > 1, the streamed and fused paths must
+    still emit identical tokens (finished rows pad with eos in both)."""
+    prompt = np.asarray([[3, 14, 15, 92], [8, 1, 9, 2]])
+    first_row0 = int(engine.generate(prompt, 1).tokens[0, 0])
+    eng = InferenceEngine(engine.cfg, engine.params, max_seq=64,
+                          sampling=SamplingParams(greedy=True),
+                          eos_id=first_row0)
+    fused = eng.generate(prompt, 8).tokens
+    streamed = np.stack(list(eng.generate_stream(prompt, 8, seed=0)), 1)
+    np.testing.assert_array_equal(fused[:, :streamed.shape[1]], streamed)
+    assert (fused[0] == first_row0).all()
+
+
 def test_capacity_guard(engine):
     prompt = np.zeros((1, 60), np.int64)
     with pytest.raises(ValueError, match="exceeds KV-cache capacity"):

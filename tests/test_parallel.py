@@ -1,6 +1,7 @@
 """Parallelism tests on the virtual 8-device CPU mesh: manual TP parity,
 SPMD pipeline training step (dp x pp x tp), sharding placement."""
 
+import os
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -271,3 +272,35 @@ def test_pipeline_generate_rejects_bad_shapes(devices):
     with mesh:
         with pytest.raises(ValueError, match="microbatches"):
             gen(params, ids, jax.random.PRNGKey(0))
+
+
+def test_init_multihost_single_process():
+    """init_multihost joins JAX's distributed runtime.  Run in a fresh
+    subprocess: initialize() must precede any backend use, which the
+    current test process has long since done."""
+    import subprocess
+    import sys
+    import socket
+
+    from distributed_inference_demo_tpu.parallel.mesh import init_multihost
+
+    with pytest.raises(ValueError, match="process topology"):
+        init_multihost("127.0.0.1:1", 2, 5)
+    with pytest.raises(ValueError, match="local_device_count"):
+        init_multihost("127.0.0.1:1", 1, 0, local_device_count=0)
+
+    with socket.socket() as s:          # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "from distributed_inference_demo_tpu.parallel.mesh import "
+         "init_multihost;"
+         f"init_multihost('127.0.0.1:{port}', 1, 0);"
+         "print('NDEV', len(jax.devices()), jax.process_count())"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [l for l in out.stdout.splitlines() if l.startswith("NDEV")][0]
+    assert line.split()[1:] == ["1", "1"] or int(line.split()[1]) >= 1
