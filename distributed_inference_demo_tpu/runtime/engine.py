@@ -49,6 +49,10 @@ class GenerationResult:
     prompt_len: int
     num_new: int
     seconds: float = 0.0
+    # model log-probabilities of the emitted tokens (raw log-softmax, NOT
+    # the temperature/top-k-filtered sampling distribution — the
+    # OpenAI-style convention), [batch, max_new_tokens] f32, or None
+    logprobs: Optional[np.ndarray] = None
 
     @property
     def tokens_per_second(self) -> float:
@@ -170,14 +174,17 @@ class InferenceEngine:
 
         eos_ = eos_id
 
-        @partial(jax.jit, donate_argnums=(2,), static_argnums=(4,))
-        def decode(params, last_logits, cache, rng, num_steps):
+        @partial(jax.jit, donate_argnums=(2,), static_argnums=(4, 5))
+        def decode(params, last_logits, cache, rng, num_steps,
+                   with_logprobs=False):
             """Fused sample+forward scan for ``num_steps`` tokens.
 
             With an ``eos_id``, rows that emitted it keep emitting it
             (static shapes can't shorten the scan, but a finished row's
             suffix is deterministic eos padding, matching the streaming
-            path's early stop semantics row-wise)."""
+            path's early stop semantics row-wise).  ``with_logprobs``
+            additionally emits each token's raw log-softmax probability
+            (one extra [b, V] reduction per step, only when asked)."""
             b = last_logits.shape[0]
 
             def step(carry, _):
@@ -187,15 +194,22 @@ class InferenceEngine:
                 if eos_ is not None:
                     tok = jnp.where(done, jnp.int32(eos_), tok)
                     done = done | (tok == eos_)
+                if with_logprobs:
+                    lp = jnp.take_along_axis(
+                        jax.nn.log_softmax(logits.astype(jnp.float32), -1),
+                        tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+                else:
+                    lp = jnp.zeros((b,), jnp.float32)
                 pos = jnp.broadcast_to(cache.length, (b, 1))
                 out, cache = stage_forward(params, cfg_, spec_, tok[:, None],
                                            cache, pos, attn_impl=attn_impl)
-                return (out[:, 0], cache, rng, done), tok
+                return (out[:, 0], cache, rng, done), (tok, lp)
 
-            (_, cache, _, _), toks = jax.lax.scan(
+            (_, cache, _, _), (toks, lps) = jax.lax.scan(
                 step, (last_logits, cache, rng, jnp.zeros((b,), bool)),
                 None, length=num_steps)
-            return jnp.swapaxes(toks, 0, 1), cache  # [batch, steps]
+            return (jnp.swapaxes(toks, 0, 1),
+                    jnp.swapaxes(lps, 0, 1), cache)  # [batch, steps]
 
         @partial(jax.jit, donate_argnums=(2,))
         def decode_one(params, last_logits, cache, rng):
@@ -256,13 +270,14 @@ class InferenceEngine:
         return last_logits, cache
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
-                 seed: int = 0) -> GenerationResult:
+                 seed: int = 0, logprobs: bool = False) -> GenerationResult:
         """Batch generation, fused decode scan (the throughput path).
 
         Runs exactly once; ``seconds`` includes compile on the first call
         for a given shape signature (jit-cached afterwards).  Benchmarks
         wanting steady-state timing call this twice and keep the second
-        result (see bench.py).
+        result (see bench.py).  ``logprobs=True`` also returns each
+        emitted token's raw log-softmax probability.
         """
         import time
         ids = jnp.asarray(prompt_ids, jnp.int32)
@@ -273,12 +288,14 @@ class InferenceEngine:
         t0 = time.perf_counter()
         cache = self.new_cache(b)
         last_logits, cache = self._run_prefill(ids, cache)
-        toks, _ = self._decode(self.params, last_logits, cache, rng,
-                               max_new_tokens)
+        toks, lps, _ = self._decode(self.params, last_logits, cache, rng,
+                                    max_new_tokens, logprobs)
         toks = np.asarray(toks)
+        lps_np = np.asarray(lps) if logprobs else None
         dt = time.perf_counter() - t0
         return GenerationResult(tokens=toks, prompt_len=plen,
-                                num_new=max_new_tokens, seconds=dt)
+                                num_new=max_new_tokens, seconds=dt,
+                                logprobs=lps_np)
 
     def classify(self, prompt_ids: np.ndarray,
                  label_token_ids) -> np.ndarray:

@@ -31,6 +31,17 @@ from typing import Optional
 import numpy as np
 
 
+def _accepts_kwarg(fn, name: str) -> bool:
+    """Duck-typed capability check: does ``fn`` accept ``name=``?  True
+    for an explicit parameter OR a **kwargs catch-all (wrapper backends
+    that forward to an engine)."""
+    import inspect
+    params = inspect.signature(fn).parameters
+    return (name in params
+            or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                   for p in params.values()))
+
+
 class HeaderBackend:
     """Adapts a PipelineHeader/ElasticHeader to the engine surface used by
     the HTTP handler (generate + generate_stream)."""
@@ -175,10 +186,31 @@ class InferenceHTTPServer:
                     return
                 try:
                     if req.get("stream"):
+                        if req.get("logprobs"):
+                            # honor-or-reject, never silently drop: the
+                            # streaming pipeline carries tokens only
+                            self._json(501, {
+                                "error": "logprobs are not supported "
+                                         "with stream"})
+                            return
                         self._stream(ids, max_new, seed)
                     else:
-                        res = outer.backend.generate(ids, max_new, seed=seed)
+                        kwargs = {}
+                        if req.get("logprobs"):
+                            if not _accepts_kwarg(outer.backend.generate,
+                                                  "logprobs"):
+                                self._json(501, {
+                                    "error": "backend does not support "
+                                             "logprobs"})
+                                return
+                            kwargs["logprobs"] = True
+                        res = outer.backend.generate(ids, max_new,
+                                                     seed=seed, **kwargs)
                         out = {"tokens": res.tokens.tolist()}
+                        if getattr(res, "logprobs", None) is not None:
+                            out["logprobs"] = [
+                                [round(float(x), 6) for x in row]
+                                for row in res.logprobs]
                         if outer.tokenizer is not None:
                             out["text"] = [outer.tokenizer.decode(row)
                                            for row in res.tokens.tolist()]

@@ -69,6 +69,53 @@ def test_generate_endpoint_matches_engine(http_server):
     assert got == want
 
 
+def test_generate_endpoint_logprobs(http_server):
+    server, engine = http_server
+    prompt = [[5, 17, 42, 7]]
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": prompt, "max_new_tokens": 5,
+                         "logprobs": True})
+    assert status == 200
+    body = json.loads(data)
+    assert len(body["logprobs"][0]) == 5
+    assert all(lp <= 0 for lp in body["logprobs"][0])
+    want = engine.generate(np.asarray(prompt), 5,
+                           logprobs=True).logprobs[0]
+    np.testing.assert_allclose(body["logprobs"][0], want, atol=1e-5)
+
+
+def test_generate_endpoint_logprobs_unsupported_backend():
+    """Backends without a logprobs parameter get a clean 501, not a 500."""
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        InferenceHTTPServer)
+
+    class NoLogprobs:
+        max_seq = 64
+
+        def generate(self, prompt_ids, max_new_tokens, seed=0):
+            raise AssertionError("must not be called")
+
+    server = InferenceHTTPServer(NoLogprobs(), port=0)
+    server.start()
+    try:
+        status, data = _req(server, "POST", "/generate",
+                            {"prompt_ids": [[1]], "max_new_tokens": 2,
+                             "logprobs": True})
+        assert status == 501
+        assert "logprobs" in json.loads(data)["error"]
+    finally:
+        server.shutdown()
+
+
+def test_generate_endpoint_stream_logprobs_rejected(http_server):
+    server, _ = http_server
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": [[1, 2]], "max_new_tokens": 3,
+                         "stream": True, "logprobs": True})
+    assert status == 501
+    assert "stream" in json.loads(data)["error"]
+
+
 def test_generate_endpoint_streaming(http_server):
     server, engine = http_server
     prompt = [[5, 17, 42, 7]]

@@ -90,6 +90,39 @@ def test_chunked_prefill_padded_past_capacity(engine):
     np.testing.assert_array_equal(want, got)
 
 
+def test_logprobs(engine):
+    """logprobs=True returns the raw log-softmax of each emitted token:
+    negative, and for greedy decoding equal to the max log-softmax (which
+    we cross-check by re-scoring the sequence)."""
+    import jax.numpy as jnp
+    from distributed_inference_demo_tpu.models.base import KVCache, StageSpec
+    from distributed_inference_demo_tpu.models.decoder import stage_forward
+
+    prompt = np.asarray([[3, 14, 15, 92], [7, 6, 5, 4]])
+    res = engine.generate(prompt, 6, logprobs=True)
+    assert res.logprobs is not None and res.logprobs.shape == (2, 6)
+    assert (res.logprobs <= 0).all()
+    # tokens unchanged by the flag
+    base = engine.generate(prompt, 6)
+    np.testing.assert_array_equal(base.tokens, res.tokens)
+    assert base.logprobs is None
+    # re-score: logprob of token t must match log_softmax at its position
+    full = np.concatenate([prompt, res.tokens], axis=1)
+    cache = KVCache.create(engine.cfg, engine.cfg.num_layers, 2,
+                           full.shape[1])
+    pos = jnp.broadcast_to(jnp.arange(full.shape[1]), full.shape)
+    logits, _ = stage_forward(engine.params, engine.cfg,
+                              StageSpec(0, 1, 0, engine.cfg.num_layers),
+                              jnp.asarray(full), cache, pos)
+    lsm = np.asarray(jax.nn.log_softmax(
+        np.asarray(logits, np.float32), axis=-1))
+    plen = prompt.shape[1]
+    for b in range(2):
+        for t in range(6):
+            want = lsm[b, plen + t - 1, res.tokens[b, t]]
+            np.testing.assert_allclose(res.logprobs[b, t], want, atol=5e-4)
+
+
 def test_eos_padding_in_fused_scan(engine):
     """Once a row emits eos_id, the fused scan pads its remaining steps
     with eos (mirrors the streaming path's early stop, row-wise)."""

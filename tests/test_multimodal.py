@@ -86,8 +86,8 @@ def test_text_only_prefix_matches_plain_engine(setup):
     embeds = embed_tokens(params, cfg, jnp.asarray(TEXT))
     cache = mm.engine.new_cache(1)
     logits, cache = mm._prefill_embeds(params, embeds, cache)
-    toks, _ = mm.engine._decode(params, logits, cache,
-                                jax.random.PRNGKey(0), 8)
+    toks, _, _ = mm.engine._decode(params, logits, cache,
+                                   jax.random.PRNGKey(0), 8)
     np.testing.assert_array_equal(np.asarray(toks), want)
 
 

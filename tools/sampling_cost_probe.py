@@ -1,0 +1,45 @@
+"""Probe: how much of a decode step does SAMPLING eat at large batch?
+
+The sweep in BENCH_SELF_r03 shows achieved GB/s falling as batch grows
+(0.61 roofline at b8 -> 0.24 at b64).  Weights traffic is batch-invariant,
+so the extra per-step time is activation work — and top-k over [b, 32000]
+logits (lax.top_k sorts) is a prime suspect.  This times the SAME decode
+loop under greedy / top-k=7 / top-p sampling to isolate that cost.
+
+Run on the real device: ``python tools/sampling_cost_probe.py``.
+"""
+
+import time
+
+import jax
+import numpy as np
+
+from distributed_inference_demo_tpu.models import get_model_config
+from distributed_inference_demo_tpu.models.decoder import init_full_params
+from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+
+def main():
+    cfg = get_model_config("tinyllama-1.1b")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    variants = [
+        ("greedy", SamplingParams(greedy=True)),
+        ("topk7", SamplingParams(temperature=0.7, top_k=7)),
+        ("topp95", SamplingParams(temperature=0.7, top_k=0, top_p=0.95)),
+    ]
+    for batch in (8, 64):
+        for name, samp in variants:
+            eng = InferenceEngine(cfg, params, max_seq=192, sampling=samp)
+            prompt = (np.arange(batch * 64).reshape(batch, 64)
+                      % 1000).astype(np.int32)
+            eng.generate(prompt, 128, seed=0)            # compile
+            r = eng.generate(prompt, 128, seed=0)
+            steps = 128
+            ms = r.seconds / steps * 1000
+            print(f"b={batch:3d} {name:7s} {r.tokens_per_second:9.1f} tok/s"
+                  f"  {ms:6.2f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+#!/bin/bash
+# One-shot TPU measurement session for when the axon tunnel is healthy:
+#   1. int8 dequant strategy probe   (tools/int8_dequant_probe.py)
+#   2. sampling cost probe           (tools/sampling_cost_probe.py)
+#   3. full bench                    (bench.py -> /tmp/bench_refresh.json)
+# Each step appends to /tmp/tpu_session.log; steps are independent so a
+# wedged tunnel mid-way still leaves earlier results on disk.
+set -x
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_session.log
+: > "$LOG"
+echo "=== tunnel check $(date -u +%H:%M:%S) ===" >> "$LOG"
+timeout 180 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1 || {
+  echo "TUNNEL DOWN" >> "$LOG"; exit 1; }
+echo "=== int8 dequant probe ===" >> "$LOG"
+timeout 2400 python tools/int8_dequant_probe.py >> "$LOG" 2>&1
+echo "=== sampling cost probe ===" >> "$LOG"
+timeout 2400 python tools/sampling_cost_probe.py >> "$LOG" 2>&1
+echo "=== full bench ===" >> "$LOG"
+BENCH_DEADLINE_S=3000 timeout 3600 python bench.py > /tmp/bench_refresh.json 2>> "$LOG"
+echo "=== done $(date -u +%H:%M:%S) ===" >> "$LOG"
