@@ -88,6 +88,26 @@ def _build_spec_engine(args):
         num_draft=args.num_draft, attn_backend=args.attn_backend)
 
 
+def _build_prompt_lookup_engine(args):
+    """Construct the draft-free PromptLookupEngine from CLI flags — the one
+    site shared by ``generate --prompt-lookup`` and
+    ``serve --prompt-lookup``.  Returns None (after printing the error)
+    for flag combinations it doesn't support."""
+    from .models.registry import get_model_config
+    from .runtime.prompt_lookup import PromptLookupEngine
+
+    if getattr(args, "kv_cache_dtype", "") or getattr(
+            args, "prefill_chunk", 0):
+        print("--kv-cache-dtype/--prefill-chunk are not supported "
+              "with --prompt-lookup", file=sys.stderr)
+        return None
+    cfg = get_model_config(args.model)
+    return PromptLookupEngine(
+        cfg, _load_full_params(args, cfg), max_seq=args.max_seq,
+        sampling=_sampling_from_args(args), num_draft=args.num_draft,
+        attn_backend=args.attn_backend)
+
+
 def _build_engine(args):
     from .models.registry import get_model_config
     from .runtime import InferenceEngine
@@ -114,6 +134,8 @@ def cmd_serve(args) -> int:
     modes = [name for name, on in [("--chain", args.chain),
                                    ("--draft-model",
                                     getattr(args, "draft_model", "")),
+                                   ("--prompt-lookup",
+                                    getattr(args, "prompt_lookup", False)),
                                    ("--batch-slots",
                                     getattr(args, "batch_slots", 0))] if on]
     if len(modes) > 1:
@@ -172,6 +194,15 @@ def cmd_serve(args) -> int:
         backend = SpeculativeBackend(engine)
         print(f"SERVE_SPECULATIVE {args.model} draft={args.draft_model} "
               f"k={args.num_draft}", flush=True)
+    elif getattr(args, "prompt_lookup", False):
+        from .runtime.speculative import SpeculativeBackend
+
+        engine = _build_prompt_lookup_engine(args)
+        if engine is None:
+            return 1
+        backend = SpeculativeBackend(engine)
+        print(f"SERVE_PROMPT_LOOKUP {args.model} k={args.num_draft}",
+              flush=True)
     elif getattr(args, "batch_slots", 0):
         from .models.registry import get_model_config
         from .runtime.batching import ContinuousBatchingEngine
@@ -492,7 +523,19 @@ def cmd_generate(args) -> int:
         return 1
 
     stats = None
-    if getattr(args, "draft_model", ""):
+    if getattr(args, "draft_model", "") and getattr(args, "prompt_lookup",
+                                                    False):
+        print("choose one of --draft-model / --prompt-lookup",
+              file=sys.stderr)
+        return 1
+    if getattr(args, "prompt_lookup", False):
+        # draft-free speculation: n-gram lookup over the context proposes,
+        # the target verifies (runtime/prompt_lookup.py)
+        pld = _build_prompt_lookup_engine(args)
+        if pld is None:
+            return 1
+        res, stats = pld.generate(ids, args.max_new_tokens, seed=args.seed)
+    elif getattr(args, "draft_model", ""):
         # speculative decoding: the draft model proposes, the target
         # verifies (runtime/speculative.py); shares every engine flag
         spec = _build_spec_engine(args)
@@ -608,6 +651,9 @@ def _add_draft_args(p) -> None:
                    help="checkpoint for the draft model weights")
     p.add_argument("--num-draft", type=int, default=4,
                    help="draft tokens proposed per verify round")
+    p.add_argument("--prompt-lookup", action="store_true",
+                   help="draft-FREE speculation: n-gram lookup over the "
+                        "context proposes, the target verifies")
 
 
 def main(argv=None) -> int:

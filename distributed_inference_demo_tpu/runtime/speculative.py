@@ -72,6 +72,90 @@ class SpecStats:
         return self.emitted / self.rounds if self.rounds else float("nan")
 
 
+def verify_emit(t_logits, drafts, q_logits, samp: SamplingParams,
+                sub_u, sub_x):
+    """The speculative accept/resample rule + emitted-block assembly,
+    shared by every proposer (draft model, prompt lookup).
+
+    t_logits: [b, K+1, V] target logits over [last_tok, d_1..d_K];
+    drafts:   [b, K] proposals;
+    q_logits: [b, K, V] proposer's filtered logits, or None for a
+              DETERMINISTIC proposer (one-hot q: accept d with prob p(d),
+              resample from p with d masked out).
+
+    Returns (emitted [b, K+1], m scalar in [1, K+1], new_last [b]):
+    per-row exactly-distributed tokens with lockstep advance m = min+1.
+    """
+    b, K = drafts.shape
+    if samp.greedy:
+        t_arg = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+        accept = drafts == t_arg[:, :K]                # [b, K] bool
+        a = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)   # [b] in [0, K]
+        # rejected at a -> the target's own argmax; all accepted -> bonus
+        # argmax after d_K.  Both are t_arg[:, a].
+        extra = jnp.take_along_axis(t_arg, a[:, None], axis=1)[:, 0]
+    else:
+        p_logits = filtered_logits(t_logits, samp)     # [b, K+1, V]
+        p = jax.nn.softmax(p_logits[:, :K], axis=-1)
+        p_d = jnp.take_along_axis(
+            p, drafts[..., None], axis=-1)[..., 0]     # [b, K]
+        u = jax.random.uniform(sub_u, p_d.shape)
+        if q_logits is None:
+            accept = u < p_d
+        else:
+            q = jax.nn.softmax(q_logits, axis=-1)
+            q_d = jnp.take_along_axis(
+                q, drafts[..., None], axis=-1)[..., 0]
+            accept = u * jnp.maximum(q_d, 1e-20) < p_d
+        a = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
+        # resample dist at the rejection point: norm(max(p - q, 0)); for a
+        # one-hot q that is p with the draft token masked out
+        a_idx = jnp.minimum(a, K - 1)[:, None, None]
+        p_a = jnp.take_along_axis(p, a_idx, axis=1)[:, 0]  # [b, V]
+        if q_logits is None:
+            d_a = jnp.take_along_axis(
+                drafts, jnp.minimum(a, K - 1)[:, None], axis=1)
+            resid_a = p_a.at[jnp.arange(b)[:, None], d_a].set(0.0)
+        else:
+            resid = jnp.maximum(p - jax.nn.softmax(q_logits, -1), 0.0)
+            resid_a = jnp.take_along_axis(resid, a_idx, axis=1)[:, 0]
+        # all-zero residual (p == q exactly / point mass on d): fall back
+        # to p_a — accept/resample then reduces to plain sampling from p
+        resid_sum = jnp.sum(resid_a, axis=-1, keepdims=True)
+        resid_a = jnp.where(resid_sum > 0, resid_a, p_a)
+        bonus = jax.nn.softmax(p_logits[:, K], axis=-1)
+        extra_probs = jnp.where((a == K)[:, None], bonus, resid_a)
+        extra = jax.random.categorical(
+            sub_x, jnp.log(extra_probs + 1e-30), axis=-1).astype(jnp.int32)
+
+    idx = jnp.arange(K + 1)[None, :]
+    drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+    emitted = jnp.where(idx < a[:, None], drafts_pad,
+                        jnp.where(idx == a[:, None], extra[:, None], 0))
+    m = jnp.min(a) + 1                                 # scalar, [1, K+1]
+    new_last = jnp.take_along_axis(
+        emitted, (m - 1)[None, None].astype(jnp.int32).repeat(b, axis=0),
+        axis=1)[:, 0]
+    return emitted, m, new_last
+
+
+def drain_round_blocks(em, ms, out, stats: SpecStats, num_draft: int,
+                       total: int, max_new: int) -> int:
+    """Host-side collection of a fused dispatch's round blocks into
+    ``out``/``stats``; returns the updated emitted-token total.  Shared by
+    every speculative engine's generate loop."""
+    for r in range(em.shape[0]):
+        m = int(ms[r])
+        out.append(em[r][:, :m])
+        stats.rounds += 1
+        stats.drafted += num_draft
+        stats.accepted += m - 1   # lockstep: min_b(accepted) used
+        total += m
+        if total >= max_new:
+            break
+    return total
+
+
 class SpeculativeEngine:
     """Draft/verify generation over two full single-stage models."""
 
@@ -164,59 +248,13 @@ class SpeculativeEngine:
                 tparams, cfg_, spec_, verify_in, tcache, pos,
                 attn_impl=attn_impl)               # [b, K+1, V]
 
-            # --- accept / resample ----------------------------------------
+            # --- accept / resample / lockstep advance (shared rule) -------
             rng, sub_u, sub_x = jax.random.split(rng, 3)
-            if samp_.greedy:
-                t_arg = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-                accept = drafts == t_arg[:, :K]            # [b, K] bool
-                acc_prefix = jnp.cumprod(accept, axis=1)
-                a = jnp.sum(acc_prefix, axis=1)            # [b] in [0, K]
-                # rejected at a -> the target's own argmax; all accepted ->
-                # bonus argmax after d_K.  Both are t_arg[:, a].
-                extra = jnp.take_along_axis(
-                    t_arg, a[:, None], axis=1)[:, 0]
-            else:
-                p_logits = filtered_logits(t_logits, samp_)  # [b, K+1, V]
-                p = jax.nn.softmax(p_logits[:, :K], axis=-1)
-                q = jax.nn.softmax(q_logits, axis=-1)
-                p_d = jnp.take_along_axis(
-                    p, drafts[..., None], axis=-1)[..., 0]   # [b, K]
-                q_d = jnp.take_along_axis(
-                    q, drafts[..., None], axis=-1)[..., 0]
-                u = jax.random.uniform(sub_u, p_d.shape)
-                accept = u * jnp.maximum(q_d, 1e-20) < p_d
-                acc_prefix = jnp.cumprod(accept, axis=1)
-                a = jnp.sum(acc_prefix, axis=1)            # [b] in [0, K]
-                # resample dist at the rejection point: norm(max(p - q, 0));
-                # if all K accepted, the bonus position samples from p_{K+1}
-                resid = jnp.maximum(p - q, 0.0)            # [b, K, V]
-                resid_a = jnp.take_along_axis(
-                    resid, jnp.minimum(a, K - 1)[:, None, None], axis=1
-                )[:, 0]                                    # [b, V]
-                # p == q exactly => resid is all-zero; fall back to p_a
-                # (accept/resample then reduces to plain sampling from p)
-                p_a = jnp.take_along_axis(
-                    p, jnp.minimum(a, K - 1)[:, None, None], axis=1)[:, 0]
-                resid_sum = jnp.sum(resid_a, axis=-1, keepdims=True)
-                resid_a = jnp.where(resid_sum > 0, resid_a, p_a)
-                bonus = jax.nn.softmax(p_logits[:, K], axis=-1)
-                extra_probs = jnp.where((a == K)[:, None], bonus, resid_a)
-                extra = jax.random.categorical(
-                    sub_x, jnp.log(extra_probs + 1e-30), axis=-1)
-                extra = extra.astype(jnp.int32)
+            emitted, m, new_last = verify_emit(
+                t_logits, drafts, None if samp_.greedy else q_logits,
+                samp_, sub_u, sub_x)
 
-            # --- assemble emitted block [b, K+1] --------------------------
-            idx = jnp.arange(K + 1)[None, :]
-            drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
-            emitted = jnp.where(idx < a[:, None], drafts_pad,
-                                jnp.where(idx == a[:, None], extra[:, None],
-                                          0))
-
-            # --- lockstep advance + rollback ------------------------------
-            m = jnp.min(a) + 1                     # scalar, in [1, K+1]
-            new_last = jnp.take_along_axis(
-                emitted, (m - 1)[None, None].astype(jnp.int32)
-                .repeat(b, axis=0), axis=1)[:, 0]
+            # --- cache rollback -------------------------------------------
             tcache = KVCache(tcache.keys, tcache.values, n + m)
             dcache = KVCache(dcache.keys, dcache.values, n + m)
             return emitted, m, new_last, tcache, dcache, rng
@@ -282,16 +320,9 @@ class SpeculativeEngine:
             em, ms, last_tok, tcache, dcache, rng = self._rounds(
                 self.params, self.draft_params, last_tok, tcache, dcache,
                 rng, R)
-            em, ms = np.asarray(em), np.asarray(ms)
-            for r in range(R):
-                m = int(ms[r])
-                out.append(em[r][:, :m])
-                stats.rounds += 1
-                stats.drafted += self.num_draft
-                stats.accepted += m - 1   # lockstep: min_b(accepted) used
-                total += m
-                if total >= max_new_tokens:
-                    break
+            total = drain_round_blocks(np.asarray(em), np.asarray(ms), out,
+                                       stats, self.num_draft, total,
+                                       max_new_tokens)
 
         toks = np.concatenate(out, axis=1)[:, :max_new_tokens]
         dt = time.perf_counter() - t0

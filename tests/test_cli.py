@@ -193,6 +193,24 @@ def test_cli_generate_speculative_self_draft():
     assert spec["speculative"]["tokens_per_round"] > 1.0
 
 
+def test_cli_generate_prompt_lookup():
+    """--prompt-lookup greedy must match plain greedy; exclusive with
+    --draft-model."""
+    argv_tail = ["--model", "llama-test", "--prompt-ids", "5,17,42,7",
+                 "--max-new-tokens", "8", "--greedy", "--max-seq", "64",
+                 "--attn-backend", "jnp"]
+    rc, plain = _run_cli(["generate"] + argv_tail)
+    assert rc == 0
+    rc, pld = _run_cli(["generate"] + argv_tail + ["--prompt-lookup"])
+    assert rc == 0
+    plain, pld = json.loads(plain), json.loads(pld)
+    assert pld["tokens"] == plain["tokens"]
+    assert "speculative" in pld
+    rc, _ = _run_cli(["generate"] + argv_tail +
+                     ["--prompt-lookup", "--draft-model", "llama-test"])
+    assert rc == 1
+
+
 def test_cli_plan_and_cache(tmp_path):
     devices = [
         {"device_id": "cpu0", "address": "127.0.0.1:7000",
