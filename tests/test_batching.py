@@ -281,6 +281,24 @@ def test_http_server_over_batching_backend(params, oracle):
             server.shutdown()
 
 
+def test_int8_weights_through_batching():
+    """Quantized params flow through the slot engine unchanged (dense()
+    dequantizes at the matmul): greedy parity vs the int8 plain engine."""
+    from distributed_inference_demo_tpu.models.decoder import (
+        init_full_params as init)
+
+    cfg8 = get_model_config("llama-test-int8")
+    params8 = init(jax.random.PRNGKey(0), cfg8, quantize=True)
+    oracle8 = InferenceEngine(cfg8, params8, max_seq=96, sampling=GREEDY)
+    with ContinuousBatchingEngine(cfg8, params8, max_seq=96, max_batch=2,
+                                  sampling=GREEDY,
+                                  prompt_buckets=(16,)) as eng:
+        prompt = [3, 14, 15, 92]
+        got = eng.submit(prompt, 10).wait(timeout=300)
+        want = oracle8.generate(np.asarray([prompt]), 10).tokens[0]
+        np.testing.assert_array_equal(got, want)
+
+
 def test_scheduler_crash_fails_waiters(params):
     """A decode-step failure (device lost, OOM, ...) must surface to every
     waiter instead of stranding them on a dead scheduler thread."""

@@ -123,6 +123,20 @@ def test_http_serve_backend(params, oracle):
         server.shutdown()
 
 
+def test_int8_weights(params):
+    """Quantized target params work through the lookup engine (greedy
+    parity vs the int8 plain engine)."""
+    cfg8 = get_model_config("llama-test-int8")
+    params8 = init_full_params(jax.random.PRNGKey(0), cfg8, quantize=True)
+    oracle8 = InferenceEngine(cfg8, params8, max_seq=96, sampling=GREEDY)
+    pld = PromptLookupEngine(cfg8, params8, max_seq=96, sampling=GREEDY,
+                             num_draft=3)
+    prompt = np.asarray([[3, 14, 15, 92, 65]])
+    want = oracle8.generate(prompt, 12).tokens
+    got, _ = pld.generate(prompt, 12)
+    np.testing.assert_array_equal(want, got.tokens)
+
+
 def test_capacity_and_validation(params):
     with pytest.raises(ValueError, match="num_draft"):
         PromptLookupEngine(CFG, params, num_draft=0)
