@@ -75,6 +75,9 @@ def _build_spec_engine(args):
         print("--prefill-chunk is not supported with --draft-model",
               file=sys.stderr)
         return None
+    if getattr(args, "tp", 1) > 1:
+        print("--tp is not supported with --draft-model", file=sys.stderr)
+        return None
     cfg = get_model_config(args.model)
     draft_cfg = get_model_config(args.draft_model)
     return SpeculativeEngine(
@@ -97,8 +100,8 @@ def _build_prompt_lookup_engine(args):
     from .runtime.prompt_lookup import PromptLookupEngine
 
     if getattr(args, "kv_cache_dtype", "") or getattr(
-            args, "prefill_chunk", 0):
-        print("--kv-cache-dtype/--prefill-chunk are not supported "
+            args, "prefill_chunk", 0) or getattr(args, "tp", 1) > 1:
+        print("--kv-cache-dtype/--prefill-chunk/--tp are not supported "
               "with --prompt-lookup", file=sys.stderr)
         return None
     cfg = get_model_config(args.model)
@@ -115,11 +118,23 @@ def _build_engine(args):
     cfg = get_model_config(args.model)
     sampling = _sampling_from_args(args)
     params = _load_full_params(args, cfg)
+    mesh = None
+    if getattr(args, "tp", 1) > 1:
+        # tensor-parallel serving (BASELINE config #3): Megatron-sliced
+        # weights + kv-head-sharded cache over the first tp local devices
+        import jax
+
+        from .parallel import MeshConfig, make_mesh
+        from .runtime.engine import shard_engine_params
+
+        mesh = make_mesh(MeshConfig(tp=args.tp), jax.devices()[:args.tp])
+        params = shard_engine_params(params, cfg, mesh)
     return cfg, InferenceEngine(
         cfg, params, max_seq=args.max_seq, sampling=sampling,
         attn_backend=args.attn_backend,
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
-        prefill_chunk=getattr(args, "prefill_chunk", 0) or None)
+        prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
+        mesh=mesh)
 
 
 # ---------------------------------------------------------------------------
@@ -140,6 +155,10 @@ def cmd_serve(args) -> int:
                                     getattr(args, "batch_slots", 0))] if on]
     if len(modes) > 1:
         print(f"choose one serve mode, got {' + '.join(modes)}",
+              file=sys.stderr)
+        return 1
+    if getattr(args, "tp", 1) > 1 and modes:
+        print(f"--tp applies to single-node serving only, got {modes[0]}",
               file=sys.stderr)
         return 1
 
@@ -260,6 +279,10 @@ def cmd_server(args) -> int:
         # pipeline StageRuntime caches don't take a dtype override yet
         print("--kv-cache-dtype is not supported by the server app",
               file=sys.stderr)
+        return 1
+    if getattr(args, "tp", 1) > 1:
+        print("--tp is not supported by the server app (the planner "
+              "assigns whole layer ranges per worker)", file=sys.stderr)
         return 1
 
     app = ServerApp(
@@ -640,6 +663,10 @@ def _add_engine_args(ap):
                     help="process prompts in fixed chunks of N tokens "
                          "(bounds prefill activation memory on long "
                          "prompts; 0 = whole-prompt prefill)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor parallelism over the first N local "
+                         "devices (Megatron-sliced weights, kv-head-"
+                         "sharded cache; single-node serve/generate only)")
 
 
 def _add_draft_args(p) -> None:
@@ -775,7 +802,14 @@ def main(argv=None) -> int:
         # a forgotten coordinator must not silently run single-host
         ap.error("--jax-num-processes/--jax-process-id require "
                  "--jax-coordinator")
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except ValueError as e:
+        # configuration errors raised below the flag layer (e.g. a tp
+        # mesh rejecting kv_cache_dtype, or tp > local devices) render as
+        # one stderr line, matching the CLI's explicit flag guards
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

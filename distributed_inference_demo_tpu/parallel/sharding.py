@@ -141,18 +141,22 @@ def param_shardings(params: StageParams, cfg: ModelConfig, mesh: Mesh,
 
 
 def stage_param_shardings(params: StageParams, cfg: ModelConfig, mesh: Mesh,
-                          pp_shard: bool = False) -> StageParams:
+                          pp_shard: bool = False,
+                          vocab_parallel_embed: bool = True) -> StageParams:
     """NamedShardings matching an actual params tree (GSPMD placement)."""
-    specs = stage_param_spec_tree(params, cfg, pp_shard=pp_shard,
-                                  vocab_parallel_embed=True)
+    specs = stage_param_spec_tree(
+        params, cfg, pp_shard=pp_shard,
+        vocab_parallel_embed=vocab_parallel_embed)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 
 
 def shard_params(params: StageParams, cfg: ModelConfig, mesh: Mesh,
-                 pp_shard: bool = False) -> StageParams:
+                 pp_shard: bool = False,
+                 vocab_parallel_embed: bool = True) -> StageParams:
     """Place a host-resident params tree onto the mesh."""
-    shardings = stage_param_shardings(params, cfg, mesh, pp_shard)
+    shardings = stage_param_shardings(params, cfg, mesh, pp_shard,
+                                      vocab_parallel_embed)
     return jax.tree.map(lambda x, s: jax.device_put(x, s), params, shardings)
 
 

@@ -33,6 +33,18 @@ from ..ops.flash_attention import make_flash_attn_impl
 from ..ops.sampling import SamplingParams, sample_logits
 
 
+def shard_engine_params(params: "StageParams", cfg: "ModelConfig", mesh):
+    """Place a full parameter tree onto a tp mesh in the engine's layout
+    (Megatron-sliced weights, replicated embed — the same specs the
+    forward's shard_map consumes) — the companion to
+    ``InferenceEngine(mesh=...)``.  Without this the engine is still
+    correct (GSPMD reshards per call) but the weights waste HBM on every
+    chip."""
+    from ..parallel.sharding import shard_params
+
+    return shard_params(params, cfg, mesh, vocab_parallel_embed=False)
+
+
 def check_capacity(max_seq: int, prompt_len: int, max_new_tokens: int):
     """Host-side KV capacity bound shared by all engines (the traced path
     cannot enforce it — ``dynamic_update_slice`` clamps silently)."""
@@ -70,9 +82,19 @@ class InferenceEngine:
                  eos_id: Optional[int] = None,
                  attn_backend: str = "auto",
                  kv_cache_dtype: Optional[str] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 mesh=None):
         """``attn_backend``: "auto" (Pallas flash kernel on TPU, jnp
         elsewhere), "flash", "flash-interpret" (testing), or "jnp".
+
+        ``mesh``: a ``jax.sharding.Mesh`` with a ``tp`` axis — every
+        forward then runs inside a shard_map with Megatron-sliced weights
+        and a kv-head-sharded cache (BASELINE config #3: attention-head
+        shards across chips via ICI all-gather); activations/logits come
+        back replicated so sampling and the decode scan are unchanged.
+        Pass params through :func:`shard_engine_params` first so the
+        weight shards live on their chips.  Forces the jnp attention path
+        (the Pallas kernel is not exercised per-shard).
 
         ``prefill_chunk``: process prompts in fixed chunks of this many
         tokens instead of one whole-prompt program.  Bounds prefill
@@ -104,6 +126,22 @@ class InferenceEngine:
             raise ValueError(
                 f"prefill_chunk must be in [1, max_seq={self.max_seq}]")
         self.prefill_chunk = prefill_chunk
+        self.mesh = mesh
+        tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+        if tp > 1:
+            if cfg.num_kv_heads % tp:
+                raise ValueError(
+                    f"num_kv_heads={cfg.num_kv_heads} not divisible by "
+                    f"tp={tp}")
+            if self.kv_cache_dtype is not None:
+                raise ValueError(
+                    "kv_cache_dtype is not supported with a tp mesh")
+            if attn_backend not in ("auto", "jnp"):
+                raise ValueError(
+                    f"attn_backend={attn_backend!r} is incompatible with "
+                    "a tp mesh (the Pallas kernel is not exercised "
+                    "per-shard); use 'auto' or 'jnp'")
+            attn_backend = "jnp"
 
         if self.kv_cache_dtype is not None:
             if attn_backend not in ("auto", "jnp"):
@@ -135,6 +173,42 @@ class InferenceEngine:
         spec_ = self.spec
         samp_ = sampling
 
+        if tp > 1:
+            # every forward runs inside this shard_map; activations,
+            # positions, and logits stay replicated so the code above
+            # the seam (sampling, scans, chunking) is mesh-oblivious.
+            # Specs come from parallel/tensor.py — the one owner of the
+            # manual-TP layout — so the engine can't drift from it.
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.tensor import _CACHE_SPEC, _tp_param_specs
+
+            p_specs = _tp_param_specs(params, cfg)
+            cache_spec = _CACHE_SPEC
+
+            def fwd(p, inputs, cache, pos, last_only):
+                def body(p, i, c, po):
+                    return stage_forward(p, cfg_, spec_, i, c, po,
+                                         tp_axis="tp",
+                                         last_logits_only=last_only)
+                return jax.shard_map(
+                    body, mesh=mesh,
+                    in_specs=(p_specs, P(), cache_spec, P()),
+                    out_specs=(P(), cache_spec),
+                    check_vma=False)(p, inputs, cache, pos)
+
+            from jax.sharding import NamedSharding
+            self._cache_sharding = KVCache(
+                keys=NamedSharding(mesh, cache_spec.keys),
+                values=NamedSharding(mesh, cache_spec.values),
+                length=NamedSharding(mesh, cache_spec.length))
+        else:
+            self._cache_sharding = None
+            def fwd(p, inputs, cache, pos, last_only):
+                return stage_forward(p, cfg_, spec_, inputs, cache, pos,
+                                     attn_impl=attn_impl,
+                                     last_logits_only=last_only)
+
         @jax.jit
         def prefill(params, ids, cache):
             b, s = ids.shape
@@ -142,9 +216,7 @@ class InferenceEngine:
             # last_logits_only: the LM head runs on the final position only
             # ([b, 1, V]) — a full [b, s, V] logits tensor at long prompts
             # would burn GBs of HBM and head-matmul FLOPs for nothing.
-            logits, cache = stage_forward(params, cfg_, spec_, ids, cache,
-                                          pos, attn_impl=attn_impl,
-                                          last_logits_only=True)
+            logits, cache = fwd(params, ids, cache, pos, True)
             return logits[:, -1], cache
 
         @partial(jax.jit, donate_argnums=(2,))
@@ -152,9 +224,7 @@ class InferenceEngine:
             """One non-final prompt chunk: extend the cache, drop logits."""
             b, s = ids.shape
             pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
-            _, cache = stage_forward(params, cfg_, spec_, ids, cache, pos,
-                                     attn_impl=attn_impl,
-                                     last_logits_only=True)
+            _, cache = fwd(params, ids, cache, pos, True)
             return cache
 
         @partial(jax.jit, donate_argnums=(2,))
@@ -163,8 +233,7 @@ class InferenceEngine:
             true last position."""
             b, s = ids.shape
             pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
-            logits, cache = stage_forward(params, cfg_, spec_, ids, cache,
-                                          pos, attn_impl=attn_impl)
+            logits, cache = fwd(params, ids, cache, pos, False)
             last = jax.lax.dynamic_index_in_dim(logits, gather_idx, axis=1,
                                                 keepdims=False)
             return last, cache
@@ -201,8 +270,7 @@ class InferenceEngine:
                 else:
                     lp = jnp.zeros((b,), jnp.float32)
                 pos = jnp.broadcast_to(cache.length, (b, 1))
-                out, cache = stage_forward(params, cfg_, spec_, tok[:, None],
-                                           cache, pos, attn_impl=attn_impl)
+                out, cache = fwd(params, tok[:, None], cache, pos, False)
                 return (out[:, 0], cache, rng, done), (tok, lp)
 
             (_, cache, _, _), (toks, lps) = jax.lax.scan(
@@ -217,8 +285,7 @@ class InferenceEngine:
             tok = sample_logits(last_logits, sub, samp_)
             b = tok.shape[0]
             pos = jnp.broadcast_to(cache.length, (b, 1))
-            out, cache = stage_forward(params, cfg_, spec_, tok[:, None],
-                                       cache, pos, attn_impl=attn_impl)
+            out, cache = fwd(params, tok[:, None], cache, pos, False)
             return tok, out[:, 0], cache, rng
 
         self._prefill = prefill
@@ -231,8 +298,13 @@ class InferenceEngine:
         check_capacity(self.max_seq, prompt_len, max_new_tokens)
 
     def new_cache(self, batch: int) -> KVCache:
-        return KVCache.create(self.cfg, self.cfg.num_layers, batch,
-                              self.max_seq, dtype=self.kv_cache_dtype)
+        cache = KVCache.create(self.cfg, self.cfg.num_layers, batch,
+                               self.max_seq, dtype=self.kv_cache_dtype)
+        if self._cache_sharding is not None:
+            # commit the fresh (donatable) buffers to their kv-head shards
+            # up front so the first forward doesn't pay a reshard
+            cache = jax.device_put(cache, self._cache_sharding)
+        return cache
 
     def _run_prefill(self, ids: jnp.ndarray, cache: KVCache):
         """Whole-prompt or chunked prefill → (last_logits [b, V], cache).

@@ -211,6 +211,22 @@ def test_cli_generate_prompt_lookup():
     assert rc == 1
 
 
+def test_cli_generate_tp():
+    """generate --tp 2 on the virtual mesh matches single-device greedy;
+    --tp combined with another serve mode is rejected."""
+    argv_tail = ["--model", "llama-test", "--prompt-ids", "5,17,42,7",
+                 "--max-new-tokens", "6", "--greedy", "--max-seq", "64",
+                 "--attn-backend", "jnp"]
+    rc, plain = _run_cli(["generate"] + argv_tail)
+    assert rc == 0
+    rc, tp = _run_cli(["generate"] + argv_tail[:-2] + ["--tp", "2"])
+    assert rc == 0
+    assert json.loads(tp)["tokens"] == json.loads(plain)["tokens"]
+    rc, _ = _run_cli(["generate"] + argv_tail + ["--tp", "2",
+                                                 "--prompt-lookup"])
+    assert rc == 1
+
+
 def test_cli_plan_and_cache(tmp_path):
     devices = [
         {"device_id": "cpu0", "address": "127.0.0.1:7000",
