@@ -55,6 +55,17 @@ def _sampling_from_args(args):
     return SamplingParams(temperature=args.temperature, top_k=args.top_k)
 
 
+def _tp_mesh_from_args(args):
+    """tp mesh over the first N local devices, or None (shared by every
+    engine builder that supports --tp)."""
+    if getattr(args, "tp", 1) <= 1:
+        return None
+    import jax
+
+    from .parallel import MeshConfig, make_mesh
+    return make_mesh(MeshConfig(tp=args.tp), jax.devices()[:args.tp])
+
+
 def _build_spec_engine(args):
     """Construct the draft/verify SpeculativeEngine from CLI flags — the
     one site shared by ``generate --draft-model`` and
@@ -75,20 +86,24 @@ def _build_spec_engine(args):
         print("--prefill-chunk is not supported with --draft-model",
               file=sys.stderr)
         return None
-    if getattr(args, "tp", 1) > 1:
-        print("--tp is not supported with --draft-model", file=sys.stderr)
-        return None
     cfg = get_model_config(args.model)
     draft_cfg = get_model_config(args.draft_model)
+    params = _load_full_params(args, cfg)
+    draft_params = _load_full_params(
+        argparse.Namespace(**{**vars(args),
+                              "model": args.draft_model,
+                              "checkpoint": args.draft_checkpoint}),
+        draft_cfg)
+    mesh = _tp_mesh_from_args(args)
+    if mesh is not None:
+        from .runtime.engine import shard_engine_params
+        params = shard_engine_params(params, cfg, mesh)
+        draft_params = shard_engine_params(draft_params, draft_cfg, mesh)
     return SpeculativeEngine(
-        cfg, _load_full_params(args, cfg),
-        draft_cfg, _load_full_params(
-            argparse.Namespace(**{**vars(args),
-                                  "model": args.draft_model,
-                                  "checkpoint": args.draft_checkpoint}),
-            draft_cfg),
+        cfg, params, draft_cfg, draft_params,
         max_seq=args.max_seq, sampling=_sampling_from_args(args),
-        num_draft=args.num_draft, attn_backend=args.attn_backend)
+        num_draft=args.num_draft, attn_backend=args.attn_backend,
+        mesh=mesh)
 
 
 def _build_prompt_lookup_engine(args):
@@ -100,15 +115,20 @@ def _build_prompt_lookup_engine(args):
     from .runtime.prompt_lookup import PromptLookupEngine
 
     if getattr(args, "kv_cache_dtype", "") or getattr(
-            args, "prefill_chunk", 0) or getattr(args, "tp", 1) > 1:
-        print("--kv-cache-dtype/--prefill-chunk/--tp are not supported "
+            args, "prefill_chunk", 0):
+        print("--kv-cache-dtype/--prefill-chunk are not supported "
               "with --prompt-lookup", file=sys.stderr)
         return None
     cfg = get_model_config(args.model)
+    params = _load_full_params(args, cfg)
+    mesh = _tp_mesh_from_args(args)
+    if mesh is not None:
+        from .runtime.engine import shard_engine_params
+        params = shard_engine_params(params, cfg, mesh)
     return PromptLookupEngine(
-        cfg, _load_full_params(args, cfg), max_seq=args.max_seq,
+        cfg, params, max_seq=args.max_seq,
         sampling=_sampling_from_args(args), num_draft=args.num_draft,
-        attn_backend=args.attn_backend)
+        attn_backend=args.attn_backend, mesh=mesh)
 
 
 def _build_engine(args):
@@ -118,16 +138,11 @@ def _build_engine(args):
     cfg = get_model_config(args.model)
     sampling = _sampling_from_args(args)
     params = _load_full_params(args, cfg)
-    mesh = None
-    if getattr(args, "tp", 1) > 1:
+    mesh = _tp_mesh_from_args(args)
+    if mesh is not None:
         # tensor-parallel serving (BASELINE config #3): Megatron-sliced
         # weights + kv-head-sharded cache over the first tp local devices
-        import jax
-
-        from .parallel import MeshConfig, make_mesh
         from .runtime.engine import shard_engine_params
-
-        mesh = make_mesh(MeshConfig(tp=args.tp), jax.devices()[:args.tp])
         params = shard_engine_params(params, cfg, mesh)
     return cfg, InferenceEngine(
         cfg, params, max_seq=args.max_seq, sampling=sampling,
@@ -157,8 +172,10 @@ def cmd_serve(args) -> int:
         print(f"choose one serve mode, got {' + '.join(modes)}",
               file=sys.stderr)
         return 1
-    if getattr(args, "tp", 1) > 1 and modes:
-        print(f"--tp applies to single-node serving only, got {modes[0]}",
+    tp_incompatible = [m for m in modes
+                       if m in ("--chain", "--batch-slots")]
+    if getattr(args, "tp", 1) > 1 and tp_incompatible:
+        print(f"--tp is not supported with {tp_incompatible[0]}",
               file=sys.stderr)
         return 1
 

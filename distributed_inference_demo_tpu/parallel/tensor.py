@@ -29,31 +29,75 @@ _CACHE_SPEC = KVCache(keys=P(None, None, "tp", None, None),
                       length=P())
 
 
+def tp_cache_sharding(mesh: Mesh) -> KVCache:
+    """NamedShardings for a KVCache on the tp mesh (kv-head-sharded) —
+    for committing fresh cache buffers to their shards up front."""
+    from jax.sharding import NamedSharding
+    return KVCache(keys=NamedSharding(mesh, _CACHE_SPEC.keys),
+                   values=NamedSharding(mesh, _CACHE_SPEC.values),
+                   length=NamedSharding(mesh, _CACHE_SPEC.length))
+
+
+def validate_tp(cfg: ModelConfig, mesh: Mesh) -> int:
+    """Check the config can shard over the mesh's tp axis; returns tp."""
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and cfg.num_kv_heads % tp:
+        raise ValueError(
+            f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}")
+    return tp
+
+
+def make_tp_forward(cfg: ModelConfig, spec: StageSpec, mesh: Mesh,
+                    params_template: StageParams):
+    """``fwd(params, inputs, cache, positions, last_logits_only)`` running
+    ``stage_forward`` inside a tp shard_map — the seam every engine builds
+    its jits on (runtime/engine.py, speculative.py, prompt_lookup.py).
+    Activations/positions/logits are replicated; weights and the KV cache
+    stay sharded per this module's specs."""
+    validate_tp(cfg, mesh)
+    p_specs = _tp_param_specs(params_template, cfg)
+
+    def fwd(p, inputs, cache, positions, last_logits_only):
+        def body(p, i, c, po):
+            return stage_forward(p, cfg, spec, i, c, po, tp_axis="tp",
+                                 last_logits_only=last_logits_only)
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(p_specs, P(), _CACHE_SPEC, P()),
+            out_specs=(P(), _CACHE_SPEC),
+            check_vma=False)(p, inputs, cache, positions)
+
+    return fwd
+
+
+def resolve_tp_attn_backend(tp: int, attn_backend: str) -> str:
+    """The one rule for attention backends under a tp mesh: force jnp
+    (the Pallas kernel is not exercised per-shard), rejecting an explicit
+    non-jnp request rather than silently downgrading it.  Shared by every
+    engine that takes ``mesh=``."""
+    if tp > 1:
+        if attn_backend not in ("auto", "jnp"):
+            raise ValueError(
+                f"attn_backend={attn_backend!r} is incompatible with a tp "
+                "mesh (the Pallas kernel is not exercised per-shard); use "
+                "'auto' or 'jnp'")
+        return "jnp"
+    return attn_backend
+
+
 def make_tp_stage_fn(cfg: ModelConfig, spec: StageSpec, mesh: Mesh,
                      params_template: StageParams):
     """Jitted fn(params, inputs, cache, positions) -> (out, cache) with the
-    stage's weights and KV cache sharded over ``tp``.
+    stage's weights and KV cache sharded over ``tp`` (all-positions logits
+    variant of :func:`make_tp_forward`).
 
     Requires ``cfg.num_kv_heads %% tp == 0`` (cache shards by kv head).
     Activations and logits come back replicated — the caller samples or
     forwards them without caring about the mesh.
     """
-    tp = mesh.shape["tp"]
-    if tp > 1 and cfg.num_kv_heads % tp:
-        raise ValueError(
-            f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}")
-
-    p_specs = _tp_param_specs(params_template, cfg)
-
-    def body(p, i, c, pos):
-        return stage_forward(p, cfg, spec, i, c, pos, tp_axis="tp")
+    fwd = make_tp_forward(cfg, spec, mesh, params_template)
 
     def fn(params, inputs, cache, positions):
-        return jax.shard_map(
-            body, mesh=mesh,
-            in_specs=(p_specs, P(), _CACHE_SPEC, P()),
-            out_specs=(P(), _CACHE_SPEC),
-            check_vma=False,
-        )(params, inputs, cache, positions)
+        return fwd(params, inputs, cache, positions, False)
 
     return jax.jit(fn, donate_argnums=(2,))

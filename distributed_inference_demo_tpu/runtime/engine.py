@@ -129,19 +129,11 @@ class InferenceEngine:
         self.mesh = mesh
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         if tp > 1:
-            if cfg.num_kv_heads % tp:
-                raise ValueError(
-                    f"num_kv_heads={cfg.num_kv_heads} not divisible by "
-                    f"tp={tp}")
+            from ..parallel.tensor import resolve_tp_attn_backend
             if self.kv_cache_dtype is not None:
                 raise ValueError(
                     "kv_cache_dtype is not supported with a tp mesh")
-            if attn_backend not in ("auto", "jnp"):
-                raise ValueError(
-                    f"attn_backend={attn_backend!r} is incompatible with "
-                    "a tp mesh (the Pallas kernel is not exercised "
-                    "per-shard); use 'auto' or 'jnp'")
-            attn_backend = "jnp"
+            attn_backend = resolve_tp_attn_backend(tp, attn_backend)
 
         if self.kv_cache_dtype is not None:
             if attn_backend not in ("auto", "jnp"):
@@ -174,34 +166,15 @@ class InferenceEngine:
         samp_ = sampling
 
         if tp > 1:
-            # every forward runs inside this shard_map; activations,
+            # every forward runs inside a tp shard_map; activations,
             # positions, and logits stay replicated so the code above
             # the seam (sampling, scans, chunking) is mesh-oblivious.
-            # Specs come from parallel/tensor.py — the one owner of the
-            # manual-TP layout — so the engine can't drift from it.
-            from jax.sharding import PartitionSpec as P
+            # The seam and its specs live in parallel/tensor.py — the one
+            # owner of the manual-TP layout — so engines can't drift.
+            from ..parallel.tensor import make_tp_forward, tp_cache_sharding
 
-            from ..parallel.tensor import _CACHE_SPEC, _tp_param_specs
-
-            p_specs = _tp_param_specs(params, cfg)
-            cache_spec = _CACHE_SPEC
-
-            def fwd(p, inputs, cache, pos, last_only):
-                def body(p, i, c, po):
-                    return stage_forward(p, cfg_, spec_, i, c, po,
-                                         tp_axis="tp",
-                                         last_logits_only=last_only)
-                return jax.shard_map(
-                    body, mesh=mesh,
-                    in_specs=(p_specs, P(), cache_spec, P()),
-                    out_specs=(P(), cache_spec),
-                    check_vma=False)(p, inputs, cache, pos)
-
-            from jax.sharding import NamedSharding
-            self._cache_sharding = KVCache(
-                keys=NamedSharding(mesh, cache_spec.keys),
-                values=NamedSharding(mesh, cache_spec.values),
-                length=NamedSharding(mesh, cache_spec.length))
+            fwd = make_tp_forward(cfg, self.spec, mesh, params)
+            self._cache_sharding = tp_cache_sharding(mesh)
         else:
             self._cache_sharding = None
             def fwd(p, inputs, cache, pos, last_only):

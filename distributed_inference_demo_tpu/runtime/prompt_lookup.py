@@ -56,7 +56,10 @@ class PromptLookupEngine:
                  max_seq: Optional[int] = None,
                  sampling: SamplingParams = SamplingParams(),
                  num_draft: int = 4,
-                 attn_backend: str = "auto"):
+                 attn_backend: str = "auto",
+                 mesh=None):
+        """``mesh``: tp mesh — the target forward runs sharded (see
+        InferenceEngine); proposal matching stays replicated VPU work."""
         if num_draft < 1:
             raise ValueError("num_draft must be >= 1")
         self.cfg, self.params = cfg, params
@@ -64,7 +67,12 @@ class PromptLookupEngine:
         self.sampling = sampling
         self.num_draft = num_draft
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
+        self.mesh = mesh
 
+        tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+        if tp > 1:
+            from ..parallel.tensor import resolve_tp_attn_backend
+            attn_backend = resolve_tp_attn_backend(tp, attn_backend)
         if attn_backend == "auto":
             attn_backend = ("flash" if jax.default_backend() == "tpu"
                             else "jnp")
@@ -74,13 +82,22 @@ class PromptLookupEngine:
         cfg_, spec_, samp_, K = cfg, self.spec, sampling, num_draft
         cap = self.max_seq + num_draft + 2   # history/cache slack per round
 
+        if tp > 1:
+            from ..parallel.tensor import make_tp_forward, tp_cache_sharding
+            fwd = make_tp_forward(cfg, self.spec, mesh, params)
+            self._cache_sharding = tp_cache_sharding(mesh)
+        else:
+            def fwd(p, inputs, cache, pos, last_only):
+                return stage_forward(p, cfg_, spec_, inputs, cache, pos,
+                                     attn_impl=attn_impl,
+                                     last_logits_only=last_only)
+            self._cache_sharding = None
+
         @jax.jit
         def prefill(params, ids, cache):
             b, s = ids.shape
             pos = jnp.broadcast_to(jnp.arange(s), (b, s))
-            logits, cache = stage_forward(
-                params, cfg_, spec_, ids, cache, pos,
-                attn_impl=attn_impl, last_logits_only=True)
+            logits, cache = fwd(params, ids, cache, pos, True)
             return logits[:, -1], cache
 
         def propose(history, hist_len):
@@ -119,9 +136,8 @@ class PromptLookupEngine:
 
             verify_in = jnp.concatenate([last_tok[:, None], drafts], axis=1)
             pos = n + jnp.broadcast_to(jnp.arange(K + 1), (b, K + 1))
-            t_logits, cache = stage_forward(
-                params, cfg_, spec_, verify_in, cache, pos,
-                attn_impl=attn_impl)                          # [b, K+1, V]
+            t_logits, cache = fwd(params, verify_in, cache, pos,
+                                  False)                      # [b, K+1, V]
 
             # shared rejection rule; q_logits=None = one-hot proposer
             rng, sub_u, sub_x = jax.random.split(rng, 3)
@@ -161,6 +177,8 @@ class PromptLookupEngine:
         the state both generate paths start every run from."""
         b, plen = ids.shape
         cache = KVCache.create(self.cfg, self.cfg.num_layers, b, self._cap)
+        if self._cache_sharding is not None:
+            cache = jax.device_put(cache, self._cache_sharding)
         last_logits, cache = self._prefill(self.params, ids, cache)
         rng, sub = jax.random.split(rng)
         last_tok = sample_logits(last_logits, sub, self.sampling)

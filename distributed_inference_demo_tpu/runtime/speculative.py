@@ -164,7 +164,8 @@ class SpeculativeEngine:
                  max_seq: Optional[int] = None,
                  sampling: SamplingParams = SamplingParams(),
                  num_draft: int = 4,
-                 attn_backend: str = "auto"):
+                 attn_backend: str = "auto",
+                 mesh=None):
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
                 f"draft vocab ({draft_cfg.vocab_size}) != target vocab "
@@ -179,7 +180,12 @@ class SpeculativeEngine:
         self.num_draft = num_draft
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.draft_spec = StageSpec(0, 1, 0, draft_cfg.num_layers)
+        self.mesh = mesh
 
+        tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+        if tp > 1:
+            from ..parallel.tensor import resolve_tp_attn_backend
+            attn_backend = resolve_tp_attn_backend(tp, attn_backend)
         if attn_backend == "auto":
             attn_backend = ("flash" if jax.default_backend() == "tpu"
                             else "jnp")
@@ -190,16 +196,32 @@ class SpeculativeEngine:
         dcfg_, dspec_ = draft_cfg, self.draft_spec
         samp_, K = sampling, num_draft
 
+        if tp > 1:
+            # BOTH models shard over the same tp axis (the draft must
+            # also satisfy the kv-head divisibility check)
+            from ..parallel.tensor import make_tp_forward, tp_cache_sharding
+            fwd_t = make_tp_forward(cfg, self.spec, mesh, params)
+            fwd_d = make_tp_forward(draft_cfg, self.draft_spec, mesh,
+                                    draft_params)
+            self._cache_sharding = tp_cache_sharding(mesh)
+        else:
+            def fwd_t(p, inputs, cache, pos, last_only):
+                return stage_forward(p, cfg_, spec_, inputs, cache, pos,
+                                     attn_impl=attn_impl,
+                                     last_logits_only=last_only)
+
+            def fwd_d(p, inputs, cache, pos, last_only):
+                return stage_forward(p, dcfg_, dspec_, inputs, cache, pos,
+                                     attn_impl=attn_impl,
+                                     last_logits_only=last_only)
+            self._cache_sharding = None
+
         @jax.jit
         def prefill_both(tparams, dparams, ids, tcache, dcache):
             b, s = ids.shape
             pos = jnp.broadcast_to(jnp.arange(s), (b, s))
-            t_logits, tcache = stage_forward(
-                tparams, cfg_, spec_, ids, tcache, pos,
-                attn_impl=attn_impl, last_logits_only=True)
-            _, dcache = stage_forward(
-                dparams, dcfg_, dspec_, ids, dcache, pos,
-                attn_impl=attn_impl, last_logits_only=True)
+            t_logits, tcache = fwd_t(tparams, ids, tcache, pos, True)
+            _, dcache = fwd_d(dparams, ids, dcache, pos, True)
             return t_logits[:, -1], tcache, dcache
 
         def one_round(tparams, dparams, last_tok, tcache, dcache, rng):
@@ -222,9 +244,7 @@ class SpeculativeEngine:
             def dstep(carry, _):
                 tok, dc, rng = carry
                 pos = jnp.broadcast_to(dc.length, (b, 1))
-                logits, dc = stage_forward(
-                    dparams, dcfg_, dspec_, tok[:, None], dc, pos,
-                    attn_impl=attn_impl, last_logits_only=True)
+                logits, dc = fwd_d(dparams, tok[:, None], dc, pos, True)
                 logits = logits[:, 0]
                 rng, sub = jax.random.split(rng)
                 if samp_.greedy:
@@ -244,9 +264,8 @@ class SpeculativeEngine:
             # --- target verify: ONE forward over K+1 tokens ---------------
             verify_in = jnp.concatenate([last_tok[:, None], drafts], axis=1)
             pos = n + jnp.broadcast_to(jnp.arange(K + 1), (b, K + 1))
-            t_logits, tcache = stage_forward(
-                tparams, cfg_, spec_, verify_in, tcache, pos,
-                attn_impl=attn_impl)               # [b, K+1, V]
+            t_logits, tcache = fwd_t(tparams, verify_in, tcache, pos,
+                                     False)        # [b, K+1, V]
 
             # --- accept / resample / lockstep advance (shared rule) -------
             rng, sub_u, sub_x = jax.random.split(rng, 3)
@@ -282,9 +301,13 @@ class SpeculativeEngine:
         # +num_draft+1 slack: a round may write K+1 positions past the
         # valid length before the rollback trims it
         cap = self.max_seq + self.num_draft + 1
-        return (KVCache.create(self.cfg, self.cfg.num_layers, batch, cap),
-                KVCache.create(self.draft_cfg, self.draft_cfg.num_layers,
-                               batch, cap))
+        tc = KVCache.create(self.cfg, self.cfg.num_layers, batch, cap)
+        dc = KVCache.create(self.draft_cfg, self.draft_cfg.num_layers,
+                            batch, cap)
+        if self._cache_sharding is not None:
+            tc = jax.device_put(tc, self._cache_sharding)
+            dc = jax.device_put(dc, self._cache_sharding)
+        return tc, dc
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0,

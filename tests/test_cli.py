@@ -222,9 +222,11 @@ def test_cli_generate_tp():
     rc, tp = _run_cli(["generate"] + argv_tail[:-2] + ["--tp", "2"])
     assert rc == 0
     assert json.loads(tp)["tokens"] == json.loads(plain)["tokens"]
-    rc, _ = _run_cli(["generate"] + argv_tail + ["--tp", "2",
-                                                 "--prompt-lookup"])
-    assert rc == 1
+    # --tp composes with speculation modes too
+    rc, tp_pld = _run_cli(["generate"] + argv_tail[:-2] +
+                          ["--tp", "2", "--prompt-lookup"])
+    assert rc == 0
+    assert json.loads(tp_pld)["tokens"] == json.loads(plain)["tokens"]
 
 
 def test_cli_plan_and_cache(tmp_path):

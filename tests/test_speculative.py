@@ -196,6 +196,27 @@ def test_stream_zero_tokens(params, draft_params):
     assert list(spec.generate_stream(np.asarray([[1, 2]]), 0)) == []
 
 
+def test_tp_mesh_parity(params, draft_params):
+    """Draft/verify over a tp=2 mesh (both models sharded): greedy output
+    equals the single-device speculative engine's."""
+    from distributed_inference_demo_tpu.parallel import MeshConfig, make_mesh
+    from distributed_inference_demo_tpu.runtime.engine import (
+        shard_engine_params)
+
+    sampling = SamplingParams(greedy=True)
+    single = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                               max_seq=96, sampling=sampling, num_draft=3)
+    mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    tp = SpeculativeEngine(
+        CFG, shard_engine_params(params, CFG, mesh),
+        DRAFT_CFG, shard_engine_params(draft_params, DRAFT_CFG, mesh),
+        max_seq=96, sampling=sampling, num_draft=3, mesh=mesh)
+    prompt = np.asarray([[3, 14, 15, 92, 65]])
+    want, _ = single.generate(prompt, 14)
+    got, _ = tp.generate(prompt, 14)
+    np.testing.assert_array_equal(want.tokens, got.tokens)
+
+
 def test_vocab_mismatch_rejected(params):
     other = dataclasses.replace(CFG, vocab_size=128)
     other_params = init_full_params(jax.random.PRNGKey(2), other)
