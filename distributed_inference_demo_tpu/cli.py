@@ -66,6 +66,18 @@ def _tp_mesh_from_args(args):
     return make_mesh(MeshConfig(tp=args.tp), jax.devices()[:args.tp])
 
 
+def _load_params_for_mesh(args, cfg):
+    """(params, mesh): checkpoint-or-seed params, sharded onto the --tp
+    mesh when one is requested — the one load+shard sequence shared by
+    every engine builder."""
+    params = _load_full_params(args, cfg)
+    mesh = _tp_mesh_from_args(args)
+    if mesh is not None:
+        from .runtime.engine import shard_engine_params
+        params = shard_engine_params(params, cfg, mesh)
+    return params, mesh
+
+
 def _build_spec_engine(args):
     """Construct the draft/verify SpeculativeEngine from CLI flags — the
     one site shared by ``generate --draft-model`` and
@@ -88,16 +100,14 @@ def _build_spec_engine(args):
         return None
     cfg = get_model_config(args.model)
     draft_cfg = get_model_config(args.draft_model)
-    params = _load_full_params(args, cfg)
+    params, mesh = _load_params_for_mesh(args, cfg)
     draft_params = _load_full_params(
         argparse.Namespace(**{**vars(args),
                               "model": args.draft_model,
                               "checkpoint": args.draft_checkpoint}),
         draft_cfg)
-    mesh = _tp_mesh_from_args(args)
     if mesh is not None:
         from .runtime.engine import shard_engine_params
-        params = shard_engine_params(params, cfg, mesh)
         draft_params = shard_engine_params(draft_params, draft_cfg, mesh)
     return SpeculativeEngine(
         cfg, params, draft_cfg, draft_params,
@@ -120,11 +130,7 @@ def _build_prompt_lookup_engine(args):
               "with --prompt-lookup", file=sys.stderr)
         return None
     cfg = get_model_config(args.model)
-    params = _load_full_params(args, cfg)
-    mesh = _tp_mesh_from_args(args)
-    if mesh is not None:
-        from .runtime.engine import shard_engine_params
-        params = shard_engine_params(params, cfg, mesh)
+    params, mesh = _load_params_for_mesh(args, cfg)
     return PromptLookupEngine(
         cfg, params, max_seq=args.max_seq,
         sampling=_sampling_from_args(args), num_draft=args.num_draft,
@@ -137,13 +143,9 @@ def _build_engine(args):
 
     cfg = get_model_config(args.model)
     sampling = _sampling_from_args(args)
-    params = _load_full_params(args, cfg)
-    mesh = _tp_mesh_from_args(args)
-    if mesh is not None:
-        # tensor-parallel serving (BASELINE config #3): Megatron-sliced
-        # weights + kv-head-sharded cache over the first tp local devices
-        from .runtime.engine import shard_engine_params
-        params = shard_engine_params(params, cfg, mesh)
+    # tensor-parallel serving (BASELINE config #3): Megatron-sliced
+    # weights + kv-head-sharded cache over the first tp local devices
+    params, mesh = _load_params_for_mesh(args, cfg)
     return cfg, InferenceEngine(
         cfg, params, max_seq=args.max_seq, sampling=sampling,
         attn_backend=args.attn_backend,
@@ -172,11 +174,9 @@ def cmd_serve(args) -> int:
         print(f"choose one serve mode, got {' + '.join(modes)}",
               file=sys.stderr)
         return 1
-    tp_incompatible = [m for m in modes
-                       if m in ("--chain", "--batch-slots")]
-    if getattr(args, "tp", 1) > 1 and tp_incompatible:
-        print(f"--tp is not supported with {tp_incompatible[0]}",
-              file=sys.stderr)
+    if getattr(args, "tp", 1) > 1 and "--chain" in modes:
+        print("--tp is not supported with --chain (stages are whole-model "
+              "slices per worker)", file=sys.stderr)
         return 1
 
     tokenizer = _load_tokenizer(args.tokenizer)
@@ -254,12 +254,14 @@ def cmd_serve(args) -> int:
             return 1
         cfg = get_model_config(args.model)
         sampling = _sampling_from_args(args)
+        params, mesh = _load_params_for_mesh(args, cfg)
         backend = ContinuousBatchingEngine(
-            cfg, _load_full_params(args, cfg), max_seq=args.max_seq,
+            cfg, params, max_seq=args.max_seq,
             max_batch=args.batch_slots, sampling=sampling, seed=args.seed,
-            prefix_cache_size=args.prefix_cache_size)
+            prefix_cache_size=args.prefix_cache_size, mesh=mesh)
         print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
-              f"prefix_cache={args.prefix_cache_size}", flush=True)
+              f"prefix_cache={args.prefix_cache_size} "
+              f"tp={getattr(args, 'tp', 1)}", flush=True)
     else:
         cfg, engine = _build_engine(args)
         backend = engine

@@ -48,18 +48,23 @@ def validate_tp(cfg: ModelConfig, mesh: Mesh) -> int:
 
 
 def make_tp_forward(cfg: ModelConfig, spec: StageSpec, mesh: Mesh,
-                    params_template: StageParams):
+                    params_template: StageParams, attn_impl=None):
     """``fwd(params, inputs, cache, positions, last_logits_only)`` running
     ``stage_forward`` inside a tp shard_map — the seam every engine builds
-    its jits on (runtime/engine.py, speculative.py, prompt_lookup.py).
-    Activations/positions/logits are replicated; weights and the KV cache
-    stay sharded per this module's specs."""
+    its jits on (runtime/engine.py, speculative.py, prompt_lookup.py,
+    batching.py).  Activations/positions/logits are replicated; weights
+    and the KV cache stay sharded per this module's specs.
+
+    ``attn_impl`` runs INSIDE the shard (per-rank head counts, local
+    kv-head cache plane) — e.g. batching's per-slot scatter impl; None
+    uses the default insert-and-attend path."""
     validate_tp(cfg, mesh)
     p_specs = _tp_param_specs(params_template, cfg)
 
     def fwd(p, inputs, cache, positions, last_logits_only):
         def body(p, i, c, po):
             return stage_forward(p, cfg, spec, i, c, po, tp_axis="tp",
+                                 attn_impl=attn_impl,
                                  last_logits_only=last_logits_only)
         return jax.shard_map(
             body, mesh=mesh,
@@ -83,6 +88,27 @@ def resolve_tp_attn_backend(tp: int, attn_backend: str) -> str:
                 "'auto' or 'jnp'")
         return "jnp"
     return attn_backend
+
+
+def make_forward_seam(cfg: ModelConfig, spec: StageSpec, mesh,
+                      params_template: StageParams, attn_impl=None):
+    """(fwd, cache_sharding) for an engine: the tp shard_map seam when
+    ``mesh`` has a tp axis > 1, else a plain ``stage_forward`` closure
+    with ``cache_sharding=None``.  The one mesh-dispatch rule shared by
+    every engine constructor (engine.py, speculative.py,
+    prompt_lookup.py, batching.py)."""
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if tp > 1:
+        return (make_tp_forward(cfg, spec, mesh, params_template,
+                                attn_impl=attn_impl),
+                tp_cache_sharding(mesh))
+
+    def fwd(p, inputs, cache, positions, last_logits_only):
+        return stage_forward(p, cfg, spec, inputs, cache, positions,
+                             attn_impl=attn_impl,
+                             last_logits_only=last_logits_only)
+
+    return fwd, None
 
 
 def make_tp_stage_fn(cfg: ModelConfig, spec: StageSpec, mesh: Mesh,

@@ -115,19 +115,26 @@ class ContinuousBatchingEngine:
                  sampling: SamplingParams = SamplingParams(),
                  eos_id: Optional[int] = None, seed: int = 0,
                  prompt_buckets: tuple = (32, 128, 512, 2048),
-                 prefix_cache_size: int = 8, min_prefix_len: int = 16):
+                 prefix_cache_size: int = 8, min_prefix_len: int = 16,
+                 mesh=None):
         """``prefix_cache_size``: LRU entries of full-prompt KV kept on
         device for automatic prefix reuse (0 disables).  A new prompt
         sharing >= ``min_prefix_len`` leading tokens with a cached one
         skips prefill for the shared part: the cached K/V block is copied
         into the slot row and only the suffix runs (causality makes a
-        prefix's KV independent of what follows, so the reuse is exact)."""
+        prefix's KV independent of what follows, so the reuse is exact).
+
+        ``mesh``: tp mesh — slot forwards run sharded (Megatron weights,
+        kv-head-sharded cache); the per-slot scatter attn impl runs
+        inside each shard on its local head planes, so ragged slots and
+        tensor parallelism compose without extra machinery."""
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
         self.sampling = sampling
         self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
+        self.mesh = mesh
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= self.max_seq
         ) or (self.max_seq,)
@@ -135,14 +142,17 @@ class ContinuousBatchingEngine:
         cfg_, spec_, samp_ = cfg, self.spec, sampling
         B, S = max_batch, self.max_seq
 
+        from ..parallel.tensor import make_forward_seam
+        fwd, self._cache_sharding = make_forward_seam(
+            cfg, self.spec, mesh, params, attn_impl=slot_attention_impl)
+
         @partial(jax.jit, donate_argnums=(1, 2))
         def step(params, ck, cv, lengths, last_tok, active, rng):
             """One lockstep decode step over all slots."""
             cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
             pos = lengths[:, None]
-            logits, cache = stage_forward(
-                params, cfg_, spec_, last_tok[:, None], cache, pos,
-                attn_impl=slot_attention_impl, last_logits_only=True)
+            logits, cache = fwd(params, last_tok[:, None], cache, pos,
+                                True)
             tok = sample_logits(logits[:, 0], rng, samp_)
             tok = jnp.where(active, tok, last_tok)
             lengths = lengths + active.astype(jnp.int32)
@@ -161,22 +171,27 @@ class ContinuousBatchingEngine:
             b, s = ids.shape
             pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
             cache = KVCache(row_k, row_v, jnp.zeros((), jnp.int32))
-            logits, cache = stage_forward(
-                params, cfg_, spec_, ids, cache, pos,
-                attn_impl=slot_attention_impl)
+            logits, cache = fwd(params, ids, cache, pos, False)
             last = jax.lax.dynamic_index_in_dim(
                 logits, real_len - 1, axis=1, keepdims=False)  # [1, V]
             tok = sample_logits(last, rng, samp_)
             return cache.keys, cache.values, tok[0]
 
-        @jax.jit
+        # rows are born on their kv-head shards under a mesh (out_shardings
+        # None = unconstrained) so admission never pays a reshard into the
+        # prefill shard_map
+        row_shardings = (None if self._cache_sharding is None else
+                         (self._cache_sharding.keys,
+                          self._cache_sharding.values))
+
+        @partial(jax.jit, out_shardings=row_shardings)
         def zero_row():
             """Fresh zero row for the cold prefill path (prefill donates
             its row buffers, so the row must be new each admission)."""
             row = KVCache.create(cfg_, cfg_.num_layers, 1, S)
             return row.keys, row.values
 
-        @jax.jit
+        @partial(jax.jit, out_shardings=row_shardings)
         def load_prefix(prefix_k, prefix_v):
             """Zero row with a cached prefix K/V block at columns [0, m)."""
             row = KVCache.create(cfg_, cfg_.num_layers, 1, S)
@@ -201,6 +216,8 @@ class ContinuousBatchingEngine:
         self._load_prefix, self._zero_row = load_prefix, zero_row
 
         cache = KVCache.create(cfg, cfg.num_layers, B, S)
+        if self._cache_sharding is not None:
+            cache = jax.device_put(cache, self._cache_sharding)
         self._ck, self._cv = cache.keys, cache.values
         self._lengths = jnp.zeros((B,), jnp.int32)
         self._last_tok = jnp.zeros((B,), jnp.int32)

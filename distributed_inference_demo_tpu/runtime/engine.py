@@ -128,12 +128,11 @@ class InferenceEngine:
         self.prefill_chunk = prefill_chunk
         self.mesh = mesh
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
-        if tp > 1:
-            from ..parallel.tensor import resolve_tp_attn_backend
-            if self.kv_cache_dtype is not None:
-                raise ValueError(
-                    "kv_cache_dtype is not supported with a tp mesh")
-            attn_backend = resolve_tp_attn_backend(tp, attn_backend)
+        from ..parallel.tensor import resolve_tp_attn_backend
+        if tp > 1 and self.kv_cache_dtype is not None:
+            raise ValueError(
+                "kv_cache_dtype is not supported with a tp mesh")
+        attn_backend = resolve_tp_attn_backend(tp, attn_backend)
 
         if self.kv_cache_dtype is not None:
             if attn_backend not in ("auto", "jnp"):
@@ -165,22 +164,13 @@ class InferenceEngine:
         spec_ = self.spec
         samp_ = sampling
 
-        if tp > 1:
-            # every forward runs inside a tp shard_map; activations,
-            # positions, and logits stay replicated so the code above
-            # the seam (sampling, scans, chunking) is mesh-oblivious.
-            # The seam and its specs live in parallel/tensor.py — the one
-            # owner of the manual-TP layout — so engines can't drift.
-            from ..parallel.tensor import make_tp_forward, tp_cache_sharding
-
-            fwd = make_tp_forward(cfg, self.spec, mesh, params)
-            self._cache_sharding = tp_cache_sharding(mesh)
-        else:
-            self._cache_sharding = None
-            def fwd(p, inputs, cache, pos, last_only):
-                return stage_forward(p, cfg_, spec_, inputs, cache, pos,
-                                     attn_impl=attn_impl,
-                                     last_logits_only=last_only)
+        # forwards run through the seam from parallel/tensor.py (the one
+        # owner of the manual-TP layout): a tp shard_map under a mesh,
+        # plain stage_forward otherwise — the code above the seam
+        # (sampling, scans, chunking) is mesh-oblivious either way
+        from ..parallel.tensor import make_forward_seam
+        fwd, self._cache_sharding = make_forward_seam(
+            cfg, self.spec, mesh, params, attn_impl=attn_impl)
 
         @jax.jit
         def prefill(params, ids, cache):

@@ -69,10 +69,9 @@ class PromptLookupEngine:
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.mesh = mesh
 
+        from ..parallel.tensor import resolve_tp_attn_backend
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
-        if tp > 1:
-            from ..parallel.tensor import resolve_tp_attn_backend
-            attn_backend = resolve_tp_attn_backend(tp, attn_backend)
+        attn_backend = resolve_tp_attn_backend(tp, attn_backend)
         if attn_backend == "auto":
             attn_backend = ("flash" if jax.default_backend() == "tpu"
                             else "jnp")
@@ -82,16 +81,9 @@ class PromptLookupEngine:
         cfg_, spec_, samp_, K = cfg, self.spec, sampling, num_draft
         cap = self.max_seq + num_draft + 2   # history/cache slack per round
 
-        if tp > 1:
-            from ..parallel.tensor import make_tp_forward, tp_cache_sharding
-            fwd = make_tp_forward(cfg, self.spec, mesh, params)
-            self._cache_sharding = tp_cache_sharding(mesh)
-        else:
-            def fwd(p, inputs, cache, pos, last_only):
-                return stage_forward(p, cfg_, spec_, inputs, cache, pos,
-                                     attn_impl=attn_impl,
-                                     last_logits_only=last_only)
-            self._cache_sharding = None
+        from ..parallel.tensor import make_forward_seam
+        fwd, self._cache_sharding = make_forward_seam(
+            cfg, self.spec, mesh, params, attn_impl=attn_impl)
 
         @jax.jit
         def prefill(params, ids, cache):

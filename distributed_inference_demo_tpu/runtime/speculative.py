@@ -182,10 +182,9 @@ class SpeculativeEngine:
         self.draft_spec = StageSpec(0, 1, 0, draft_cfg.num_layers)
         self.mesh = mesh
 
+        from ..parallel.tensor import resolve_tp_attn_backend
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
-        if tp > 1:
-            from ..parallel.tensor import resolve_tp_attn_backend
-            attn_backend = resolve_tp_attn_backend(tp, attn_backend)
+        attn_backend = resolve_tp_attn_backend(tp, attn_backend)
         if attn_backend == "auto":
             attn_backend = ("flash" if jax.default_backend() == "tpu"
                             else "jnp")
@@ -196,25 +195,14 @@ class SpeculativeEngine:
         dcfg_, dspec_ = draft_cfg, self.draft_spec
         samp_, K = sampling, num_draft
 
-        if tp > 1:
-            # BOTH models shard over the same tp axis (the draft must
-            # also satisfy the kv-head divisibility check)
-            from ..parallel.tensor import make_tp_forward, tp_cache_sharding
-            fwd_t = make_tp_forward(cfg, self.spec, mesh, params)
-            fwd_d = make_tp_forward(draft_cfg, self.draft_spec, mesh,
-                                    draft_params)
-            self._cache_sharding = tp_cache_sharding(mesh)
-        else:
-            def fwd_t(p, inputs, cache, pos, last_only):
-                return stage_forward(p, cfg_, spec_, inputs, cache, pos,
-                                     attn_impl=attn_impl,
-                                     last_logits_only=last_only)
-
-            def fwd_d(p, inputs, cache, pos, last_only):
-                return stage_forward(p, dcfg_, dspec_, inputs, cache, pos,
-                                     attn_impl=attn_impl,
-                                     last_logits_only=last_only)
-            self._cache_sharding = None
+        # BOTH models build on the shared seam over the same tp axis
+        # (the draft must also satisfy the kv-head divisibility check)
+        from ..parallel.tensor import make_forward_seam
+        fwd_t, self._cache_sharding = make_forward_seam(
+            cfg, self.spec, mesh, params, attn_impl=attn_impl)
+        fwd_d, _ = make_forward_seam(
+            draft_cfg, self.draft_spec, mesh, draft_params,
+            attn_impl=attn_impl)
 
         @jax.jit
         def prefill_both(tparams, dparams, ids, tcache, dcache):

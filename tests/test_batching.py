@@ -281,6 +281,26 @@ def test_http_server_over_batching_backend(params, oracle):
             server.shutdown()
 
 
+def test_tp_mesh_batching_parity(params, oracle):
+    """Continuous batching over a tp=2 mesh: ragged slots + prefix cache
+    + tensor parallelism compose, greedy-exact vs the plain engine."""
+    from distributed_inference_demo_tpu.parallel import MeshConfig, make_mesh
+    from distributed_inference_demo_tpu.runtime.engine import (
+        shard_engine_params)
+
+    mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    sharded = shard_engine_params(params, CFG, mesh)
+    with ContinuousBatchingEngine(CFG, sharded, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  min_prefix_len=4, mesh=mesh) as eng:
+        prompts = [[3, 14, 15, 92], [3, 14, 15, 92, 65, 35]]  # shared prefix
+        reqs = [eng.submit(p, 10) for p in prompts]
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(r.wait(timeout=300),
+                                          expected(oracle, p, 10))
+        assert eng.prefix_stats["hits"] >= 1   # prefix reuse under tp
+
+
 def test_int8_weights_through_batching():
     """Quantized params flow through the slot engine unchanged (dense()
     dequantizes at the matmul): greedy parity vs the int8 plain engine."""
