@@ -56,14 +56,9 @@ def _sampling_from_args(args):
 
 
 def _tp_mesh_from_args(args):
-    """tp mesh over the first N local devices, or None (shared by every
-    engine builder that supports --tp)."""
-    if getattr(args, "tp", 1) <= 1:
-        return None
-    import jax
-
-    from .parallel import MeshConfig, make_mesh
-    return make_mesh(MeshConfig(tp=args.tp), jax.devices()[:args.tp])
+    """tp mesh from the --tp flag (parallel.mesh owns the rule)."""
+    from .parallel.mesh import local_tp_mesh
+    return local_tp_mesh(getattr(args, "tp", 1))
 
 
 def _load_params_for_mesh(args, cfg):
@@ -374,6 +369,9 @@ def cmd_worker(args) -> int:
     ap.add_argument("--temperature", type=float, default=0.7)
     ap.add_argument("--top-k", type=int, default=7)
     ap.add_argument("--step-timeout", type=float, default=120.0)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor parallelism over this host's first N "
+                         "local devices (elastic pipeline x tp)")
     a = ap.parse_args(args.rest)
 
     cfg = get_model_config(a.model)
@@ -382,7 +380,9 @@ def cmd_worker(args) -> int:
         SamplingParams(temperature=a.temperature, top_k=a.top_k)
     layer_end = a.layer_end if a.layer_end >= 0 else cfg.num_layers
     spec = StageSpec(a.stage_id, a.num_stages, a.layer_start, layer_end)
-    rt = ElasticStageRuntime(cfg, spec, full, a.max_seq, sampling)
+    from .parallel.mesh import local_tp_mesh
+    rt = ElasticStageRuntime(cfg, spec, full, a.max_seq, sampling,
+                             mesh=local_tp_mesh(a.tp))
     transport = ZmqTransport(a.device_id, bind_host=a.bind_host, port=a.port)
     next_id = None
     if a.next:

@@ -50,6 +50,15 @@ class MeshConfig:
                 "tp": self.tp, "sp": self.sp}
 
 
+def local_tp_mesh(tp: int):
+    """tp mesh over the first ``tp`` local devices, or None for tp <= 1 —
+    the one mesh-selection rule shared by the CLI engine builders and the
+    worker processes."""
+    if tp <= 1:
+        return None
+    return make_mesh(MeshConfig(tp=tp), jax.devices()[:tp])
+
+
 def init_multihost(coordinator: str, num_processes: int, process_id: int,
                    local_device_count: Optional[int] = None) -> None:
     """Join this process to a multi-host JAX runtime (DCN control plane).

@@ -48,7 +48,6 @@ import numpy as np
 from ..comm import wire
 from ..comm.transport import BaseTransport
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
-from ..models.decoder import stage_forward
 from ..ops.sampling import SamplingParams, sample_logits
 from .stats import StageStats, timer
 
@@ -62,14 +61,27 @@ class StageRuntime:
 
     def __init__(self, cfg: ModelConfig, spec: StageSpec, params: StageParams,
                  max_seq: int, sampling: SamplingParams = SamplingParams(),
-                 seed: int = 0):
+                 seed: int = 0, mesh=None):
+        """``mesh``: a local tp mesh — this stage's layer range then runs
+        with Megatron-sliced weights and a kv-head-sharded cache on this
+        host's chips (pipeline across hosts x tensor parallelism within
+        one, each worker choosing its own tp independently — the
+        activations on the wire stay replicated [b, s, H] either way)."""
         self.cfg = cfg
         self.spec = spec
-        self.params = params
         self.max_seq = max_seq
         self.sampling = sampling
+        self.mesh = mesh
         self._rng_base = jax.random.PRNGKey(seed)
         self.caches: Dict[int, KVCache] = {}
+
+        from ..parallel.tensor import make_forward_seam
+        fwd, self._cache_sharding = make_forward_seam(cfg, spec, mesh,
+                                                      params)
+        if self._cache_sharding is not None:
+            from .engine import shard_engine_params
+            params = shard_engine_params(params, cfg, mesh)
+        self.params = params
 
         take_last = spec.is_last
 
@@ -77,7 +89,7 @@ class StageRuntime:
         def forward(params, inputs, cache):
             b, s = inputs.shape[0], inputs.shape[1]
             pos = cache.length + jnp.broadcast_to(jnp.arange(s), (b, s))
-            out, cache = stage_forward(params, cfg, spec, inputs, cache, pos)
+            out, cache = fwd(params, inputs, cache, pos, False)
             return (out[:, -1] if take_last else out), cache
 
         @jax.jit
@@ -92,6 +104,8 @@ class StageRuntime:
         if cache is None:
             cache = KVCache.create(self.cfg, self.spec.num_layers, batch,
                                    self.max_seq)
+            if self._cache_sharding is not None:
+                cache = jax.device_put(cache, self._cache_sharding)
             self.caches[rid] = cache
         return cache
 

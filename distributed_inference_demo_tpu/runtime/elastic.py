@@ -73,10 +73,10 @@ class ElasticStageRuntime(StageRuntime):
     def __init__(self, cfg: ModelConfig, spec: StageSpec,
                  full_params: StageParams, max_seq: int,
                  sampling: SamplingParams = SamplingParams(),
-                 seed: int = 0):
+                 seed: int = 0, mesh=None):
         self.full_params = full_params
         super().__init__(cfg, spec, slice_stage(full_params, cfg, spec),
-                         max_seq, sampling, seed)
+                         max_seq, sampling, seed, mesh=mesh)
         self._seed = seed
 
     def reassign(self, spec: StageSpec) -> None:
@@ -90,7 +90,8 @@ class ElasticStageRuntime(StageRuntime):
         # for the new spec (old executables are dropped with the old refs).
         StageRuntime.__init__(self, self.cfg, spec,
                               slice_stage(self.full_params, self.cfg, spec),
-                              self.max_seq, self.sampling, self._seed)
+                              self.max_seq, self.sampling, self._seed,
+                              mesh=self.mesh)
 
 
 def _spec_payload(spec: StageSpec) -> dict:

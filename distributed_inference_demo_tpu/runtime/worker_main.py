@@ -36,8 +36,12 @@ def build_worker(args):
     params = slice_stage(full, cfg, spec)
     sampling = SamplingParams(greedy=True) if args.greedy else \
         SamplingParams(temperature=args.temperature, top_k=args.top_k)
+    # pipeline x tensor parallelism: this stage runs tp-sharded over its
+    # host's first N local devices; the wire stays [b, s, H]
+    from ..parallel.mesh import local_tp_mesh
     runtime = StageRuntime(cfg, spec, params, max_seq=args.max_seq,
-                           sampling=sampling, seed=args.seed)
+                           sampling=sampling, seed=args.seed,
+                           mesh=local_tp_mesh(getattr(args, "tp", 1)))
 
     transport = ZmqTransport(args.device_id, bind_host=args.bind_host,
                              port=args.port)
@@ -76,6 +80,9 @@ def main(argv=None) -> int:
     ap.add_argument("--temperature", type=float, default=0.7)
     ap.add_argument("--top-k", type=int, default=7)
     ap.add_argument("--step-timeout", type=float, default=120.0)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor parallelism over this host's first N "
+                         "local devices (pipeline x tp)")
     args = ap.parse_args(argv)
 
     worker, transport = build_worker(args)

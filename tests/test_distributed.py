@@ -183,3 +183,49 @@ def test_two_process_pipeline_over_sockets(tmp_path):
         if proc.poll() is None:
             proc.kill()
         header_transport.close()
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_worker_tp(tmp_path):
+    """Pipeline x tensor parallelism: the worker process runs its stage
+    tp=2-sharded over virtual devices while the header stays single-
+    device — greedy tokens must still match the plain engine (the wire
+    carries replicated [b, s, H] either way)."""
+    from distributed_inference_demo_tpu.comm.transport import ZmqTransport
+
+    model = "llama-test"
+    cfg = get_model_config(model)
+    full = init_full_params(jax.random.PRNGKey(0), cfg)
+    specs = split_layer_ranges(cfg.num_layers, 2)
+    want = reference_tokens(model, PROMPT, 8)
+
+    header_transport = ZmqTransport("header")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributed_inference_demo_tpu.runtime.worker_main",
+         "--model", model, "--stage-id", "1", "--num-stages", "2",
+         "--layer-start", str(specs[1].layer_start),
+         "--layer-end", str(specs[1].layer_end),
+         "--device-id", "w1", "--port", "0",
+         "--header", f"header@{header_transport.address}",
+         "--max-seq", "128", "--greedy", "--tp", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("WORKER_READY w1 "), line
+        header_transport.connect("w1", line.split()[-1])
+        header = PipelineHeader(
+            StageRuntime(cfg, specs[0], slice_stage(full, cfg, specs[0]),
+                         128, GREEDY),
+            header_transport, next_id="w1", step_timeout=120)
+        got = header.generate(PROMPT, 8)
+        np.testing.assert_array_equal(got, want)
+        header.shutdown_pipeline()
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        header_transport.close()
