@@ -238,10 +238,6 @@ def cmd_serve(args) -> int:
         from .models.registry import get_model_config
         from .runtime.batching import ContinuousBatchingEngine
 
-        if getattr(args, "kv_cache_dtype", ""):
-            print("--kv-cache-dtype is not supported with --batch-slots",
-                  file=sys.stderr)
-            return 1
         if getattr(args, "prefill_chunk", 0):
             # the batching engine buckets prompts itself (prompt_buckets)
             print("--prefill-chunk is not supported with --batch-slots "
@@ -253,7 +249,8 @@ def cmd_serve(args) -> int:
         backend = ContinuousBatchingEngine(
             cfg, params, max_seq=args.max_seq,
             max_batch=args.batch_slots, sampling=sampling, seed=args.seed,
-            prefix_cache_size=args.prefix_cache_size, mesh=mesh)
+            prefix_cache_size=args.prefix_cache_size, mesh=mesh,
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
         print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
               f"prefix_cache={args.prefix_cache_size} "
               f"tp={getattr(args, 'tp', 1)}", flush=True)

@@ -116,7 +116,7 @@ class ContinuousBatchingEngine:
                  eos_id: Optional[int] = None, seed: int = 0,
                  prompt_buckets: tuple = (32, 128, 512, 2048),
                  prefix_cache_size: int = 8, min_prefix_len: int = 16,
-                 mesh=None):
+                 mesh=None, kv_cache_dtype=None):
         """``prefix_cache_size``: LRU entries of full-prompt KV kept on
         device for automatic prefix reuse (0 disables).  A new prompt
         sharing >= ``min_prefix_len`` leading tokens with a cached one
@@ -127,7 +127,11 @@ class ContinuousBatchingEngine:
         ``mesh``: tp mesh — slot forwards run sharded (Megatron weights,
         kv-head-sharded cache); the per-slot scatter attn impl runs
         inside each shard on its local head planes, so ragged slots and
-        tensor parallelism compose without extra machinery."""
+        tensor parallelism compose without extra machinery.
+
+        ``kv_cache_dtype``: reduced-precision cache storage (e.g.
+        "float8_e4m3fn") — the slot scatter casts on insert and attention
+        upcasts on read, same contract as InferenceEngine's."""
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -135,6 +139,11 @@ class ContinuousBatchingEngine:
         self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.mesh = mesh
+        self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
+                               if kv_cache_dtype else None)
+        if self.kv_cache_dtype is not None and mesh is not None:
+            raise ValueError(
+                "kv_cache_dtype is not supported with a tp mesh")
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= self.max_seq
         ) or (self.max_seq,)
@@ -184,17 +193,19 @@ class ContinuousBatchingEngine:
                          (self._cache_sharding.keys,
                           self._cache_sharding.values))
 
+        kv_dtype = self.kv_cache_dtype
+
         @partial(jax.jit, out_shardings=row_shardings)
         def zero_row():
             """Fresh zero row for the cold prefill path (prefill donates
             its row buffers, so the row must be new each admission)."""
-            row = KVCache.create(cfg_, cfg_.num_layers, 1, S)
+            row = KVCache.create(cfg_, cfg_.num_layers, 1, S, dtype=kv_dtype)
             return row.keys, row.values
 
         @partial(jax.jit, out_shardings=row_shardings)
         def load_prefix(prefix_k, prefix_v):
             """Zero row with a cached prefix K/V block at columns [0, m)."""
-            row = KVCache.create(cfg_, cfg_.num_layers, 1, S)
+            row = KVCache.create(cfg_, cfg_.num_layers, 1, S, dtype=kv_dtype)
             zero = jnp.zeros((), jnp.int32)
             idx = (zero, zero, zero, zero, zero)
             return (jax.lax.dynamic_update_slice(row.keys, prefix_k, idx),
@@ -215,7 +226,8 @@ class ContinuousBatchingEngine:
         self._step, self._prefill, self._admit = step, prefill, admit
         self._load_prefix, self._zero_row = load_prefix, zero_row
 
-        cache = KVCache.create(cfg, cfg.num_layers, B, S)
+        cache = KVCache.create(cfg, cfg.num_layers, B, S,
+                               dtype=self.kv_cache_dtype)
         if self._cache_sharding is not None:
             cache = jax.device_put(cache, self._cache_sharding)
         self._ck, self._cv = cache.keys, cache.values
