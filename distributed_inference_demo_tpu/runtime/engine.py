@@ -204,10 +204,17 @@ class InferenceEngine:
         self._prefill_chunk_mid = prefill_chunk_mid
         self._prefill_chunk_last = prefill_chunk_last
 
-        eos_ = eos_id
+        def _mask_eos(tok, done, eos):
+            """Shared eos row-padding rule (eos < 0 = disabled); the eos id
+            is a TRACED scalar so ``engine.eos_id`` can change between
+            calls without recompiling or re-baking closures."""
+            live = eos >= 0
+            tok = jnp.where(done, jnp.where(live, eos, tok), tok)
+            done = done | (live & (tok == eos))
+            return tok, done
 
-        @partial(jax.jit, donate_argnums=(2,), static_argnums=(4, 5))
-        def decode(params, last_logits, cache, rng, num_steps,
+        @partial(jax.jit, donate_argnums=(2,), static_argnums=(5, 6))
+        def decode(params, last_logits, cache, rng, eos, num_steps,
                    with_logprobs=False):
             """Fused sample+forward scan for ``num_steps`` tokens.
 
@@ -223,9 +230,7 @@ class InferenceEngine:
                 logits, cache, rng, done = carry
                 rng, sub = jax.random.split(rng)
                 tok = sample_logits(logits, sub, samp_)
-                if eos_ is not None:
-                    tok = jnp.where(done, jnp.int32(eos_), tok)
-                    done = done | (tok == eos_)
+                tok, done = _mask_eos(tok, done, eos)
                 if with_logprobs:
                     lp = jnp.take_along_axis(
                         jax.nn.log_softmax(logits.astype(jnp.float32), -1),
@@ -243,13 +248,23 @@ class InferenceEngine:
                     jnp.swapaxes(lps, 0, 1), cache)  # [batch, steps]
 
         @partial(jax.jit, donate_argnums=(2,))
-        def decode_one(params, last_logits, cache, rng):
+        def decode_one(params, last_logits, cache, rng, eos, done):
+            """One streamed step; eos masking and the logprob both happen
+            HERE, in the same order as the fused scan's step (mask first,
+            then score the emitted token), so the two paths agree on
+            (token, logprob) pairs row-wise."""
             rng, sub = jax.random.split(rng)
             tok = sample_logits(last_logits, sub, samp_)
+            tok, done = _mask_eos(tok, done, eos)
             b = tok.shape[0]
+            # per-token logprob rides along (one [b, V] reduction; the
+            # streaming path is dispatch-bound, so it's in the noise)
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(last_logits.astype(jnp.float32), -1),
+                tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
             pos = jnp.broadcast_to(cache.length, (b, 1))
             out, cache = fwd(params, tok[:, None], cache, pos, False)
-            return tok, out[:, 0], cache, rng
+            return tok, lp, out[:, 0], cache, rng, done
 
         self._prefill = prefill
         self._decode = decode
@@ -259,6 +274,11 @@ class InferenceEngine:
 
     def _check_capacity(self, prompt_len: int, max_new_tokens: int):
         check_capacity(self.max_seq, prompt_len, max_new_tokens)
+
+    def _eos_scalar(self):
+        """eos_id as the traced sentinel scalar (-1 = disabled), read at
+        call time so eos_id assignment between calls takes effect."""
+        return jnp.int32(self.eos_id if self.eos_id is not None else -1)
 
     def new_cache(self, batch: int) -> KVCache:
         cache = KVCache.create(self.cfg, self.cfg.num_layers, batch,
@@ -324,7 +344,8 @@ class InferenceEngine:
         cache = self.new_cache(b)
         last_logits, cache = self._run_prefill(ids, cache)
         toks, lps, _ = self._decode(self.params, last_logits, cache, rng,
-                                    max_new_tokens, logprobs)
+                                    self._eos_scalar(), max_new_tokens,
+                                    logprobs)
         toks = np.asarray(toks)
         lps_np = np.asarray(lps) if logprobs else None
         dt = time.perf_counter() - t0
@@ -352,24 +373,22 @@ class InferenceEngine:
         return np.argmax(sub, axis=-1).astype(np.int32)
 
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
-                        seed: int = 0) -> Iterator[np.ndarray]:
-        """Yield one [batch] token array per step (UI streaming path)."""
+                        seed: int = 0,
+                        logprobs: bool = False) -> Iterator[np.ndarray]:
+        """Yield one [batch] token array per step (UI streaming path);
+        with ``logprobs=True`` yields ([batch] tokens, [batch] logprobs)
+        pairs instead."""
         ids = jnp.asarray(prompt_ids, jnp.int32)
         b, plen = ids.shape
         self._check_capacity(plen, max_new_tokens)
         cache = self.new_cache(b)
         rng = jax.random.PRNGKey(seed)
         logits, cache = self._run_prefill(ids, cache)
-        done = np.zeros(b, bool)
+        done = jnp.zeros((b,), bool)
         for _ in range(max_new_tokens):
-            tok, logits, cache, rng = self._decode_one(
-                self.params, logits, cache, rng)
+            tok, lp, logits, cache, rng, done = self._decode_one(
+                self.params, logits, cache, rng, self._eos_scalar(), done)
             tok_np = np.asarray(tok)
-            if self.eos_id is not None:
-                # finished rows pad with eos — matches the fused scan's
-                # row-wise semantics, so both paths emit identical tokens
-                tok_np = np.where(done, self.eos_id, tok_np)
-                done |= tok_np == self.eos_id
-            yield tok_np
-            if self.eos_id is not None and done.all():
+            yield (tok_np, np.asarray(lp)) if logprobs else tok_np
+            if self.eos_id is not None and np.asarray(done).all():
                 return

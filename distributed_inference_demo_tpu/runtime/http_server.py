@@ -31,6 +31,11 @@ from typing import Optional
 import numpy as np
 
 
+def _round_lps(row) -> list:
+    """JSON-friendly logprob row (6 decimals ≈ float32 noise floor)."""
+    return [round(float(x), 6) for x in row]
+
+
 def _accepts_kwarg(fn, name: str) -> bool:
     """Duck-typed capability check: does ``fn`` accept ``name=``?  True
     for an explicit parameter OR a **kwargs catch-all (wrapper backends
@@ -186,14 +191,15 @@ class InferenceHTTPServer:
                     return
                 try:
                     if req.get("stream"):
-                        if req.get("logprobs"):
-                            # honor-or-reject, never silently drop: the
-                            # streaming pipeline carries tokens only
+                        want_lp = bool(req.get("logprobs"))
+                        if want_lp and not _accepts_kwarg(
+                                outer.backend.generate_stream, "logprobs"):
+                            # honor-or-reject, never silently drop
                             self._json(501, {
-                                "error": "logprobs are not supported "
-                                         "with stream"})
+                                "error": "backend does not support "
+                                         "logprobs with stream"})
                             return
-                        self._stream(ids, max_new, seed)
+                        self._stream(ids, max_new, seed, logprobs=want_lp)
                     else:
                         kwargs = {}
                         if req.get("logprobs"):
@@ -208,9 +214,8 @@ class InferenceHTTPServer:
                                                      seed=seed, **kwargs)
                         out = {"tokens": res.tokens.tolist()}
                         if getattr(res, "logprobs", None) is not None:
-                            out["logprobs"] = [
-                                [round(float(x), 6) for x in row]
-                                for row in res.logprobs]
+                            out["logprobs"] = [_round_lps(row)
+                                               for row in res.logprobs]
                         if outer.tokenizer is not None:
                             out["text"] = [outer.tokenizer.decode(row)
                                            for row in res.tokens.tolist()]
@@ -244,12 +249,14 @@ class InferenceHTTPServer:
                 except Exception as e:      # stalled pipeline etc. -> 500
                     self._json(500, {"error": str(e)})
 
-            def _stream(self, ids, max_new, seed):
+            def _stream(self, ids, max_new, seed, logprobs=False):
                 # pull the FIRST step before committing to 200 + chunked:
                 # validation errors (capacity etc.) surface on first next()
                 # and must become a clean 400, not a status line spliced
                 # into an already-open chunked body.
-                gen = outer.backend.generate_stream(ids, max_new, seed=seed)
+                kwargs = {"logprobs": True} if logprobs else {}
+                gen = outer.backend.generate_stream(ids, max_new, seed=seed,
+                                                    **kwargs)
                 first = None
                 try:
                     first = next(gen)
@@ -273,8 +280,11 @@ class InferenceHTTPServer:
                     self.wfile.write(f"{len(data):x}\r\n".encode())
                     self.wfile.write(data + b"\r\n")
 
-                def emit(i, toks):
+                def emit(i, item):
+                    toks, lps = item if logprobs else (item, None)
                     line = {"step": i, "tokens": np.asarray(toks).tolist()}
+                    if lps is not None:
+                        line["logprobs"] = _round_lps(np.asarray(lps))
                     if outer.tokenizer is not None:
                         line["text"] = [outer.tokenizer.decode([t])
                                         for t in np.asarray(toks).tolist()]
@@ -283,8 +293,8 @@ class InferenceHTTPServer:
                 try:
                     if first is not None:
                         emit(0, first)
-                        for i, toks in enumerate(gen, start=1):
-                            emit(i, toks)
+                        for i, item in enumerate(gen, start=1):
+                            emit(i, item)
                 except OSError:
                     return      # client went away; the socket is dead
                 except Exception as e:

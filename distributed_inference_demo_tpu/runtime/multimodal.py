@@ -98,7 +98,8 @@ class MultimodalEngine:
         cache = eng.new_cache(b)
         logits, cache = self._prefill_embeds(eng.params, embeds, cache)
         toks, _, _ = eng._decode(eng.params, logits, cache,
-                                 jax.random.PRNGKey(seed), max_new_tokens)
+                                 jax.random.PRNGKey(seed),
+                                 eng._eos_scalar(), max_new_tokens)
         toks = np.asarray(toks)
         return GenerationResult(tokens=toks, prompt_len=seq,
                                 num_new=max_new_tokens,

@@ -107,13 +107,43 @@ def test_generate_endpoint_logprobs_unsupported_backend():
         server.shutdown()
 
 
-def test_generate_endpoint_stream_logprobs_rejected(http_server):
-    server, _ = http_server
+def test_generate_endpoint_stream_logprobs(http_server):
+    """Streaming with logprobs: each JSONL line carries the step's token
+    logprobs, matching the blocking path's values."""
+    server, engine = http_server
+    prompt = [[5, 17, 42, 7]]
     status, data = _req(server, "POST", "/generate",
-                        {"prompt_ids": [[1, 2]], "max_new_tokens": 3,
+                        {"prompt_ids": prompt, "max_new_tokens": 4,
                          "stream": True, "logprobs": True})
-    assert status == 501
-    assert "stream" in json.loads(data)["error"]
+    assert status == 200
+    lines = [json.loads(l) for l in data.decode().splitlines() if l.strip()]
+    assert len(lines) == 4
+    want = engine.generate(np.asarray(prompt), 4, logprobs=True).logprobs[0]
+    got = np.asarray([l["logprobs"][0] for l in lines])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_generate_endpoint_stream_logprobs_unsupported_backend():
+    """Stream backends without logprobs support still get a clean 501."""
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        InferenceHTTPServer)
+
+    class NoLogprobsStream:
+        max_seq = 64
+
+        def generate_stream(self, prompt_ids, max_new_tokens, seed=0):
+            raise AssertionError("must not be called")
+
+    server = InferenceHTTPServer(NoLogprobsStream(), port=0)
+    server.start()
+    try:
+        status, data = _req(server, "POST", "/generate",
+                            {"prompt_ids": [[1]], "max_new_tokens": 2,
+                             "stream": True, "logprobs": True})
+        assert status == 501
+        assert "logprobs" in json.loads(data)["error"]
+    finally:
+        server.shutdown()
 
 
 def test_generate_endpoint_streaming(http_server):

@@ -271,3 +271,20 @@ def test_fp8_kv_cache_rejects_explicit_flash():
     with pytest.raises(ValueError, match="incompatible"):
         InferenceEngine(cfg, params, max_seq=64, attn_backend="flash",
                         kv_cache_dtype="float8_e4m3fn")
+
+
+def test_eos_stream_logprobs_match_fused(engine):
+    """(token, logprob) pairs from the stream must match the fused scan
+    even on eos-padded rows (mask-then-score order is shared)."""
+    prompt = np.asarray([[3, 14, 15, 92], [8, 1, 9, 2]])
+    first_row0 = int(engine.generate(prompt, 1).tokens[0, 0])
+    eng = InferenceEngine(engine.cfg, engine.params, max_seq=64,
+                          sampling=SamplingParams(greedy=True),
+                          eos_id=first_row0)
+    fused = eng.generate(prompt, 6, logprobs=True)
+    pairs = list(eng.generate_stream(prompt, 6, logprobs=True))
+    toks = np.stack([t for t, _ in pairs], 1)
+    lps = np.stack([l for _, l in pairs], 1)
+    n = toks.shape[1]
+    np.testing.assert_array_equal(fused.tokens[:, :n], toks)
+    np.testing.assert_allclose(fused.logprobs[:, :n], lps, atol=1e-5)

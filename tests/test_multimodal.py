@@ -87,7 +87,8 @@ def test_text_only_prefix_matches_plain_engine(setup):
     cache = mm.engine.new_cache(1)
     logits, cache = mm._prefill_embeds(params, embeds, cache)
     toks, _, _ = mm.engine._decode(params, logits, cache,
-                                   jax.random.PRNGKey(0), 8)
+                                   jax.random.PRNGKey(0),
+                                   mm.engine._eos_scalar(), 8)
     np.testing.assert_array_equal(np.asarray(toks), want)
 
 
