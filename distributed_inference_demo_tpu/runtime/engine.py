@@ -73,8 +73,8 @@ class GenerationResult:
 
 
 class InferenceEngine:
-    """KV-cached generation over a full model (single stage; optionally a
-    tensor-parallel mesh via ``tp_fn``)."""
+    """KV-cached generation over a full model — single chip, or
+    tensor-parallel over a tp mesh (``mesh=`` + :func:`shard_engine_params`)."""
 
     def __init__(self, cfg: ModelConfig, params: StageParams,
                  max_seq: Optional[int] = None,
