@@ -637,20 +637,58 @@ def cmd_classify(args) -> int:
 
 
 def cmd_bench(args) -> int:
-    """Engine decode benchmark (same shape as the repo-root bench.py)."""
+    """Engine decode benchmark (same shape as the repo-root bench.py).
+
+    With ``--prompt-lookup`` or ``--draft-model``, ALSO times the
+    speculative engine on the same workload and reports the speedup with
+    acceptance stats — how speculation is evaluated on real weights."""
     import numpy as np
 
-    _, engine = _build_engine(args)
+    want_pld = bool(getattr(args, "prompt_lookup", False))
+    want_draft = bool(getattr(args, "draft_model", ""))
+    if want_pld and want_draft:
+        print("choose one of --draft-model / --prompt-lookup",
+              file=sys.stderr)
+        return 1
+
+    spec = None
+    if want_pld or want_draft:
+        # build the speculative engine FIRST and reuse its target weights
+        # for the baseline — loading a large checkpoint twice would hold
+        # two copies in device memory (and can OOM exactly the models
+        # this comparison is for)
+        spec = (_build_prompt_lookup_engine(args) if want_pld
+                else _build_spec_engine(args))
+        if spec is None:
+            return 1
+        from .runtime import InferenceEngine
+        engine = InferenceEngine(
+            spec.cfg, spec.params, max_seq=args.max_seq,
+            sampling=_sampling_from_args(args),
+            attn_backend=args.attn_backend, mesh=spec.mesh)
+    else:
+        _, engine = _build_engine(args)
+
     prompt = np.arange(args.batch * args.prompt_len).reshape(
         args.batch, args.prompt_len) % 1000
     engine.generate(prompt, args.max_new_tokens, seed=0)       # compile
     res = engine.generate(prompt, args.max_new_tokens, seed=0)
-    print(json.dumps({
+    out = {
         "metric": f"decode tokens/sec ({args.model}, batch={args.batch}, "
                   f"prompt={args.prompt_len}, new={args.max_new_tokens})",
         "value": round(res.tokens_per_second, 2),
         "unit": "tokens/sec",
-    }))
+    }
+    if spec is not None:
+        from .runtime.speculative import stats_json
+        spec.generate(prompt, args.max_new_tokens, seed=0)     # compile
+        sres, stats = spec.generate(prompt, args.max_new_tokens, seed=0)
+        out["speculative"] = dict(
+            stats_json(stats, args.num_draft),
+            tokens_per_sec=round(sres.tokens_per_second, 2),
+            speedup=round(sres.tokens_per_second
+                          / res.tokens_per_second, 3))
+    print(json.dumps(out))
     return 0
 
 
@@ -793,6 +831,7 @@ def main(argv=None) -> int:
     _add_engine_args(b)
     b.add_argument("--batch", type=int, default=8)
     b.add_argument("--prompt-len", type=int, default=64)
+    _add_draft_args(b)
     b.set_defaults(fn=cmd_bench)
 
     cl = sub.add_parser("classify", help="CSV dataset classification "

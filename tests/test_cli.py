@@ -359,3 +359,19 @@ def test_cli_bench_runs():
     assert rc == 0
     body = json.loads(out)
     assert body["unit"] == "tokens/sec" and body["value"] > 0
+
+
+def test_cli_bench_prompt_lookup():
+    """bench --prompt-lookup reports baseline + speculative tok/s with
+    acceptance stats on one workload."""
+    rc, out = _run_cli([
+        "bench", "--model", "llama-test", "--batch", "2",
+        "--prompt-len", "8", "--max-new-tokens", "8", "--greedy",
+        "--max-seq", "64", "--attn-backend", "jnp", "--prompt-lookup",
+        "--num-draft", "3"])
+    assert rc == 0
+    body = json.loads(out)
+    assert body["value"] > 0
+    spec = body["speculative"]
+    assert spec["tokens_per_sec"] > 0 and spec["speedup"] > 0
+    assert spec["rounds"] >= 1

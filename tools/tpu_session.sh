@@ -17,11 +17,15 @@ timeout 2400 python tools/int8_dequant_probe.py >> "$LOG" 2>&1
 echo "=== sampling cost probe ===" >> "$LOG"
 timeout 2400 python tools/sampling_cost_probe.py >> "$LOG" 2>&1
 echo "=== full bench ===" >> "$LOG"
-BENCH_DEADLINE_S=3000 timeout 3600 python bench.py > /tmp/bench_refresh.json 2>> "$LOG"
+rm -f /tmp/bench_refresh.json   # never let a stale run masquerade as this one
+if BENCH_DEADLINE_S=3000 timeout 3600 python bench.py > /tmp/bench_refresh.json 2>> "$LOG"; then
+  cp /tmp/bench_refresh.json BENCH_TUNNEL_RECOVERY.json
+else
+  echo "bench.py failed or timed out; no BENCH_TUNNEL_RECOVERY.json" >> "$LOG"
+fi
 echo "=== done $(date -u +%H:%M:%S) ===" >> "$LOG"
-# land results inside the repo so an end-of-round auto-commit preserves them
-# even if no interactive session is alive to fold them in
+# land the probe log inside the repo so an end-of-round auto-commit
+# preserves it even if no interactive session is alive to fold it in
 { echo "# Probe + bench results from the tunnel-recovery watcher."
   echo "# Produced by tools/tpu_session.sh at $(date -u +%FT%TZ)."
   cat "$LOG"; } > TUNNEL_RECOVERY_PROBES.log
-cp /tmp/bench_refresh.json BENCH_TUNNEL_RECOVERY.json 2>/dev/null || true
