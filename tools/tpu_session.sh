@@ -1,10 +1,14 @@
 #!/bin/bash
 # One-shot TPU measurement session for when the axon tunnel is healthy:
-#   1. int8 dequant strategy probe   (tools/int8_dequant_probe.py)
-#   2. sampling cost probe           (tools/sampling_cost_probe.py)
-#   3. full bench                    (bench.py -> /tmp/bench_refresh.json)
-# Each step appends to /tmp/tpu_session.log; steps are independent so a
-# wedged tunnel mid-way still leaves earlier results on disk.
+#   1. full bench (ALL legs, generous deadline) -> BENCH_TUNNEL_RECOVERY.json
+#   2. decode-profile probe (tools/decode_profile_probe.py, if present)
+#   3. int8 dequant strategy probe   (tools/int8_dequant_probe.py)
+#   4. sampling cost probe           (tools/sampling_cost_probe.py)
+# The bench runs FIRST: it is the round's evidence, and the tunnel can die
+# again mid-session — probes are gravy.  Each step appends to
+# /tmp/tpu_session.log; steps are independent so a wedged tunnel mid-way
+# still leaves earlier results on disk.  Artifacts are COMMITTED (path-
+# scoped) so an end-of-round untracked-file finding can't happen again.
 set -x
 cd "$(dirname "$0")/.."
 LOG=/tmp/tpu_session.log
@@ -12,20 +16,35 @@ LOG=/tmp/tpu_session.log
 echo "=== tunnel check $(date -u +%H:%M:%S) ===" >> "$LOG"
 timeout 180 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1 || {
   echo "TUNNEL DOWN" >> "$LOG"; exit 1; }
-echo "=== int8 dequant probe ===" >> "$LOG"
-timeout 2400 python tools/int8_dequant_probe.py >> "$LOG" 2>&1
-echo "=== sampling cost probe ===" >> "$LOG"
-timeout 2400 python tools/sampling_cost_probe.py >> "$LOG" 2>&1
+
 echo "=== full bench ===" >> "$LOG"
 rm -f /tmp/bench_refresh.json   # never let a stale run masquerade as this one
-if BENCH_DEADLINE_S=3000 timeout 3600 python bench.py > /tmp/bench_refresh.json 2>> "$LOG"; then
+if BENCH_DEADLINE_S=4500 timeout 5400 python bench.py > /tmp/bench_refresh.json 2>> "$LOG"; then
   cp /tmp/bench_refresh.json BENCH_TUNNEL_RECOVERY.json
+  git add BENCH_TUNNEL_RECOVERY.json
+  git commit -m "Record tunnel-recovery bench artifact" -- BENCH_TUNNEL_RECOVERY.json >> "$LOG" 2>&1 || {
+    echo "artifact commit failed; unstaging so it cannot ride another commit" >> "$LOG"
+    git reset -q -- BENCH_TUNNEL_RECOVERY.json; }
 else
   echo "bench.py failed or timed out; no BENCH_TUNNEL_RECOVERY.json" >> "$LOG"
 fi
+
+if [ -f tools/decode_profile_probe.py ]; then
+  echo "=== decode profile probe ===" >> "$LOG"
+  timeout 2400 python tools/decode_profile_probe.py >> "$LOG" 2>&1
+fi
+echo "=== int8 dequant probe ===" >> "$LOG"
+timeout 1800 python tools/int8_dequant_probe.py >> "$LOG" 2>&1
+echo "=== sampling cost probe ===" >> "$LOG"
+timeout 1800 python tools/sampling_cost_probe.py >> "$LOG" 2>&1
 echo "=== done $(date -u +%H:%M:%S) ===" >> "$LOG"
+
 # land the probe log inside the repo so an end-of-round auto-commit
 # preserves it even if no interactive session is alive to fold it in
 { echo "# Probe + bench results from the tunnel-recovery watcher."
   echo "# Produced by tools/tpu_session.sh at $(date -u +%FT%TZ)."
   cat "$LOG"; } > TUNNEL_RECOVERY_PROBES.log
+git add TUNNEL_RECOVERY_PROBES.log
+git commit -m "Record tunnel-recovery probe log" -- TUNNEL_RECOVERY_PROBES.log >> "$LOG" 2>&1 || {
+  echo "log commit failed; unstaging" >> "$LOG"
+  git reset -q -- TUNNEL_RECOVERY_PROBES.log; }
