@@ -108,7 +108,7 @@ def _build_spec_engine(args):
         cfg, params, draft_cfg, draft_params,
         max_seq=args.max_seq, sampling=_sampling_from_args(args),
         num_draft=args.num_draft, attn_backend=args.attn_backend,
-        mesh=mesh)
+        mesh=mesh, eos_id=getattr(args, "eos_id", None))
 
 
 def _build_prompt_lookup_engine(args):
@@ -129,7 +129,8 @@ def _build_prompt_lookup_engine(args):
     return PromptLookupEngine(
         cfg, params, max_seq=args.max_seq,
         sampling=_sampling_from_args(args), num_draft=args.num_draft,
-        attn_backend=args.attn_backend, mesh=mesh)
+        attn_backend=args.attn_backend, mesh=mesh,
+        eos_id=getattr(args, "eos_id", None))
 
 
 def _build_engine(args):
@@ -146,7 +147,7 @@ def _build_engine(args):
         attn_backend=args.attn_backend,
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
         prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
-        mesh=mesh)
+        mesh=mesh, eos_id=getattr(args, "eos_id", None))
 
 
 # ---------------------------------------------------------------------------
@@ -250,7 +251,8 @@ def cmd_serve(args) -> int:
             cfg, params, max_seq=args.max_seq,
             max_batch=args.batch_slots, sampling=sampling, seed=args.seed,
             prefix_cache_size=args.prefix_cache_size, mesh=mesh,
-            kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
+            eos_id=getattr(args, "eos_id", None))
         print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
               f"prefix_cache={args.prefix_cache_size} "
               f"tp={getattr(args, 'tp', 1)}", flush=True)
@@ -709,6 +711,10 @@ def _add_engine_args(ap):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--attn-backend", default="auto",
                     choices=["auto", "flash", "flash-interpret", "jnp"])
+    ap.add_argument("--eos-id", type=int, default=None,
+                    help="end-of-sequence token id: finished rows pad "
+                         "with it and generation stops early once every "
+                         "row emitted it")
     ap.add_argument("--kv-cache-dtype", default="",
                     help="reduced-precision KV cache storage, e.g. "
                          "float8_e4m3fn (half the cache bytes; small "
@@ -862,7 +868,12 @@ def main(argv=None) -> int:
     except ValueError as e:
         # configuration errors raised below the flag layer (e.g. a tp
         # mesh rejecting kv_cache_dtype, or tp > local devices) render as
-        # one stderr line, matching the CLI's explicit flag guards
+        # one stderr line, matching the CLI's explicit flag guards.
+        # DIDEMO_DEBUG=1 re-raises with the full traceback so a genuine
+        # bug surfacing as ValueError isn't flattened to one line.
+        import os
+        if os.environ.get("DIDEMO_DEBUG") == "1":
+            raise
         print(f"error: {e}", file=sys.stderr)
         return 1
 

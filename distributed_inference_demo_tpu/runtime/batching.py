@@ -262,6 +262,10 @@ class ContinuousBatchingEngine:
         check_capacity(self.max_seq, len(prompt), max_new_tokens)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            # admission records the first sampled token unconditionally,
+            # so a 0-token request would still produce one
+            raise ValueError("max_new_tokens must be >= 1")
         req = Request(prompt=prompt, max_new=max_new_tokens)
         with self._submit_lock:
             if not self._running:
@@ -313,8 +317,13 @@ class ContinuousBatchingEngine:
             for i, r in enumerate(reqs):
                 while not finished[i] and len(fetched[i]) <= step_i:
                     item = r.stream.get()
-                    if item is None:          # finished early (EOS)
+                    if item is None:   # end sentinel: EOS, or a failure
                         finished[i] = True
+                        if r.error is not None:
+                            # a scheduler/device failure must surface to
+                            # the streaming consumer, not end the stream
+                            # as a cleanly-truncated generation
+                            raise r.error
                     else:
                         fetched[i].append(item)
                 out.append(fetched[i][step_i]

@@ -46,7 +46,9 @@ from ..models.decoder import stage_forward
 from ..ops.flash_attention import make_flash_attn_impl
 from ..ops.sampling import SamplingParams, sample_logits
 from .engine import GenerationResult, check_capacity
-from .speculative import SpecStats, drain_round_blocks, verify_emit
+from .speculative import (SpecStats, drain_round_blocks, emit_stream_block,
+                          init_done, mask_after_eos, pad_to_width,
+                          verify_emit)
 
 
 class PromptLookupEngine:
@@ -57,7 +59,8 @@ class PromptLookupEngine:
                  sampling: SamplingParams = SamplingParams(),
                  num_draft: int = 4,
                  attn_backend: str = "auto",
-                 mesh=None):
+                 mesh=None,
+                 eos_id: Optional[int] = None):
         """``mesh``: tp mesh — the target forward runs sharded (see
         InferenceEngine); proposal matching stays replicated VPU work."""
         if num_draft < 1:
@@ -66,6 +69,7 @@ class PromptLookupEngine:
         self.max_seq = max_seq or cfg.max_seq_len
         self.sampling = sampling
         self.num_draft = num_draft
+        self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.mesh = mesh
 
@@ -195,18 +199,24 @@ class PromptLookupEngine:
         last_tok, cache, history, hist_len, rng = self._init_state(ids, rng)
 
         stats = SpecStats()
-        out = [np.asarray(last_tok)[:, None]]
+        first = np.asarray(last_tok)
+        out = [first[:, None]]
+        done = init_done(first, self.eos_id)
         total = 1
-        while total < max_new_tokens:
+        while total < max_new_tokens and not done.all():
             em, ms, last_tok, cache, history, hist_len, rng = self._rounds(
                 self.params, last_tok, cache, history, hist_len, rng, R)
             total = drain_round_blocks(np.asarray(em), np.asarray(ms), out,
                                        stats, self.num_draft, total,
-                                       max_new_tokens)
+                                       max_new_tokens, self.eos_id, done)
 
         toks = np.concatenate(out, axis=1)[:, :max_new_tokens]
+        toks = mask_after_eos(pad_to_width(toks, max_new_tokens,
+                                           self.eos_id), self.eos_id)
         dt = time.perf_counter() - t0
-        stats.emitted = toks.shape[1]
+        # actual emitted count, not the eos-padded width (keeps
+        # tokens_per_round honest and matches the stream path)
+        stats.emitted = min(total, max_new_tokens)
         return (GenerationResult(tokens=toks.astype(np.int32),
                                  prompt_len=plen,
                                  num_new=toks.shape[1], seconds=dt),
@@ -227,9 +237,11 @@ class PromptLookupEngine:
         stats = stats_out if stats_out is not None else SpecStats()
         last_tok, cache, history, hist_len, rng = self._init_state(ids, rng)
 
-        yield np.asarray(last_tok)
+        first = np.asarray(last_tok)
+        yield first
+        done = init_done(first, self.eos_id)
         total = stats.emitted = 1
-        while total < max_new_tokens:
+        while total < max_new_tokens and not done.all():
             em, ms, last_tok, cache, history, hist_len, rng = self._rounds(
                 self.params, last_tok, cache, history, hist_len, rng, 1)
             m = int(np.asarray(ms)[0])
@@ -237,7 +249,11 @@ class PromptLookupEngine:
             stats.rounds += 1
             stats.drafted += self.num_draft
             stats.accepted += m - 1
-            for j in range(min(m, max_new_tokens - total)):
-                yield block[:, j]
+            for tok, all_done in emit_stream_block(
+                    block, m, done, total, max_new_tokens, self.eos_id,
+                    stats):
+                yield tok
+                if all_done:
+                    return
             total += m
             stats.emitted = min(total, max_new_tokens)

@@ -139,14 +139,74 @@ def verify_emit(t_logits, drafts, q_logits, samp: SamplingParams,
     return emitted, m, new_last
 
 
+def mask_after_eos(toks: np.ndarray, eos_id: Optional[int]) -> np.ndarray:
+    """Rows keep emitting ``eos_id`` after their first eos — the fused
+    decode scan's row-padding semantics (engine.py ``_mask_eos``), applied
+    host-side to a speculative run's assembled [b, T] block."""
+    if eos_id is None:
+        return toks
+    hit = toks == eos_id
+    after = (np.cumsum(hit, axis=1) - hit) > 0
+    toks = toks.copy()
+    toks[after] = eos_id
+    return toks
+
+
+def init_done(first: np.ndarray, eos_id: Optional[int]) -> np.ndarray:
+    """[b] done mask seeded from the prefill-sampled first token — the
+    one definition shared by every speculative generate/stream path."""
+    return (first == eos_id if eos_id is not None
+            else np.zeros(first.shape, bool))
+
+
+def pad_to_width(toks: np.ndarray, max_new: int,
+                 eos_id: Optional[int]) -> np.ndarray:
+    """Pad an early-eos-stopped [b, T] block out to the fused scan's
+    full [b, max_new] shape.  Only reachable when every row already
+    emitted eos (the generate loops can't stop early otherwise), so the
+    pad is all-eos."""
+    b, t = toks.shape
+    if t < max_new:
+        toks = np.concatenate(
+            [toks, np.full((b, max_new - t), eos_id, toks.dtype)], axis=1)
+    return toks
+
+
+def emit_stream_block(block, m, done, total, max_new, eos_id,
+                      stats: SpecStats):
+    """Mask and hand out one verify round's [b, m] token block for the
+    streaming surface: finished rows keep emitting eos (the streamed twin
+    of the fused scan's _mask_eos padding, like
+    InferenceEngine.generate_stream), ``done`` ([b] bool) updates in
+    place, ``stats.emitted`` advances per token.  Yields
+    ``(tok, all_done)`` pairs; the caller yields ``tok`` outward and
+    returns on ``all_done``.  Shared by every speculative engine."""
+    for j in range(min(m, max_new - total)):
+        tok = block[:, j].copy()
+        if eos_id is not None:
+            tok[done] = eos_id
+        stats.emitted = total + j + 1
+        all_done = False
+        if eos_id is not None:
+            np.logical_or(done, tok == eos_id, out=done)
+            all_done = bool(done.all())
+        yield tok, all_done
+
+
 def drain_round_blocks(em, ms, out, stats: SpecStats, num_draft: int,
-                       total: int, max_new: int) -> int:
+                       total: int, max_new: int, eos_id: Optional[int] = None,
+                       done: Optional[np.ndarray] = None) -> int:
     """Host-side collection of a fused dispatch's round blocks into
-    ``out``/``stats``; returns the updated emitted-token total.  Shared by
-    every speculative engine's generate loop."""
+    ``out``/``stats``; returns the updated emitted-token total.  With
+    ``eos_id``/``done`` given, ORs each block's eos hits into ``done``
+    row-wise (the generate loops' incremental early-stop mask).  Shared
+    by every speculative engine's generate loop."""
     for r in range(em.shape[0]):
         m = int(ms[r])
-        out.append(em[r][:, :m])
+        block = em[r][:, :m]
+        out.append(block)
+        if eos_id is not None and done is not None:
+            np.logical_or(done, (block == eos_id).any(axis=1), out=done)
         stats.rounds += 1
         stats.drafted += num_draft
         stats.accepted += m - 1   # lockstep: min_b(accepted) used
@@ -165,7 +225,8 @@ class SpeculativeEngine:
                  sampling: SamplingParams = SamplingParams(),
                  num_draft: int = 4,
                  attn_backend: str = "auto",
-                 mesh=None):
+                 mesh=None,
+                 eos_id: Optional[int] = None):
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
                 f"draft vocab ({draft_cfg.vocab_size}) != target vocab "
@@ -178,6 +239,7 @@ class SpeculativeEngine:
         self.max_seq = max_seq or cfg.max_seq_len
         self.sampling = sampling
         self.num_draft = num_draft
+        self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.draft_spec = StageSpec(0, 1, 0, draft_cfg.num_layers)
         self.mesh = mesh
@@ -325,19 +387,25 @@ class SpeculativeEngine:
         last_tok = sample_logits(last_logits, sub, self.sampling)
 
         stats = SpecStats()
-        out = [np.asarray(last_tok)[:, None]]
+        first = np.asarray(last_tok)
+        out = [first[:, None]]
+        done = init_done(first, self.eos_id)
         total = 1
-        while total < max_new_tokens:
+        while total < max_new_tokens and not done.all():
             em, ms, last_tok, tcache, dcache, rng = self._rounds(
                 self.params, self.draft_params, last_tok, tcache, dcache,
                 rng, R)
             total = drain_round_blocks(np.asarray(em), np.asarray(ms), out,
                                        stats, self.num_draft, total,
-                                       max_new_tokens)
+                                       max_new_tokens, self.eos_id, done)
 
         toks = np.concatenate(out, axis=1)[:, :max_new_tokens]
+        toks = mask_after_eos(pad_to_width(toks, max_new_tokens,
+                                           self.eos_id), self.eos_id)
         dt = time.perf_counter() - t0
-        stats.emitted = toks.shape[1]
+        # actual emitted count, not the eos-padded width (keeps
+        # tokens_per_round honest and matches the stream path)
+        stats.emitted = min(total, max_new_tokens)
         return (GenerationResult(tokens=toks.astype(np.int32),
                                  prompt_len=plen,
                                  num_new=toks.shape[1], seconds=dt),
@@ -364,9 +432,11 @@ class SpeculativeEngine:
             self.params, self.draft_params, ids, tcache, dcache)
         rng, sub = jax.random.split(rng)
         last_tok = sample_logits(last_logits, sub, self.sampling)
-        yield np.asarray(last_tok)
+        first = np.asarray(last_tok)
+        yield first
+        done = init_done(first, self.eos_id)
         total = stats.emitted = 1
-        while total < max_new_tokens:
+        while total < max_new_tokens and not done.all():
             em, ms, last_tok, tcache, dcache, rng = self._rounds(
                 self.params, self.draft_params, last_tok, tcache, dcache,
                 rng, 1)
@@ -375,8 +445,12 @@ class SpeculativeEngine:
             stats.rounds += 1
             stats.drafted += self.num_draft
             stats.accepted += m - 1
-            for j in range(min(m, max_new_tokens - total)):
-                yield block[:, j]
+            for tok, all_done in emit_stream_block(
+                    block, m, done, total, max_new_tokens, self.eos_id,
+                    stats):
+                yield tok
+                if all_done:
+                    return
             total += m
             stats.emitted = min(total, max_new_tokens)
 

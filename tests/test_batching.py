@@ -370,3 +370,29 @@ def test_fp8_kv_cache(params):
     with pytest.raises(ValueError, match="tp mesh"):
         ContinuousBatchingEngine(CFG, params, max_seq=96, mesh=mesh,
                                  kv_cache_dtype="float8_e4m3fn")
+
+
+def test_submit_rejects_nonpositive_max_new(params):
+    """Admission unconditionally records the first sampled token, so a
+    max_new_tokens <= 0 request must be rejected at submit()."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY,
+                                  prompt_buckets=(16,)) as eng:
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit([1, 2, 3], 0)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit([1, 2, 3], -4)
+
+
+def test_stream_surfaces_scheduler_error(params):
+    """A device/scheduler failure mid-request must raise out of the
+    streaming consumer, not end the stream as a clean truncation."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY,
+                                  prompt_buckets=(16,)) as eng:
+        def boom(*a, **k):
+            raise RuntimeError("injected device failure")
+        eng._prefill = boom            # admission path fails in the loop
+        with pytest.raises(RuntimeError, match="injected device failure"):
+            for _ in eng.generate_stream(np.asarray([1, 2, 3]), 4):
+                pass

@@ -160,3 +160,36 @@ def test_capacity_and_validation(params):
     pld = PromptLookupEngine(CFG, params, max_seq=32, sampling=GREEDY)
     with pytest.raises(ValueError, match="exceeds"):
         pld.generate(np.zeros((1, 30), np.int64), 10)
+
+
+def test_eos_padding_matches_engine(params):
+    """With eos_id set, greedy PLD equals InferenceEngine's eos-padded
+    fused scan bit-exactly."""
+    sampling = SamplingParams(greedy=True)
+    base = InferenceEngine(CFG, params, max_seq=160, sampling=sampling)
+    prompt = np.asarray([[3, 14, 15, 92, 65, 3, 14, 15]])
+    plain = base.generate(prompt, 24).tokens
+    eos = int(plain[0, 4])
+    base_eos = InferenceEngine(CFG, params, max_seq=160, sampling=sampling,
+                               eos_id=eos)
+    want = base_eos.generate(prompt, 24).tokens
+    pld = PromptLookupEngine(CFG, params, max_seq=160, sampling=sampling,
+                             num_draft=4, eos_id=eos)
+    got, _ = pld.generate(prompt, 24)
+    np.testing.assert_array_equal(want, got.tokens)
+
+
+def test_eos_stream_matches_engine_stream(params):
+    sampling = SamplingParams(greedy=True)
+    base = InferenceEngine(CFG, params, max_seq=160, sampling=sampling)
+    prompt = np.asarray([[3, 14, 15, 92, 65, 3, 14, 15]])
+    plain = base.generate(prompt, 24).tokens
+    eos = int(plain[0, 4])
+    base_eos = InferenceEngine(CFG, params, max_seq=160, sampling=sampling,
+                               eos_id=eos)
+    want = list(base_eos.generate_stream(prompt, 24))
+    pld = PromptLookupEngine(CFG, params, max_seq=160, sampling=sampling,
+                             num_draft=4, eos_id=eos)
+    got = list(pld.generate_stream(prompt, 24))
+    assert len(want) == len(got)
+    np.testing.assert_array_equal(np.stack(want), np.stack(got))

@@ -229,3 +229,57 @@ def test_capacity_guard(params, draft_params):
                              max_seq=32, sampling=SamplingParams(greedy=True))
     with pytest.raises(ValueError, match="exceeds"):
         spec.generate(np.zeros((1, 30), np.int64), 10)
+
+
+def test_eos_padding_matches_engine(params, draft_params):
+    """With eos_id set, greedy spec decode equals InferenceEngine's
+    eos-padded fused scan bit-exactly (rows pad with eos after their
+    first eos; unfinished rows are untouched)."""
+    sampling = SamplingParams(greedy=True)
+    base = InferenceEngine(CFG, params, max_seq=96, sampling=sampling)
+    prompt = np.asarray([[3, 14, 15, 92, 65], [1, 2, 3, 4, 5]])
+    plain = base.generate(prompt, 24).tokens
+    eos = int(plain[0, 4])            # appears mid-run in row 0
+    base_eos = InferenceEngine(CFG, params, max_seq=96, sampling=sampling,
+                               eos_id=eos)
+    want = base_eos.generate(prompt, 24).tokens
+    spec = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                             max_seq=96, sampling=sampling, num_draft=4,
+                             eos_id=eos)
+    got, _ = spec.generate(prompt, 24)
+    np.testing.assert_array_equal(want, got.tokens)
+
+
+def test_eos_early_stop_skips_rounds(params, draft_params):
+    """When every row's FIRST token is eos, the round loop must not
+    dispatch at all and the result is full-width eos padding."""
+    sampling = SamplingParams(greedy=True)
+    base = InferenceEngine(CFG, params, max_seq=96, sampling=sampling)
+    prompt = np.asarray([[3, 1, 4]])
+    eos = int(base.generate(prompt, 1).tokens[0, 0])
+    spec = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                             max_seq=96, sampling=sampling, num_draft=4,
+                             eos_id=eos)
+    got, stats = spec.generate(prompt, 12)
+    assert stats.rounds == 0
+    np.testing.assert_array_equal(got.tokens,
+                                  np.full((1, 12), eos, np.int32))
+
+
+def test_eos_stream_matches_engine_stream(params, draft_params):
+    """Streamed spec decode with eos stops at the same step and yields the
+    same (eos-padded) tokens as InferenceEngine.generate_stream."""
+    sampling = SamplingParams(greedy=True)
+    base = InferenceEngine(CFG, params, max_seq=96, sampling=sampling)
+    prompt = np.asarray([[3, 14, 15, 92, 65], [1, 2, 3, 4, 5]])
+    plain = base.generate(prompt, 24).tokens
+    eos = int(plain[0, 4])
+    base_eos = InferenceEngine(CFG, params, max_seq=96, sampling=sampling,
+                               eos_id=eos)
+    want = list(base_eos.generate_stream(prompt, 24))
+    spec = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                             max_seq=96, sampling=sampling, num_draft=4,
+                             eos_id=eos)
+    got = list(spec.generate_stream(prompt, 24))
+    assert len(want) == len(got)
+    np.testing.assert_array_equal(np.stack(want), np.stack(got))
