@@ -73,6 +73,24 @@ def _load_params_for_mesh(args, cfg):
     return params, mesh
 
 
+def _load_draft_for_mesh(args, mesh):
+    """(draft_cfg, draft_params) from the --draft-model/--draft-checkpoint
+    flags, sharded onto ``mesh`` when serving tensor-parallel — shared by
+    the standalone speculative engine and the batching composition."""
+    from .models.registry import get_model_config
+
+    draft_cfg = get_model_config(args.draft_model)
+    draft_params = _load_full_params(
+        argparse.Namespace(**{**vars(args),
+                              "model": args.draft_model,
+                              "checkpoint": args.draft_checkpoint}),
+        draft_cfg)
+    if mesh is not None:
+        from .runtime.engine import shard_engine_params
+        draft_params = shard_engine_params(draft_params, draft_cfg, mesh)
+    return draft_cfg, draft_params
+
+
 def _build_spec_engine(args):
     """Construct the draft/verify SpeculativeEngine from CLI flags — the
     one site shared by ``generate --draft-model`` and
@@ -94,16 +112,8 @@ def _build_spec_engine(args):
               file=sys.stderr)
         return None
     cfg = get_model_config(args.model)
-    draft_cfg = get_model_config(args.draft_model)
     params, mesh = _load_params_for_mesh(args, cfg)
-    draft_params = _load_full_params(
-        argparse.Namespace(**{**vars(args),
-                              "model": args.draft_model,
-                              "checkpoint": args.draft_checkpoint}),
-        draft_cfg)
-    if mesh is not None:
-        from .runtime.engine import shard_engine_params
-        draft_params = shard_engine_params(draft_params, draft_cfg, mesh)
+    draft_cfg, draft_params = _load_draft_for_mesh(args, mesh)
     return SpeculativeEngine(
         cfg, params, draft_cfg, draft_params,
         max_seq=args.max_seq, sampling=_sampling_from_args(args),
@@ -166,7 +176,10 @@ def cmd_serve(args) -> int:
                                     getattr(args, "prompt_lookup", False)),
                                    ("--batch-slots",
                                     getattr(args, "batch_slots", 0))] if on]
-    if len(modes) > 1:
+    # --batch-slots composes with --draft-model (speculative decoding
+    # inside the slot loop — the production serving shape); every other
+    # pairing stays an explicit error
+    if len(modes) > 1 and set(modes) != {"--batch-slots", "--draft-model"}:
         print(f"choose one serve mode, got {' + '.join(modes)}",
               file=sys.stderr)
         return 1
@@ -217,7 +230,8 @@ def cmd_serve(args) -> int:
                                 num_stages=len(chain))
         print(f"SERVE_PIPELINE {chain} ranges="
               f"{[(s.layer_start, s.layer_end) for s in specs]}", flush=True)
-    elif getattr(args, "draft_model", ""):
+    elif (getattr(args, "draft_model", "")
+          and not getattr(args, "batch_slots", 0)):
         from .runtime.speculative import SpeculativeBackend
 
         engine = _build_spec_engine(args)
@@ -247,15 +261,23 @@ def cmd_serve(args) -> int:
         cfg = get_model_config(args.model)
         sampling = _sampling_from_args(args)
         params, mesh = _load_params_for_mesh(args, cfg)
+        draft_cfg = draft_params = None
+        if getattr(args, "draft_model", ""):
+            # speculative decoding inside the slot loop
+            draft_cfg, draft_params = _load_draft_for_mesh(args, mesh)
         backend = ContinuousBatchingEngine(
             cfg, params, max_seq=args.max_seq,
             max_batch=args.batch_slots, sampling=sampling, seed=args.seed,
             prefix_cache_size=args.prefix_cache_size, mesh=mesh,
             kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
-            eos_id=getattr(args, "eos_id", None))
+            eos_id=getattr(args, "eos_id", None),
+            draft_cfg=draft_cfg, draft_params=draft_params,
+            num_draft=args.num_draft)
         print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
               f"prefix_cache={args.prefix_cache_size} "
-              f"tp={getattr(args, 'tp', 1)}", flush=True)
+              f"tp={getattr(args, 'tp', 1)}"
+              + (f" draft={args.draft_model} k={args.num_draft}"
+                 if draft_cfg is not None else ""), flush=True)
     else:
         cfg, engine = _build_engine(args)
         backend = engine

@@ -56,8 +56,9 @@ import numpy as np
 
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..models.decoder import stage_forward
-from ..ops.sampling import SamplingParams, sample_logits
+from ..ops.sampling import SamplingParams, filtered_logits, sample_logits
 from .engine import GenerationResult, check_capacity
+from .speculative import verify_emit_per_row
 
 
 def slot_attention_impl(q, k, v, k_cache, v_cache, positions, cache_start,
@@ -116,7 +117,10 @@ class ContinuousBatchingEngine:
                  eos_id: Optional[int] = None, seed: int = 0,
                  prompt_buckets: tuple = (32, 128, 512, 2048),
                  prefix_cache_size: int = 8, min_prefix_len: int = 16,
-                 mesh=None, kv_cache_dtype=None):
+                 mesh=None, kv_cache_dtype=None,
+                 draft_cfg: Optional[ModelConfig] = None,
+                 draft_params: Optional[StageParams] = None,
+                 num_draft: int = 4):
         """``prefix_cache_size``: LRU entries of full-prompt KV kept on
         device for automatic prefix reuse (0 disables).  A new prompt
         sharing >= ``min_prefix_len`` leading tokens with a cached one
@@ -131,7 +135,20 @@ class ContinuousBatchingEngine:
 
         ``kv_cache_dtype``: reduced-precision cache storage (e.g.
         "float8_e4m3fn") — the slot scatter casts on insert and attention
-        upcasts on read, same contract as InferenceEngine's."""
+        upcasts on read, same contract as InferenceEngine's.
+
+        ``draft_cfg``/``draft_params``: enable SPECULATIVE decoding inside
+        the slot loop — the production serving shape (continuous batching
+        x draft/verify).  Each lockstep iteration becomes one speculative
+        round: the draft proposes ``num_draft`` tokens per slot, the
+        target verifies all slots' proposals in ONE [B, K+1] forward, and
+        each row advances by its OWN accepted count (no lockstep minimum —
+        the slot cache's per-row positions make ragged advance free,
+        unlike SpeculativeEngine's single-offset cache).  Greedy output
+        stays bit-identical to the non-draft engine (pinned by tests);
+        admission additionally prefills the prompt into a draft-side slot
+        row (full prompt — the prefix cache accelerates only the target
+        side)."""
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -139,6 +156,18 @@ class ContinuousBatchingEngine:
         self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.mesh = mesh
+        self.draft_cfg, self.draft_params = draft_cfg, draft_params
+        self.num_draft = num_draft
+        if (draft_cfg is None) != (draft_params is None):
+            raise ValueError("draft_cfg and draft_params go together")
+        if draft_cfg is not None:
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({draft_cfg.vocab_size}) != target vocab "
+                    f"({cfg.vocab_size}); speculative decoding needs a "
+                    "shared token space")
+            if num_draft < 1:
+                raise ValueError("num_draft must be >= 1")
         self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
                                if kv_cache_dtype else None)
         if self.kv_cache_dtype is not None and mesh is not None:
@@ -226,7 +255,116 @@ class ContinuousBatchingEngine:
         self._step, self._prefill, self._admit = step, prefill, admit
         self._load_prefix, self._zero_row = load_prefix, zero_row
 
-        cache = KVCache.create(cfg, cfg.num_layers, B, S,
+        # ------------------------------------------------------------------
+        # speculative slot decoding (draft model inside the slot loop)
+        self._spec_step = None
+        slack = 0
+        if draft_cfg is not None:
+            # a verify round writes K+1 positions past a row's length
+            # before the host learns how many were kept; rows advance
+            # contiguously (n <= K+1 per round), so a query only ever
+            # reaches a column in the round that writes it — slack columns
+            # are never attended stale, even across slot reuse
+            slack = num_draft + 1
+            K = num_draft
+            dcfg_ = draft_cfg
+            fwd_d, _ = make_forward_seam(
+                draft_cfg, StageSpec(0, 1, 0, draft_cfg.num_layers), mesh,
+                draft_params, attn_impl=slot_attention_impl)
+
+            @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+            def spec_step(params, dparams, ck, cv, dck, dcv, lengths,
+                          last_tok, active, rng):
+                """One speculative round over all slots: draft K per row,
+                verify [B, K+1] in one target forward, per-row accept
+                (verify_emit_per_row).  Returns the emitted blocks +
+                per-row counts for the host to drain; inactive rows
+                advance by 0 and keep last_tok."""
+                b = last_tok.shape[0]
+                cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
+                dcache = KVCache(dck, dcv, jnp.zeros((), jnp.int32))
+
+                # K proposals + one extra step inserting d_K's KV so an
+                # all-accept round leaves the draft cache fully populated
+                # (speculative.py's dstep, with per-row positions)
+                def dstep(carry, j):
+                    tok, dc, rng = carry
+                    pos = (lengths + j)[:, None]
+                    logits, dc = fwd_d(dparams, tok[:, None], dc, pos,
+                                       True)
+                    logits = logits[:, 0]
+                    rng, sub = jax.random.split(rng)
+                    if samp_.greedy:
+                        d = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                        q = logits  # unused in greedy verify
+                    else:
+                        q = filtered_logits(logits, samp_)
+                        d = jax.random.categorical(sub, q, axis=-1)
+                        d = d.astype(jnp.int32)
+                    return (d, dc, rng), (d, q)
+
+                (_, dcache, rng), (drafts, q_logits) = jax.lax.scan(
+                    dstep, (last_tok, dcache, rng), jnp.arange(K + 1))
+                drafts = drafts[:K].T                        # [b, K]
+                q_logits = jnp.swapaxes(q_logits[:K], 0, 1)  # [b, K, V]
+
+                verify_in = jnp.concatenate([last_tok[:, None], drafts],
+                                            axis=1)
+                pos = lengths[:, None] + jnp.arange(K + 1)[None, :]
+                t_logits, cache = fwd(params, verify_in, cache, pos,
+                                      False)                 # [b, K+1, V]
+
+                rng, sub_u, sub_x = jax.random.split(rng, 3)
+                emitted, n, new_last = verify_emit_per_row(
+                    t_logits, drafts,
+                    None if samp_.greedy else q_logits, samp_,
+                    sub_u, sub_x)
+
+                n = jnp.where(active, n, 0)
+                new_last = jnp.where(active, new_last, last_tok)
+                lengths = lengths + n
+                return (cache.keys, cache.values, dcache.keys,
+                        dcache.values, lengths, new_last, emitted, n)
+
+            @partial(jax.jit, donate_argnums=(2, 3))
+            def dprefill(dparams, ids, row_k, row_v):
+                """Full-prompt draft-side prefill of a slot row (no
+                sampling — the first token always comes from the TARGET's
+                prefill logits).  Pad-tail garbage K/V is overwritten by
+                the draft scan before any query can attend it (the same
+                stale-slot invariant as the target prefill's)."""
+                b, s = ids.shape
+                pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+                dcache = KVCache(row_k, row_v, jnp.zeros((), jnp.int32))
+                _, dcache = fwd_d(dparams, ids, dcache, pos, True)
+                return dcache.keys, dcache.values
+
+            @partial(jax.jit, out_shardings=row_shardings)
+            def zero_row_d():
+                row = KVCache.create(dcfg_, dcfg_.num_layers, 1, S,
+                                     dtype=kv_dtype)
+                return row.keys, row.values
+
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def admit_d(dck, dcv, row_k, row_v, slot):
+                zero = jnp.zeros((), jnp.int32)
+                dck = jax.lax.dynamic_update_slice(
+                    dck, row_k, (zero, slot, zero, zero, zero))
+                dcv = jax.lax.dynamic_update_slice(
+                    dcv, row_v, (zero, slot, zero, zero, zero))
+                return dck, dcv
+
+            self._spec_step = spec_step
+            self._dprefill, self._zero_row_d = dprefill, zero_row_d
+            self._admit_d = admit_d
+            dcache = KVCache.create(draft_cfg, draft_cfg.num_layers, B,
+                                    S + slack, dtype=self.kv_cache_dtype)
+            if self._cache_sharding is not None:
+                dcache = jax.device_put(dcache, self._cache_sharding)
+            self._dck, self._dcv = dcache.keys, dcache.values
+        self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
+
+        cache = KVCache.create(cfg, cfg.num_layers, B, S + slack,
                                dtype=self.kv_cache_dtype)
         if self._cache_sharding is not None:
             cache = jax.device_put(cache, self._cache_sharding)
@@ -322,7 +460,11 @@ class ContinuousBatchingEngine:
                         if r.error is not None:
                             # a scheduler/device failure must surface to
                             # the streaming consumer, not end the stream
-                            # as a cleanly-truncated generation
+                            # as a cleanly-truncated generation; free the
+                            # sibling rows' slots first (nobody will
+                            # drain them after the raise)
+                            for rr in reqs:
+                                rr.cancel()
                             raise r.error
                     else:
                         fetched[i].append(item)
@@ -333,6 +475,23 @@ class ContinuousBatchingEngine:
             pad = self.eos_id if self.eos_id is not None else 0
             yield np.asarray([pad if o is None else o for o in out],
                              np.int32)
+
+    def stats(self) -> dict:
+        """Scheduler counters for the HTTP ``/stats`` surface."""
+        out = {"slots": self.max_batch, "steps": self._step_count,
+               "prefix_cache": dict(self.prefix_stats)}
+        if self._spec_step is not None:
+            s = self.spec_stats
+            out["speculative"] = {
+                "num_draft": self.num_draft, "rounds": s["rounds"],
+                "acceptance_rate": (round(s["accepted"] / s["drafted"], 4)
+                                    if s["drafted"] else None)}
+        return out
+
+    def reset_stats(self) -> None:
+        self._step_count = 0
+        self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
+        self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
 
     def close(self):
         self._running = False
@@ -418,6 +577,16 @@ class ContinuousBatchingEngine:
             self._ck, self._cv, row_k, row_v, jnp.int32(slot),
             self._lengths, self._last_tok, jnp.int32(plen),
             tok.astype(jnp.int32))
+        if self._spec_step is not None:
+            # draft-side slot row: always the FULL prompt (prefix reuse
+            # applies to the target cache only; the draft is cheap)
+            dbucket = self._bucket(plen)
+            dpad = np.zeros((1, dbucket), np.int32)
+            dpad[0, :plen] = req.prompt
+            drow_k, drow_v = self._dprefill(
+                self.draft_params, jnp.asarray(dpad), *self._zero_row_d())
+            self._dck, self._dcv = self._admit_d(
+                self._dck, self._dcv, drow_k, drow_v, jnp.int32(slot))
         self._slots[slot] = req
         self._record_token(slot, req, int(tok))
 
@@ -495,15 +664,36 @@ class ContinuousBatchingEngine:
 
             active_mask = np.array([s is not None for s in self._slots])
             self._rng, sub = jax.random.split(self._rng)
-            self._ck, self._cv, self._lengths, tok = self._step(
-                self.params, self._ck, self._cv, self._lengths,
-                self._last_tok, jnp.asarray(active_mask), sub)
-            self._last_tok = tok
-            tok_np = np.asarray(tok)
-            self._step_count += 1
-            for i, req in enumerate(self._slots):
-                if req is not None:
-                    self._record_token(i, req, int(tok_np[i]))
+            if self._spec_step is not None:
+                (self._ck, self._cv, self._dck, self._dcv, self._lengths,
+                 tok, emitted, ns) = self._spec_step(
+                    self.params, self.draft_params, self._ck, self._cv,
+                    self._dck, self._dcv, self._lengths, self._last_tok,
+                    jnp.asarray(active_mask), sub)
+                self._last_tok = tok
+                em_np, ns_np = np.asarray(emitted), np.asarray(ns)
+                self._step_count += 1
+                self.spec_stats["rounds"] += 1
+                self.spec_stats["drafted"] += (
+                    self.num_draft * int(active_mask.sum()))
+                for i, req in enumerate(self._slots):
+                    if req is None:
+                        continue
+                    self.spec_stats["accepted"] += int(ns_np[i]) - 1
+                    for j in range(int(ns_np[i])):
+                        if self._slots[i] is None:
+                            break      # row hit max_new or eos mid-block
+                        self._record_token(i, req, int(em_np[i, j]))
+            else:
+                self._ck, self._cv, self._lengths, tok = self._step(
+                    self.params, self._ck, self._cv, self._lengths,
+                    self._last_tok, jnp.asarray(active_mask), sub)
+                self._last_tok = tok
+                tok_np = np.asarray(tok)
+                self._step_count += 1
+                for i, req in enumerate(self._slots):
+                    if req is not None:
+                        self._record_token(i, req, int(tok_np[i]))
 
         # drain: fail anything still queued or in flight
         self._drain_all(RuntimeError("engine closed while request in flight"))

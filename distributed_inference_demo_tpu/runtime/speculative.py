@@ -72,10 +72,11 @@ class SpecStats:
         return self.emitted / self.rounds if self.rounds else float("nan")
 
 
-def verify_emit(t_logits, drafts, q_logits, samp: SamplingParams,
-                sub_u, sub_x):
-    """The speculative accept/resample rule + emitted-block assembly,
-    shared by every proposer (draft model, prompt lookup).
+def accept_and_extra(t_logits, drafts, q_logits, samp: SamplingParams,
+                     sub_u, sub_x):
+    """The speculative accept/resample rule, shared by every proposer
+    (draft model, prompt lookup) and every advance policy (lockstep,
+    per-row).
 
     t_logits: [b, K+1, V] target logits over [last_tok, d_1..d_K];
     drafts:   [b, K] proposals;
@@ -83,8 +84,8 @@ def verify_emit(t_logits, drafts, q_logits, samp: SamplingParams,
               DETERMINISTIC proposer (one-hot q: accept d with prob p(d),
               resample from p with d masked out).
 
-    Returns (emitted [b, K+1], m scalar in [1, K+1], new_last [b]):
-    per-row exactly-distributed tokens with lockstep advance m = min+1.
+    Returns (a [b] accepted-draft counts in [0, K], extra [b]: the
+    rejection-point resample, or the bonus token after K accepts).
     """
     b, K = drafts.shape
     if samp.greedy:
@@ -127,16 +128,53 @@ def verify_emit(t_logits, drafts, q_logits, samp: SamplingParams,
         extra_probs = jnp.where((a == K)[:, None], bonus, resid_a)
         extra = jax.random.categorical(
             sub_x, jnp.log(extra_probs + 1e-30), axis=-1).astype(jnp.int32)
+    return a, extra
 
+
+def assemble_emitted(drafts, a, extra):
+    """[b, K+1] emitted block from per-row accept counts: row i is
+    [d_1..d_{a_i}, extra_i, 0...] — each row its own exactly-distributed
+    sample."""
+    K = drafts.shape[1]
     idx = jnp.arange(K + 1)[None, :]
     drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
-    emitted = jnp.where(idx < a[:, None], drafts_pad,
-                        jnp.where(idx == a[:, None], extra[:, None], 0))
+    return jnp.where(idx < a[:, None], drafts_pad,
+                     jnp.where(idx == a[:, None], extra[:, None], 0))
+
+
+def verify_emit(t_logits, drafts, q_logits, samp: SamplingParams,
+                sub_u, sub_x):
+    """Accept/resample + emitted-block assembly with LOCKSTEP advance:
+    all rows move by ``m = min_b(a_b) + 1`` (one scalar keeps the
+    single-cache engines' shapes static; rows that accepted more
+    re-propose next round).
+
+    Returns (emitted [b, K+1], m scalar in [1, K+1], new_last [b]).
+    """
+    b = drafts.shape[0]
+    a, extra = accept_and_extra(t_logits, drafts, q_logits, samp,
+                                sub_u, sub_x)
+    emitted = assemble_emitted(drafts, a, extra)
     m = jnp.min(a) + 1                                 # scalar, [1, K+1]
     new_last = jnp.take_along_axis(
         emitted, (m - 1)[None, None].astype(jnp.int32).repeat(b, axis=0),
         axis=1)[:, 0]
     return emitted, m, new_last
+
+
+def verify_emit_per_row(t_logits, drafts, q_logits, samp: SamplingParams,
+                        sub_u, sub_x):
+    """Accept/resample + assembly with PER-ROW advance: row i moves by
+    ``n_i = a_i + 1`` — no lockstep minimum, no wasted acceptances.  The
+    policy for engines whose cache positions are already per-row (the
+    continuous-batching slot cache); the follow-up token is always the
+    row's ``extra``.
+
+    Returns (emitted [b, K+1], n [b] in [1, K+1], new_last [b]).
+    """
+    a, extra = accept_and_extra(t_logits, drafts, q_logits, samp,
+                                sub_u, sub_x)
+    return assemble_emitted(drafts, a, extra), a + 1, extra
 
 
 def mask_after_eos(toks: np.ndarray, eos_id: Optional[int]) -> np.ndarray:

@@ -396,3 +396,111 @@ def test_stream_surfaces_scheduler_error(params):
         with pytest.raises(RuntimeError, match="injected device failure"):
             for _ in eng.generate_stream(np.asarray([1, 2, 3]), 4):
                 pass
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding inside the slot loop (batching x draft/verify)
+
+import dataclasses
+
+DRAFT_CFG = dataclasses.replace(CFG, num_layers=2)
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    # different seed AND depth: a genuinely different proposer
+    return init_full_params(jax.random.PRNGKey(1), DRAFT_CFG)
+
+
+def spec_engine(params, draft_params, **kw):
+    return ContinuousBatchingEngine(
+        CFG, params, max_seq=96, max_batch=4, sampling=GREEDY,
+        prompt_buckets=(16,), draft_cfg=DRAFT_CFG,
+        draft_params=draft_params, num_draft=4, **kw)
+
+
+def test_spec_single_request_matches_engine(params, draft_params, oracle):
+    """Greedy speculative batching must be bit-identical to the plain
+    engine — speculation AND batching are both pure scheduling."""
+    with spec_engine(params, draft_params) as eng:
+        prompt = [3, 14, 15, 92, 65]
+        got = eng.submit(prompt, 12).wait(timeout=300)
+        np.testing.assert_array_equal(got, expected(oracle, prompt, 12))
+        assert eng.stats()["speculative"]["rounds"] >= 1
+
+
+def test_spec_concurrent_requests_all_match(params, draft_params, oracle):
+    prompts = [[3, 14, 15], [9, 2, 6, 5, 3, 5], [1], [7, 7, 7, 7]]
+    ns = [10, 14, 8, 12]
+    with spec_engine(params, draft_params) as eng:
+        reqs = [eng.submit(p, n) for p, n in zip(prompts, ns)]
+        for p, n, r in zip(prompts, ns, reqs):
+            np.testing.assert_array_equal(r.wait(timeout=300),
+                                          expected(oracle, p, n))
+
+
+def test_spec_late_joiner_matches(params, draft_params, oracle):
+    """Admission between speculative rounds must stay bit-exact for both
+    the in-flight and the joining request."""
+    with spec_engine(params, draft_params) as eng:
+        first = eng.submit([5, 4, 3, 2], 40)
+        deadline = time.monotonic() + 240
+        while len(first.tokens) < 5:
+            assert time.monotonic() < deadline, "first request stalled"
+            time.sleep(0.01)
+        assert not first.done.is_set()
+        second = eng.submit([8, 8, 1], 10)
+        np.testing.assert_array_equal(second.wait(timeout=300),
+                                      expected(oracle, [8, 8, 1], 10))
+        np.testing.assert_array_equal(first.wait(timeout=300),
+                                      expected(oracle, [5, 4, 3, 2], 40))
+
+
+def test_spec_self_draft_accepts_everything(params):
+    """Draft == target: greedy acceptance must be 1.0 and rounds must
+    emit num_draft+1 tokens each (per-row advance, no lockstep min)."""
+    with ContinuousBatchingEngine(
+            CFG, params, max_seq=96, max_batch=2, sampling=GREEDY,
+            prompt_buckets=(16,), draft_cfg=CFG, draft_params=params,
+            num_draft=4) as eng:
+        got = eng.submit([3, 1, 4], 21).wait(timeout=300)
+        assert got.shape == (21,)
+        st = eng.stats()["speculative"]
+        assert st["acceptance_rate"] == 1.0
+        # 1 prefill token + 20 from ceil(20/5)=4 all-accept rounds
+        assert st["rounds"] == 4
+
+
+def test_spec_eos_terminates_row_mid_block(params, draft_params, oracle):
+    """A row whose eos lands inside an accepted block must finish with
+    exactly the oracle's eos-truncated output."""
+    prompt = [3, 14, 15, 92, 65]
+    ref = expected(oracle, prompt, 12)
+    eos = int(ref[4])
+    want = list(ref[:5])                       # truncated AT first eos
+    with ContinuousBatchingEngine(
+            CFG, params, max_seq=96, max_batch=4, sampling=GREEDY,
+            prompt_buckets=(16,), eos_id=eos, draft_cfg=DRAFT_CFG,
+            draft_params=draft_params, num_draft=4) as eng:
+        got = eng.submit(prompt, 12).wait(timeout=300)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_spec_stream_matches_plain_stream(params, draft_params):
+    """Streaming through the speculative slot loop yields the same
+    per-step rows as the non-draft batching engine."""
+    prompt = np.asarray([3, 14, 15, 92, 65])
+    with ContinuousBatchingEngine(
+            CFG, params, max_seq=96, max_batch=2, sampling=GREEDY,
+            prompt_buckets=(16,)) as plain:
+        want = [t[0] for t in plain.generate_stream(prompt, 12)]
+    with spec_engine(params, draft_params) as eng:
+        got = [t[0] for t in eng.generate_stream(prompt, 12)]
+    np.testing.assert_array_equal(want, got)
+
+
+def test_spec_draft_vocab_mismatch_rejected(params):
+    bad = dataclasses.replace(CFG, vocab_size=CFG.vocab_size + 1)
+    with pytest.raises(ValueError, match="vocab"):
+        ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                 draft_cfg=bad, draft_params=params)

@@ -22,6 +22,8 @@ from distributed_inference_demo_tpu.models import get_model_config
 from distributed_inference_demo_tpu.models.decoder import init_full_params
 from distributed_inference_demo_tpu.ops.sampling import SamplingParams
 from distributed_inference_demo_tpu.runtime import InferenceEngine
+from distributed_inference_demo_tpu.runtime.batching import (
+    ContinuousBatchingEngine)
 from distributed_inference_demo_tpu.runtime.http_server import (
     InferenceHTTPServer)
 
@@ -375,3 +377,41 @@ def test_cli_bench_prompt_lookup():
     spec = body["speculative"]
     assert spec["tokens_per_sec"] > 0 and spec["speedup"] > 0
     assert spec["rounds"] >= 1
+
+
+def test_serve_mode_pairing_rules(capsys):
+    """--batch-slots composes with --draft-model; every other mode pair
+    stays an explicit one-line error."""
+    base = ["serve", "--model", "llama-test"]
+    assert cli.main(base + ["--chain", "w@127.0.0.1:1",
+                            "--batch-slots", "2"]) == 1
+    assert cli.main(base + ["--batch-slots", "2", "--prompt-lookup"]) == 1
+    assert cli.main(base + ["--draft-model", "llama-test",
+                            "--prompt-lookup"]) == 1
+    capsys.readouterr()
+
+
+def test_http_batching_with_draft(http_server):
+    """The composed serving shape (continuous batching x speculative
+    decoding) over HTTP: greedy output matches the plain engine, /stats
+    reports acceptance."""
+    _, engine = http_server
+    backend = ContinuousBatchingEngine(
+        engine.cfg, engine.params, max_seq=64, max_batch=2,
+        sampling=GREEDY, prompt_buckets=(16,), draft_cfg=engine.cfg,
+        draft_params=engine.params, num_draft=3)
+    server = InferenceHTTPServer(backend, port=0, model_name="llama-test")
+    server.start()
+    try:
+        prompt = [[5, 17, 42, 7]]
+        status, data = _req(server, "POST", "/generate",
+                            {"prompt_ids": prompt, "max_new_tokens": 6})
+        assert status == 200
+        want = engine.generate(np.asarray(prompt), 6).tokens.tolist()
+        assert json.loads(data)["tokens"] == want
+        status, stats = _req(server, "GET", "/stats")
+        assert status == 200
+        assert json.loads(stats)["speculative"]["acceptance_rate"] == 1.0
+    finally:
+        server.shutdown()
+        backend.close()
