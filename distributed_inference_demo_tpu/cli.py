@@ -199,12 +199,6 @@ def cmd_serve(args) -> int:
         from .runtime.elastic import ElasticHeader, ElasticStageRuntime
 
         cfg = get_model_config(args.model)
-        if getattr(args, "kv_cache_dtype", ""):
-            # StageRuntime caches don't take a dtype override yet: reject
-            # rather than silently serving full-precision caches
-            print("--kv-cache-dtype is not supported with --chain",
-                  file=sys.stderr)
-            return 1
         if getattr(args, "prefill_chunk", 0):
             print("--prefill-chunk is not supported with --chain",
                   file=sys.stderr)
@@ -219,7 +213,12 @@ def cmd_serve(args) -> int:
                                  port=args.port)
         for pid, addr in peers:
             transport.connect(pid, addr)
-        rt = ElasticStageRuntime(cfg, specs[0], full, args.max_seq, sampling)
+        # the header's own stage honors --kv-cache-dtype; chain workers
+        # take their own --kv-cache-dtype flag (each stage's cache is its
+        # own business — the wire carries activations, not cache state)
+        rt = ElasticStageRuntime(
+            cfg, specs[0], full, args.max_seq, sampling,
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", "") or None)
         header = ElasticHeader(rt, transport, chain,
                                step_timeout=args.step_timeout)
         # initial reshard pushes the authoritative layer plan to the chain —
@@ -228,8 +227,20 @@ def cmd_serve(args) -> int:
         header.reshard(chain)
         backend = HeaderBackend(header, max_seq=args.max_seq,
                                 num_stages=len(chain))
+        kv_dtype = getattr(args, "kv_cache_dtype", "") or None
+        if kv_dtype:
+            # each stage owns its cache dtype; this flag reaches only the
+            # header's stage — say so loudly, or a chain whose workers
+            # weren't launched with their own --kv-cache-dtype silently
+            # keeps full-precision caches on every other host
+            print(f"note: --kv-cache-dtype={kv_dtype} applies to the "
+                  "header stage only; start each worker with its own "
+                  "--kv-cache-dtype to reduce its cache too",
+                  file=sys.stderr)
         print(f"SERVE_PIPELINE {chain} ranges="
-              f"{[(s.layer_start, s.layer_end) for s in specs]}", flush=True)
+              f"{[(s.layer_start, s.layer_end) for s in specs]}"
+              + (f" header_kv_cache_dtype={kv_dtype}" if kv_dtype else ""),
+              flush=True)
     elif (getattr(args, "draft_model", "")
           and not getattr(args, "batch_slots", 0)):
         from .runtime.speculative import SpeculativeBackend
@@ -393,6 +404,9 @@ def cmd_worker(args) -> int:
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over this host's first N "
                          "local devices (elastic pipeline x tp)")
+    ap.add_argument("--kv-cache-dtype", default="",
+                    help="reduced-precision KV cache storage for this "
+                         "stage, e.g. float8_e4m3fn")
     a = ap.parse_args(args.rest)
 
     cfg = get_model_config(a.model)
@@ -403,7 +417,8 @@ def cmd_worker(args) -> int:
     spec = StageSpec(a.stage_id, a.num_stages, a.layer_start, layer_end)
     from .parallel.mesh import local_tp_mesh
     rt = ElasticStageRuntime(cfg, spec, full, a.max_seq, sampling,
-                             mesh=local_tp_mesh(a.tp))
+                             mesh=local_tp_mesh(a.tp),
+                             kv_cache_dtype=a.kv_cache_dtype or None)
     transport = ZmqTransport(a.device_id, bind_host=a.bind_host, port=a.port)
     next_id = None
     if a.next:

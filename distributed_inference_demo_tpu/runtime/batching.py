@@ -170,9 +170,6 @@ class ContinuousBatchingEngine:
                 raise ValueError("num_draft must be >= 1")
         self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
                                if kv_cache_dtype else None)
-        if self.kv_cache_dtype is not None and mesh is not None:
-            raise ValueError(
-                "kv_cache_dtype is not supported with a tp mesh")
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= self.max_seq
         ) or (self.max_seq,)

@@ -61,17 +61,24 @@ class StageRuntime:
 
     def __init__(self, cfg: ModelConfig, spec: StageSpec, params: StageParams,
                  max_seq: int, sampling: SamplingParams = SamplingParams(),
-                 seed: int = 0, mesh=None):
+                 seed: int = 0, mesh=None, kv_cache_dtype=None):
         """``mesh``: a local tp mesh — this stage's layer range then runs
         with Megatron-sliced weights and a kv-head-sharded cache on this
         host's chips (pipeline across hosts x tensor parallelism within
         one, each worker choosing its own tp independently — the
-        activations on the wire stay replicated [b, s, H] either way)."""
+        activations on the wire stay replicated [b, s, H] either way).
+
+        ``kv_cache_dtype``: reduced-precision storage for this stage's
+        request cache slots (e.g. "float8_e4m3fn"), same insert-cast /
+        read-upcast contract as InferenceEngine's — each pipeline stage
+        halves its own cache bytes independently."""
         self.cfg = cfg
         self.spec = spec
         self.max_seq = max_seq
         self.sampling = sampling
         self.mesh = mesh
+        self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
+                               if kv_cache_dtype else None)
         self._rng_base = jax.random.PRNGKey(seed)
         self.caches: Dict[int, KVCache] = {}
 
@@ -103,7 +110,8 @@ class StageRuntime:
         cache = self.caches.get(rid)
         if cache is None:
             cache = KVCache.create(self.cfg, self.spec.num_layers, batch,
-                                   self.max_seq)
+                                   self.max_seq,
+                                   dtype=self.kv_cache_dtype)
             if self._cache_sharding is not None:
                 cache = jax.device_put(cache, self._cache_sharding)
             self.caches[rid] = cache

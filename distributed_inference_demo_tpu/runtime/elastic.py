@@ -73,10 +73,11 @@ class ElasticStageRuntime(StageRuntime):
     def __init__(self, cfg: ModelConfig, spec: StageSpec,
                  full_params: StageParams, max_seq: int,
                  sampling: SamplingParams = SamplingParams(),
-                 seed: int = 0, mesh=None):
+                 seed: int = 0, mesh=None, kv_cache_dtype=None):
         self.full_params = full_params
         super().__init__(cfg, spec, slice_stage(full_params, cfg, spec),
-                         max_seq, sampling, seed, mesh=mesh)
+                         max_seq, sampling, seed, mesh=mesh,
+                         kv_cache_dtype=kv_cache_dtype)
         self._seed = seed
 
     def reassign(self, spec: StageSpec) -> None:
@@ -91,7 +92,8 @@ class ElasticStageRuntime(StageRuntime):
         StageRuntime.__init__(self, self.cfg, spec,
                               slice_stage(self.full_params, self.cfg, spec),
                               self.max_seq, self.sampling, self._seed,
-                              mesh=self.mesh)
+                              mesh=self.mesh,
+                              kv_cache_dtype=self.kv_cache_dtype)
 
 
 def _spec_payload(spec: StageSpec) -> dict:

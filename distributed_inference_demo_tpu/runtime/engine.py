@@ -129,9 +129,12 @@ class InferenceEngine:
         self.mesh = mesh
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         from ..parallel.tensor import resolve_tp_attn_backend
-        if tp > 1 and self.kv_cache_dtype is not None:
-            raise ValueError(
-                "kv_cache_dtype is not supported with a tp mesh")
+        # kv_cache_dtype composes with a tp mesh: the insert cast
+        # (update_kv_cache) and the read upcast (ops.attention) both run
+        # INSIDE the shard on its local kv-head planes, and the cache
+        # sharding specs are dtype-agnostic — tp just forces the jnp
+        # attention path, which is what reduced-precision caches use
+        # anyway (parity pinned by tests/test_engine.py)
         attn_backend = resolve_tp_attn_backend(tp, attn_backend)
 
         if self.kv_cache_dtype is not None:

@@ -41,7 +41,9 @@ def build_worker(args):
     from ..parallel.mesh import local_tp_mesh
     runtime = StageRuntime(cfg, spec, params, max_seq=args.max_seq,
                            sampling=sampling, seed=args.seed,
-                           mesh=local_tp_mesh(getattr(args, "tp", 1)))
+                           mesh=local_tp_mesh(getattr(args, "tp", 1)),
+                           kv_cache_dtype=getattr(args, "kv_cache_dtype",
+                                                  "") or None)
 
     transport = ZmqTransport(args.device_id, bind_host=args.bind_host,
                              port=args.port)
@@ -80,6 +82,9 @@ def main(argv=None) -> int:
     ap.add_argument("--temperature", type=float, default=0.7)
     ap.add_argument("--top-k", type=int, default=7)
     ap.add_argument("--step-timeout", type=float, default=120.0)
+    ap.add_argument("--kv-cache-dtype", default="",
+                    help="reduced-precision KV cache storage for this "
+                         "stage, e.g. float8_e4m3fn")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over this host's first N "
                          "local devices (pipeline x tp)")

@@ -366,10 +366,20 @@ def test_fp8_kv_cache(params):
         # => greedy parity holds for fp8 exactly as it does for f32
         want = fp8_oracle.generate(np.asarray([prompt]), 10).tokens[0]
         np.testing.assert_array_equal(got, want)
+    # fp8 composes with tp: per-shard insert cast + read upcast => the
+    # tp=2 slot engine matches the single-device fp8 oracle bit-exactly
+    from distributed_inference_demo_tpu.runtime.engine import (
+        shard_engine_params)
     mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
-    with pytest.raises(ValueError, match="tp mesh"):
-        ContinuousBatchingEngine(CFG, params, max_seq=96, mesh=mesh,
-                                 kv_cache_dtype="float8_e4m3fn")
+    sharded = shard_engine_params(params, CFG, mesh)
+    with ContinuousBatchingEngine(CFG, sharded, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  mesh=mesh,
+                                  kv_cache_dtype="float8_e4m3fn") as eng:
+        prompt = [3, 14, 15, 92]
+        got = eng.submit(prompt, 10).wait(timeout=300)
+        want = fp8_oracle.generate(np.asarray([prompt]), 10).tokens[0]
+        np.testing.assert_array_equal(got, want)
 
 
 def test_submit_rejects_nonpositive_max_new(params):
@@ -504,3 +514,22 @@ def test_spec_draft_vocab_mismatch_rejected(params):
     with pytest.raises(ValueError, match="vocab"):
         ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                  draft_cfg=bad, draft_params=params)
+
+
+def test_spec_sampled_self_draft_accepts_everything(params):
+    """Temperature sampling through the slot-loop speculative path with
+    draft == target: q == p exactly, so the accept rule (u*q_d < p_d)
+    accepts every proposal — exercises the non-greedy q_logits alignment
+    and RNG plumbing end-to-end (a row/column misalignment would show as
+    acceptance < 1)."""
+    samp = SamplingParams(temperature=0.9, top_k=0)
+    with ContinuousBatchingEngine(
+            CFG, params, max_seq=96, max_batch=2, sampling=samp,
+            prompt_buckets=(16,), draft_cfg=CFG, draft_params=params,
+            num_draft=4) as eng:
+        a = eng.submit([3, 1, 4], 16).wait(timeout=300)
+        b = eng.submit([5, 6], 12).wait(timeout=300)
+        assert a.shape == (16,) and b.shape == (12,)
+        for t in (a, b):
+            assert (t >= 0).all() and (t < CFG.vocab_size).all()
+        assert eng.stats()["speculative"]["acceptance_rate"] == 1.0

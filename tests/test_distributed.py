@@ -229,3 +229,34 @@ def test_two_process_pipeline_worker_tp(tmp_path):
         if proc.poll() is None:
             proc.kill()
         header_transport.close()
+
+
+def test_pipeline_fp8_kv_cache_matches_fp8_engine():
+    """--chain --kv-cache-dtype: every stage stores its own layers' K/V
+    at fp8 with the engine's insert-cast/read-upcast contract, so the
+    pipeline must match the single fp8 engine bit-exactly."""
+    from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+    cfg = get_model_config("llama-test")
+    full = init_full_params(jax.random.PRNGKey(0), cfg)
+    oracle = InferenceEngine(cfg, full, max_seq=128, sampling=GREEDY,
+                             kv_cache_dtype="float8_e4m3fn")
+    want = oracle.generate(PROMPT, 12).tokens
+
+    specs = split_layer_ranges(cfg.num_layers, 2)
+    net = LoopbackNetwork()
+    transports = [LoopbackTransport(d, net) for d in ("s0", "s1")]
+    header = PipelineHeader(
+        StageRuntime(cfg, specs[0], slice_stage(full, cfg, specs[0]),
+                     128, GREEDY, kv_cache_dtype="float8_e4m3fn"),
+        transports[0], next_id="s1", step_timeout=60)
+    worker = PipelineWorker(
+        StageRuntime(cfg, specs[1], slice_stage(full, cfg, specs[1]),
+                     128, GREEDY, kv_cache_dtype="float8_e4m3fn"),
+        transports[1], next_id=None, header_id="s0", step_timeout=60)
+    t = threading.Thread(target=worker.serve_forever, daemon=True)
+    t.start()
+    got = header.generate(PROMPT, 12)
+    header.shutdown_pipeline()
+    t.join(timeout=30)
+    np.testing.assert_array_equal(got, want)

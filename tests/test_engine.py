@@ -117,12 +117,32 @@ def test_tp_mesh_validation(engine):
     from distributed_inference_demo_tpu.parallel import MeshConfig, make_mesh
 
     mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
-    with pytest.raises(ValueError, match="kv_cache_dtype"):
-        InferenceEngine(engine.cfg, engine.params, max_seq=64, mesh=mesh,
-                        kv_cache_dtype="float8_e4m3fn")
     with pytest.raises(ValueError, match="incompatible"):
         InferenceEngine(engine.cfg, engine.params, max_seq=64, mesh=mesh,
                         attn_backend="flash")
+
+
+def test_fp8_kv_cache_under_tp_mesh(engine):
+    """kv_cache_dtype composes with a tp mesh: the insert cast and read
+    upcast run inside the shard, so tp-sharded fp8 decode must equal
+    single-device fp8 decode bit-exactly."""
+    import jax.numpy as jnp
+    from distributed_inference_demo_tpu.parallel import MeshConfig, make_mesh
+    from distributed_inference_demo_tpu.runtime.engine import (
+        shard_engine_params)
+
+    single = InferenceEngine(engine.cfg, engine.params, max_seq=64,
+                             sampling=SamplingParams(greedy=True),
+                             kv_cache_dtype="float8_e4m3fn")
+    mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    params = shard_engine_params(engine.params, engine.cfg, mesh)
+    tp_fp8 = InferenceEngine(engine.cfg, params, max_seq=64,
+                             sampling=SamplingParams(greedy=True),
+                             kv_cache_dtype="float8_e4m3fn", mesh=mesh)
+    assert tp_fp8.new_cache(2).keys.dtype == jnp.float8_e4m3fn
+    prompt = np.asarray([[3, 14, 15, 92], [7, 6, 5, 4]])
+    np.testing.assert_array_equal(single.generate(prompt, 10).tokens,
+                                  tp_fp8.generate(prompt, 10).tokens)
 
 
 def test_logprobs(engine):
