@@ -29,7 +29,9 @@ Extra legs (each reported inside the same JSON object):
   repetitive prompt, vs plain decode;
 - ``batching``: continuous-batching aggregate throughput (24 requests
   into 8 slots) vs sequential plain batches, plus the automatic prefix
-  cache's hit/reuse counters on a shared-prefix workload.
+  cache's hit/reuse counters on a shared-prefix workload;
+- ``long_context``: 32k-token single-chip generation via chunked prefill
+  + flash attention (prefill and decode tok/s at full context).
 
 **Process isolation:** every leg runs in a fresh subprocess (`--leg` mode)
 with its own TPU context, so one leg's allocations or failure can never
@@ -314,6 +316,55 @@ def _leg_prefill_long(model: str) -> dict:
                 / point["jnp_tokens_per_sec"], 3)
         out["points"].append(point)
     return out
+
+
+def _leg_long_context(model: str) -> dict:
+    """Single-chip long-context generation at 32k tokens: chunked prefill
+    (ONE compiled 2048-token chunk shape regardless of prompt length,
+    bounding activation memory) + flash attention + KV-cached decode at
+    full context.  The sequence-parallel strategies (ring / Ulysses)
+    cover contexts beyond one chip and are certified by the multichip
+    dryrun's engine-parity checks; this leg is the real-hardware
+    long-context number (SURVEY §5.7 — absent in the reference, whose
+    max_length was 40)."""
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    ctx = int(os.environ.get("BENCH_LONG_CTX", "32768"))
+    new, chunk = 64, min(2048, ctx // 2)
+    plen = ctx - new
+    engine = InferenceEngine(cfg, params, max_seq=ctx,
+                             sampling=SamplingParams(greedy=True),
+                             prefill_chunk=chunk)
+    prompt = (np.arange(plen) % 1000).astype(np.int32)[None, :]
+
+    import jax.numpy as jnp
+
+    engine.generate(prompt, new, seed=0)            # compile warmup
+    cache = engine.new_cache(1)
+    t0 = time.perf_counter()
+    logits, cache = engine._run_prefill(jnp.asarray(prompt), cache)
+    np.asarray(logits)                               # hard fence
+    prefill_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    toks, _, _ = engine._decode(engine.params, logits, cache,
+                                jax.random.PRNGKey(0),
+                                engine._eos_scalar(), new, False)
+    np.asarray(toks)
+    decode_s = time.perf_counter() - t0
+    return {
+        "model": model, "batch": 1, "context": ctx, "prompt_len": plen,
+        "new_tokens": new, "prefill_chunk": chunk,
+        "attn_backend": engine.attn_backend,
+        "prefill_tokens_per_sec": round(plen / prefill_s, 1),
+        "decode_tokens_per_sec": round(new / decode_s, 2),
+    }
 
 
 def _leg_pipeline(model: str, batch: int, prompt_len: int,
@@ -768,6 +819,8 @@ def run_leg(name: str, p: dict) -> dict:
                                         min(new_tokens, 8))
         elif name == "prefill_long":
             out = _leg_prefill_long(model)
+        elif name == "long_context":
+            out = _leg_long_context(model)
         elif name == "roofline_probe":
             out = _leg_roofline_probe()
         else:
@@ -852,7 +905,7 @@ def main() -> None:
     # not new
     legs = ["roofline_probe", "headline", "headline_int8",
             "speculative", "prompt_lookup", "batching",
-            "planner_pipeline", "sweep",
+            "planner_pipeline", "long_context", "sweep",
             "flagship_int8", "flagship_bf16", "pipeline", "prefill_long"]
     for skip_var, leg_names in (
             ("BENCH_SKIP_FLAGSHIP", ["flagship_int8", "flagship_bf16"]),
@@ -860,6 +913,7 @@ def main() -> None:
             ("BENCH_SKIP_SWEEP", ["sweep"]),
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
                                     "batching"]),
+            ("BENCH_SKIP_LONGCTX", ["long_context"]),
             ("BENCH_SKIP_PREFILL", ["prefill_long"])):
         if os.environ.get(skip_var, "") == "1":
             legs = [l for l in legs if l not in leg_names]

@@ -322,7 +322,10 @@ def cmd_server(args) -> int:
     from .server_app import ServerApp
 
     if getattr(args, "kv_cache_dtype", ""):
-        # pipeline StageRuntime caches don't take a dtype override yet
+        # StageRuntime takes the dtype override, but ServerApp doesn't
+        # forward it to the workers it configures over the control plane
+        # yet — reject rather than serving a silently mixed-precision
+        # pipeline
         print("--kv-cache-dtype is not supported by the server app",
               file=sys.stderr)
         return 1
@@ -606,6 +609,18 @@ def cmd_generate(args) -> int:
         print("choose one of --draft-model / --prompt-lookup",
               file=sys.stderr)
         return 1
+    if getattr(args, "sp", 1) > 1:
+        # long-context sequence parallelism: the prompt is sharded over
+        # the sp mesh axis, prefill runs ring attention (or Ulysses
+        # all-to-all), and the KV cache stays sequence-sharded for the
+        # whole generation (parallel/sequence.py, parallel/ulysses.py)
+        if (getattr(args, "draft_model", "")
+                or getattr(args, "prompt_lookup", False)
+                or getattr(args, "tp", 1) > 1):
+            print("--sp is exclusive with --draft-model/--prompt-lookup/"
+                  "--tp", file=sys.stderr)
+            return 1
+        return _generate_sp(args, ids, tokenizer)
     if getattr(args, "prompt_lookup", False):
         # draft-free speculation: n-gram lookup over the context proposes,
         # the target verifies (runtime/prompt_lookup.py)
@@ -630,6 +645,67 @@ def cmd_generate(args) -> int:
         out["speculative"] = stats_json(stats, args.num_draft)
     if tokenizer is not None:
         out["text"] = [tokenizer.decode(r) for r in res.tokens.tolist()]
+    print(json.dumps(out))
+    return 0
+
+
+def _generate_sp(args, ids, tokenizer) -> int:
+    """``generate --sp N``: one-shot long-context generation over a local
+    sequence-parallel mesh.  ``--sp-strategy ring`` shards the KV cache by
+    sequence (ring-attention prefill, log-sum-exp decode reduction);
+    ``ulysses`` re-shards by head via all_to_all.  The prompt length must
+    be a multiple of N (sharding is by contiguous chunk; pad or trim
+    client-side — silent padding would change what the model attends)."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from .models.registry import get_model_config
+    from .parallel.mesh import local_sp_mesh
+
+    unsupported = [flag for flag, on in [
+        ("--eos-id", getattr(args, "eos_id", None) is not None),
+        ("--kv-cache-dtype", bool(getattr(args, "kv_cache_dtype", ""))),
+        ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
+        ("--attn-backend", args.attn_backend != "auto")] if on]
+    if unsupported:
+        # the sp generate fns own their attention/cache strategy and have
+        # no eos/dtype/chunk plumbing — reject loudly rather than
+        # silently ignoring the flags
+        print(f"{'/'.join(unsupported)} not supported with --sp",
+              file=sys.stderr)
+        return 1
+    cfg = get_model_config(args.model)
+    mesh = local_sp_mesh(args.sp)   # call site guards args.sp > 1
+    if ids.shape[1] % args.sp:
+        print(f"prompt length {ids.shape[1]} not divisible by "
+              f"--sp {args.sp} (shard-by-contiguous-chunk; pad or trim "
+              "client-side)", file=sys.stderr)
+        return 1
+    sampling = _sampling_from_args(args)
+    if args.sp_strategy == "ring":
+        from .parallel.sequence import make_sp_generate_fn
+        gen = make_sp_generate_fn(cfg, mesh, max_seq=args.max_seq,
+                                  num_new_tokens=args.max_new_tokens,
+                                  sampling=sampling)
+    else:
+        from .parallel.ulysses import make_ulysses_generate_fn
+        gen = make_ulysses_generate_fn(cfg, mesh, max_seq=args.max_seq,
+                                       num_new_tokens=args.max_new_tokens,
+                                       sampling=sampling)
+    params = _load_full_params(args, cfg)
+    t0 = _time.perf_counter()
+    with mesh:
+        toks = np.asarray(gen(params, np.asarray(ids),
+                              jax.random.PRNGKey(args.seed)))
+    dt = _time.perf_counter() - t0
+    # like the plain generate path, the one-shot timing includes compile
+    out = {"tokens": toks.tolist(),
+           "tokens_per_second": toks.size / dt,
+           "sp": args.sp, "sp_strategy": args.sp_strategy}
+    if tokenizer is not None:
+        out["text"] = [tokenizer.decode(r) for r in toks.tolist()]
     print(json.dumps(out))
     return 0
 
@@ -867,6 +943,18 @@ def main(argv=None) -> int:
     _add_engine_args(g)
     g.add_argument("--prompt-ids", default="")
     g.add_argument("--prompt", default=None)
+    g.add_argument("--sp", type=int, default=1,
+                   help="sequence/context parallelism over the first N "
+                        "local devices for LONG prompts: the prompt "
+                        "shards by contiguous chunk, prefill runs ring "
+                        "attention (or Ulysses), the KV cache stays "
+                        "sharded for the whole generation; prompt length "
+                        "must divide by N")
+    g.add_argument("--sp-strategy", default="ring",
+                   choices=["ring", "ulysses"],
+                   help="ring = sequence-sharded cache + ring-attention "
+                        "prefill; ulysses = all_to_all to head-sharded "
+                        "attention (needs heads divisible by N)")
     _add_draft_args(g)
     g.set_defaults(fn=cmd_generate)
 

@@ -59,6 +59,15 @@ def local_tp_mesh(tp: int):
     return make_mesh(MeshConfig(tp=tp), jax.devices()[:tp])
 
 
+def local_sp_mesh(sp: int):
+    """sp (sequence/context-parallel) mesh over the first ``sp`` local
+    devices, or None for sp <= 1 — the CLI's long-context mesh rule
+    (``generate --sp``), mirroring :func:`local_tp_mesh`."""
+    if sp <= 1:
+        return None
+    return make_mesh(MeshConfig(sp=sp), jax.devices()[:sp])
+
+
 def init_multihost(coordinator: str, num_processes: int, process_id: int,
                    local_device_count: Optional[int] = None) -> None:
     """Join this process to a multi-host JAX runtime (DCN control plane).

@@ -415,3 +415,31 @@ def test_http_batching_with_draft(http_server):
     finally:
         server.shutdown()
         backend.close()
+
+
+def test_cli_generate_sp_matches_plain():
+    """generate --sp 2 (ring AND ulysses) on the virtual mesh must equal
+    plain greedy decode; non-divisible prompts and mode mixing are
+    rejected with one-line errors."""
+    ids = ",".join(str(i % 250) for i in range(16))
+    argv = ["generate", "--model", "llama-test", "--prompt-ids", ids,
+            "--max-new-tokens", "6", "--greedy", "--max-seq", "32"]
+    rc, plain = _run_cli(argv + ["--attn-backend", "jnp"])
+    assert rc == 0
+    for strategy in ("ring", "ulysses"):
+        rc, out = _run_cli(argv + ["--sp", "2", "--sp-strategy", strategy])
+        assert rc == 0
+        assert json.loads(out)["tokens"] == json.loads(plain)["tokens"]
+    # flags the sp paths have no plumbing for are rejected loudly
+    for extra in (["--eos-id", "7"], ["--kv-cache-dtype", "float8_e4m3fn"],
+                  ["--attn-backend", "jnp"]):
+        rc, _ = _run_cli(argv + ["--sp", "2"] + extra)
+        assert rc == 1
+    # 15 tokens don't shard over sp=2
+    bad = ",".join(str(i % 250) for i in range(15))
+    rc, _ = _run_cli(["generate", "--model", "llama-test", "--prompt-ids",
+                      bad, "--max-new-tokens", "4", "--greedy",
+                      "--max-seq", "32", "--sp", "2"])
+    assert rc == 1
+    rc, _ = _run_cli(argv + ["--sp", "2", "--prompt-lookup"])
+    assert rc == 1
