@@ -678,11 +678,9 @@ def _generate_sp(args, ids, tokenizer) -> int:
         return 1
     cfg = get_model_config(args.model)
     mesh = local_sp_mesh(args.sp)   # call site guards args.sp > 1
-    if ids.shape[1] % args.sp:
-        print(f"prompt length {ids.shape[1]} not divisible by "
-              f"--sp {args.sp} (shard-by-contiguous-chunk; pad or trim "
-              "client-side)", file=sys.stderr)
-        return 1
+    # prompt divisibility is validated by the generate fns' checked
+    # wrapper (parallel/sequence.py); its ValueError renders as the
+    # CLI's one-line error like every other config error
     sampling = _sampling_from_args(args)
     if args.sp_strategy == "ring":
         from .parallel.sequence import make_sp_generate_fn
