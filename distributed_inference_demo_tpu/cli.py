@@ -676,11 +676,15 @@ def _generate_sp(args, ids, tokenizer) -> int:
         print(f"{'/'.join(unsupported)} not supported with --sp",
               file=sys.stderr)
         return 1
+    from .parallel.sequence import validate_sp_prompt
+
     cfg = get_model_config(args.model)
     mesh = local_sp_mesh(args.sp)   # call site guards args.sp > 1
-    # prompt divisibility is validated by the generate fns' checked
-    # wrapper (parallel/sequence.py); its ValueError renders as the
-    # CLI's one-line error like every other config error
+    # the generate fns re-validate at call time; running the shared rule
+    # HERE fails fast before a multi-GB checkpoint load (its ValueError
+    # renders as the CLI's one-line error like every other config error)
+    validate_sp_prompt(ids.shape[1], args.sp, args.max_seq,
+                       args.max_new_tokens)
     sampling = _sampling_from_args(args)
     if args.sp_strategy == "ring":
         from .parallel.sequence import make_sp_generate_fn

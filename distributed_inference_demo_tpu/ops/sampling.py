@@ -26,6 +26,28 @@ class SamplingParams:
     greedy: bool = False
 
 
+def kth_largest(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """The k-th largest value of [..., vocab] logits (counting
+    duplicates, exactly ``lax.top_k(x, k)[0][..., -1]``), as k
+    argmax-and-mask passes instead of a sort.
+
+    Decode's top-k filter needs only this one VALUE per row, but
+    ``lax.top_k`` pays a full-vocab sort per step — per-row VPU work that
+    grows with batch and shows up as the large-batch roofline erosion in
+    the bench sweep (tools/decode_profile_probe.py measures both paths
+    on-chip).  k-1 (argmax, mask-one-element) rounds plus a final max are
+    O(k*V) elementwise/reduce work with no sort; each round masks only
+    the FIRST occurrence of the current max (argmax's tie rule), so
+    duplicate logit values count toward k exactly as in top_k.  For
+    large k the unrolled rounds lose to the sort — callers gate on k."""
+    x = logits
+    iota = jnp.arange(x.shape[-1])
+    for _ in range(k - 1):
+        idx = jnp.argmax(x, axis=-1, keepdims=True)
+        x = jnp.where(iota == idx, -jnp.inf, x)
+    return jnp.max(x, axis=-1, keepdims=True)
+
+
 def filtered_logits(logits: jnp.ndarray,
                     params: SamplingParams) -> jnp.ndarray:
     """Apply temperature / top-k / top-p to [..., vocab] logits.
@@ -42,7 +64,11 @@ def filtered_logits(logits: jnp.ndarray,
         logits = logits / jnp.maximum(params.temperature, 1e-6)
 
     if params.top_k > 0 and params.top_k < logits.shape[-1]:
-        kth = jax.lax.top_k(logits, params.top_k)[0][..., -1:]
+        # small k (the serving default is 7): iterative exact kth value,
+        # no full-vocab sort; large k: lax.top_k's sort wins
+        kth = (kth_largest(logits, params.top_k)
+               if params.top_k <= 32 else
+               jax.lax.top_k(logits, params.top_k)[0][..., -1:])
         logits = jnp.where(logits < kth, -jnp.inf, logits)
 
     if params.top_p < 1.0:

@@ -89,16 +89,24 @@ def _wrap_sp_body(body, mesh: Mesh, sp: int, max_seq: int,
         return sharded(params, prompt_ids, rng)
 
     def checked(params, prompt_ids, rng):
-        b, plen = prompt_ids.shape
-        if plen % sp:
-            raise ValueError(
-                f"prompt_len={plen} not divisible by sp={sp}; pad first")
-        if plen + num_new_tokens > max_seq:
-            raise ValueError(
-                f"prompt {plen} + new {num_new_tokens} > max_seq {max_seq}")
+        validate_sp_prompt(prompt_ids.shape[1], sp, max_seq,
+                           num_new_tokens)
         return fn(params, prompt_ids, rng)
 
     return checked
+
+
+def validate_sp_prompt(plen: int, sp: int, max_seq: int,
+                       num_new_tokens: int) -> None:
+    """The sp prompt-shape rule, owned here and shared by the generate
+    fns' call-time check and any caller that wants to FAIL FAST before
+    paying a checkpoint load (cli ``generate --sp``)."""
+    if plen % sp:
+        raise ValueError(
+            f"prompt_len={plen} not divisible by sp={sp}; pad first")
+    if plen + num_new_tokens > max_seq:
+        raise ValueError(
+            f"prompt {plen} + new {num_new_tokens} > max_seq {max_seq}")
 
 
 def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
