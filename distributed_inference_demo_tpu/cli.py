@@ -321,14 +321,6 @@ def cmd_server(args) -> int:
     logging.basicConfig(level=logging.INFO)
     from .server_app import ServerApp
 
-    if getattr(args, "kv_cache_dtype", ""):
-        # StageRuntime takes the dtype override, but ServerApp doesn't
-        # forward it to the workers it configures over the control plane
-        # yet — reject rather than serving a silently mixed-precision
-        # pipeline
-        print("--kv-cache-dtype is not supported by the server app",
-              file=sys.stderr)
-        return 1
     if getattr(args, "tp", 1) > 1:
         print("--tp is not supported by the server app (the planner "
               "assigns whole layer ranges per worker)", file=sys.stderr)
@@ -343,7 +335,10 @@ def cmd_server(args) -> int:
         http_port=args.http_port, collect_window=args.collect_window,
         collect_timeout=args.collect_timeout,
         monitor_timeout=args.monitor_timeout,
-        step_timeout=args.step_timeout)
+        step_timeout=args.step_timeout,
+        # broadcast in the OPEN RunConfig, so every auto worker's stage
+        # cache uses it too — no mixed-precision pipeline
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", "") or None)
     return app.run()
 
 

@@ -77,6 +77,9 @@ class RunConfig:
     skip_artifact_transfer: bool = False
     reload_sample_id: Optional[int] = None  # drain/resume (server.py:1011)
     plan_version: int = 0
+    # reduced-precision KV cache storage on every stage (e.g.
+    # "float8_e4m3fn"); None = the model dtype
+    kv_cache_dtype: Optional[str] = None
 
     def to_payload(self) -> dict:
         return {
@@ -93,6 +96,7 @@ class RunConfig:
             "skip_artifact_transfer": self.skip_artifact_transfer,
             "reload_sample_id": self.reload_sample_id,
             "plan_version": self.plan_version,
+            "kv_cache_dtype": self.kv_cache_dtype,
         }
 
     @staticmethod
@@ -109,6 +113,7 @@ class RunConfig:
             skip_artifact_transfer=p["skip_artifact_transfer"],
             reload_sample_id=p.get("reload_sample_id"),
             plan_version=p.get("plan_version", 0),
+            kv_cache_dtype=p.get("kv_cache_dtype"),
         )
 
 

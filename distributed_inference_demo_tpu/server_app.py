@@ -68,7 +68,8 @@ class ServerApp:
                  collect_timeout: float = 120.0,
                  monitor_timeout: float = 60.0,
                  step_timeout: float = 120.0,
-                 device_id: str = "header"):
+                 device_id: str = "header",
+                 kv_cache_dtype: Optional[str] = None):
         self.model = model
         self.num_workers = num_workers
         self.checkpoint = checkpoint
@@ -86,6 +87,7 @@ class ServerApp:
         self.monitor_timeout = monitor_timeout
         self.step_timeout = step_timeout
         self.device_id = device_id
+        self.kv_cache_dtype = kv_cache_dtype
 
         self.ports: Optional[ServerPorts] = None
         self.plan = None
@@ -221,7 +223,8 @@ class ServerApp:
             mesh_axes={}, sampling=(
                 {"greedy": 1.0} if self.greedy else
                 {"temperature": self.temperature, "top_k": self.top_k}),
-            plan_version=self.plan.plan_version)
+            plan_version=self.plan.plan_version,
+            kv_cache_dtype=self.kv_cache_dtype)
         lifecycle = LifecycleServer(config, artifact_provider,
                                     bind_host=self.bind_host)
         lifecycle.expected = set(self.plan.device_ids) - {self.device_id}
@@ -238,7 +241,8 @@ class ServerApp:
         runtime = StageRuntime(
             cfg, my_spec,
             maybe_quantize(slice_stage(full, cfg, my_spec), cfg),
-            self.max_seq, self._sampling())
+            self.max_seq, self._sampling(),
+            kv_cache_dtype=self.kv_cache_dtype)
         next_idx = self.plan.device_ids.index(self.device_id) + 1
         next_id = self.plan.device_ids[next_idx]
         transport.connect(next_id, addresses[next_id])
@@ -351,7 +355,8 @@ def run_auto_worker(registry: str, device_id: str,
                 SamplingParams(temperature=s.get("temperature", 0.7),
                                top_k=int(s.get("top_k", 7))))
     runtime = StageRuntime(cfg, spec, params, max_seq=config.max_seq,
-                           sampling=sampling)
+                           sampling=sampling,
+                           kv_cache_dtype=config.kv_cache_dtype)
 
     header_id = ids[0]
     transport.connect(header_id, config.device_graph[0])

@@ -175,7 +175,8 @@ def run_config(n=2):
         device_graph=[f"127.0.0.1:{9100+i}" for i in range(n)],
         device_ids=ids,
         stage_ranges={ids[0]: [0, 2], ids[-1]: [2, 4]},
-        mesh_axes={"dp": 1, "tp": 1})
+        mesh_axes={"dp": 1, "tp": 1},
+        kv_cache_dtype="float8_e4m3fn")
 
 
 def test_runconfig_roundtrip():

@@ -55,11 +55,16 @@ def _read_until(proc, prefix, timeout=180.0, sink=None):
 
 
 @pytest.mark.slow
-def test_server_with_bare_workers_end_to_end(tmp_path):
+@pytest.mark.parametrize("kv_dtype", ["", "float8_e4m3fn"])
+def test_server_with_bare_workers_end_to_end(tmp_path, kv_dtype):
+    """The composed server e2e; the fp8 variant proves --kv-cache-dtype
+    rides the OPEN RunConfig to every auto worker's stage cache (greedy
+    parity vs a ref engine with the SAME cache dtype)."""
     cfg = get_model_config(MODEL)
     ref_engine = InferenceEngine(
         cfg, init_full_params(jax.random.PRNGKey(SEED), cfg),
-        max_seq=64, sampling=SamplingParams(greedy=True))
+        max_seq=64, sampling=SamplingParams(greedy=True),
+        kv_cache_dtype=kv_dtype or None)
     want = ref_engine.generate(np.asarray(PROMPT, np.int32), 8).tokens
 
     env = _cpu_env()
@@ -68,7 +73,8 @@ def test_server_with_bare_workers_end_to_end(tmp_path):
          "--model", MODEL, "--num-workers", "2", "--max-seq", "64",
          "--max-new-tokens", "8", "--greedy", "--weights-seed", str(SEED),
          "--collect-timeout", "300", "--monitor-timeout", "300",
-         "--step-timeout", "300"],
+         "--step-timeout", "300"]
+        + (["--kv-cache-dtype", kv_dtype] if kv_dtype else []),
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
         text=True)
     workers = []
