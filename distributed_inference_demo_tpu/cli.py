@@ -176,10 +176,12 @@ def cmd_serve(args) -> int:
                                     getattr(args, "prompt_lookup", False)),
                                    ("--batch-slots",
                                     getattr(args, "batch_slots", 0))] if on]
-    # --batch-slots composes with --draft-model (speculative decoding
-    # inside the slot loop — the production serving shape); every other
-    # pairing stays an explicit error
-    if len(modes) > 1 and set(modes) != {"--batch-slots", "--draft-model"}:
+    # --batch-slots composes with --draft-model OR --prompt-lookup
+    # (speculative decoding inside the slot loop — the production serving
+    # shape); every other pairing stays an explicit error
+    if len(modes) > 1 and set(modes) not in (
+            {"--batch-slots", "--draft-model"},
+            {"--batch-slots", "--prompt-lookup"}):
         print(f"choose one serve mode, got {' + '.join(modes)}",
               file=sys.stderr)
         return 1
@@ -241,8 +243,39 @@ def cmd_serve(args) -> int:
               f"{[(s.layer_start, s.layer_end) for s in specs]}"
               + (f" header_kv_cache_dtype={kv_dtype}" if kv_dtype else ""),
               flush=True)
-    elif (getattr(args, "draft_model", "")
-          and not getattr(args, "batch_slots", 0)):
+    elif getattr(args, "batch_slots", 0):
+        from .models.registry import get_model_config
+        from .runtime.batching import ContinuousBatchingEngine
+
+        if getattr(args, "prefill_chunk", 0):
+            # the batching engine buckets prompts itself (prompt_buckets)
+            print("--prefill-chunk is not supported with --batch-slots "
+                  "(admission already buckets prompts)", file=sys.stderr)
+            return 1
+        cfg = get_model_config(args.model)
+        sampling = _sampling_from_args(args)
+        params, mesh = _load_params_for_mesh(args, cfg)
+        draft_cfg = draft_params = None
+        if getattr(args, "draft_model", ""):
+            # speculative decoding inside the slot loop
+            draft_cfg, draft_params = _load_draft_for_mesh(args, mesh)
+        pld = bool(getattr(args, "prompt_lookup", False))
+        backend = ContinuousBatchingEngine(
+            cfg, params, max_seq=args.max_seq,
+            max_batch=args.batch_slots, sampling=sampling, seed=args.seed,
+            prefix_cache_size=args.prefix_cache_size, mesh=mesh,
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
+            eos_id=getattr(args, "eos_id", None),
+            draft_cfg=draft_cfg, draft_params=draft_params,
+            num_draft=args.num_draft, prompt_lookup=pld)
+        print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
+              f"prefix_cache={args.prefix_cache_size} "
+              f"tp={getattr(args, 'tp', 1)}"
+              + (f" draft={args.draft_model} k={args.num_draft}"
+                 if draft_cfg is not None else "")
+              + (f" prompt_lookup k={args.num_draft}" if pld else ""),
+              flush=True)
+    elif getattr(args, "draft_model", ""):
         from .runtime.speculative import SpeculativeBackend
 
         engine = _build_spec_engine(args)
@@ -260,35 +293,6 @@ def cmd_serve(args) -> int:
         backend = SpeculativeBackend(engine)
         print(f"SERVE_PROMPT_LOOKUP {args.model} k={args.num_draft}",
               flush=True)
-    elif getattr(args, "batch_slots", 0):
-        from .models.registry import get_model_config
-        from .runtime.batching import ContinuousBatchingEngine
-
-        if getattr(args, "prefill_chunk", 0):
-            # the batching engine buckets prompts itself (prompt_buckets)
-            print("--prefill-chunk is not supported with --batch-slots "
-                  "(admission already buckets prompts)", file=sys.stderr)
-            return 1
-        cfg = get_model_config(args.model)
-        sampling = _sampling_from_args(args)
-        params, mesh = _load_params_for_mesh(args, cfg)
-        draft_cfg = draft_params = None
-        if getattr(args, "draft_model", ""):
-            # speculative decoding inside the slot loop
-            draft_cfg, draft_params = _load_draft_for_mesh(args, mesh)
-        backend = ContinuousBatchingEngine(
-            cfg, params, max_seq=args.max_seq,
-            max_batch=args.batch_slots, sampling=sampling, seed=args.seed,
-            prefix_cache_size=args.prefix_cache_size, mesh=mesh,
-            kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
-            eos_id=getattr(args, "eos_id", None),
-            draft_cfg=draft_cfg, draft_params=draft_params,
-            num_draft=args.num_draft)
-        print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
-              f"prefix_cache={args.prefix_cache_size} "
-              f"tp={getattr(args, 'tp', 1)}"
-              + (f" draft={args.draft_model} k={args.num_draft}"
-                 if draft_cfg is not None else ""), flush=True)
     else:
         cfg, engine = _build_engine(args)
         backend = engine

@@ -120,7 +120,8 @@ class ContinuousBatchingEngine:
                  mesh=None, kv_cache_dtype=None,
                  draft_cfg: Optional[ModelConfig] = None,
                  draft_params: Optional[StageParams] = None,
-                 num_draft: int = 4):
+                 num_draft: int = 4,
+                 prompt_lookup: bool = False):
         """``prefix_cache_size``: LRU entries of full-prompt KV kept on
         device for automatic prefix reuse (0 disables).  A new prompt
         sharing >= ``min_prefix_len`` leading tokens with a cached one
@@ -148,7 +149,13 @@ class ContinuousBatchingEngine:
         stays bit-identical to the non-draft engine (pinned by tests);
         admission additionally prefills the prompt into a draft-side slot
         row (full prompt — the prefix cache accelerates only the target
-        side)."""
+        side).
+
+        ``prompt_lookup``: draft-FREE speculation in the slot loop — the
+        proposer is an n-gram match over each slot's own token history
+        (prompt_lookup.ngram_propose), verified the same per-row way.
+        No second model, no second cache; exclusive with
+        ``draft_cfg``."""
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -158,6 +165,12 @@ class ContinuousBatchingEngine:
         self.mesh = mesh
         self.draft_cfg, self.draft_params = draft_cfg, draft_params
         self.num_draft = num_draft
+        self.prompt_lookup = prompt_lookup
+        if prompt_lookup and draft_cfg is not None:
+            raise ValueError(
+                "prompt_lookup and draft_cfg are exclusive proposers")
+        if prompt_lookup and num_draft < 1:
+            raise ValueError("num_draft must be >= 1")
         if (draft_cfg is None) != (draft_params is None):
             raise ValueError("draft_cfg and draft_params go together")
         if draft_cfg is not None:
@@ -252,10 +265,77 @@ class ContinuousBatchingEngine:
         self._step, self._prefill, self._admit = step, prefill, admit
         self._load_prefix, self._zero_row = load_prefix, zero_row
 
+        def verify_slots(params, cache, drafts, q_logits, lengths,
+                         last_tok, active, rng):
+            """Target-verify all slots' proposals in ONE [B, K+1]
+            forward + per-row accept + inactive-row masking — the verify
+            half shared by the draft-model and prompt-lookup step jits
+            (their host-side twin is _drain_spec_blocks)."""
+            K = drafts.shape[1]
+            verify_in = jnp.concatenate([last_tok[:, None], drafts],
+                                        axis=1)
+            pos = lengths[:, None] + jnp.arange(K + 1)[None, :]
+            t_logits, cache = fwd(params, verify_in, cache, pos, False)
+            rng, sub_u, sub_x = jax.random.split(rng, 3)
+            emitted, n, new_last = verify_emit_per_row(
+                t_logits, drafts, q_logits, samp_, sub_u, sub_x)
+            n = jnp.where(active, n, 0)
+            new_last = jnp.where(active, new_last, last_tok)
+            return cache, emitted, n, new_last, lengths + n
+
+        # ------------------------------------------------------------------
+        # draft-free speculative slot decoding (n-gram prompt lookup)
+        self._pld_step = None
+        if prompt_lookup:
+            from .prompt_lookup import ngram_propose
+            K = num_draft
+            # +K+2: emitted blocks write up to K+1 past each row's
+            # history length (same contiguous-coverage invariant as the
+            # cache slack below)
+            hcap = S + K + 2
+
+            @partial(jax.jit, donate_argnums=(1, 2, 3))
+            def pld_step(params, ck, cv, history, lengths, last_tok,
+                         active, rng):
+                """One prompt-lookup round over all slots: n-gram propose
+                per row, verify [B, K+1] in one forward, per-row accept,
+                append the emitted block to each active row's history."""
+                b = last_tok.shape[0]
+                cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
+                hist_len = lengths + 1     # history = prompt + emitted
+                drafts = ngram_propose(history, hist_len, K)
+                # one-hot proposer (q_logits=None), like PromptLookupEngine
+                cache, emitted, n, new_last, new_lengths = verify_slots(
+                    params, cache, drafts, None, lengths, last_tok,
+                    active, rng)
+                # append emitted at cols hist_len..hist_len+K per row;
+                # inactive rows are routed out of bounds (scatter drops
+                # OOB updates) so a freed slot's stale lengths can't
+                # corrupt its row before re-admission rewrites it
+                rows = jnp.arange(b)[:, None]
+                cols = jnp.where(active[:, None],
+                                 hist_len[:, None] + jnp.arange(K + 1),
+                                 hcap)
+                history = history.at[rows, cols].set(emitted)
+                return (cache.keys, cache.values, history, new_lengths,
+                        new_last, emitted, n)
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def admit_h(history, row_ids, slot, plen, tok):
+                """Seed a slot's history row: prompt + the first sampled
+                token (pad-tail beyond it is masked by hist_len until
+                overwritten)."""
+                history = jax.lax.dynamic_update_slice(
+                    history, row_ids, (slot, jnp.zeros((), jnp.int32)))
+                return history.at[slot, plen].set(tok)
+
+            self._pld_step, self._admit_h = pld_step, admit_h
+            self._history = jnp.zeros((B, hcap), jnp.int32)
+
         # ------------------------------------------------------------------
         # speculative slot decoding (draft model inside the slot loop)
         self._spec_step = None
-        slack = 0
+        slack = num_draft + 1 if prompt_lookup else 0
         if draft_cfg is not None:
             # a verify round writes K+1 positions past a row's length
             # before the host learns how many were kept; rows advance
@@ -305,23 +385,12 @@ class ContinuousBatchingEngine:
                 drafts = drafts[:K].T                        # [b, K]
                 q_logits = jnp.swapaxes(q_logits[:K], 0, 1)  # [b, K, V]
 
-                verify_in = jnp.concatenate([last_tok[:, None], drafts],
-                                            axis=1)
-                pos = lengths[:, None] + jnp.arange(K + 1)[None, :]
-                t_logits, cache = fwd(params, verify_in, cache, pos,
-                                      False)                 # [b, K+1, V]
-
-                rng, sub_u, sub_x = jax.random.split(rng, 3)
-                emitted, n, new_last = verify_emit_per_row(
-                    t_logits, drafts,
-                    None if samp_.greedy else q_logits, samp_,
-                    sub_u, sub_x)
-
-                n = jnp.where(active, n, 0)
-                new_last = jnp.where(active, new_last, last_tok)
-                lengths = lengths + n
+                cache, emitted, n, new_last, new_lengths = verify_slots(
+                    params, cache, drafts,
+                    None if samp_.greedy else q_logits, lengths,
+                    last_tok, active, rng)
                 return (cache.keys, cache.values, dcache.keys,
-                        dcache.values, lengths, new_last, emitted, n)
+                        dcache.values, new_lengths, new_last, emitted, n)
 
             @partial(jax.jit, donate_argnums=(2, 3))
             def dprefill(dparams, ids, row_k, row_v):
@@ -477,9 +546,11 @@ class ContinuousBatchingEngine:
         """Scheduler counters for the HTTP ``/stats`` surface."""
         out = {"slots": self.max_batch, "steps": self._step_count,
                "prefix_cache": dict(self.prefix_stats)}
-        if self._spec_step is not None:
+        if self._spec_step is not None or self._pld_step is not None:
             s = self.spec_stats
             out["speculative"] = {
+                "proposer": ("prompt_lookup" if self._pld_step is not None
+                             else "draft"),
                 "num_draft": self.num_draft, "rounds": s["rounds"],
                 "acceptance_rate": (round(s["accepted"] / s["drafted"], 4)
                                     if s["drafted"] else None)}
@@ -584,8 +655,32 @@ class ContinuousBatchingEngine:
                 self.draft_params, jnp.asarray(dpad), *self._zero_row_d())
             self._dck, self._dcv = self._admit_d(
                 self._dck, self._dcv, drow_k, drow_v, jnp.int32(slot))
+        if self._pld_step is not None:
+            # seed the slot's n-gram history: full prompt + first token
+            hpad = np.zeros((1, self._bucket(plen)), np.int32)
+            hpad[0, :plen] = req.prompt
+            self._history = self._admit_h(
+                self._history, jnp.asarray(hpad), jnp.int32(slot),
+                jnp.int32(plen), tok.astype(jnp.int32))
         self._slots[slot] = req
         self._record_token(slot, req, int(tok))
+
+    def _drain_spec_blocks(self, em_np, ns_np, active_mask) -> None:
+        """Record one speculative round's per-row emitted blocks into the
+        slots' requests + acceptance stats — shared by the draft-model
+        and prompt-lookup step branches."""
+        self._step_count += 1
+        self.spec_stats["rounds"] += 1
+        self.spec_stats["drafted"] += (
+            self.num_draft * int(active_mask.sum()))
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            self.spec_stats["accepted"] += int(ns_np[i]) - 1
+            for j in range(int(ns_np[i])):
+                if self._slots[i] is None:
+                    break              # row hit max_new or eos mid-block
+                self._record_token(i, req, int(em_np[i, j]))
 
     def _record_token(self, slot: int, req: Request, tok: int):
         req.tokens.append(tok)
@@ -661,26 +756,24 @@ class ContinuousBatchingEngine:
 
             active_mask = np.array([s is not None for s in self._slots])
             self._rng, sub = jax.random.split(self._rng)
-            if self._spec_step is not None:
+            if self._pld_step is not None:
+                (self._ck, self._cv, self._history, self._lengths,
+                 tok, emitted, ns) = self._pld_step(
+                    self.params, self._ck, self._cv, self._history,
+                    self._lengths, self._last_tok,
+                    jnp.asarray(active_mask), sub)
+                self._last_tok = tok
+                self._drain_spec_blocks(np.asarray(emitted),
+                                        np.asarray(ns), active_mask)
+            elif self._spec_step is not None:
                 (self._ck, self._cv, self._dck, self._dcv, self._lengths,
                  tok, emitted, ns) = self._spec_step(
                     self.params, self.draft_params, self._ck, self._cv,
                     self._dck, self._dcv, self._lengths, self._last_tok,
                     jnp.asarray(active_mask), sub)
                 self._last_tok = tok
-                em_np, ns_np = np.asarray(emitted), np.asarray(ns)
-                self._step_count += 1
-                self.spec_stats["rounds"] += 1
-                self.spec_stats["drafted"] += (
-                    self.num_draft * int(active_mask.sum()))
-                for i, req in enumerate(self._slots):
-                    if req is None:
-                        continue
-                    self.spec_stats["accepted"] += int(ns_np[i]) - 1
-                    for j in range(int(ns_np[i])):
-                        if self._slots[i] is None:
-                            break      # row hit max_new or eos mid-block
-                        self._record_token(i, req, int(em_np[i, j]))
+                self._drain_spec_blocks(np.asarray(emitted),
+                                        np.asarray(ns), active_mask)
             else:
                 self._ck, self._cv, self._lengths, tok = self._step(
                     self.params, self._ck, self._cv, self._lengths,

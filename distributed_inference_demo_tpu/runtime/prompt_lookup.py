@@ -51,6 +51,37 @@ from .speculative import (SpecStats, drain_round_blocks, emit_stream_block,
                           verify_emit)
 
 
+def ngram_propose(history: jnp.ndarray, hist_len: jnp.ndarray,
+                  num_draft: int) -> jnp.ndarray:
+    """[b, K] proposals from the latest bigram/unigram match over a
+    [b, cap] token-history buffer with per-row valid lengths.
+
+    For each row: score position j by 2*(bigram match ending at j) +
+    (history[j] == last token), require j < hist_len - 1 (the match must
+    have a following token inside the valid region), take the
+    highest-scoring latest j, and propose the K tokens after it.  Score 0
+    everywhere degenerates to j = cap-1, whose clamped gather proposes
+    the last token repeated — verification makes any bad proposal merely
+    useless, never wrong.  Shared by PromptLookupEngine's round scan and
+    the continuous-batching slot loop (prompt_lookup=True)."""
+    cap, K = history.shape[1], num_draft
+    pos = jnp.arange(cap)[None, :]                    # [1, cap]
+    last = jnp.take_along_axis(
+        history, (hist_len - 1)[:, None], axis=1)     # [b, 1]
+    prev = jnp.take_along_axis(
+        history, jnp.maximum(hist_len - 2, 0)[:, None], axis=1)
+    uni = history == last                             # [b, cap]
+    prev_hist = jnp.roll(history, 1, axis=1)
+    bi = uni & (prev_hist == prev) & (pos > 0)
+    valid = pos < (hist_len - 1)[:, None]
+    score = (2 * bi + uni) * valid
+    # lexicographic (score, position) argmax via score*cap + pos
+    j = jnp.argmax(score * cap + pos, axis=1)         # [b]
+    idx = j[:, None] + 1 + jnp.arange(K)[None, :]     # [b, K]
+    idx = jnp.minimum(idx, hist_len[:, None] - 1)
+    return jnp.take_along_axis(history, idx, axis=1).astype(jnp.int32)
+
+
 class PromptLookupEngine:
     """Draft-free speculative generation over a single-stage model."""
 
@@ -96,39 +127,11 @@ class PromptLookupEngine:
             logits, cache = fwd(params, ids, cache, pos, True)
             return logits[:, -1], cache
 
-        def propose(history, hist_len):
-            """[b, K] proposals from the latest bigram/unigram match.
-
-            For each row: score position j by 2*(bigram match ending at j)
-            + (history[j] == last token), require j < hist_len - 1 (the
-            match must have a following token inside the valid region),
-            take the highest-scoring latest j, and propose the K tokens
-            after it.  Score 0 everywhere degenerates to j = cap-1, whose
-            clamped gather proposes the last token repeated —
-            verification makes any bad proposal merely useless, never
-            wrong."""
-            pos = jnp.arange(cap)[None, :]                    # [1, cap]
-            last = jnp.take_along_axis(
-                history, (hist_len - 1)[:, None], axis=1)     # [b, 1]
-            prev = jnp.take_along_axis(
-                history, jnp.maximum(hist_len - 2, 0)[:, None], axis=1)
-            uni = history == last                             # [b, cap]
-            prev_hist = jnp.roll(history, 1, axis=1)
-            bi = uni & (prev_hist == prev) & (pos > 0)
-            valid = pos < (hist_len - 1)[:, None]
-            score = (2 * bi + uni) * valid
-            # lexicographic (score, position) argmax via score*cap + pos
-            j = jnp.argmax(score * cap + pos, axis=1)         # [b]
-            idx = j[:, None] + 1 + jnp.arange(K)[None, :]     # [b, K]
-            idx = jnp.minimum(idx, hist_len[:, None] - 1)
-            return jnp.take_along_axis(history, idx, axis=1).astype(
-                jnp.int32)
-
         def one_round(params, last_tok, cache, history, hist_len, rng):
             b = last_tok.shape[0]
             n = cache.length
 
-            drafts = propose(history, hist_len)            # [b, K]
+            drafts = ngram_propose(history, hist_len, K)   # [b, K]
 
             verify_in = jnp.concatenate([last_tok[:, None], drafts], axis=1)
             pos = n + jnp.broadcast_to(jnp.arange(K + 1), (b, K + 1))

@@ -533,3 +533,75 @@ def test_spec_sampled_self_draft_accepts_everything(params):
         for t in (a, b):
             assert (t >= 0).all() and (t < CFG.vocab_size).all()
         assert eng.stats()["speculative"]["acceptance_rate"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# prompt-lookup (draft-free) speculation inside the slot loop
+
+
+def pld_engine(params, **kw):
+    return ContinuousBatchingEngine(
+        CFG, params, max_seq=160, max_batch=4, sampling=GREEDY,
+        prompt_buckets=(16, 64), prompt_lookup=True, num_draft=4, **kw)
+
+
+def test_pld_single_request_matches_engine(params, oracle):
+    """Greedy prompt-lookup batching must be bit-identical to the plain
+    engine — the n-gram proposer can be arbitrarily wrong."""
+    with pld_engine(params) as eng:
+        prompt = [3, 14, 15, 92, 65]
+        got = eng.submit(prompt, 12).wait(timeout=300)
+        np.testing.assert_array_equal(got, expected(oracle, prompt, 12))
+        st = eng.stats()["speculative"]
+        assert st["proposer"] == "prompt_lookup" and st["rounds"] >= 1
+
+
+def test_pld_concurrent_and_late_joiner_match(params, oracle):
+    with pld_engine(params) as eng:
+        first = eng.submit([5, 4, 3, 2], 40)
+        deadline = time.monotonic() + 240
+        while len(first.tokens) < 5:
+            assert time.monotonic() < deadline, "first request stalled"
+            time.sleep(0.01)
+        second = eng.submit([8, 8, 1], 10)
+        third = eng.submit([1, 2, 3, 4, 5, 6], 14)
+        np.testing.assert_array_equal(second.wait(timeout=300),
+                                      expected(oracle, [8, 8, 1], 10))
+        np.testing.assert_array_equal(third.wait(timeout=300),
+                                      expected(oracle, [1, 2, 3, 4, 5, 6],
+                                               14))
+        np.testing.assert_array_equal(first.wait(timeout=300),
+                                      expected(oracle, [5, 4, 3, 2], 40))
+
+
+def test_pld_repetitive_prompt_accepts(params):
+    """A prompt whose greedy continuation re-uses its own spans gets
+    acceptance > 0 through the slot loop (the PLD payoff).  greedy decode
+    of the seed-init model loops on a tiled motif, like the standalone
+    PromptLookupEngine tests."""
+    motif = list(np.arange(16) * 7 % 250)
+    oracle64 = InferenceEngine(CFG, params, max_seq=160, sampling=GREEDY)
+    want = oracle64.generate(np.asarray([motif * 4]), 48).tokens[0]
+    with pld_engine(params) as eng:
+        got = eng.submit(motif * 4, 48).wait(timeout=300)
+        np.testing.assert_array_equal(got, want)
+        assert eng.stats()["speculative"]["acceptance_rate"] > 0
+
+
+def test_pld_eos_terminates_mid_block(params, oracle):
+    prompt = [3, 14, 15, 92, 65]
+    ref = expected(oracle, prompt, 12)
+    eos = int(ref[4])
+    with ContinuousBatchingEngine(
+            CFG, params, max_seq=160, max_batch=2, sampling=GREEDY,
+            prompt_buckets=(16,), eos_id=eos, prompt_lookup=True,
+            num_draft=4) as eng:
+        got = eng.submit(prompt, 12).wait(timeout=300)
+        np.testing.assert_array_equal(got, list(ref[:5]))
+
+
+def test_pld_exclusive_with_draft(params):
+    with pytest.raises(ValueError, match="exclusive"):
+        ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                 prompt_lookup=True, draft_cfg=CFG,
+                                 draft_params=params)

@@ -385,8 +385,9 @@ def test_serve_mode_pairing_rules(capsys):
     base = ["serve", "--model", "llama-test"]
     assert cli.main(base + ["--chain", "w@127.0.0.1:1",
                             "--batch-slots", "2"]) == 1
-    assert cli.main(base + ["--batch-slots", "2", "--prompt-lookup"]) == 1
     assert cli.main(base + ["--draft-model", "llama-test",
+                            "--prompt-lookup"]) == 1
+    assert cli.main(base + ["--chain", "w@127.0.0.1:1",
                             "--prompt-lookup"]) == 1
     capsys.readouterr()
 
@@ -443,3 +444,28 @@ def test_cli_generate_sp_matches_plain():
     assert rc == 1
     rc, _ = _run_cli(argv + ["--sp", "2", "--prompt-lookup"])
     assert rc == 1
+
+
+def test_http_batching_with_prompt_lookup(http_server):
+    """Continuous batching x draft-free speculation over HTTP: greedy
+    output matches the plain engine, /stats names the proposer."""
+    _, engine = http_server
+    backend = ContinuousBatchingEngine(
+        engine.cfg, engine.params, max_seq=64, max_batch=2,
+        sampling=GREEDY, prompt_buckets=(16,), prompt_lookup=True,
+        num_draft=3)
+    server = InferenceHTTPServer(backend, port=0, model_name="llama-test")
+    server.start()
+    try:
+        prompt = [[5, 17, 42, 7]]
+        status, data = _req(server, "POST", "/generate",
+                            {"prompt_ids": prompt, "max_new_tokens": 6})
+        assert status == 200
+        want = engine.generate(np.asarray(prompt), 6).tokens.tolist()
+        assert json.loads(data)["tokens"] == want
+        status, stats = _req(server, "GET", "/stats")
+        assert json.loads(stats)["speculative"]["proposer"] == \
+            "prompt_lookup"
+    finally:
+        server.shutdown()
+        backend.close()
