@@ -189,6 +189,12 @@ def cmd_serve(args) -> int:
         print("--tp is not supported with --chain (stages are whole-model "
               "slices per worker)", file=sys.stderr)
         return 1
+    if getattr(args, "pool_size", 1) > 1 and not args.chain:
+        # reject loudly rather than silently serializing requests
+        print("--pool-size requires --chain (pipeline dynamic batching); "
+              "--batch-slots is the single-node batching mode",
+              file=sys.stderr)
+        return 1
 
     tokenizer = _load_tokenizer(args.tokenizer)
 
@@ -222,13 +228,24 @@ def cmd_serve(args) -> int:
             cfg, specs[0], full, args.max_seq, sampling,
             kv_cache_dtype=getattr(args, "kv_cache_dtype", "") or None)
         header = ElasticHeader(rt, transport, chain,
+                               eos_id=getattr(args, "eos_id", None),
                                step_timeout=args.step_timeout)
         # initial reshard pushes the authoritative layer plan to the chain —
         # workers may start with any placeholder range (cli worker --elastic
         # defaults to the full model) and are aligned here.
         header.reshard(chain)
-        backend = HeaderBackend(header, max_seq=args.max_seq,
-                                num_stages=len(chain))
+        pool = getattr(args, "pool_size", 1)
+        if pool > 1:
+            # dynamic batching: concurrent HTTP requests group into
+            # generate_many windows with pool_size rids interleaving
+            # through the stages (runtime/dynamic_batch.py)
+            from .runtime.dynamic_batch import DynamicBatchingHeaderBackend
+            backend = DynamicBatchingHeaderBackend(
+                header, max_seq=args.max_seq, num_stages=len(chain),
+                pool_size=pool)
+        else:
+            backend = HeaderBackend(header, max_seq=args.max_seq,
+                                    num_stages=len(chain))
         kv_dtype = getattr(args, "kv_cache_dtype", "") or None
         if kv_dtype:
             # each stage owns its cache dtype; this flag reaches only the
@@ -881,6 +898,11 @@ def main(argv=None) -> int:
     s.add_argument("--port", type=int, default=0,
                    help="data-plane port (pipeline mode)")
     s.add_argument("--step-timeout", type=float, default=120.0)
+    s.add_argument("--pool-size", type=int, default=1,
+                   help="with --chain: dynamic batching — concurrent HTTP "
+                        "requests group into windows of up to N in-flight "
+                        "rids interleaving through the pipeline stages "
+                        "(1 = serialized requests)")
     s.add_argument("--batch-slots", type=int, default=0,
                    help="continuous batching with N slots: concurrent "
                         "requests join the running decode batch between "

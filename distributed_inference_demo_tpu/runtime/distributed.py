@@ -344,18 +344,30 @@ class PipelineHeader:
         self._send_hidden(req.rid, req.step, hidden)
 
     def _make_requests(self, prompts: Sequence[np.ndarray],
-                       max_new_tokens: int) -> List[_Request]:
-        """Capacity-check every prompt and mint _Requests with fresh rids."""
-        for p in prompts:
-            need = p.shape[1] + max_new_tokens
+                       max_new_tokens) -> List[_Request]:
+        """Capacity-check every prompt and mint _Requests with fresh rids.
+
+        ``max_new_tokens``: one int for every prompt, or a per-prompt
+        sequence (each _Request already carries its own budget — the
+        dynamic-batching backend groups requests with different
+        lengths into one window)."""
+        if isinstance(max_new_tokens, (int, np.integer)):
+            per = [max_new_tokens] * len(prompts)
+        else:
+            per = [int(n) for n in max_new_tokens]
+            if len(per) != len(prompts):
+                raise ValueError(
+                    f"{len(per)} max_new_tokens for {len(prompts)} prompts")
+        for p, mn in zip(prompts, per):
+            need = p.shape[1] + mn
             if need > self.rt.max_seq:
                 raise ValueError(
-                    f"prompt ({p.shape[1]}) + new ({max_new_tokens}) = "
+                    f"prompt ({p.shape[1]}) + new ({mn}) = "
                     f"{need} exceeds KV capacity {self.rt.max_seq}")
         pending = [
             _Request(rid=self._next_rid + i, prompt=np.asarray(p),
-                     max_new_tokens=max_new_tokens)
-            for i, p in enumerate(prompts)]
+                     max_new_tokens=mn)
+            for i, (p, mn) in enumerate(zip(prompts, per))]
         self._next_rid += len(pending)
         return pending
 

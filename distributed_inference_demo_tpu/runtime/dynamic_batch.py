@@ -1,0 +1,195 @@
+"""Dynamic batching over the socket pipeline (``serve --chain --pool-size``).
+
+The plain ``HeaderBackend`` serializes concurrent HTTP requests with a
+lock — each waits for the whole previous generation.  The ring protocol
+itself interleaves requests fine: messages are rid-tagged and every stage
+keeps per-rid KV cache slots (the reference's ``core_pool_size`` socket
+sets, ``Communication.java:425-437``, rebuilt as tags).  This backend
+exploits that for the HTTP surface: requests that arrive while a window is
+in flight queue up and launch TOGETHER in the next ``generate_many``
+window, ``pool_size`` rids interleaving through the stages.
+
+This is *dynamic* batching (grouped windows), not the slot-continuous
+admission of ``runtime/batching.py`` — a request never joins a window
+mid-flight.  The trade is deliberate: continuous admission needs the
+device-side step program to absorb new rows between steps (one chip, one
+compiled step — batching.py), while a pipeline stage's unit of work is a
+whole rid-tagged forward; grouping at window boundaries gets the
+concurrency without touching the ring protocol.
+
+Control operations (stats / reset / classify) run as commands on the same
+scheduler thread, BETWEEN windows — the transport has exactly one
+consumer, so a stats reply can never be eaten by a generate window's
+``recv_any`` loop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from .engine import GenerationResult, check_capacity
+
+
+@dataclass
+class _HttpRequest:
+    """One queued HTTP generation (a whole [b, s] prompt batch = one rid)."""
+    prompt: np.ndarray
+    max_new: int
+    stream: "queue.Queue" = field(default_factory=queue.Queue)
+    done: threading.Event = field(default_factory=threading.Event)
+    tokens: Optional[np.ndarray] = None
+    error: Optional[BaseException] = None
+
+
+@dataclass
+class _Command:
+    """A control op executed between windows on the scheduler thread."""
+    fn: object                      # callable(header) -> result
+    done: threading.Event = field(default_factory=threading.Event)
+    result: object = None
+    error: Optional[BaseException] = None
+
+
+class DynamicBatchingHeaderBackend:
+    """Adapts a PipelineHeader/ElasticHeader to the HTTP surface with
+    windowed request grouping (``pool_size`` rids in flight)."""
+
+    def __init__(self, header, max_seq: int, num_stages: int = 2,
+                 pool_size: int = 2, max_group: int = 8):
+        self.header = header
+        self.max_seq = max_seq
+        self.num_stages = num_stages
+        self.pool_size = max(1, pool_size)
+        self.max_group = max(1, max_group)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._running = True
+        # serializes submissions against close(): nothing can land in the
+        # queue after the drain ran, so no waiter can hang forever
+        self._submit_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # HTTP surface
+
+    def submit(self, prompt_ids, max_new_tokens: int) -> _HttpRequest:
+        prompt = np.asarray(prompt_ids, np.int32)
+        check_capacity(self.max_seq, prompt.shape[1], max_new_tokens)
+        req = _HttpRequest(prompt=prompt, max_new=max_new_tokens)
+        with self._submit_lock:
+            if not self._running:
+                raise RuntimeError("backend is closed")
+            self._queue.put(req)
+        return req
+
+    def generate(self, prompt_ids, max_new_tokens: int, seed: int = 0):
+        import time
+        t0 = time.perf_counter()
+        req = self.submit(prompt_ids, max_new_tokens)
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        return GenerationResult(tokens=req.tokens,
+                                prompt_len=req.prompt.shape[1],
+                                num_new=req.tokens.shape[1],
+                                seconds=time.perf_counter() - t0)
+
+    def generate_stream(self, prompt_ids, max_new_tokens: int,
+                        seed: int = 0):
+        req = self.submit(prompt_ids, max_new_tokens)
+        while True:
+            item = req.stream.get()
+            if item is None:
+                break
+            yield item
+        if req.error is not None:
+            raise req.error
+
+    def classify(self, prompt_ids, label_token_ids):
+        [pred] = self._command(
+            lambda h: h.classify_many([np.asarray(prompt_ids)],
+                                      label_token_ids))
+        return pred
+
+    def stats(self) -> dict:
+        return {"stages": self._command(
+            lambda h: h.collect_stats(self.num_stages))}
+
+    def reset_stats(self) -> None:
+        self._command(lambda h: h.reset_stats())
+
+    def close(self) -> None:
+        with self._submit_lock:
+            self._running = False
+            self._queue.put(None)
+        self._thread.join(timeout=30)
+
+    # ------------------------------------------------------------------
+    # scheduler
+
+    def _command(self, fn):
+        cmd = _Command(fn=fn)
+        with self._submit_lock:
+            if not self._running:
+                raise RuntimeError("backend is closed")
+            self._queue.put(cmd)
+        cmd.done.wait()
+        if cmd.error is not None:
+            raise cmd.error
+        return cmd.result
+
+    def _run_window(self, reqs: List[_HttpRequest]) -> None:
+        try:
+            results = self.header.generate_many(
+                [r.prompt for r in reqs], [r.max_new for r in reqs],
+                pool_size=self.pool_size,
+                on_token=lambda i, step, toks: reqs[i].stream.put(toks))
+            for r, toks in zip(reqs, results):
+                r.tokens = toks
+        except BaseException as e:      # surface to every waiter
+            for r in reqs:
+                r.error = e
+        finally:
+            for r in reqs:
+                r.stream.put(None)
+                r.done.set()
+
+    def _loop(self) -> None:
+        while self._running:
+            item = self._queue.get()
+            if item is None:
+                break
+            group = [item]
+            while len(group) < self.max_group:
+                try:
+                    group.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            for cmd in (g for g in group if isinstance(g, _Command)):
+                try:
+                    cmd.result = cmd.fn(self.header)
+                except BaseException as e:
+                    cmd.error = e
+                finally:
+                    cmd.done.set()
+            reqs = [g for g in group if isinstance(g, _HttpRequest)]
+            if reqs:
+                self._run_window(reqs)
+        # drain: fail anything still queued
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if isinstance(item, _HttpRequest):
+                item.error = RuntimeError("backend closed")
+                item.stream.put(None)
+                item.done.set()
+            elif isinstance(item, _Command):
+                item.error = RuntimeError("backend closed")
+                item.done.set()

@@ -65,13 +65,16 @@ class HeaderBackend:
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0):
-        with self._lock:
-            toks = self.header.generate(np.asarray(prompt_ids),
-                                        max_new_tokens)
+        import time
 
-        class R:          # minimal GenerationResult shape
-            tokens = toks
-        return R()
+        from .engine import GenerationResult
+        ids = np.asarray(prompt_ids)
+        t0 = time.perf_counter()
+        with self._lock:
+            toks = self.header.generate(ids, max_new_tokens)
+        return GenerationResult(tokens=toks, prompt_len=ids.shape[1],
+                                num_new=toks.shape[1],
+                                seconds=time.perf_counter() - t0)
 
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         seed: int = 0):

@@ -36,6 +36,13 @@ def reference_tokens(model, prompt, max_new):
     return engine.generate(prompt, max_new).tokens
 
 
+def reference_classify(model, prompt, label_token_ids):
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(cfg, params, max_seq=128, sampling=GREEDY)
+    return engine.classify(prompt, label_token_ids)
+
+
 def build_pipeline(model, num_stages, max_seq=128):
     """In-process pipeline over loopback: header + workers on threads."""
     cfg = get_model_config(model)
@@ -260,3 +267,57 @@ def test_pipeline_fp8_kv_cache_matches_fp8_engine():
     header.shutdown_pipeline()
     t.join(timeout=30)
     np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# dynamic batching over the pipeline (serve --chain --pool-size)
+
+
+def test_dynamic_batching_backend_concurrent_parity():
+    """Concurrent requests with DIFFERENT lengths group into
+    generate_many windows and each comes out bit-exact; stats/classify
+    commands execute between windows on the one transport consumer."""
+    from distributed_inference_demo_tpu.runtime.dynamic_batch import (
+        DynamicBatchingHeaderBackend)
+
+    header, threads = build_pipeline("llama-test", 2)
+    backend = DynamicBatchingHeaderBackend(header, max_seq=128,
+                                           num_stages=2, pool_size=2)
+    try:
+        prompts = [np.array([[5, 17, 42, 7]], dtype=np.int32),
+                   np.array([[9, 8, 7]], dtype=np.int32),
+                   np.array([[1, 2]], dtype=np.int32)]
+        ns = [10, 6, 8]
+        wants = [reference_tokens("llama-test", p, n)
+                 for p, n in zip(prompts, ns)]
+
+        results = {}
+
+        def run(i):
+            results[i] = backend.generate(prompts[i], ns[i]).tokens
+
+        ts = [threading.Thread(target=run, args=(i,))
+              for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        for i, want in enumerate(wants):
+            np.testing.assert_array_equal(results[i], want)
+
+        # streaming yields per-step [b] arrays matching the blocking path
+        steps = list(backend.generate_stream(prompts[0], 5))
+        np.testing.assert_array_equal(np.stack(steps, axis=1), wants[0][:, :5])
+
+        # control ops ride the scheduler thread between windows
+        stats = backend.stats()
+        assert {s["role"] for s in stats["stages"]} == {"header", "tail"}
+        labels = [7, 42, 99]
+        want_cls = reference_classify("llama-test", prompts[0], labels)
+        assert backend.classify(prompts[0], labels).tolist() == \
+            want_cls.tolist()
+    finally:
+        backend.close()
+        header.shutdown_pipeline()
+        for t in threads:
+            t.join(timeout=30)
