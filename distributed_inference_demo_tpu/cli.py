@@ -327,6 +327,10 @@ def cmd_serve(args) -> int:
         pass
     finally:
         server.shutdown()
+        if hasattr(backend, "close"):
+            # the dynamic-batching/continuous-batching backends run a
+            # scheduler thread that must drain its waiters on the way out
+            backend.close()
     return 0
 
 
@@ -359,7 +363,8 @@ def cmd_server(args) -> int:
         step_timeout=args.step_timeout,
         # broadcast in the OPEN RunConfig, so every auto worker's stage
         # cache uses it too — no mixed-precision pipeline
-        kv_cache_dtype=getattr(args, "kv_cache_dtype", "") or None)
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", "") or None,
+        pool_size=args.pool_size)
     return app.run()
 
 
@@ -919,6 +924,10 @@ def main(argv=None) -> int:
                         "profile, plan, distribute, serve")
     _add_engine_args(sv)
     sv.add_argument("--num-workers", type=int, default=1)
+    sv.add_argument("--pool-size", type=int, default=1,
+                    help="dynamic batching at the composed server's HTTP "
+                         "surface: concurrent requests group into windows "
+                         "of up to N in-flight pipeline requests")
     sv.add_argument("--bind-host", default="127.0.0.1")
     sv.add_argument("--http-host", default="127.0.0.1")
     sv.add_argument("--http-port", type=int, default=0)

@@ -69,7 +69,8 @@ class ServerApp:
                  monitor_timeout: float = 60.0,
                  step_timeout: float = 120.0,
                  device_id: str = "header",
-                 kv_cache_dtype: Optional[str] = None):
+                 kv_cache_dtype: Optional[str] = None,
+                 pool_size: int = 1):
         self.model = model
         self.num_workers = num_workers
         self.checkpoint = checkpoint
@@ -88,6 +89,7 @@ class ServerApp:
         self.step_timeout = step_timeout
         self.device_id = device_id
         self.kv_cache_dtype = kv_cache_dtype
+        self.pool_size = pool_size
 
         self.ports: Optional[ServerPorts] = None
         self.plan = None
@@ -216,7 +218,7 @@ class ServerApp:
 
         config = RunConfig(
             model=self.model, max_new_tokens=self.max_new_tokens,
-            max_seq=self.max_seq,
+            max_seq=self.max_seq, pool_size=self.pool_size,
             device_graph=[addresses[d] for d in self.plan.device_ids],
             device_ids=list(self.plan.device_ids),
             stage_ranges=self.plan.stage_ranges,
@@ -254,8 +256,17 @@ class ServerApp:
             raise TimeoutError("workers never reached INITIALIZED")
         log.info("pipeline running: %s", self.plan.device_ids)
 
-        backend = HeaderBackend(header, max_seq=self.max_seq,
-                                num_stages=len(specs))
+        if self.pool_size > 1:
+            # dynamic batching: concurrent HTTP requests group into
+            # generate_many windows (runtime/dynamic_batch.py)
+            from .runtime.dynamic_batch import DynamicBatchingHeaderBackend
+            backend = DynamicBatchingHeaderBackend(
+                header, max_seq=self.max_seq, num_stages=len(specs),
+                pool_size=self.pool_size)
+        else:
+            backend = HeaderBackend(header, max_seq=self.max_seq,
+                                    num_stages=len(specs))
+        self._backend = backend
         self._http = InferenceHTTPServer(
             backend, host=self.http_host, port=self.http_port,
             model_name=self.model, default_max_new=self.max_new_tokens)
@@ -273,6 +284,17 @@ class ServerApp:
         return 0
 
     def shutdown(self) -> None:
+        # close the scheduler-threaded backend FIRST: it is the
+        # transport's one consumer — stopping the pipeline under a
+        # mid-window scheduler would violate that invariant, and queued
+        # HTTP waiters must get their 'backend closed' error
+        if getattr(self, "_backend", None) is not None:
+            if hasattr(self._backend, "close"):
+                try:
+                    self._backend.close()
+                except Exception:
+                    pass
+            self._backend = None
         if self._header is not None:
             try:
                 self._header.shutdown_pipeline()

@@ -59,7 +59,9 @@ def _read_until(proc, prefix, timeout=180.0, sink=None):
 def test_server_with_bare_workers_end_to_end(tmp_path, kv_dtype):
     """The composed server e2e; the fp8 variant proves --kv-cache-dtype
     rides the OPEN RunConfig to every auto worker's stage cache (greedy
-    parity vs a ref engine with the SAME cache dtype)."""
+    parity vs a ref engine with the SAME cache dtype) AND runs the HTTP
+    surface through the dynamic-batching backend (--pool-size 2:
+    generate + stats + classify all ride the scheduler thread)."""
     cfg = get_model_config(MODEL)
     ref_engine = InferenceEngine(
         cfg, init_full_params(jax.random.PRNGKey(SEED), cfg),
@@ -74,7 +76,8 @@ def test_server_with_bare_workers_end_to_end(tmp_path, kv_dtype):
          "--max-new-tokens", "8", "--greedy", "--weights-seed", str(SEED),
          "--collect-timeout", "300", "--monitor-timeout", "300",
          "--step-timeout", "300"]
-        + (["--kv-cache-dtype", kv_dtype] if kv_dtype else []),
+        + (["--kv-cache-dtype", kv_dtype, "--pool-size", "2"]
+           if kv_dtype else []),
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
         text=True)
     workers = []
