@@ -101,6 +101,9 @@ def main():
         "top_k": jax.jit(lambda x: jax.lax.top_k(x, 7)[0][..., -1]),
         "iter_kth": jax.jit(lambda x: kth_largest(x, 7)[..., 0]),
         "argmax": jax.jit(lambda x: jnp.argmax(x, -1)),
+        # the OTHER half of the sampling tax: the [b, vocab] gumbel draw
+        "categorical": jax.jit(lambda x: jax.random.categorical(
+            jax.random.PRNGKey(0), x, axis=-1)),
     }
     for b in BATCHES:
         logits = jax.random.normal(jax.random.PRNGKey(1), (b, 32000),
