@@ -423,6 +423,16 @@ def _leg_pipeline(model: str, batch: int, prompt_len: int,
         header.generate(prompt, new_tokens)
         dt = time.perf_counter() - t0
         stats = header.collect_stats(num_stages=2, timeout=30)
+        # dynamic-batching phase: the same 4 requests serialized vs
+        # interleaved (pool_size rids in flight — the serve --pool-size
+        # capability measured on the live 2-process pipeline; prompt
+        # shapes match the warmup so no new compiles)
+        pool_pts = {}
+        for pool in (1, 4):
+            t1 = time.perf_counter()
+            header.generate_many([prompt] * 4, new_tokens, pool_size=pool)
+            pool_pts[f"pool{pool}_tokens_per_sec"] = round(
+                4 * batch * new_tokens / (time.perf_counter() - t1), 2)
         header.shutdown_pipeline()
         proc.wait(timeout=60)
     finally:
@@ -442,6 +452,10 @@ def _leg_pipeline(model: str, batch: int, prompt_len: int,
                 "runs on the tunneled TPU; activation_hop_* is the "
                 "framework metric",
         "pipeline_tokens_per_sec": round(batch * new_tokens / dt, 2),
+        "dynamic_batching_4req": dict(
+            pool_pts,
+            speedup=round(pool_pts["pool4_tokens_per_sec"]
+                          / pool_pts["pool1_tokens_per_sec"], 3)),
         "ring_rtt_p50_ms": h.get("ring_rtt_p50_ms"),
         "ring_rtt_p95_ms": h.get("ring_rtt_p95_ms"),
         "tail_compute_p50_ms": tail_p50,

@@ -605,3 +605,42 @@ def test_pld_exclusive_with_draft(params):
         ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                  prompt_lookup=True, draft_cfg=CFG,
                                  draft_params=params)
+
+
+# ---------------------------------------------------------------------------
+# randomized soak: scheduler races under a mixed workload
+
+
+@pytest.mark.parametrize("mode", ["plain", "draft", "pld"])
+def test_soak_random_workload(params, draft_params, oracle, mode):
+    """30 requests with random lengths, ~20% random cancellations, and
+    staggered submission against 3 slots: every surviving request must
+    stay bit-exact (fuzz for admission/drain/cancel races in the
+    scheduler, across all three proposer modes)."""
+    rng = np.random.default_rng(42)
+    kw = {}
+    if mode == "draft":
+        kw = dict(draft_cfg=DRAFT_CFG, draft_params=draft_params,
+                  num_draft=3)
+    elif mode == "pld":
+        kw = dict(prompt_lookup=True, num_draft=3)
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=3,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  **kw) as eng:
+        reqs = []
+        for _ in range(30):
+            plen = int(rng.integers(1, 9))
+            n = int(rng.integers(1, 20))
+            prompt = rng.integers(0, 250, size=(plen,)).tolist()
+            r = eng.submit(prompt, n)
+            if rng.random() < 0.2:
+                r.cancel()
+            reqs.append((prompt, n, r))
+            if rng.random() < 0.3:
+                time.sleep(0.005)
+        for prompt, n, r in reqs:
+            assert r.done.wait(300), "request neither finished nor failed"
+            if r.cancelled:
+                continue               # partial tokens are fine
+            np.testing.assert_array_equal(r.wait(timeout=300),
+                                          expected(oracle, prompt, n))
