@@ -284,7 +284,8 @@ def cmd_serve(args) -> int:
             kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
             eos_id=getattr(args, "eos_id", None),
             draft_cfg=draft_cfg, draft_params=draft_params,
-            num_draft=args.num_draft, prompt_lookup=pld)
+            num_draft=args.num_draft, prompt_lookup=pld,
+            decode_block=args.decode_block)
         print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
               f"prefix_cache={args.prefix_cache_size} "
               f"tp={getattr(args, 'tp', 1)}"
@@ -912,6 +913,11 @@ def main(argv=None) -> int:
                    help="continuous batching with N slots: concurrent "
                         "requests join the running decode batch between "
                         "steps (single-node mode only)")
+    s.add_argument("--decode-block", type=int, default=1,
+                   help="with --batch-slots: fuse N decode steps per "
+                        "dispatch when no admissions are waiting (one "
+                        "host sync per block; admission latency <= N "
+                        "steps; plain decoding only)")
     s.add_argument("--prefix-cache-size", type=int, default=8,
                    help="with --batch-slots: LRU entries of full-prompt "
                         "KV kept on device for automatic prefix reuse "

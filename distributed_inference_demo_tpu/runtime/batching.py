@@ -121,7 +121,8 @@ class ContinuousBatchingEngine:
                  draft_cfg: Optional[ModelConfig] = None,
                  draft_params: Optional[StageParams] = None,
                  num_draft: int = 4,
-                 prompt_lookup: bool = False):
+                 prompt_lookup: bool = False,
+                 decode_block: int = 1):
         """``prefix_cache_size``: LRU entries of full-prompt KV kept on
         device for automatic prefix reuse (0 disables).  A new prompt
         sharing >= ``min_prefix_len`` leading tokens with a cached one
@@ -155,7 +156,16 @@ class ContinuousBatchingEngine:
         proposer is an n-gram match over each slot's own token history
         (prompt_lookup.ngram_propose), verified the same per-row way.
         No second model, no second cache; exclusive with
-        ``draft_cfg``."""
+        ``draft_cfg``.
+
+        ``decode_block``: fuse N lockstep steps into one dispatch when no
+        admissions are waiting (one host sync per block — the throughput
+        mode for high-dispatch-latency devices).  Admission/cancel
+        latency grows to <= N steps; greedy output is unchanged
+        (sampled streams differ from N=1 — per-request seeds are not
+        honored either way, see above).  Plain mode only: the
+        speculative proposers already amortize dispatches by emitting
+        up to num_draft+1 tokens per round."""
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -166,6 +176,13 @@ class ContinuousBatchingEngine:
         self.draft_cfg, self.draft_params = draft_cfg, draft_params
         self.num_draft = num_draft
         self.prompt_lookup = prompt_lookup
+        self.decode_block = decode_block
+        if decode_block < 1:
+            raise ValueError("decode_block must be >= 1")
+        if decode_block > 1 and (prompt_lookup or draft_cfg is not None):
+            raise ValueError(
+                "decode_block applies to plain decoding only (speculative "
+                "rounds already amortize dispatches)")
         if prompt_lookup and draft_cfg is not None:
             raise ValueError(
                 "prompt_lookup and draft_cfg are exclusive proposers")
@@ -194,17 +211,47 @@ class ContinuousBatchingEngine:
         fwd, self._cache_sharding = make_forward_seam(
             cfg, self.spec, mesh, params, attn_impl=slot_attention_impl)
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def step(params, ck, cv, lengths, last_tok, active, rng):
-            """One lockstep decode step over all slots."""
-            cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
+        def one_step(params, cache, lengths, last_tok, active, rng):
+            """One lockstep decode step over all slots — the shared core
+            of the per-step jit and the fused multi-step scan."""
             pos = lengths[:, None]
             logits, cache = fwd(params, last_tok[:, None], cache, pos,
                                 True)
             tok = sample_logits(logits[:, 0], rng, samp_)
             tok = jnp.where(active, tok, last_tok)
             lengths = lengths + active.astype(jnp.int32)
+            return cache, lengths, tok
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, ck, cv, lengths, last_tok, active, rng):
+            cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
+            cache, lengths, tok = one_step(params, cache, lengths,
+                                           last_tok, active, rng)
             return cache.keys, cache.values, lengths, tok
+
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(7,))
+        def multi_step(params, ck, cv, lengths, last_tok, active, rng,
+                       num_steps):
+            """``num_steps`` lockstep steps fused in one dispatch (one
+            host sync per BLOCK, not per token — on a device with ~10 ms
+            dispatch latency this is the difference between ~100 tok/s
+            and the HBM roofline).  The active mask is frozen for the
+            block; rows that hit max_new/eos mid-block keep decoding
+            into their own stale positions and the host drain simply
+            stops recording them (the speculative drain's guard)."""
+            cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
+
+            def body(carry, sub):
+                cache, lengths, tok = carry
+                cache, lengths, tok = one_step(params, cache, lengths,
+                                               tok, active, sub)
+                return (cache, lengths, tok), tok
+
+            (cache, lengths, tok), toks = jax.lax.scan(
+                body, (cache, lengths, last_tok),
+                jax.random.split(rng, num_steps))
+            return (cache.keys, cache.values, lengths, tok,
+                    jnp.swapaxes(toks, 0, 1))          # [B, num_steps]
 
         @partial(jax.jit, donate_argnums=(3, 4))
         def prefill(params, ids, start, row_k, row_v, real_len, rng):
@@ -263,6 +310,7 @@ class ContinuousBatchingEngine:
             return ck, cv, lengths, last_tok
 
         self._step, self._prefill, self._admit = step, prefill, admit
+        self._multi_step = multi_step
         self._load_prefix, self._zero_row = load_prefix, zero_row
 
         def verify_slots(params, cache, drafts, q_logits, lengths,
@@ -665,22 +713,32 @@ class ContinuousBatchingEngine:
         self._slots[slot] = req
         self._record_token(slot, req, int(tok))
 
+    def _record_row_blocks(self, em_np, counts) -> None:
+        """Record per-row emitted token blocks into the slots' requests
+        (``counts[i]`` tokens from row i), stopping a row the moment it
+        finishes (max_new/eos frees the slot mid-block — the stale-slot
+        guard shared by the speculative rounds and the fused
+        decode-block path)."""
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            for j in range(int(counts[i])):
+                if self._slots[i] is None:
+                    break              # row hit max_new or eos mid-block
+                self._record_token(i, req, int(em_np[i, j]))
+
     def _drain_spec_blocks(self, em_np, ns_np, active_mask) -> None:
-        """Record one speculative round's per-row emitted blocks into the
-        slots' requests + acceptance stats — shared by the draft-model
-        and prompt-lookup step branches."""
+        """Record one speculative round's per-row emitted blocks +
+        acceptance stats — shared by the draft-model and prompt-lookup
+        step branches."""
         self._step_count += 1
         self.spec_stats["rounds"] += 1
         self.spec_stats["drafted"] += (
             self.num_draft * int(active_mask.sum()))
-        for i, req in enumerate(self._slots):
-            if req is None:
-                continue
-            self.spec_stats["accepted"] += int(ns_np[i]) - 1
-            for j in range(int(ns_np[i])):
-                if self._slots[i] is None:
-                    break              # row hit max_new or eos mid-block
-                self._record_token(i, req, int(em_np[i, j]))
+        self.spec_stats["accepted"] += int(
+            sum(int(ns_np[i]) - 1 for i, r in enumerate(self._slots)
+                if r is not None))
+        self._record_row_blocks(em_np, ns_np)
 
     def _record_token(self, slot: int, req: Request, tok: int):
         req.tokens.append(tok)
@@ -774,6 +832,22 @@ class ContinuousBatchingEngine:
                 self._last_tok = tok
                 self._drain_spec_blocks(np.asarray(emitted),
                                         np.asarray(ns), active_mask)
+            elif self.decode_block > 1 and (
+                    self._queue.empty() or active_mask.all()):
+                # fuse a block whenever no admission could land anyway:
+                # queue empty, OR every slot busy (the saturated regime
+                # is exactly where the fused path pays — a queue backlog
+                # must not silently disable it)
+                (self._ck, self._cv, self._lengths, tok,
+                 blocks) = self._multi_step(
+                    self.params, self._ck, self._cv, self._lengths,
+                    self._last_tok, jnp.asarray(active_mask), sub,
+                    self.decode_block)
+                self._last_tok = tok
+                self._step_count += self.decode_block
+                self._record_row_blocks(
+                    np.asarray(blocks),
+                    np.full(len(self._slots), self.decode_block))
             else:
                 self._ck, self._cv, self._lengths, tok = self._step(
                     self.params, self._ck, self._cv, self._lengths,

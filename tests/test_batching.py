@@ -644,3 +644,47 @@ def test_soak_random_workload(params, draft_params, oracle, mode):
                 continue               # partial tokens are fine
             np.testing.assert_array_equal(r.wait(timeout=300),
                                           expected(oracle, prompt, n))
+
+
+# ---------------------------------------------------------------------------
+# fused multi-step decode blocks (decode_block > 1)
+
+
+def test_decode_block_parity_and_late_joiner(params, oracle):
+    """decode_block=4 fuses steps per dispatch; greedy output must stay
+    bit-exact, including a joiner admitted between blocks and budgets
+    that are not block multiples."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=3,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  decode_block=4) as eng:
+        first = eng.submit([5, 4, 3, 2], 30)   # not a multiple of 4
+        deadline = time.monotonic() + 240
+        while len(first.tokens) < 3:
+            assert time.monotonic() < deadline, "first request stalled"
+            time.sleep(0.005)
+        second = eng.submit([8, 8, 1], 9)
+        third = eng.submit([1, 2], 6)
+        np.testing.assert_array_equal(second.wait(timeout=300),
+                                      expected(oracle, [8, 8, 1], 9))
+        np.testing.assert_array_equal(third.wait(timeout=300),
+                                      expected(oracle, [1, 2], 6))
+        np.testing.assert_array_equal(first.wait(timeout=300),
+                                      expected(oracle, [5, 4, 3, 2], 30))
+
+
+def test_decode_block_eos_mid_block(params, oracle):
+    """A row whose eos lands mid-block truncates exactly there."""
+    prompt = [3, 14, 15, 92, 65]
+    ref = expected(oracle, prompt, 12)
+    eos = int(ref[4])
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  eos_id=eos, decode_block=4) as eng:
+        got = eng.submit(prompt, 12).wait(timeout=300)
+        np.testing.assert_array_equal(got, list(ref[:5]))
+
+
+def test_decode_block_rejects_speculative_modes(params):
+    with pytest.raises(ValueError, match="decode_block"):
+        ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                 prompt_lookup=True, decode_block=2)
