@@ -716,6 +716,26 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
             k: eng.prefix_stats[k] - base.get(k, 0)
             for k in eng.prefix_stats}
 
+    # Phase B2: the fused decode-block throughput mode (one host sync
+    # per 8 steps) on the phase-A workload — on a high-dispatch-latency
+    # device this is where batching stops being dispatch-bound
+    try:
+        with ContinuousBatchingEngine(
+                cfg, params, max_seq=max_seq, max_batch=slots,
+                sampling=sampling, prefix_cache_size=0,
+                decode_block=8) as eng:
+            eng.submit(prompts[0][:8], 4).wait(timeout=600)   # warm 32
+            eng.submit(prompts[0], 4).wait(timeout=600)       # warm 128
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, new_tokens) for p in prompts]
+            for r in reqs:
+                r.wait(timeout=900)
+            dt = time.perf_counter() - t0
+            out["decode_block8_tokens_per_sec"] = round(
+                n_req * new_tokens / dt, 2)
+    except Exception as e:   # phase isolation
+        out["decode_block8_error"] = f"{type(e).__name__}: {e}"
+
     # Phase C: the composed serving shape — speculative decoding inside
     # the slot loop (int8 self-draft, as in the speculative leg), same
     # phase-A workload, greedy (the composition's parity mode)
@@ -993,6 +1013,10 @@ def main() -> None:
     # timeout exactly this way)
     deadline = time.monotonic() + int(
         os.environ.get("BENCH_DEADLINE_S", "2700"))
+    # the batching leg builds several engine instances (plain compare +
+    # slot/decode-block/speculative phases), each with its own compiles —
+    # give it more rope than the single-engine legs
+    leg_timeouts = {"batching": 1500}
     results = {}
     for leg in legs:
         left = deadline - time.monotonic()
@@ -1001,7 +1025,8 @@ def main() -> None:
             continue
         t0 = time.perf_counter()
         results[leg] = _spawn_leg(leg, params,
-                                  timeout=min(900, int(left)))
+                                  timeout=min(leg_timeouts.get(leg, 900),
+                                              int(left)))
         if isinstance(results[leg], dict):
             results[leg]["leg_seconds"] = round(time.perf_counter() - t0, 1)
 
