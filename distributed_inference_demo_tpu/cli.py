@@ -914,10 +914,11 @@ def main(argv=None) -> int:
                         "requests join the running decode batch between "
                         "steps (single-node mode only)")
     s.add_argument("--decode-block", type=int, default=1,
-                   help="with --batch-slots: fuse N decode steps per "
-                        "dispatch when no admissions are waiting (one "
-                        "host sync per block; admission latency <= N "
-                        "steps; plain decoding only)")
+                   help="with --batch-slots: fuse N decode steps (or N "
+                        "draft/verify rounds under --draft-model/"
+                        "--prompt-lookup) per dispatch when no admission "
+                        "could land anyway (one host sync per block; "
+                        "admission latency <= N steps)")
     s.add_argument("--prefix-cache-size", type=int, default=8,
                    help="with --batch-slots: LRU entries of full-prompt "
                         "KV kept on device for automatic prefix reuse "

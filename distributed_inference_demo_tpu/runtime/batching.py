@@ -158,14 +158,14 @@ class ContinuousBatchingEngine:
         No second model, no second cache; exclusive with
         ``draft_cfg``.
 
-        ``decode_block``: fuse N lockstep steps into one dispatch when no
-        admissions are waiting (one host sync per block — the throughput
-        mode for high-dispatch-latency devices).  Admission/cancel
-        latency grows to <= N steps; greedy output is unchanged
-        (sampled streams differ from N=1 — per-request seeds are not
-        honored either way, see above).  Plain mode only: the
-        speculative proposers already amortize dispatches by emitting
-        up to num_draft+1 tokens per round."""
+        ``decode_block``: fuse N lockstep steps (or, in the speculative
+        modes, N draft/verify ROUNDS — SpeculativeEngine's
+        rounds_per_dispatch, slot-shaped) into one dispatch when no
+        admission could land anyway (one host sync per block — the
+        throughput mode for high-dispatch-latency devices).
+        Admission/cancel latency grows to <= N steps/rounds; greedy
+        output is unchanged (sampled streams differ from N=1 —
+        per-request seeds are not honored either way, see above)."""
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -179,10 +179,6 @@ class ContinuousBatchingEngine:
         self.decode_block = decode_block
         if decode_block < 1:
             raise ValueError("decode_block must be >= 1")
-        if decode_block > 1 and (prompt_lookup or draft_cfg is not None):
-            raise ValueError(
-                "decode_block applies to plain decoding only (speculative "
-                "rounds already amortize dispatches)")
         if prompt_lookup and draft_cfg is not None:
             raise ValueError(
                 "prompt_lookup and draft_cfg are exclusive proposers")
@@ -337,36 +333,50 @@ class ContinuousBatchingEngine:
         if prompt_lookup:
             from .prompt_lookup import ngram_propose
             K = num_draft
-            # +K+2: emitted blocks write up to K+1 past each row's
-            # history length (same contiguous-coverage invariant as the
-            # cache slack below)
-            hcap = S + K + 2
+            # emitted blocks write up to decode_block*(K+1) past a row's
+            # history length before the host drains (same contiguous-
+            # coverage invariant as the cache slack below)
+            hcap = S + decode_block * (K + 1) + 1
 
-            @partial(jax.jit, donate_argnums=(1, 2, 3))
+            @partial(jax.jit, donate_argnums=(1, 2, 3),
+                     static_argnums=(8,))
             def pld_step(params, ck, cv, history, lengths, last_tok,
-                         active, rng):
-                """One prompt-lookup round over all slots: n-gram propose
-                per row, verify [B, K+1] in one forward, per-row accept,
-                append the emitted block to each active row's history."""
+                         active, rng, num_rounds):
+                """``num_rounds`` prompt-lookup rounds over all slots,
+                fused in one dispatch: n-gram propose per row, verify
+                [B, K+1] in one forward, per-row accept, append the
+                emitted block to each active row's history."""
                 b = last_tok.shape[0]
                 cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
-                hist_len = lengths + 1     # history = prompt + emitted
-                drafts = ngram_propose(history, hist_len, K)
-                # one-hot proposer (q_logits=None), like PromptLookupEngine
-                cache, emitted, n, new_last, new_lengths = verify_slots(
-                    params, cache, drafts, None, lengths, last_tok,
-                    active, rng)
-                # append emitted at cols hist_len..hist_len+K per row;
-                # inactive rows are routed out of bounds (scatter drops
-                # OOB updates) so a freed slot's stale lengths can't
-                # corrupt its row before re-admission rewrites it
-                rows = jnp.arange(b)[:, None]
-                cols = jnp.where(active[:, None],
-                                 hist_len[:, None] + jnp.arange(K + 1),
-                                 hcap)
-                history = history.at[rows, cols].set(emitted)
-                return (cache.keys, cache.values, history, new_lengths,
-                        new_last, emitted, n)
+
+                def one_round(carry, sub):
+                    cache, history, lengths, last_tok = carry
+                    hist_len = lengths + 1   # history = prompt + emitted
+                    drafts = ngram_propose(history, hist_len, K)
+                    # one-hot proposer (q_logits=None), like
+                    # PromptLookupEngine
+                    cache, emitted, n, new_last, new_lengths = \
+                        verify_slots(params, cache, drafts, None, lengths,
+                                     last_tok, active, sub)
+                    # append emitted at cols hist_len..hist_len+K per
+                    # row; inactive rows are routed out of bounds
+                    # (scatter drops OOB updates) so a freed slot's stale
+                    # lengths can't corrupt its row before re-admission
+                    # rewrites it
+                    rows = jnp.arange(b)[:, None]
+                    cols = jnp.where(active[:, None],
+                                     hist_len[:, None] + jnp.arange(K + 1),
+                                     hcap)
+                    history = history.at[rows, cols].set(emitted)
+                    return (cache, history, new_lengths, new_last), \
+                        (emitted, n)
+
+                (cache, history, lengths, last_tok), (em, ns) = \
+                    jax.lax.scan(one_round,
+                                 (cache, history, lengths, last_tok),
+                                 jax.random.split(rng, num_rounds))
+                return (cache.keys, cache.values, history, lengths,
+                        last_tok, em, ns)
 
             @partial(jax.jit, donate_argnums=(0,))
             def admit_h(history, row_ids, slot, plen, tok):
@@ -383,62 +393,79 @@ class ContinuousBatchingEngine:
         # ------------------------------------------------------------------
         # speculative slot decoding (draft model inside the slot loop)
         self._spec_step = None
-        slack = num_draft + 1 if prompt_lookup else 0
+        slack = decode_block * (num_draft + 1) if prompt_lookup else 0
         if draft_cfg is not None:
-            # a verify round writes K+1 positions past a row's length
+            # each fused round writes K+1 positions past a row's length
             # before the host learns how many were kept; rows advance
             # contiguously (n <= K+1 per round), so a query only ever
             # reaches a column in the round that writes it — slack columns
-            # are never attended stale, even across slot reuse
-            slack = num_draft + 1
+            # are never attended stale, even across slot reuse.  With
+            # decode_block rounds fused the overshoot compounds.
+            slack = decode_block * (num_draft + 1)
             K = num_draft
             dcfg_ = draft_cfg
             fwd_d, _ = make_forward_seam(
                 draft_cfg, StageSpec(0, 1, 0, draft_cfg.num_layers), mesh,
                 draft_params, attn_impl=slot_attention_impl)
 
-            @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+            @partial(jax.jit, donate_argnums=(2, 3, 4, 5),
+                     static_argnums=(10,))
             def spec_step(params, dparams, ck, cv, dck, dcv, lengths,
-                          last_tok, active, rng):
-                """One speculative round over all slots: draft K per row,
-                verify [B, K+1] in one target forward, per-row accept
-                (verify_emit_per_row).  Returns the emitted blocks +
-                per-row counts for the host to drain; inactive rows
-                advance by 0 and keep last_tok."""
+                          last_tok, active, rng, num_rounds):
+                """``num_rounds`` speculative rounds over all slots,
+                fused in one dispatch: draft K per row, verify [B, K+1]
+                in one target forward, per-row accept
+                (verify_emit_per_row).  Returns [R, B, K+1] emitted
+                blocks + [R, B] counts for the host to drain; inactive
+                rows advance by 0 and keep last_tok."""
                 b = last_tok.shape[0]
                 cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
                 dcache = KVCache(dck, dcv, jnp.zeros((), jnp.int32))
 
-                # K proposals + one extra step inserting d_K's KV so an
-                # all-accept round leaves the draft cache fully populated
-                # (speculative.py's dstep, with per-row positions)
-                def dstep(carry, j):
-                    tok, dc, rng = carry
-                    pos = (lengths + j)[:, None]
-                    logits, dc = fwd_d(dparams, tok[:, None], dc, pos,
-                                       True)
-                    logits = logits[:, 0]
-                    rng, sub = jax.random.split(rng)
-                    if samp_.greedy:
-                        d = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                        q = logits  # unused in greedy verify
-                    else:
-                        q = filtered_logits(logits, samp_)
-                        d = jax.random.categorical(sub, q, axis=-1)
-                        d = d.astype(jnp.int32)
-                    return (d, dc, rng), (d, q)
+                def one_round(carry, sub):
+                    cache, dcache, lengths, last_tok = carry
 
-                (_, dcache, rng), (drafts, q_logits) = jax.lax.scan(
-                    dstep, (last_tok, dcache, rng), jnp.arange(K + 1))
-                drafts = drafts[:K].T                        # [b, K]
-                q_logits = jnp.swapaxes(q_logits[:K], 0, 1)  # [b, K, V]
+                    # K proposals + one extra step inserting d_K's KV so
+                    # an all-accept round leaves the draft cache fully
+                    # populated (speculative.py's dstep, per-row
+                    # positions)
+                    def dstep(c, j):
+                        tok, dc, r = c
+                        pos = (lengths + j)[:, None]
+                        logits, dc = fwd_d(dparams, tok[:, None], dc,
+                                           pos, True)
+                        logits = logits[:, 0]
+                        r, s = jax.random.split(r)
+                        if samp_.greedy:
+                            d = jnp.argmax(logits, axis=-1).astype(
+                                jnp.int32)
+                            q = logits  # unused in greedy verify
+                        else:
+                            q = filtered_logits(logits, samp_)
+                            d = jax.random.categorical(s, q, axis=-1)
+                            d = d.astype(jnp.int32)
+                        return (d, dc, r), (d, q)
 
-                cache, emitted, n, new_last, new_lengths = verify_slots(
-                    params, cache, drafts,
-                    None if samp_.greedy else q_logits, lengths,
-                    last_tok, active, rng)
+                    sub, sub_d = jax.random.split(sub)
+                    (_, dcache, _), (drafts, q_logits) = jax.lax.scan(
+                        dstep, (last_tok, dcache, sub_d),
+                        jnp.arange(K + 1))
+                    drafts = drafts[:K].T                        # [b, K]
+                    q_logits = jnp.swapaxes(q_logits[:K], 0, 1)
+
+                    cache, emitted, n, new_last, lengths = verify_slots(
+                        params, cache, drafts,
+                        None if samp_.greedy else q_logits, lengths,
+                        last_tok, active, sub)
+                    return (cache, dcache, lengths, new_last), \
+                        (emitted, n)
+
+                (cache, dcache, lengths, last_tok), (em, ns) = \
+                    jax.lax.scan(one_round,
+                                 (cache, dcache, lengths, last_tok),
+                                 jax.random.split(rng, num_rounds))
                 return (cache.keys, cache.values, dcache.keys,
-                        dcache.values, new_lengths, new_last, emitted, n)
+                        dcache.values, lengths, last_tok, em, ns)
 
             @partial(jax.jit, donate_argnums=(2, 3))
             def dprefill(dparams, ids, row_k, row_v):
@@ -496,6 +523,39 @@ class ContinuousBatchingEngine:
         self._min_prefix_len = max(1, min_prefix_len)
         self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
+
+        if self.decode_block > 1:
+            # compile BOTH round-count variants now: the non-fused
+            # variant's first use otherwise lands as a multi-second
+            # XLA compile in the middle of serving (all-inactive mask:
+            # state is unchanged where it matters, rows are unadmitted)
+            idle = jnp.zeros((B,), bool)
+            warm_rng = jax.random.PRNGKey(0)
+            for n_r in (1, self.decode_block):
+                if self._pld_step is not None:
+                    (self._ck, self._cv, self._history, self._lengths,
+                     self._last_tok, _, _) = self._pld_step(
+                        self.params, self._ck, self._cv, self._history,
+                        self._lengths, self._last_tok, idle, warm_rng,
+                        n_r)
+                elif self._spec_step is not None:
+                    (self._ck, self._cv, self._dck, self._dcv,
+                     self._lengths, self._last_tok, _, _) = \
+                        self._spec_step(
+                            self.params, self.draft_params, self._ck,
+                            self._cv, self._dck, self._dcv,
+                            self._lengths, self._last_tok, idle,
+                            warm_rng, n_r)
+                elif n_r > 1:
+                    (self._ck, self._cv, self._lengths, self._last_tok,
+                     _) = self._multi_step(
+                        self.params, self._ck, self._cv, self._lengths,
+                        self._last_tok, idle, warm_rng, n_r)
+                else:
+                    (self._ck, self._cv, self._lengths,
+                     self._last_tok) = self._step(
+                        self.params, self._ck, self._cv, self._lengths,
+                        self._last_tok, idle, warm_rng)
 
         self._slots: List[Optional[Request]] = [None] * B
         self._queue: "queue.Queue" = queue.Queue()
@@ -727,17 +787,18 @@ class ContinuousBatchingEngine:
                     break              # row hit max_new or eos mid-block
                 self._record_token(i, req, int(em_np[i, j]))
 
-    def _drain_spec_blocks(self, em_np, ns_np, active_mask) -> None:
+    def _drain_spec_blocks(self, em_np, ns_np) -> None:
         """Record one speculative round's per-row emitted blocks +
         acceptance stats — shared by the draft-model and prompt-lookup
-        step branches."""
+        step branches.  Both counters come from the slots still OCCUPIED
+        at drain time, so rounds after a row finished mid-block (fused
+        decode_block) inflate neither drafted nor accepted."""
         self._step_count += 1
         self.spec_stats["rounds"] += 1
-        self.spec_stats["drafted"] += (
-            self.num_draft * int(active_mask.sum()))
+        live = [i for i, r in enumerate(self._slots) if r is not None]
+        self.spec_stats["drafted"] += self.num_draft * len(live)
         self.spec_stats["accepted"] += int(
-            sum(int(ns_np[i]) - 1 for i, r in enumerate(self._slots)
-                if r is not None))
+            sum(int(ns_np[i]) - 1 for i in live))
         self._record_row_blocks(em_np, ns_np)
 
     def _record_token(self, slot: int, req: Request, tok: int):
@@ -814,30 +875,32 @@ class ContinuousBatchingEngine:
 
             active_mask = np.array([s is not None for s in self._slots])
             self._rng, sub = jax.random.split(self._rng)
-            if self._pld_step is not None:
-                (self._ck, self._cv, self._history, self._lengths,
-                 tok, emitted, ns) = self._pld_step(
-                    self.params, self._ck, self._cv, self._history,
-                    self._lengths, self._last_tok,
-                    jnp.asarray(active_mask), sub)
+            # fuse a block whenever no admission could land anyway:
+            # queue empty, OR every slot busy (the saturated regime is
+            # exactly where the fused path pays — a queue backlog must
+            # not silently disable it)
+            fuse = (self.decode_block > 1
+                    and (self._queue.empty() or active_mask.all()))
+            rounds = self.decode_block if fuse else 1
+            if self._pld_step is not None or self._spec_step is not None:
+                if self._pld_step is not None:
+                    (self._ck, self._cv, self._history, self._lengths,
+                     tok, em, ns) = self._pld_step(
+                        self.params, self._ck, self._cv, self._history,
+                        self._lengths, self._last_tok,
+                        jnp.asarray(active_mask), sub, rounds)
+                else:
+                    (self._ck, self._cv, self._dck, self._dcv,
+                     self._lengths, tok, em, ns) = self._spec_step(
+                        self.params, self.draft_params, self._ck,
+                        self._cv, self._dck, self._dcv, self._lengths,
+                        self._last_tok, jnp.asarray(active_mask), sub,
+                        rounds)
                 self._last_tok = tok
-                self._drain_spec_blocks(np.asarray(emitted),
-                                        np.asarray(ns), active_mask)
-            elif self._spec_step is not None:
-                (self._ck, self._cv, self._dck, self._dcv, self._lengths,
-                 tok, emitted, ns) = self._spec_step(
-                    self.params, self.draft_params, self._ck, self._cv,
-                    self._dck, self._dcv, self._lengths, self._last_tok,
-                    jnp.asarray(active_mask), sub)
-                self._last_tok = tok
-                self._drain_spec_blocks(np.asarray(emitted),
-                                        np.asarray(ns), active_mask)
-            elif self.decode_block > 1 and (
-                    self._queue.empty() or active_mask.all()):
-                # fuse a block whenever no admission could land anyway:
-                # queue empty, OR every slot busy (the saturated regime
-                # is exactly where the fused path pays — a queue backlog
-                # must not silently disable it)
+                em_np, ns_np = np.asarray(em), np.asarray(ns)
+                for r in range(rounds):
+                    self._drain_spec_blocks(em_np[r], ns_np[r])
+            elif fuse:
                 (self._ck, self._cv, self._lengths, tok,
                  blocks) = self._multi_step(
                     self.params, self._ck, self._cv, self._lengths,

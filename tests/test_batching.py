@@ -684,7 +684,30 @@ def test_decode_block_eos_mid_block(params, oracle):
         np.testing.assert_array_equal(got, list(ref[:5]))
 
 
-def test_decode_block_rejects_speculative_modes(params):
-    with pytest.raises(ValueError, match="decode_block"):
-        ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
-                                 prompt_lookup=True, decode_block=2)
+@pytest.mark.parametrize("mode", ["draft", "pld"])
+def test_decode_block_composes_with_speculation(params, draft_params,
+                                                oracle, mode):
+    """decode_block in the speculative modes fuses N draft/verify ROUNDS
+    per dispatch; greedy output stays bit-exact, including eos landing
+    inside a fused block."""
+    kw = (dict(draft_cfg=DRAFT_CFG, draft_params=draft_params)
+          if mode == "draft" else dict(prompt_lookup=True))
+    prompt = [3, 14, 15, 92, 65]
+    ref = expected(oracle, prompt, 20)
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  num_draft=3, decode_block=3,
+                                  **kw) as eng:
+        a = eng.submit(prompt, 20)
+        b = eng.submit([8, 8, 1], 9)
+        np.testing.assert_array_equal(a.wait(timeout=300), ref)
+        np.testing.assert_array_equal(b.wait(timeout=300),
+                                      expected(oracle, [8, 8, 1], 9))
+        assert eng.stats()["speculative"]["rounds"] >= 2
+    eos = int(ref[4])
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  num_draft=3, decode_block=3, eos_id=eos,
+                                  **kw) as eng:
+        got = eng.submit(prompt, 20).wait(timeout=300)
+        np.testing.assert_array_equal(got, list(ref[:5]))
