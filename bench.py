@@ -964,14 +964,15 @@ def main() -> None:
         print(json.dumps(run_leg(args.leg, params)))
         return
 
-    # priority order: the legs with no artifact from any prior round
-    # (speculative / prompt_lookup / batching / planner_pipeline) run
-    # BEFORE the already-proven tails so a deadline cuts old evidence,
-    # not new
+    # priority order: never-measured evidence first (speculative /
+    # prompt_lookup / planner_pipeline / long_context), then the flagship
+    # headline re-measurement, THEN the expensive multi-engine batching
+    # leg (its 1500s budget must not starve the flagship under the
+    # driver's deadline), then the already-proven tails
     legs = ["roofline_probe", "headline", "headline_int8",
-            "speculative", "prompt_lookup", "batching",
-            "planner_pipeline", "long_context", "sweep",
-            "flagship_int8", "flagship_bf16", "pipeline", "prefill_long"]
+            "speculative", "prompt_lookup", "planner_pipeline",
+            "long_context", "flagship_int8", "batching", "sweep",
+            "flagship_bf16", "pipeline", "prefill_long"]
     for skip_var, leg_names in (
             ("BENCH_SKIP_FLAGSHIP", ["flagship_int8", "flagship_bf16"]),
             ("BENCH_SKIP_PIPELINE", ["pipeline", "planner_pipeline"]),

@@ -528,7 +528,10 @@ class ContinuousBatchingEngine:
             # compile BOTH round-count variants now: the non-fused
             # variant's first use otherwise lands as a multi-second
             # XLA compile in the middle of serving (all-inactive mask:
-            # state is unchanged where it matters, rows are unadmitted)
+            # state is unchanged where it matters, rows are unadmitted).
+            # Real executions on purpose — jit's AOT path
+            # (.lower().compile()) returns a separate executable and
+            # does NOT seed the call cache the serving loop hits.
             idle = jnp.zeros((B,), bool)
             warm_rng = jax.random.PRNGKey(0)
             for n_r in (1, self.decode_block):
