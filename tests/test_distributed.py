@@ -321,3 +321,23 @@ def test_dynamic_batching_backend_concurrent_parity():
         header.shutdown_pipeline()
         for t in threads:
             t.join(timeout=30)
+
+
+def test_dynamic_batching_backend_close_drains_waiters():
+    """close() must fail queued waiters with a clear error instead of
+    hanging them, and reject post-close submissions."""
+    from distributed_inference_demo_tpu.runtime.dynamic_batch import (
+        DynamicBatchingHeaderBackend)
+
+    header, threads = build_pipeline("llama-test", 2)
+    backend = DynamicBatchingHeaderBackend(header, max_seq=128,
+                                           num_stages=2, pool_size=2)
+    prompt = np.array([[5, 17, 42]], dtype=np.int32)
+    # one request completes normally first (proves the loop was live)
+    assert backend.generate(prompt, 4).tokens.shape == (1, 4)
+    backend.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        backend.generate(prompt, 4)
+    header.shutdown_pipeline()
+    for t in threads:
+        t.join(timeout=30)
