@@ -136,7 +136,11 @@ class KVCache:
     @staticmethod
     def create(cfg: ModelConfig, num_layers: int, batch: int,
                max_seq: Optional[int] = None, dtype=None) -> "KVCache":
-        max_seq = max_seq or cfg.max_seq_len
+        # requested capacity is a lower bound: the buffer is padded to the
+        # sublane granule HERE, at the one choke point, so no engine can
+        # reintroduce the flash kernel's divisible-by-8 crash by forgetting
+        # to pad (see pad_cache_capacity below)
+        max_seq = pad_cache_capacity(max_seq or cfg.max_seq_len)
         dtype = dtype or cfg.dtype
         shape = (num_layers, batch, cfg.num_kv_heads, max_seq, cfg.head_dim)
         return KVCache(
@@ -148,6 +152,21 @@ class KVCache:
     @property
     def max_seq(self) -> int:
         return self.keys.shape[3]
+
+
+def pad_cache_capacity(n: int) -> int:
+    """KV buffer capacity rounded up to the TPU sublane granule (8).
+
+    The flash kernel streams [block_k, head_dim] K/V tiles whose sublane
+    dimension must divide into the cache's sequence axis in multiples of 8
+    (``ops/flash_attention.py:_pick_block``), so every engine allocates its
+    cache a few slots larger than the user-facing ``max_seq`` bound when
+    that bound isn't already aligned.  Purely a buffer-shape concern: the
+    extra slots sit beyond every valid length and stay masked (the same
+    stale-slot invariant that covers speculative rollback and batching
+    admission), and the capacity CHECK (``check_capacity``) still enforces
+    the caller's ``max_seq``."""
+    return -(-n // 8) * 8
 
 
 @partial(jax.tree_util.register_dataclass,

@@ -54,7 +54,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
+from ..models.base import (KVCache, ModelConfig, StageParams,
+                           StageSpec, pad_cache_capacity)
 from ..models.decoder import stage_forward
 from ..ops.sampling import SamplingParams, filtered_logits, sample_logits
 from .engine import GenerationResult, check_capacity
@@ -201,7 +202,13 @@ class ContinuousBatchingEngine:
         ) or (self.max_seq,)
 
         cfg_, spec_, samp_ = cfg, self.spec, sampling
-        B, S = max_batch, self.max_seq
+        # S is a BUFFER capacity (row caches, the batch cache, history),
+        # sublane-aligned for the flash kernel and held equal across the
+        # row/batch dynamic_update_slice pairs; admission limits still
+        # check the caller's max_seq.  (KVCache.create would pad each
+        # buffer anyway — padding S once keeps the row and batch shapes
+        # derived from ONE number.)
+        B, S = max_batch, pad_cache_capacity(self.max_seq)
 
         from ..parallel.tensor import make_forward_seam
         fwd, self._cache_sharding = make_forward_seam(

@@ -284,6 +284,8 @@ class InferenceEngine:
         return jnp.int32(self.eos_id if self.eos_id is not None else -1)
 
     def new_cache(self, batch: int) -> KVCache:
+        # KVCache.create pads the buffer to the sublane granule; max_seq
+        # stays the enforced capacity bound (check_capacity)
         cache = KVCache.create(self.cfg, self.cfg.num_layers, batch,
                                self.max_seq, dtype=self.kv_cache_dtype)
         if self._cache_sharding is not None:
@@ -320,6 +322,12 @@ class InferenceEngine:
                     padded, i * C, C, axis=1),
                 cache, jnp.int32(i * C))
         start = min((n_chunks - 1) * C, self.max_seq - C)
+        # the left shift must apply to the cache WRITE offset too (the
+        # insert position is cache.length inside stage_forward), so the
+        # column==position invariant holds; with the buffer padded past
+        # max_seq (pad_cache_capacity) the old implicit
+        # dynamic_update_slice start-clamp no longer realizes it
+        cache = KVCache(cache.keys, cache.values, jnp.int32(start))
         last_logits, cache = self._prefill_chunk_last(
             self.params, jax.lax.dynamic_slice_in_dim(
                 padded, start, C, axis=1),

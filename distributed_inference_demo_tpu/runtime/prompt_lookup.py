@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
+from ..models.base import (KVCache, ModelConfig, StageParams,
+                           StageSpec, pad_cache_capacity)
 from ..models.decoder import stage_forward
 from ..ops.flash_attention import make_flash_attn_impl
 from ..ops.sampling import SamplingParams, sample_logits
@@ -114,7 +115,8 @@ class PromptLookupEngine:
                      else None)
 
         cfg_, spec_, samp_, K = cfg, self.spec, sampling, num_draft
-        cap = self.max_seq + num_draft + 2   # history/cache slack per round
+        # history/cache slack per round, sublane-aligned for flash
+        cap = pad_cache_capacity(self.max_seq + num_draft + 2)
 
         from ..parallel.tensor import make_forward_seam
         fwd, self._cache_sharding = make_forward_seam(

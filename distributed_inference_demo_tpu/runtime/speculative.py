@@ -387,7 +387,8 @@ class SpeculativeEngine:
 
     def new_caches(self, batch: int):
         # +num_draft+1 slack: a round may write K+1 positions past the
-        # valid length before the rollback trims it
+        # valid length before the rollback trims it (KVCache.create pads
+        # the buffer to the sublane granule on top)
         cap = self.max_seq + self.num_draft + 1
         tc = KVCache.create(self.cfg, self.cfg.num_layers, batch, cap)
         dc = KVCache.create(self.draft_cfg, self.draft_cfg.num_layers,

@@ -246,6 +246,46 @@ def test_attn_backend_flash_interpret_parity():
     np.testing.assert_array_equal(toks["jnp"], toks["flash-interpret"])
 
 
+def test_flash_accepts_misaligned_max_seq():
+    """A max_seq that is NOT a multiple of 8 must still work on the flash
+    backend: the engine pads the cache BUFFER to the sublane granule
+    (models/base.pad_cache_capacity) while check_capacity keeps enforcing
+    the caller's bound.  Regression: the r04 bench speculative leg died
+    with 'flash attention requires max_seq divisible by 8, got 197'."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 11))
+    toks = {}
+    for backend in ("jnp", "flash-interpret"):
+        eng = InferenceEngine(cfg, params, max_seq=27,
+                              sampling=SamplingParams(greedy=True),
+                              attn_backend=backend)
+        assert eng.new_cache(2).max_seq == 32     # padded buffer
+        toks[backend] = eng.generate(prompt, 8, seed=0).tokens
+        with pytest.raises(ValueError, match="exceeds KV-cache capacity"):
+            eng.generate(prompt, 17, seed=0)      # 11+17 > 27 still rejected
+    np.testing.assert_array_equal(toks["jnp"], toks["flash-interpret"])
+
+
+def test_chunked_prefill_misaligned_max_seq(engine):
+    """Chunked prefill x non-multiple-of-8 max_seq: the left-shifted final
+    chunk must WRITE at the shifted offset explicitly.  With the buffer
+    padded past max_seq (27 -> 32) the old implicit dynamic_update_slice
+    clamp lands at 32-8=24 instead of start=19, scattering the last
+    chunk's K/V to the wrong columns — this pins the explicit
+    length=start rewind in _run_prefill (engine.py)."""
+    cfg = engine.cfg
+    whole = InferenceEngine(cfg, engine.params, max_seq=27,
+                            sampling=SamplingParams(greedy=True))
+    chunked = InferenceEngine(cfg, engine.params, max_seq=27,
+                              sampling=SamplingParams(greedy=True),
+                              prefill_chunk=8)
+    prompt = (np.arange(2 * 25).reshape(2, 25) % 199).astype(np.int32)
+    want = whole.generate(prompt, 2).tokens
+    got = chunked.generate(prompt, 2).tokens
+    np.testing.assert_array_equal(want, got)
+
+
 def test_attn_backend_rejects_unknown():
     cfg = get_model_config("llama-test")
     params = init_full_params(jax.random.PRNGKey(0), cfg)

@@ -231,6 +231,18 @@ def test_capacity_guard(params, draft_params):
         spec.generate(np.zeros((1, 30), np.int64), 10)
 
 
+def test_cache_capacity_sublane_aligned(params, draft_params):
+    """The draft-window slack (+K+1) lands on a multiple of 8 so the flash
+    kernel accepts the buffers (r04 bench regression: max_seq=192, K=4
+    allocated 197 and the flash trace raised)."""
+    spec = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                             max_seq=192, num_draft=4,
+                             sampling=SamplingParams(greedy=True))
+    tc, dc = spec.new_caches(1)
+    assert tc.max_seq % 8 == 0 and tc.max_seq >= 197
+    assert dc.max_seq % 8 == 0
+
+
 def test_eos_padding_matches_engine(params, draft_params):
     """With eos_id set, greedy spec decode equals InferenceEngine's
     eos-padded fused scan bit-exactly (rows pad with eos after their
