@@ -903,6 +903,39 @@ def run_leg(name: str, p: dict) -> dict:
     return out
 
 
+def headline_summary(headline: dict, params: dict, device: str) -> dict:
+    """The artifact's top-level metric/value/vs_baseline/baseline block —
+    ONE owner for the comparability caveats, shared by main() and the
+    incremental session harness (tools/measure_session.py).
+
+    Only a same-model/batch/prompt/new-tokens comparison is meaningful;
+    anything else reports null rather than a mislabeled multiplier.  The
+    one stated asymmetry is dtype: CPU runs f32 (its native dtype — bf16
+    is emulated and slower there), TPU runs bf16."""
+    baseline = _load_baseline()
+    tps = headline.get("decode_tokens_per_sec")
+    base_tps = baseline.get("tokens_per_sec")
+    comparable = all(
+        baseline.get(k) == params[k]
+        for k in ("model", "batch", "prompt_len", "new_tokens"))
+    vs = (round(tps / base_tps, 2)
+          if tps is not None and base_tps and comparable else None)
+    return {
+        "metric": f"decode tokens/sec ({params['model']}, "
+                  f"{headline.get('dtype', '?')}, batch={params['batch']}, "
+                  f"prompt={params['prompt_len']}, "
+                  f"new={params['new_tokens']}, "
+                  f"device={device}) vs measured 2-process CPU "
+                  f"socket-pipeline baseline (same model/batch/prompt/new; "
+                  f"CPU at f32, its native dtype)",
+        "value": tps,
+        "vs_baseline": vs,
+        "baseline": {k: baseline.get(k) for k in
+                     ("tokens_per_sec", "model", "dtype", "batch", "host",
+                      "cpu", "measured_at", "source")},
+    }
+
+
 def _run_group_killable(cmd, timeout: int):
     """Run ``cmd`` in its own process GROUP; on timeout kill the whole
     group (children included — e.g. the planner leg's server/worker hold
@@ -1031,7 +1064,6 @@ def main() -> None:
         if isinstance(results[leg], dict):
             results[leg]["leg_seconds"] = round(time.perf_counter() - t0, 1)
 
-    baseline = _load_baseline()
     headline = results.get("headline", {})
     # headline may have errored; any leg that reached the device knows it
     # (planner_pipeline excluded: its device field is a topology
@@ -1040,22 +1072,9 @@ def main() -> None:
         (r["device"] for name, r in results.items()
          if name != "planner_pipeline"
          and isinstance(r, dict) and r.get("device")), "unknown")
-    tps = headline.get("decode_tokens_per_sec")
-    base_tps = baseline.get("tokens_per_sec")
-    # only a same-model/batch/prompt/new-tokens comparison is meaningful;
-    # anything else reports null rather than a mislabeled multiplier.  The
-    # one stated asymmetry is dtype: CPU runs f32 (its native dtype — bf16
-    # is emulated and slower there), TPU runs bf16.
-    comparable = all(
-        baseline.get(k) == params[k]
-        for k in ("model", "batch", "prompt_len", "new_tokens"))
-    vs = (round(tps / base_tps, 2)
-          if tps is not None and base_tps and comparable else None)
+    summary = headline_summary(headline, params, device)
 
-    extras = {"device": device, "baseline": {
-        k: baseline.get(k) for k in
-        ("tokens_per_sec", "model", "dtype", "batch", "host", "cpu",
-         "measured_at", "source")}}
+    extras = {"device": device, "baseline": summary["baseline"]}
     extras.update({k: v for k, v in results.items() if k != "headline"})
 
     # roofline fractions against THIS chip's measured HBM ceiling (the
@@ -1073,16 +1092,10 @@ def main() -> None:
             add_measured(pt)
 
     print(json.dumps({
-        "metric": f"decode tokens/sec ({params['model']}, "
-                  f"{headline.get('dtype', '?')}, batch={params['batch']}, "
-                  f"prompt={params['prompt_len']}, "
-                  f"new={params['new_tokens']}, "
-                  f"device={device}) vs measured 2-process CPU "
-                  f"socket-pipeline baseline (same model/batch/prompt/new; "
-                  f"CPU at f32, its native dtype)",
-        "value": tps,
+        "metric": summary["metric"],
+        "value": summary["value"],
         "unit": "tokens/sec",
-        "vs_baseline": vs,
+        "vs_baseline": summary["vs_baseline"],
         "headline": headline,
         "extras": extras,
     }))

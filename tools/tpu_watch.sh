@@ -10,22 +10,26 @@
 #   nohup bash tools/tpu_watch.sh >> /tmp/tpu_watch.log 2>&1 &
 cd "$(dirname "$0")/.."
 ART="${1:-BENCH_SELF_r04.json}"
+# probe log named after the artifact's round tag (BENCH_SELF_r04.json ->
+# PROBES_r04.log) so a future round's watcher doesn't mislabel its output
+TAG=$(basename "$ART" .json); TAG=${TAG#BENCH_SELF_}
+PLOG="PROBES_${TAG}.log"
 while true; do
   echo "=== watch tick $(date -u +%H:%M:%S) ==="
   python tools/measure_session.py --artifact "$ART"
   rc=$?
   if [ "$rc" -eq 0 ]; then
     echo "=== all legs done; running probes ==="
-    for p in decode_profile_probe int8_dequant_probe sampling_cost_probe; do
-      [ -f "tools/$p.py" ] || continue
-      echo "=== probe $p $(date -u +%H:%M:%S) ==="
-      timeout 2400 python "tools/$p.py" 2>&1
-    done
     { echo "# Probe output from tools/tpu_watch.sh at $(date -u +%FT%TZ)."
       echo "# (bench legs live in $ART; this file is the probe log)"
-      tail -n 300 /tmp/tpu_watch.log; } > PROBES_r04.log
-    git add PROBES_r04.log
-    git commit -m "Record r04 probe log" -- PROBES_r04.log
+      for p in decode_profile_probe int8_dequant_probe sampling_cost_probe; do
+        [ -f "tools/$p.py" ] || continue
+        echo "=== probe $p $(date -u +%H:%M:%S) ==="
+        timeout 2400 python "tools/$p.py" 2>&1
+      done
+    } | tee "$PLOG"
+    git add "$PLOG"
+    git commit -m "Record $TAG probe log" -- "$PLOG"
     echo "=== watcher done ==="
     exit 0
   fi
