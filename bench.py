@@ -49,6 +49,9 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent
 BASELINE_PATH = REPO / "tools" / "cpu_baseline.json"
+# the round's incremental-session artifact (tools/measure_session.py) —
+# ONE owner for the name, shared with the session harness; bump per round
+PRIOR_ARTIFACT_NAME = "BENCH_SELF_r04.json"
 
 # Approximate HBM bandwidth by device kind, for roofline fractions in the
 # report (sources: public TPU specs; v5e ~819 GB/s, v4 ~1228 GB/s).
@@ -903,6 +906,39 @@ def run_leg(name: str, p: dict) -> dict:
     return out
 
 
+def _load_prior() -> dict:
+    """Measured legs from this round's incremental-session artifact
+    (tools/measure_session.py), used ONLY to annotate a live run's failed
+    legs: the r03 driver bench printed all-null because the tunnel was
+    down at round end even though the same numbers had been measured
+    hours earlier.  Prior results are always labeled as prior — they
+    never masquerade as the live run's."""
+    name = os.environ.get("BENCH_PRIOR_ARTIFACT", PRIOR_ARTIFACT_NAME)
+    path = REPO / name
+    try:
+        art = json.loads(path.read_text())
+        mtime = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              time.gmtime(path.stat().st_mtime))
+    except (OSError, json.JSONDecodeError):
+        return {}
+    # provenance rides every prior label: which file, written when — so a
+    # stale artifact (e.g. a new round without the constant bumped) is
+    # visible instead of masquerading as fresh
+    art_src = f"{name} (written {mtime})"
+    legs = {}
+    h = art.get("headline") or {}
+    if h and "error" not in h:
+        legs["headline"] = h
+    for k, v in (art.get("extras") or {}).items():
+        if k in ("baseline", "device") or k.endswith("_rerun"):
+            continue
+        if isinstance(v, dict) and v and "error" not in v:
+            legs[k] = v
+    return {"legs": legs, "note": art.get("note", ""), "source": art_src,
+            "metric": art.get("metric"), "value": art.get("value"),
+            "vs_baseline": art.get("vs_baseline")}
+
+
 def headline_summary(headline: dict, params: dict, device: str) -> dict:
     """The artifact's top-level metric/value/vs_baseline/baseline block —
     ONE owner for the comparability caveats, shared by main() and the
@@ -940,12 +976,22 @@ def _run_group_killable(cmd, timeout: int):
     """Run ``cmd`` in its own process GROUP; on timeout kill the whole
     group (children included — e.g. the planner leg's server/worker hold
     the exclusive TPU and ports) and survive a D-state child on a wedged
-    tunnel.  Returns (returncode_or_None_on_timeout, stdout, stderr)."""
+    tunnel.  Returns (returncode_or_None_on_timeout, stdout, stderr).
+
+    Every child gets JAX's persistent compilation cache pointed at a
+    repo-local dir: leg wall-time over the tunnel is compile-dominated,
+    and the cache makes a re-run of the same leg (watcher session now,
+    driver bench at round end) nearly compile-free.  Harmless where the
+    backend ignores it — a miss is just the normal path."""
     import signal
 
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   str(REPO / ".jax_cache"))
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
-                            cwd=str(REPO), start_new_session=True)
+                            cwd=str(REPO), start_new_session=True,
+                            env=env)
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
         return proc.returncode, stdout, stderr
@@ -1033,12 +1079,28 @@ def main() -> None:
         last = ((p_err or "").strip().splitlines() or ["?"])[-1]
         reason = f"device probe exited rc={rc}: {last}"
     if not backend_ok:
-        print(json.dumps({
+        out = {
             "metric": "decode tokens/sec (backend unreachable)",
             "value": None, "unit": "tokens/sec", "vs_baseline": None,
             "headline": {},
             "extras": {"error": f"backend unreachable, no leg attempted: "
-                                f"{reason}"}}))
+                                f"{reason}"}}
+        prior = _load_prior()
+        if prior.get("legs"):
+            # surface this round's incremental-session measurements so an
+            # end-of-round tunnel outage can't zero the round's evidence;
+            # every field says PRIOR
+            out["value"] = prior["value"]
+            out["vs_baseline"] = prior["vs_baseline"]
+            out["metric"] = (
+                (prior["metric"] or out["metric"])
+                + f" [PRIOR measurement from {prior['source']}; the live "
+                  "end-of-round run could not reach the device]")
+            out["headline"] = prior["legs"].get("headline", {})
+            out["extras"]["prior_legs"] = {
+                k: v for k, v in prior["legs"].items() if k != "headline"}
+            out["extras"]["prior_note"] = prior["note"]
+        print(json.dumps(out))
         return
 
     # global deadline: the tunnel TPU hangs for many minutes at times, and
@@ -1074,8 +1136,37 @@ def main() -> None:
          and isinstance(r, dict) and r.get("device")), "unknown")
     summary = headline_summary(headline, params, device)
 
+    # failed legs get this round's incremental-session result attached
+    # (labeled PRIOR, never replacing the live error) so a mid-run tunnel
+    # wedge can't zero out evidence that already exists
+    prior = _load_prior()
+    for leg, r in results.items():
+        if (isinstance(r, dict) and "error" in r
+                and leg in prior.get("legs", {})):
+            r["prior_measurement"] = dict(prior["legs"][leg])
+            r["prior_measurement"]["prior_note"] = (
+                f"prior measurement from {prior['source']}; the live leg "
+                "errored as recorded above")
+    headline_is_prior = False
+    if (summary["value"] is None and "headline" in prior.get("legs", {})
+            and prior.get("metric")):
+        # reuse the artifact's OWN stored metric/value/vs_baseline (they
+        # were computed against the prior headline's params — recomputing
+        # with this run's params could mislabel the comparison)
+        summary = {"metric": prior["metric"]
+                   + f" [PRIOR measurement from {prior['source']}; the "
+                     "live headline leg errored]",
+                   "value": prior["value"],
+                   "vs_baseline": prior["vs_baseline"],
+                   "baseline": summary["baseline"]}
+        headline = prior["legs"]["headline"]
+        headline_is_prior = True
+
     extras = {"device": device, "baseline": summary["baseline"]}
     extras.update({k: v for k, v in results.items() if k != "headline"})
+    if headline_is_prior:
+        # the substituted headline must not hide the live failure
+        extras["headline_live_error"] = results.get("headline")
 
     # roofline fractions against THIS chip's measured HBM ceiling (the
     # paper-spec fraction stays in each leg as hbm_roofline_frac)
@@ -1085,7 +1176,10 @@ def main() -> None:
             if isinstance(leg, dict) and leg.get("achieved_gbs"):
                 leg["hbm_roofline_frac_measured"] = round(
                     leg["achieved_gbs"] / measured, 3)
-        add_measured(headline)
+        if not headline_is_prior:
+            # a prior headline keeps ITS session's measured-ceiling
+            # fraction; this run's probe doesn't describe that session
+            add_measured(headline)
         for key in ("headline_int8", "flagship_int8", "flagship_bf16"):
             add_measured(extras.get(key, {}))
         for pt in extras.get("sweep", {}).get("points", []):

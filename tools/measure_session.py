@@ -160,7 +160,7 @@ def commit(path: Path, msg: str):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--artifact", default="BENCH_SELF_r04.json")
+    ap.add_argument("--artifact", default=bench.PRIOR_ARTIFACT_NAME)
     ap.add_argument("--legs", default=",".join(DEFAULT_LEGS))
     ap.add_argument("--force", default="",
                     help="comma list of legs to re-run even if done")
