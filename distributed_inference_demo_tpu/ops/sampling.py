@@ -48,6 +48,59 @@ def kth_largest(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.max(x, axis=-1, keepdims=True)
 
 
+def topk_vals_idx(logits: jnp.ndarray, k: int, with_mask: bool = False):
+    """Exact top-k (values, indices) of [..., vocab] logits via k
+    argmax-and-mask passes — no full-vocab sort.  Ties resolve to the
+    first occurrence per round, i.e. the same index set as
+    ``lax.top_k``.  Same O(k*V) elementwise shape as :func:`kth_largest`
+    (which keeps only the k-th VALUE); this variant also carries the
+    indices so the sampler can draw over k candidates instead of the
+    whole vocab.  ``with_mask`` additionally returns the boolean
+    membership mask over the vocab axis (accumulated for free during the
+    passes — it is exactly the set of removed maxima)."""
+    x = logits
+    iota = jnp.arange(x.shape[-1])
+    vals, idxs = [], []
+    member = jnp.zeros(x.shape, bool)
+    for _ in range(k):
+        i = jnp.argmax(x, axis=-1)
+        vals.append(jnp.take_along_axis(x, i[..., None], axis=-1)[..., 0])
+        idxs.append(i)
+        hit = iota == i[..., None]
+        member = member | hit
+        x = jnp.where(hit, -jnp.inf, x)
+    out = (jnp.stack(vals, axis=-1), jnp.stack(idxs, axis=-1))
+    return out + (member,) if with_mask else out
+
+
+def topk_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Boolean membership mask of the exactly-k first-occurrence top-k
+    over [..., vocab] — the ONE tie semantic shared by
+    :func:`filtered_logits` and :func:`sample_logits`'s fused draw (a
+    value-threshold mask would keep MORE than k tokens when logits tie
+    at the k-th boundary, silently diverging from the fused draw's
+    distribution).  Small k: iterative passes; large k: ``lax.top_k``
+    (same first-occurrence tie rule) + scatter."""
+    if k <= 32:
+        return topk_vals_idx(logits, k, with_mask=True)[2]
+    _, idx = jax.lax.top_k(logits, k)
+    flat = idx.reshape(-1, k)
+    m = jnp.zeros((flat.shape[0], logits.shape[-1]), bool)
+    m = m.at[jnp.arange(flat.shape[0])[:, None], flat].set(True)
+    return m.reshape(logits.shape)
+
+
+def _temperature_scaled(logits: jnp.ndarray,
+                        params: SamplingParams) -> jnp.ndarray:
+    """f32 + temperature preamble shared by filtered_logits and the fused
+    draw — one owner, so the two distribution-identical paths cannot
+    drift."""
+    logits = logits.astype(jnp.float32)
+    if params.temperature != 1.0:
+        logits = logits / jnp.maximum(params.temperature, 1e-6)
+    return logits
+
+
 def filtered_logits(logits: jnp.ndarray,
                     params: SamplingParams) -> jnp.ndarray:
     """Apply temperature / top-k / top-p to [..., vocab] logits.
@@ -59,17 +112,14 @@ def filtered_logits(logits: jnp.ndarray,
     sampler it must stay consistent with.  Not meaningful for greedy
     (argmax needs no distribution).
     """
-    logits = logits.astype(jnp.float32)
-    if params.temperature != 1.0:
-        logits = logits / jnp.maximum(params.temperature, 1e-6)
+    logits = _temperature_scaled(logits, params)
 
     if params.top_k > 0 and params.top_k < logits.shape[-1]:
-        # small k (the serving default is 7): iterative exact kth value,
-        # no full-vocab sort; large k: lax.top_k's sort wins
-        kth = (kth_largest(logits, params.top_k)
-               if params.top_k <= 32 else
-               jax.lax.top_k(logits, params.top_k)[0][..., -1:])
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        # exactly-k first-occurrence membership (topk_mask) — NOT a
+        # value threshold, which would keep extra boundary-tied tokens
+        # and diverge from the fused draw in sample_logits
+        logits = jnp.where(topk_mask(logits, params.top_k),
+                           logits, -jnp.inf)
 
     if params.top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
@@ -86,8 +136,26 @@ def filtered_logits(logits: jnp.ndarray,
 
 def sample_logits(logits: jnp.ndarray, rng: jax.Array,
                   params: SamplingParams) -> jnp.ndarray:
-    """Sample next-token ids from [batch, vocab] logits -> [batch] int32."""
+    """Sample next-token ids from [batch, vocab] logits -> [batch] int32.
+
+    Small-k top-k sampling (the serving default, k=7) draws the
+    categorical over the [batch, k] candidate VALUES and gathers the
+    chosen index, instead of masking the vocab and drawing over
+    [batch, vocab] — saves the full-vocab gumbel+softmax passes that
+    grow with batch (see tools/sampling_cost_probe.py).  The sampling
+    DISTRIBUTION is identical to ``softmax(filtered_logits(...))`` (the
+    contract speculative decoding's accept/resample rule depends on);
+    only the RNG consumption pattern differs, so a fixed seed yields a
+    different — equally distributed — sequence than the full-vocab
+    draw would."""
     if params.greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    k = params.top_k
+    if 0 < k <= 32 and k < logits.shape[-1] and params.top_p >= 1.0:
+        x = _temperature_scaled(logits, params)
+        vals, idx = topk_vals_idx(x, k)
+        choice = jax.random.categorical(rng, vals, axis=-1)
+        return jnp.take_along_axis(
+            idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
     return jax.random.categorical(
         rng, filtered_logits(logits, params), axis=-1).astype(jnp.int32)

@@ -168,3 +168,53 @@ def test_sampling_modes():
     # top_p tiny -> only the argmax survives
     topp = sample_logits(logits, rng, SamplingParams(top_k=0, top_p=0.1))
     assert (np.asarray(topp) == 1).all()
+
+
+def test_topk_vals_idx_matches_lax_topk():
+    from distributed_inference_demo_tpu.ops.sampling import topk_vals_idx
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 257).astype(np.float32))
+    # plant duplicates to exercise the tie rule
+    x = x.at[:, 11].set(x[:, 3])
+    want_v, want_i = jax.lax.top_k(x, 7)
+    got_v, got_i = topk_vals_idx(x, 7)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v))
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+
+
+def test_topk_boundary_ties_exactly_k():
+    """Logits tying AT the k-th boundary: both the filter and the fused
+    draw must keep exactly k first-occurrence tokens — a value-threshold
+    filter would keep the tied extra and diverge from the fused draw's
+    distribution (the speculative accept/resample contract)."""
+    from distributed_inference_demo_tpu.ops.sampling import filtered_logits
+    params = SamplingParams(temperature=1.0, top_k=2)
+    logits = jnp.asarray([[5.0, 3.0, 3.0, 1.0]])
+    f = np.asarray(filtered_logits(logits, params))[0]
+    assert np.isfinite(f).sum() == 2         # exactly k survive
+    assert np.isfinite(f[[0, 1]]).all()      # first occurrence of the tie
+    for s in range(50):
+        tok = int(sample_logits(logits, jax.random.PRNGKey(s), params)[0])
+        assert tok in (0, 1)
+
+
+def test_topk_fused_draw_matches_filtered_distribution():
+    """The [b, k] candidate draw must follow the SAME distribution as a
+    categorical over softmax(filtered_logits) — the contract speculative
+    decoding's accept/resample rule depends on.  Compare empirical
+    frequencies over many seeds against the exact probabilities."""
+    from distributed_inference_demo_tpu.ops.sampling import filtered_logits
+    params = SamplingParams(temperature=0.7, top_k=3)
+    logits = jnp.asarray([[0.0, 2.0, 1.0, -1.0, 1.5]])
+    p_exact = np.asarray(
+        jax.nn.softmax(filtered_logits(logits, params), axis=-1))[0]
+    draws = np.asarray([
+        int(sample_logits(logits, jax.random.PRNGKey(s), params)[0])
+        for s in range(4000)])
+    freq = np.bincount(draws, minlength=5) / draws.size
+    # zero-probability tokens must never appear; kept tokens within 3 sigma
+    assert freq[p_exact == 0].sum() == 0
+    for tok in np.nonzero(p_exact)[0]:
+        sigma = np.sqrt(p_exact[tok] * (1 - p_exact[tok]) / draws.size)
+        assert abs(freq[tok] - p_exact[tok]) < 3 * sigma + 1e-9, (
+            tok, freq[tok], p_exact[tok])

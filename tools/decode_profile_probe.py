@@ -96,17 +96,35 @@ def main():
         np.asarray(out)          # axon-safe fence
         return (time.perf_counter() - t0) / reps * 1000
 
-    from distributed_inference_demo_tpu.ops.sampling import kth_largest
+    from distributed_inference_demo_tpu.ops.sampling import (
+        SamplingParams as SP, filtered_logits, kth_largest, sample_logits,
+        topk_vals_idx)
     key = jax.random.PRNGKey(0)
+    samp7 = SP(temperature=0.7, top_k=7)
+
+    def full_vocab_draw(k, x):
+        # the pre-r04 sampler: mask the vocab, gumbel over [b, V]
+        return jax.random.categorical(k, filtered_logits(x, samp7), axis=-1)
+
+    def fused_draw(k, x):
+        # the r04 sampler: k argmax passes -> categorical over [b, k]
+        return sample_logits(x, k, samp7)
+
     variants = {
         "top_k": jax.jit(lambda x: jax.lax.top_k(x, 7)[0][..., -1]),
         "iter_kth": jax.jit(lambda x: kth_largest(x, 7)[..., 0]),
+        "iter_topk_vi": jax.jit(lambda x: topk_vals_idx(x, 7)[0]),
         "argmax": jax.jit(lambda x: jnp.argmax(x, -1)),
         # the OTHER half of the sampling tax: the [b, vocab] gumbel draw
         # (the key rides in as an argument — a baked constant key would
         # let XLA constant-fold the whole noise tensor out of the timing)
         "categorical": (lambda f: lambda x: f(key, x))(jax.jit(
             lambda k, x: jax.random.categorical(k, x, axis=-1))),
+        # end-to-end samplers, old vs new (same distribution, different
+        # draw shape: [b, V] gumbel vs [b, 7])
+        "full_draw": (lambda f: lambda x: f(key, x))(jax.jit(
+            full_vocab_draw)),
+        "fused_draw": (lambda f: lambda x: f(key, x))(jax.jit(fused_draw)),
     }
     for b in BATCHES:
         logits = jax.random.normal(jax.random.PRNGKey(1), (b, 32000),
