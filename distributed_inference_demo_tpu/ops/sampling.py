@@ -112,14 +112,18 @@ def filtered_logits(logits: jnp.ndarray,
     sampler it must stay consistent with.  Not meaningful for greedy
     (argmax needs no distribution).
     """
+    # top-k membership is computed on the NATIVE-dtype logits, BEFORE
+    # temperature scaling — the same selection rule as sample_logits'
+    # fused draw, so the two paths keep identical candidate sets by
+    # construction (scaling first could collapse 1-ulp-apart f32 values
+    # into a boundary tie and flip the kept set).  Exactly-k
+    # first-occurrence membership (topk_mask), NOT a value threshold,
+    # which would keep extra boundary-tied tokens.
+    keep = (topk_mask(logits, params.top_k)
+            if 0 < params.top_k < logits.shape[-1] else None)
     logits = _temperature_scaled(logits, params)
-
-    if params.top_k > 0 and params.top_k < logits.shape[-1]:
-        # exactly-k first-occurrence membership (topk_mask) — NOT a
-        # value threshold, which would keep extra boundary-tied tokens
-        # and diverge from the fused draw in sample_logits
-        logits = jnp.where(topk_mask(logits, params.top_k),
-                           logits, -jnp.inf)
+    if keep is not None:
+        logits = jnp.where(keep, logits, -jnp.inf)
 
     if params.top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
@@ -152,8 +156,12 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     k = params.top_k
     if 0 < k <= 32 and k < logits.shape[-1] and params.top_p >= 1.0:
-        x = _temperature_scaled(logits, params)
-        vals, idx = topk_vals_idx(x, k)
+        # select on the NATIVE dtype — the same rule filtered_logits
+        # applies (its top-k mask is also computed pre-scaling), so the
+        # candidate SET is identical by construction — then scale only
+        # the [batch, k] values: no full-vocab f32 cast or divide pass
+        vals, idx = topk_vals_idx(logits, k)
+        vals = _temperature_scaled(vals, params)
         choice = jax.random.categorical(rng, vals, axis=-1)
         return jnp.take_along_axis(
             idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
