@@ -1,0 +1,102 @@
+"""The measurement tooling is round evidence infrastructure — pin its
+merge/guard semantics so a regression can't silently destroy measured
+results (bench.py `_load_prior`/`headline_summary`, tools/measure_session
+merge/retry logic).  Pure-JSON logic, no device needed."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+def _ms():
+    spec = importlib.util.spec_from_file_location(
+        "measure_session", REPO / "tools" / "measure_session.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+PARAMS = {"model": "m", "batch": 8, "prompt_len": 64, "new_tokens": 128,
+          "flagship": "f"}
+
+
+def test_merge_error_never_clobbers_measured():
+    ms = _ms()
+    art = {"note": "", "headline": {}, "extras": {}}
+    art = ms.merge(art, "sweep", {"points": [1]}, PARAMS)
+    art = ms.merge(art, "sweep", {"error": "late boom"}, PARAMS)
+    assert art["extras"]["sweep"] == {"points": [1]}
+    assert "error" in art["extras"]["sweep_rerun"]
+
+
+def test_merge_retry_attempts_and_exhaustion():
+    ms = _ms()
+    art = {"note": "", "headline": {}, "extras": {}}
+    for n in range(ms.MAX_ATTEMPTS):
+        assert not ms.leg_exhausted(art, "sweep")
+        art = ms.merge(art, "sweep", {"error": "boom"}, PARAMS)
+    assert ms.leg_exhausted(art, "sweep")
+    # a success resets the ledger
+    art = ms.merge(art, "sweep", {"points": [2]}, PARAMS)
+    assert ms.leg_done(art, "sweep") and not ms.leg_exhausted(art, "sweep")
+
+
+def test_merge_headline_error_never_clobbers_measured():
+    ms = _ms()
+    art = {"note": "", "metric": "m0", "value": 1.0, "headline": {"x": 1},
+           "extras": {}}
+    art = ms.merge(art, "headline", {"error": "h"}, PARAMS)
+    # the measured top-level value/metric/headline survive the failure
+    assert art["value"] == 1.0 and art["metric"] == "m0"
+    assert art["headline"] == {"x": 1}
+    assert "error" in art["extras"]["headline_rerun"]
+    # a measured leg is done: it never re-enters the todo list, so
+    # exhaustion bookkeeping is moot for it
+    assert ms.leg_done(art, "headline")
+
+
+def test_merge_unmeasured_headline_errors_exhaust():
+    ms = _ms()
+    art = {"note": "", "headline": {}, "extras": {}}
+    for _ in range(ms.MAX_ATTEMPTS):
+        assert not ms.leg_exhausted(art, "headline")
+        art = ms.merge(art, "headline", {"error": "h"}, PARAMS)
+    assert art["headline"] == {}           # still unmeasured, never faked
+    assert ms.leg_exhausted(art, "headline")
+
+
+def test_load_prior_skips_errors_and_stamps_provenance(tmp_path,
+                                                       monkeypatch):
+    art = {"note": "n", "metric": "m", "value": 2.0, "vs_baseline": 3.0,
+           "headline": {"decode_tokens_per_sec": 2.0},
+           "extras": {"good": {"v": 1}, "bad": {"error": "x"},
+                      "bad_rerun": {"error": "y"},
+                      "baseline": {"tokens_per_sec": 1}}}
+    p = tmp_path / "prior.json"
+    p.write_text(json.dumps(art))
+    monkeypatch.setattr(bench, "REPO", tmp_path)
+    monkeypatch.setenv("BENCH_PRIOR_ARTIFACT", "prior.json")
+    prior = bench._load_prior()
+    assert set(prior["legs"]) == {"headline", "good"}
+    assert "prior.json" in prior["source"] and "written" in prior["source"]
+    assert prior["value"] == 2.0
+
+
+def test_load_prior_missing_artifact(monkeypatch, tmp_path):
+    monkeypatch.setattr(bench, "REPO", tmp_path)
+    assert bench._load_prior() == {}
+
+
+def test_headline_summary_null_when_not_comparable():
+    # a different batch than the stored CPU baseline must report null,
+    # never a mislabeled multiplier
+    s = bench.headline_summary(
+        {"decode_tokens_per_sec": 100.0, "dtype": "bf16"},
+        dict(PARAMS, model="tinyllama-1.1b", batch=999), "dev")
+    assert s["value"] == 100.0 and s["vs_baseline"] is None
