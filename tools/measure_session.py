@@ -198,6 +198,13 @@ def main():
         result = bench._spawn_leg(leg, params, timeout=budget)
         dt = round(time.perf_counter() - t0, 1)
         result["leg_seconds"] = dt
+        # legs land across hours as the tunnel allows, possibly spanning
+        # perf commits — stamp each with the code it actually measured
+        head = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              cwd=str(REPO), capture_output=True,
+                              text=True).stdout.strip()
+        if head:
+            result["git_head"] = head
         artifact = merge(artifact, leg, result, params)
         stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         # append session provenance without destroying the hand-written
