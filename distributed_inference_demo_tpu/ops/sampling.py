@@ -80,8 +80,11 @@ def topk_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     value-threshold mask would keep MORE than k tokens when logits tie
     at the k-th boundary, silently diverging from the fused draw's
     distribution).  Small k: iterative passes; large k: ``lax.top_k``
-    (same first-occurrence tie rule) + scatter."""
-    if k <= 32:
+    (same first-occurrence tie rule) + scatter.  Crossover at 16: the
+    unrolled argmax/mask/take rounds triple per-round ops vs a sort at
+    k=32 (compile time and program size grow linearly with k), while the
+    serving defaults (k<=8) stay comfortably on the sort-free path."""
+    if k <= 16:
         return topk_vals_idx(logits, k, with_mask=True)[2]
     _, idx = jax.lax.top_k(logits, k)
     flat = idx.reshape(-1, k)
@@ -155,7 +158,7 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array,
     if params.greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     k = params.top_k
-    if 0 < k <= 32 and k < logits.shape[-1] and params.top_p >= 1.0:
+    if 0 < k <= 16 and k < logits.shape[-1] and params.top_p >= 1.0:
         # select on the NATIVE dtype — the same rule filtered_logits
         # applies (its top-k mask is also computed pre-scaling), so the
         # candidate SET is identical by construction — then scale only

@@ -93,6 +93,62 @@ def test_load_prior_missing_artifact(monkeypatch, tmp_path):
     assert bench._load_prior() == {}
 
 
+def test_merge_forced_rerun_failures_accumulate_attempts():
+    # an errored --force re-run of a MEASURED leg lands in the rerun
+    # slot with a running attempts counter (without it, repeatedly
+    # failing forced re-runs never registered in the retry ledger)
+    ms = _ms()
+    art = {"note": "", "headline": {}, "extras": {}}
+    art = ms.merge(art, "sweep", {"points": [1]}, PARAMS)
+    art = ms.merge(art, "sweep", {"error": "a"}, PARAMS)
+    art = ms.merge(art, "sweep", {"error": "b"}, PARAMS)
+    assert art["extras"]["sweep"] == {"points": [1]}   # still measured
+    assert art["extras"]["sweep_rerun"]["attempts"] == 2
+
+
+def test_session_ceiling_is_max_probe_and_labels_suspect_legs():
+    ms = _ms()
+    art = {"note": "", "headline": {}, "extras": {
+        "roofline_probe": {"hbm_read_gbs": 300.0},
+        "probe_history": [{"hbm_gbs": 450.0}, {"hbm_gbs": 120.0}]}}
+    assert ms.session_ceiling(art) == 450.0
+    # a decode leg beating every probe gets labeled, not frac > 1 silence
+    art = ms.merge(art, "headline_int8", {"achieved_gbs": 500.0}, PARAMS)
+    r = art["extras"]["headline_int8"]
+    assert r["hbm_roofline_frac_measured"] > 1.0
+    assert "ceiling_suspect" in r
+    # a later, healthier probe raises the ceiling and clears the label
+    art["extras"]["probe_history"].append({"hbm_gbs": 600.0})
+    art = ms.merge(art, "pipeline", {"tok_s": 1}, PARAMS)
+    r = art["extras"]["headline_int8"]
+    assert r["hbm_roofline_frac_measured"] < 1.0
+    assert "ceiling_suspect" not in r
+    assert art["extras"]["measured_ceiling_gbs"] == 600.0
+
+
+def test_load_prior_chains_artifacts_with_per_leg_provenance(
+        tmp_path, monkeypatch):
+    new = {"note": "r5", "metric": "m5", "value": 5.0, "vs_baseline": 1.5,
+           "headline": {"decode_tokens_per_sec": 5.0},
+           "extras": {"probe_history": [{"hbm_gbs": 1}]}}
+    old = {"note": "r4", "metric": "m4", "value": 4.0, "vs_baseline": 1.4,
+           "headline": {"decode_tokens_per_sec": 4.0},
+           "extras": {"sweep": {"points": [1]}}}
+    (tmp_path / "new.json").write_text(json.dumps(new))
+    (tmp_path / "old.json").write_text(json.dumps(old))
+    monkeypatch.setattr(bench, "REPO", tmp_path)
+    monkeypatch.setenv("BENCH_PRIOR_ARTIFACT", "new.json")
+    monkeypatch.setattr(bench, "PRIOR_ARTIFACT_FALLBACKS", ["old.json"])
+    prior = bench._load_prior()
+    # headline from the newest artifact, sweep borrowed from the older
+    # one — each stamped with the artifact it came from
+    assert prior["value"] == 5.0
+    assert "new.json" in prior["legs"]["headline"]["prior_source"]
+    assert "old.json" in prior["legs"]["sweep"]["prior_source"]
+    # probe_history is session bookkeeping, never surfaced as a leg
+    assert "probe_history" not in prior["legs"]
+
+
 def test_headline_summary_null_when_not_comparable():
     # a different batch than the stored CPU baseline must report null,
     # never a mislabeled multiplier

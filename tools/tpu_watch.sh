@@ -9,7 +9,10 @@
 #
 #   nohup bash tools/tpu_watch.sh >> /tmp/tpu_watch.log 2>&1 &
 cd "$(dirname "$0")/.."
-ART="${1:-BENCH_SELF_r04.json}"
+# default artifact comes from bench.py's PRIOR_ARTIFACT_NAME (one owner,
+# bumped per round) so an argument-less watcher can't write a new round's
+# legs into an old round's artifact
+ART="${1:-$(python -c 'import bench; print(bench.PRIOR_ARTIFACT_NAME)' 2>/dev/null || echo BENCH_SELF_r05.json)}"
 # probe log named after the artifact's round tag (BENCH_SELF_r04.json ->
 # PROBES_r04.log) so a future round's watcher doesn't mislabel its output
 TAG=$(basename "$ART" .json); TAG=${TAG#BENCH_SELF_}
@@ -29,7 +32,17 @@ while true; do
       done
     } | tee "$PLOG"
     git add "$PLOG"
-    git commit -m "Record $TAG probe log" -- "$PLOG"
+    if ! git commit -m "Record $TAG probe log" -- "$PLOG"; then
+      # a stale session process may hold index.lock; one retry after a
+      # beat, and a second failure is reported instead of exit 0 lying
+      echo "probe-log commit failed; retrying in 10s"
+      sleep 10
+      git add "$PLOG"
+      git commit -m "Record $TAG probe log" -- "$PLOG" || {
+        echo "probe-log commit failed twice; $PLOG left uncommitted"
+        exit 1
+      }
+    fi
     echo "=== watcher done ==="
     exit 0
   fi
