@@ -895,6 +895,125 @@ def _leg_planner_pipeline(model: str, batch: int, prompt_len: int,
 
 # ---------------------------------------------------------------------------
 # Leg dispatch (subprocess entry) + orchestrator
+def _leg_moe(batch: int, prompt_len: int, new_tokens: int,
+             moe_model: str = "mixtral-tpu-1b",
+             dense_model: str = "mixtral-tpu-1b-dense") -> dict:
+    """MoE decode on one chip (BASELINE config #4 at a chip-fitting
+    scale, ~0.8 B params bf16).
+
+    mixtral-tpu-1b (8 experts, top-2) against its dense FLOPs-matched
+    twin (dense intermediate = 2x expert intermediate, i.e. the SAME
+    active compute per token): the tok/s ratio isolates routing +
+    dispatch cost.  The single-chip MoE layer computes all experts
+    batched on the MXU and combines by gate weight
+    (models/decoder.py:201-230), so the MoE side also streams ~4x the
+    active expert weights per step — achieved_gbs shows how much of
+    that the chip absorbs.  int8 is the throughput configuration."""
+    moe = _bench_engine(moe_model, batch, prompt_len, new_tokens)
+    moe_int8 = _bench_engine(moe_model, batch, prompt_len,
+                             new_tokens, quant=True)
+    dense = _bench_engine(dense_model, batch, prompt_len, new_tokens)
+    out = {"moe_bf16": moe, "moe_int8": moe_int8,
+           "dense_equal_active_flops_bf16": dense}
+    if moe.get("decode_tokens_per_sec") and dense.get(
+            "decode_tokens_per_sec"):
+        out["moe_vs_dense_decode"] = round(
+            moe["decode_tokens_per_sec"] / dense["decode_tokens_per_sec"],
+            3)
+    return out
+
+
+def _leg_multimodal(batch: int, new_tokens: int,
+                    scale: str = "llava15",
+                    decoder_model: str = "tinyllama-1.1b") -> dict:
+    """LLaVA-stage throughput (BASELINE config #5).
+
+    Two measures: (a) the vision encoder alone at llava-1.5 scale
+    (336px / patch 14 / hidden 1024 / 24 layers, bf16) in images/s —
+    the edge-client stage's capacity; (b) e2e image+text generation on
+    MultimodalEngine with a tinyllama-class decoder — vision prefix +
+    combined prefill + fused decode, in decode tok/s.  The reference
+    has no vision path (its closest concept is per-device module
+    placement, server.py:831-832); SURVEY lists multimodal as a
+    framework goal."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import (
+        init_full_params)
+    from distributed_inference_demo_tpu.models.vision import (
+        VisionConfig, init_vision_params, vision_forward)
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.multimodal import (
+        MultimodalEngine)
+
+    # (a) llava-1.5-scale tower alone ("tiny" keeps the same code path
+    # runnable on CPU for the leg's smoke test)
+    if scale == "llava15":
+        vcfg = VisionConfig(image_size=336, patch_size=14,
+                            hidden_size=1024, num_layers=24, num_heads=16,
+                            intermediate_size=4096,
+                            dtype_name="bfloat16")
+    else:
+        vcfg = VisionConfig(image_size=32, patch_size=16, hidden_size=32,
+                            num_layers=2, num_heads=2,
+                            intermediate_size=64, dtype_name="float32")
+    dcfg = get_model_config(decoder_model)
+    rng = jax.random.PRNGKey(0)
+    vparams = init_vision_params(rng, vcfg,
+                                 decoder_hidden=dcfg.hidden_size)
+    fwd = jax.jit(lambda p, img: vision_forward(p, vcfg, img))
+    images = jnp.ones((batch, vcfg.image_size, vcfg.image_size, 3),
+                      vcfg.dtype)
+    np.asarray(fwd(vparams, images))                  # compile
+    t0 = time.perf_counter()
+    rounds = 4
+    for _ in range(rounds):
+        out_h = fwd(vparams, images)
+    np.asarray(out_h)                                 # fence
+    enc_s = (time.perf_counter() - t0) / rounds
+    encoder = {
+        "images_per_sec": round(batch / enc_s, 2),
+        "batch": batch, "image_size": vcfg.image_size,
+        "patches_per_image": vcfg.num_patches,
+        "vit_layers": vcfg.num_layers, "dtype": vcfg.dtype_name,
+        "projector_out_dim": dcfg.hidden_size,
+    }
+
+    # (b) e2e: small tower + a real decoder
+    dparams = init_full_params(jax.random.PRNGKey(1), dcfg)
+    if scale == "llava15":
+        small_v = VisionConfig(image_size=224, patch_size=14,
+                               hidden_size=256, num_layers=6, num_heads=8,
+                               intermediate_size=1024,
+                               dtype_name="bfloat16")
+    else:
+        small_v = vcfg
+    svp = init_vision_params(jax.random.PRNGKey(2), small_v,
+                             decoder_hidden=dcfg.hidden_size)
+    b2 = min(batch, 4)
+    n_img = small_v.num_patches
+    text_len = min(32, dcfg.max_seq_len // 4)
+    eng = MultimodalEngine(dcfg, dparams, small_v, svp,
+                           max_seq=n_img + text_len + new_tokens,
+                           sampling=SamplingParams(temperature=0.7,
+                                                   top_k=7))
+    side = small_v.image_size
+    imgs = np.ones((b2, side, side, 3), np.float32)
+    text = (np.arange(b2 * text_len).reshape(b2, text_len)
+            % dcfg.vocab_size).astype(np.int32)
+    eng.generate(imgs, text, new_tokens, seed=0)      # compile
+    res = eng.generate(imgs, text, new_tokens, seed=0)
+    e2e = {
+        "decode_tokens_per_sec": round(res.tokens_per_second, 2),
+        "batch": b2, "image_tokens": n_img, "text_tokens": text_len,
+        "new_tokens": new_tokens, "decoder": decoder_model,
+    }
+    return {"vision_encoder_llava15_scale": encoder,
+            "e2e_image_text_generate": e2e}
+
+
 # ---------------------------------------------------------------------------
 
 def run_leg(name: str, p: dict) -> dict:
@@ -933,6 +1052,10 @@ def run_leg(name: str, p: dict) -> dict:
             out = _leg_long_context(model)
         elif name == "roofline_probe":
             out = _leg_roofline_probe()
+        elif name == "moe":
+            out = _leg_moe(batch, prompt_len, min(new_tokens, 64))
+        elif name == "multimodal":
+            out = _leg_multimodal(batch, min(new_tokens, 64))
         else:
             raise SystemExit(f"unknown leg {name!r}")
     except Exception as e:         # structured error, not a dead process
@@ -1118,7 +1241,8 @@ def main() -> None:
     legs = ["roofline_probe", "headline", "headline_int8",
             "speculative", "prompt_lookup", "planner_pipeline",
             "long_context", "flagship_int8", "batching", "sweep",
-            "flagship_bf16", "pipeline", "prefill_long"]
+            "flagship_bf16", "pipeline", "prefill_long", "moe",
+            "multimodal"]
     for skip_var, leg_names in (
             ("BENCH_SKIP_FLAGSHIP", ["flagship_int8", "flagship_bf16"]),
             ("BENCH_SKIP_PIPELINE", ["pipeline", "planner_pipeline"]),
@@ -1126,7 +1250,8 @@ def main() -> None:
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
                                     "batching"]),
             ("BENCH_SKIP_LONGCTX", ["long_context"]),
-            ("BENCH_SKIP_PREFILL", ["prefill_long"])):
+            ("BENCH_SKIP_PREFILL", ["prefill_long"]),
+            ("BENCH_SKIP_MOE_MM", ["moe", "multimodal"])):
         if os.environ.get(skip_var, "") == "1":
             legs = [l for l in legs if l not in leg_names]
     only = os.environ.get("BENCH_ONLY")

@@ -1,0 +1,36 @@
+"""Smoke the bench legs' code paths at tiny scale on CPU.
+
+A leg bug on the real TPU burns one of the measurement session's three
+retry attempts (plus a subprocess budget of up to 40 minutes), so every
+leg that can run its full structure on tiny models must prove it here
+first.  Numbers are not asserted — only structure and non-error shape.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+def test_leg_moe_structure_tiny():
+    out = bench._leg_moe(2, 8, 4, moe_model="mixtral-test",
+                         dense_model="llama-test")
+    assert "error" not in out
+    for key in ("moe_bf16", "moe_int8", "dense_equal_active_flops_bf16"):
+        assert out[key]["decode_tokens_per_sec"] > 0
+        assert out[key]["prefill_tokens_per_sec"] > 0
+    assert out["moe_vs_dense_decode"] > 0
+
+
+def test_leg_multimodal_structure_tiny():
+    out = bench._leg_multimodal(2, 4, scale="tiny",
+                                decoder_model="llama-test")
+    assert "error" not in out
+    enc = out["vision_encoder_llava15_scale"]
+    assert enc["images_per_sec"] > 0
+    e2e = out["e2e_image_text_generate"]
+    assert e2e["decode_tokens_per_sec"] > 0
+    assert e2e["image_tokens"] == enc["patches_per_image"]

@@ -51,6 +51,8 @@ LEG_BUDGETS = {
     "flagship_bf16": 2400,
     "pipeline": 1500,
     "prefill_long": 1800,
+    "moe": 1800,
+    "multimodal": 1500,
 }
 DEFAULT_LEGS = list(LEG_BUDGETS)
 
