@@ -168,20 +168,26 @@ def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
     result = engine.generate(prompt, new_tokens, seed=0)  # steady state
     decode_tps = result.tokens_per_second
 
-    # prefill throughput: time prefill alone on a fresh cache (np.asarray
-    # as the fence — axon's block_until_ready returns early, see
-    # _leg_prefill_long)
-    cache = engine.new_cache(batch)
-    t0 = time.perf_counter()
-    logits, cache = engine._prefill(engine.params, prompt, cache)
-    np.asarray(logits)
-    prefill_s = time.perf_counter() - t0
-    prefill_tps = batch * prompt_len / prefill_s
+    # prefill throughput: best of 3 single dispatches on fresh caches
+    # (np.asarray as the fence — axon's block_until_ready returns early,
+    # see _leg_prefill_long).  One dispatch is maximally exposed to
+    # tunnel jitter — the r04 artifact's 2.8x prefill "regression" vs
+    # r03 was a single sample taken while the tunnel was degrading; the
+    # per-round list makes that failure mode visible in the artifact.
+    rounds = []
+    for _ in range(3):
+        cache = engine.new_cache(batch)           # fresh, outside timing
+        t0 = time.perf_counter()
+        logits, cache = engine._prefill(engine.params, prompt, cache)
+        np.asarray(logits)
+        rounds.append(time.perf_counter() - t0)
+    prefill_tps = batch * prompt_len / min(rounds)
 
     out = {
         "model": name,
         "decode_tokens_per_sec": round(decode_tps, 2),
         "prefill_tokens_per_sec": round(prefill_tps, 2),
+        "prefill_round_ms": [round(r * 1000, 1) for r in rounds],
         "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
         "dtype": "int8" if quant else cfg.dtype_name,
     }

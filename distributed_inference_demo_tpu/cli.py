@@ -99,12 +99,6 @@ def _build_spec_engine(args):
     from .models.registry import get_model_config
     from .runtime import SpeculativeEngine
 
-    if getattr(args, "kv_cache_dtype", ""):
-        # SpeculativeEngine caches don't take a dtype override yet:
-        # reject rather than silently serving full-precision caches
-        print("--kv-cache-dtype is not supported with --draft-model",
-              file=sys.stderr)
-        return None
     if getattr(args, "prefill_chunk", 0):
         # the draft/verify engines run whole-prompt prefill; silently
         # ignoring the flag would defeat its memory-bounding purpose
@@ -118,7 +112,8 @@ def _build_spec_engine(args):
         cfg, params, draft_cfg, draft_params,
         max_seq=args.max_seq, sampling=_sampling_from_args(args),
         num_draft=args.num_draft, attn_backend=args.attn_backend,
-        mesh=mesh, eos_id=getattr(args, "eos_id", None))
+        mesh=mesh, eos_id=getattr(args, "eos_id", None),
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
 
 
 def _build_prompt_lookup_engine(args):
@@ -129,10 +124,9 @@ def _build_prompt_lookup_engine(args):
     from .models.registry import get_model_config
     from .runtime.prompt_lookup import PromptLookupEngine
 
-    if getattr(args, "kv_cache_dtype", "") or getattr(
-            args, "prefill_chunk", 0):
-        print("--kv-cache-dtype/--prefill-chunk are not supported "
-              "with --prompt-lookup", file=sys.stderr)
+    if getattr(args, "prefill_chunk", 0):
+        print("--prefill-chunk is not supported with --prompt-lookup",
+              file=sys.stderr)
         return None
     cfg = get_model_config(args.model)
     params, mesh = _load_params_for_mesh(args, cfg)
@@ -140,7 +134,8 @@ def _build_prompt_lookup_engine(args):
         cfg, params, max_seq=args.max_seq,
         sampling=_sampling_from_args(args), num_draft=args.num_draft,
         attn_backend=args.attn_backend, mesh=mesh,
-        eos_id=getattr(args, "eos_id", None))
+        eos_id=getattr(args, "eos_id", None),
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
 
 
 def _build_engine(args):
@@ -175,7 +170,9 @@ def cmd_serve(args) -> int:
                                    ("--prompt-lookup",
                                     getattr(args, "prompt_lookup", False)),
                                    ("--batch-slots",
-                                    getattr(args, "batch_slots", 0))] if on]
+                                    getattr(args, "batch_slots", 0)),
+                                   ("--sp",
+                                    getattr(args, "sp", 1) > 1)] if on]
     # --batch-slots composes with --draft-model OR --prompt-lookup
     # (speculative decoding inside the slot loop — the production serving
     # shape); every other pairing stays an explicit error
@@ -259,6 +256,32 @@ def cmd_serve(args) -> int:
         print(f"SERVE_PIPELINE {chain} ranges="
               f"{[(s.layer_start, s.layer_end) for s in specs]}"
               + (f" header_kv_cache_dtype={kv_dtype}" if kv_dtype else ""),
+              flush=True)
+    elif getattr(args, "sp", 1) > 1:
+        # long-context serving: ring/Ulysses sequence parallelism behind
+        # the same HTTP surface (runtime/sp_backend.py); --tp is covered
+        # by the mode exclusivity above only for other MODES, so guard
+        # the mesh conflict explicitly
+        from .models.registry import get_model_config
+        from .parallel.mesh import local_sp_mesh
+        from .runtime.sp_backend import SequenceParallelBackend
+
+        if getattr(args, "tp", 1) > 1:
+            print("--sp is exclusive with --tp", file=sys.stderr)
+            return 1
+        unsupported = _sp_unsupported_flags(args)
+        if unsupported:
+            print(f"{'/'.join(unsupported)} not supported with --sp",
+                  file=sys.stderr)
+            return 1
+        cfg = get_model_config(args.model)
+        mesh = local_sp_mesh(args.sp)
+        params = _load_full_params(args, cfg)
+        backend = SequenceParallelBackend(
+            cfg, params, mesh, max_seq=args.max_seq,
+            strategy=args.sp_strategy, sampling=_sampling_from_args(args))
+        print(f"SERVE_SP {args.model} sp={args.sp} "
+              f"strategy={args.sp_strategy} max_seq={args.max_seq}",
               flush=True)
     elif getattr(args, "batch_slots", 0):
         from .models.registry import get_model_config
@@ -686,11 +709,7 @@ def _generate_sp(args, ids, tokenizer) -> int:
     from .models.registry import get_model_config
     from .parallel.mesh import local_sp_mesh
 
-    unsupported = [flag for flag, on in [
-        ("--eos-id", getattr(args, "eos_id", None) is not None),
-        ("--kv-cache-dtype", bool(getattr(args, "kv_cache_dtype", ""))),
-        ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
-        ("--attn-backend", args.attn_backend != "auto")] if on]
+    unsupported = _sp_unsupported_flags(args)
     if unsupported:
         # the sp generate fns own their attention/cache strategy and have
         # no eos/dtype/chunk plumbing — reject loudly rather than
@@ -866,6 +885,33 @@ def _add_engine_args(ap):
                          "sharded cache; single-node serve/generate only)")
 
 
+def _add_sp_args(p) -> None:
+    """Sequence/context-parallelism flags, shared by generate and serve."""
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence/context parallelism over the first N "
+                        "local devices for LONG prompts: the prompt "
+                        "shards by contiguous chunk, prefill runs ring "
+                        "attention (or Ulysses), the KV cache stays "
+                        "sharded for the whole generation; prompt length "
+                        "must divide by N")
+    p.add_argument("--sp-strategy", default="ring",
+                   choices=["ring", "ulysses"],
+                   help="ring = sequence-sharded cache + ring-attention "
+                        "prefill; ulysses = all_to_all to head-sharded "
+                        "attention (needs heads divisible by N)")
+
+
+def _sp_unsupported_flags(args) -> list:
+    """Engine flags the sp generate fns have no plumbing for — one rule
+    shared by ``generate --sp`` and ``serve --sp`` so the two surfaces
+    cannot drift.  Rejected loudly rather than silently ignored."""
+    return [flag for flag, on in [
+        ("--eos-id", getattr(args, "eos_id", None) is not None),
+        ("--kv-cache-dtype", bool(getattr(args, "kv_cache_dtype", ""))),
+        ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
+        ("--attn-backend", args.attn_backend != "auto")] if on]
+
+
 def _add_draft_args(p) -> None:
     """Speculative-decoding flags, shared by generate and serve."""
     p.add_argument("--draft-model", default="",
@@ -924,6 +970,7 @@ def main(argv=None) -> int:
                         "KV kept on device for automatic prefix reuse "
                         "(0 disables; each entry costs up to a "
                         "prompt-bucket of KV in HBM)")
+    _add_sp_args(s)
     _add_draft_args(s)
     s.set_defaults(fn=cmd_serve)
 
@@ -982,18 +1029,7 @@ def main(argv=None) -> int:
     _add_engine_args(g)
     g.add_argument("--prompt-ids", default="")
     g.add_argument("--prompt", default=None)
-    g.add_argument("--sp", type=int, default=1,
-                   help="sequence/context parallelism over the first N "
-                        "local devices for LONG prompts: the prompt "
-                        "shards by contiguous chunk, prefill runs ring "
-                        "attention (or Ulysses), the KV cache stays "
-                        "sharded for the whole generation; prompt length "
-                        "must divide by N")
-    g.add_argument("--sp-strategy", default="ring",
-                   choices=["ring", "ulysses"],
-                   help="ring = sequence-sharded cache + ring-attention "
-                        "prefill; ulysses = all_to_all to head-sharded "
-                        "attention (needs heads divisible by N)")
+    _add_sp_args(g)
     _add_draft_args(g)
     g.set_defaults(fn=cmd_generate)
 

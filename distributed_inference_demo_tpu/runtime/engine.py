@@ -72,6 +72,25 @@ class GenerationResult:
         return total / self.seconds if self.seconds > 0 else float("nan")
 
 
+def resolve_cache_dtype_backend(kv_cache_dtype, attn_backend: str):
+    """The reduced-precision-cache rule, ONE owner for every engine
+    (plain / speculative / prompt-lookup / batching): a reduced-dtype KV
+    cache forces the jnp attention path (the Pallas kernel is not
+    exercised on f8 loads), and an explicit non-jnp kernel request
+    errors rather than silently downgrading.  Returns
+    ``(jnp.dtype | None, attn_backend)``."""
+    dt = jnp.dtype(kv_cache_dtype) if kv_cache_dtype else None
+    if dt is not None:
+        if attn_backend not in ("auto", "jnp"):
+            raise ValueError(
+                f"attn_backend={attn_backend!r} is incompatible with "
+                "kv_cache_dtype (the Pallas kernel is not exercised "
+                "on reduced-precision cache loads); use 'auto' or "
+                "'jnp'")
+        attn_backend = "jnp"
+    return dt, attn_backend
+
+
 class InferenceEngine:
     """KV-cached generation over a full model — single chip, or
     tensor-parallel over a tp mesh (``mesh=`` + :func:`shard_engine_params`)."""
@@ -119,8 +138,6 @@ class InferenceEngine:
         self.sampling = sampling
         self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
-        self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
-                               if kv_cache_dtype else None)
         if prefill_chunk is not None and not (
                 1 <= prefill_chunk <= self.max_seq):
             raise ValueError(
@@ -136,16 +153,8 @@ class InferenceEngine:
         # attention path, which is what reduced-precision caches use
         # anyway (parity pinned by tests/test_engine.py)
         attn_backend = resolve_tp_attn_backend(tp, attn_backend)
-
-        if self.kv_cache_dtype is not None:
-            if attn_backend not in ("auto", "jnp"):
-                # never silently downgrade an explicit kernel request
-                raise ValueError(
-                    f"attn_backend={attn_backend!r} is incompatible with "
-                    "kv_cache_dtype (the Pallas kernel is not exercised "
-                    "on reduced-precision cache loads); use 'auto' or "
-                    "'jnp'")
-            attn_backend = "jnp"
+        self.kv_cache_dtype, attn_backend = resolve_cache_dtype_backend(
+            kv_cache_dtype, attn_backend)
         if attn_backend == "auto":
             attn_backend = ("flash" if jax.default_backend() == "tpu"
                             else "jnp")

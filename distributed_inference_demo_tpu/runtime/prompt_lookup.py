@@ -92,9 +92,13 @@ class PromptLookupEngine:
                  num_draft: int = 4,
                  attn_backend: str = "auto",
                  mesh=None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 kv_cache_dtype=None):
         """``mesh``: tp mesh — the target forward runs sharded (see
-        InferenceEngine); proposal matching stays replicated VPU work."""
+        InferenceEngine); proposal matching stays replicated VPU work.
+        ``kv_cache_dtype``: reduced-precision cache storage, same
+        contract as InferenceEngine (insert rounds, attention upcasts,
+        jnp path forced)."""
         if num_draft < 1:
             raise ValueError("num_draft must be >= 1")
         self.cfg, self.params = cfg, params
@@ -106,8 +110,11 @@ class PromptLookupEngine:
         self.mesh = mesh
 
         from ..parallel.tensor import resolve_tp_attn_backend
+        from .engine import resolve_cache_dtype_backend
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         attn_backend = resolve_tp_attn_backend(tp, attn_backend)
+        self.kv_cache_dtype, attn_backend = resolve_cache_dtype_backend(
+            kv_cache_dtype, attn_backend)
         if attn_backend == "auto":
             attn_backend = ("flash" if jax.default_backend() == "tpu"
                             else "jnp")
@@ -177,7 +184,8 @@ class PromptLookupEngine:
         """Prefill + first target-sampled token + seeded history buffer —
         the state both generate paths start every run from."""
         b, plen = ids.shape
-        cache = KVCache.create(self.cfg, self.cfg.num_layers, b, self._cap)
+        cache = KVCache.create(self.cfg, self.cfg.num_layers, b, self._cap,
+                               dtype=self.kv_cache_dtype)
         if self._cache_sharding is not None:
             cache = jax.device_put(cache, self._cache_sharding)
         last_logits, cache = self._prefill(self.params, ids, cache)

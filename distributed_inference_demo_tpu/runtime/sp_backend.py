@@ -1,0 +1,146 @@
+"""``serve --sp N``: long-context sequence-parallel serving backend.
+
+The reference has no long-context serving story (its max context is a
+single device's attention; SURVEY §5.7 names sequence parallelism a
+framework goal).  This backend puts the ring-attention / Ulysses
+generate fns (``parallel/sequence.py``, ``parallel/ulysses.py``) behind
+the same HTTP surface every other serve mode uses, so a ≥32k-token
+request is one POST /generate like any other.
+
+Design notes:
+
+- The sp generate fns bake ``num_new_tokens`` into the jitted program
+  (fixed-trip decode scan inside ``shard_map``); the backend caches one
+  built fn per requested ``max_new_tokens`` and lets jit re-specialize
+  per prompt-length bucket as usual.  Long-context clients typically
+  reuse one ``max_new_tokens``, so the cache stays tiny.
+- Prompts must arrive padded to a multiple of sp.  That is the same
+  rule ``generate --sp`` enforces: silent server-side padding would
+  change what the model attends, so a bad length is an HTTP 400
+  (``validate_sp_prompt``'s ValueError), never a silent fix-up.
+- One request runs at a time (lock): the sp mesh owns every device in
+  the group, so concurrent requests would interleave collectives from
+  two programs on the same chips.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from ..models.base import ModelConfig
+from ..ops.sampling import SamplingParams
+from ..parallel.sequence import make_sp_generate_fn, validate_sp_prompt
+from ..parallel.ulysses import make_ulysses_generate_fn
+from .engine import GenerationResult
+
+STRATEGIES = ("ring", "ulysses")
+
+
+class SequenceParallelBackend:
+    """Engine-like backend over a local sp mesh for InferenceHTTPServer."""
+
+    def __init__(self, cfg: ModelConfig, params, mesh, *, max_seq: int,
+                 strategy: str = "ring",
+                 sampling: Optional[SamplingParams] = None):
+        if strategy not in STRATEGIES:
+            raise ValueError(f"unknown sp strategy {strategy!r}; "
+                             f"known: {STRATEGIES}")
+        self.cfg = cfg
+        self.params = params
+        self.mesh = mesh
+        self.max_seq = max_seq
+        self.strategy = strategy
+        self.sampling = sampling
+        self.sp = int(mesh.shape["sp"])
+        self._fns: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
+        self._served = 0
+        self._decode_seconds = 0.0
+        self._tokens_out = 0
+        # fail at CONSTRUCTION, not at the first request: the generate
+        # fns' build-time checks (max_seq % sp, Ulysses head
+        # divisibility) run here, so a misconfigured server errors
+        # before it ever prints HTTP_READY — a launch mistake must not
+        # surface as HTTP 400s blaming the clients
+        self._build(1)
+
+    def _build(self, num_new: int):
+        make = (make_sp_generate_fn if self.strategy == "ring"
+                else make_ulysses_generate_fn)
+        return make(self.cfg, self.mesh, max_seq=self.max_seq,
+                    num_new_tokens=num_new, sampling=self.sampling)
+
+    # each distinct max_new_tokens is its own jitted program (the decode
+    # scan's trip count is baked in); the cache is LRU-bounded so a
+    # client scanning max_new values can't grow compiled programs
+    # without limit — evicted variants just recompile on next use
+    MAX_COMPILED_VARIANTS = 8
+
+    def _fn(self, num_new: int):
+        fn = self._fns.get(num_new)
+        if fn is None:
+            fn = self._build(num_new)
+            self._fns[num_new] = fn
+            while len(self._fns) > self.MAX_COMPILED_VARIANTS:
+                self._fns.popitem(last=False)
+        else:
+            self._fns.move_to_end(num_new)
+        return fn
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 seed: int = 0) -> GenerationResult:
+        import jax
+
+        ids = np.asarray(prompt_ids, dtype=np.int32)
+        num_new = int(max_new_tokens)
+        # ValueError renders as HTTP 400 with the rule spelled out
+        validate_sp_prompt(ids.shape[1], self.sp, self.max_seq, num_new)
+        with self._lock:
+            fn = self._fn(num_new)
+            t0 = time.perf_counter()
+            with self.mesh:
+                toks = np.asarray(
+                    fn(self.params, ids, jax.random.PRNGKey(seed)))
+            dt = time.perf_counter() - t0
+            self._served += 1
+            self._decode_seconds += dt
+            self._tokens_out += int(toks.size)
+        return GenerationResult(tokens=toks, prompt_len=ids.shape[1],
+                                num_new=num_new, seconds=dt)
+
+    def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                        seed: int = 0):
+        """Step-wise view over the fused sp program (the chat REPL and
+        ``stream: true`` requests need one).  The whole generation runs
+        in ONE dispatch — the sp decode is a fused scan — then tokens
+        stream per step, so first-token latency equals full-generation
+        latency.  Acceptable at long context, where prefill dominates
+        end-to-end time; true incremental sp streaming would need a
+        step-split program.  Validation errors surface on the first
+        ``next()`` (a clean 400), like every other backend."""
+        res = self.generate(prompt_ids, max_new_tokens, seed=seed)
+        for i in range(res.tokens.shape[1]):
+            yield res.tokens[:, i]
+
+    def stats(self) -> dict:
+        with self._lock:   # _fn() mutates the variant cache mid-request
+            return {
+                "mode": "sequence_parallel",
+                "strategy": self.strategy,
+                "sp": self.sp,
+                "max_seq": self.max_seq,
+                "requests_served": self._served,
+                "tokens_out": self._tokens_out,
+                "seconds_generating": round(self._decode_seconds, 3),
+                "compiled_max_new_variants": sorted(self._fns),
+            }
+
+    def reset_stats(self) -> None:
+        self._served = 0
+        self._decode_seconds = 0.0
+        self._tokens_out = 0

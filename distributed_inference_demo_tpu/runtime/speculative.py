@@ -264,7 +264,14 @@ class SpeculativeEngine:
                  num_draft: int = 4,
                  attn_backend: str = "auto",
                  mesh=None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 kv_cache_dtype=None):
+        """``kv_cache_dtype``: reduced-precision storage for BOTH the
+        target and draft caches (same contract as InferenceEngine /
+        ContinuousBatchingEngine: insert rounds via update_kv_cache's
+        cast, attention upcasts to f32, the jnp attention path is
+        forced) — greedy output matches a plain engine with the same
+        cache dtype bit-exactly."""
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
                 f"draft vocab ({draft_cfg.vocab_size}) != target vocab "
@@ -283,8 +290,11 @@ class SpeculativeEngine:
         self.mesh = mesh
 
         from ..parallel.tensor import resolve_tp_attn_backend
+        from .engine import resolve_cache_dtype_backend
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         attn_backend = resolve_tp_attn_backend(tp, attn_backend)
+        self.kv_cache_dtype, attn_backend = resolve_cache_dtype_backend(
+            kv_cache_dtype, attn_backend)
         if attn_backend == "auto":
             attn_backend = ("flash" if jax.default_backend() == "tpu"
                             else "jnp")
@@ -390,9 +400,10 @@ class SpeculativeEngine:
         # valid length before the rollback trims it (KVCache.create pads
         # the buffer to the sublane granule on top)
         cap = self.max_seq + self.num_draft + 1
-        tc = KVCache.create(self.cfg, self.cfg.num_layers, batch, cap)
+        tc = KVCache.create(self.cfg, self.cfg.num_layers, batch, cap,
+                            dtype=self.kv_cache_dtype)
         dc = KVCache.create(self.draft_cfg, self.draft_cfg.num_layers,
-                            batch, cap)
+                            batch, cap, dtype=self.kv_cache_dtype)
         if self._cache_sharding is not None:
             tc = jax.device_put(tc, self._cache_sharding)
             dc = jax.device_put(dc, self._cache_sharding)

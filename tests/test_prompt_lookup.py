@@ -40,6 +40,25 @@ def test_greedy_exactness(params, oracle):
     assert 0.0 <= stats.acceptance_rate <= 1.0
 
 
+def test_fp8_kv_greedy_matches_fp8_engine(params):
+    """Prompt-lookup with an fp8 KV cache matches a plain engine at the
+    SAME cache dtype bit-exactly (shared resolve_cache_dtype_backend
+    rule: insert rounds, attention upcasts, jnp path forced)."""
+    fp8_oracle = InferenceEngine(CFG, params, max_seq=96, sampling=GREEDY,
+                                 kv_cache_dtype="float8_e4m3fn")
+    pld = PromptLookupEngine(CFG, params, max_seq=96, sampling=GREEDY,
+                             num_draft=4,
+                             kv_cache_dtype="float8_e4m3fn")
+    prompt = np.asarray([[3, 14, 15, 92, 65]])
+    want = fp8_oracle.generate(prompt, 16).tokens
+    got, _ = pld.generate(prompt, 16)
+    np.testing.assert_array_equal(want, got.tokens)
+    with pytest.raises(ValueError, match="attn_backend"):
+        PromptLookupEngine(CFG, params, max_seq=96, sampling=GREEDY,
+                           attn_backend="flash",
+                           kv_cache_dtype="float8_e4m3fn")
+
+
 def test_lookup_accelerates_self_repetition(params, oracle):
     """Greedy decode of a tiny random model falls into loops; once the
     loop is in the history the lookup proposer should ride it, emitting

@@ -1,0 +1,141 @@
+"""``serve --sp`` — the long-context serving surface.
+
+Greedy output through the sequence-parallel HTTP backend must be
+bit-identical to the plain single-device engine (the repo's standing
+oracle), bad prompt lengths must surface as clean HTTP 400s (never a
+silent server-side pad), and the CLI's mode pairing rules must reject
+--sp against every other serve mode.
+"""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_inference_demo_tpu import cli
+from distributed_inference_demo_tpu.models import get_model_config
+from distributed_inference_demo_tpu.models.decoder import init_full_params
+from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+from distributed_inference_demo_tpu.parallel.mesh import local_sp_mesh
+from distributed_inference_demo_tpu.runtime import InferenceEngine
+from distributed_inference_demo_tpu.runtime.http_server import (
+    InferenceHTTPServer)
+from distributed_inference_demo_tpu.runtime.sp_backend import (
+    SequenceParallelBackend)
+
+GREEDY = SamplingParams(greedy=True)
+
+
+def _req(server, method, path, body=None):
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=60)
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+@pytest.fixture(scope="module", params=["ring", "ulysses"])
+def sp_server(request):
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    plain = InferenceEngine(cfg, params, max_seq=32, sampling=GREEDY)
+    backend = SequenceParallelBackend(
+        cfg, params, local_sp_mesh(2), max_seq=32,
+        strategy=request.param, sampling=GREEDY)
+    server = InferenceHTTPServer(backend, port=0, model_name="llama-test")
+    server.start()
+    yield server, plain, backend
+    server.shutdown()
+
+
+def test_sp_serve_matches_plain_engine(sp_server):
+    server, plain, _ = sp_server
+    prompt = [[5, 17, 42, 7, 9, 2, 30, 11]]       # len 8, divides sp=2
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": prompt, "max_new_tokens": 4})
+    assert status == 200
+    got = json.loads(data)["tokens"]
+    want = plain.generate(np.asarray(prompt), 4).tokens.tolist()
+    assert got == want
+
+
+def test_sp_serve_rejects_indivisible_prompt(sp_server):
+    server, _, _ = sp_server
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": [[1, 2, 3]], "max_new_tokens": 4})
+    assert status == 400
+    assert "divisible" in json.loads(data)["error"]
+
+
+def test_sp_serve_rejects_over_capacity(sp_server):
+    server, _, _ = sp_server
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": [list(range(30))],
+                         "max_new_tokens": 10})
+    assert status == 400
+    assert "max_seq" in json.loads(data)["error"]
+
+
+def test_sp_serve_stats(sp_server):
+    server, _, backend = sp_server
+    status, data = _req(server, "GET", "/stats")
+    assert status == 200
+    body = json.loads(data)
+    assert body["mode"] == "sequence_parallel"
+    assert body["sp"] == 2
+    assert body["strategy"] == backend.strategy
+
+
+def test_sp_serve_streaming(sp_server):
+    """stream: true works against serve --sp (the chat REPL always
+    streams); tokens arrive as JSONL steps and match the plain engine."""
+    server, plain, _ = sp_server
+    prompt = [[5, 17, 42, 7, 9, 2, 30, 11]]
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=60)
+    conn.request("POST", "/generate",
+                 body=json.dumps({"prompt_ids": prompt,
+                                  "max_new_tokens": 4, "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    lines = [json.loads(line) for line in resp.read().decode().splitlines()
+             if line.strip()]
+    conn.close()
+    got = [line["tokens"][0] for line in lines]
+    want = plain.generate(np.asarray(prompt), 4).tokens[0].tolist()
+    assert got == want
+
+
+def test_sp_backend_rejects_bad_config_at_construction():
+    """A misconfigured server must fail BEFORE HTTP_READY, not 400
+    every client: max_seq not divisible by sp errors in __init__."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="divisible"):
+        SequenceParallelBackend(cfg, params, local_sp_mesh(2),
+                                max_seq=33, sampling=GREEDY)
+
+
+def test_sp_backend_bounds_compiled_variants(sp_server):
+    _, _, backend = sp_server
+    for n in range(1, backend.MAX_COMPILED_VARIANTS + 3):
+        backend._fn(n)
+    assert len(backend._fns) == backend.MAX_COMPILED_VARIANTS
+
+
+def test_sp_serve_mode_pairing_rules(capsys):
+    base = ["serve", "--model", "llama-test", "--sp", "2"]
+    assert cli.main(base + ["--batch-slots", "2"]) == 1
+    assert cli.main(base + ["--draft-model", "llama-test"]) == 1
+    assert cli.main(base + ["--prompt-lookup"]) == 1
+    assert cli.main(base + ["--chain", "w@127.0.0.1:1"]) == 1
+    assert cli.main(base + ["--tp", "2"]) == 1
+    assert cli.main(base + ["--kv-cache-dtype", "float8_e4m3fn"]) == 1
+    err = capsys.readouterr().err
+    assert "--kv-cache-dtype" in err

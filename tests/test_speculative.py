@@ -46,6 +46,32 @@ def test_greedy_matches_target_only(params, draft_params):
     assert stats.rounds >= 1
 
 
+def test_fp8_kv_greedy_matches_fp8_engine(params, draft_params):
+    """Standalone spec decode with fp8 KV caches (both models) matches a
+    plain engine running the SAME cache dtype bit-exactly — the same
+    insert-rounding + f32-upcast contract the batching engine's fp8 x
+    draft mode already satisfies (tests/test_batching.py)."""
+    sampling = SamplingParams(greedy=True)
+    base = InferenceEngine(CFG, params, max_seq=96, sampling=sampling,
+                           kv_cache_dtype="float8_e4m3fn")
+    spec = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                             max_seq=96, sampling=sampling, num_draft=4,
+                             kv_cache_dtype="float8_e4m3fn")
+    prompt = np.asarray([[3, 14, 15, 92, 65]])
+    want = base.generate(prompt, max_new_tokens=16).tokens
+    got, stats = spec.generate(prompt, max_new_tokens=16)
+    np.testing.assert_array_equal(want, got.tokens)
+    tc, dc = spec.new_caches(1)
+    assert str(tc.keys.dtype) == "float8_e4m3fn"
+    assert str(dc.keys.dtype) == "float8_e4m3fn"
+    # an explicit kernel request must not silently downgrade
+    with pytest.raises(ValueError, match="attn_backend"):
+        SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                          max_seq=96, sampling=sampling,
+                          attn_backend="flash",
+                          kv_cache_dtype="float8_e4m3fn")
+
+
 def test_greedy_matches_across_dispatch_sizes(params, draft_params):
     """Rounds-per-dispatch is a pure batching knob: R=1 and R=8 agree."""
     sampling = SamplingParams(greedy=True)
