@@ -143,8 +143,9 @@ def apply_measured_frac(leg, ceiling) -> None:
 
 
 def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
-                  quant: bool = False) -> dict:
-    """Single-chip decode + prefill throughput via InferenceEngine."""
+                  quant=False) -> dict:
+    """Single-chip decode + prefill throughput via InferenceEngine.
+    ``quant``: False | True (int8) | "int8" | "int4"."""
     import jax
     import numpy as np
     from distributed_inference_demo_tpu.models import get_model_config
@@ -152,12 +153,14 @@ def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
     from distributed_inference_demo_tpu.ops.sampling import SamplingParams
     from distributed_inference_demo_tpu.runtime import InferenceEngine
 
-    name = model + ("-int8" if quant else "")
+    mode = "int8" if quant is True else quant
+    name = model + (f"-{mode}" if mode else "")
     cfg = get_model_config(name)
     # layer-chunked init+quantize: peak HBM stays near the int8 footprint
     # instead of materializing the float tree first (which would OOM exactly
     # the chips int8 exists to fit on) — models/decoder.py:_init_quantized
-    params = init_full_params(jax.random.PRNGKey(0), cfg, quantize=quant)
+    params = init_full_params(jax.random.PRNGKey(0), cfg,
+                              quantize=bool(mode))
     engine = InferenceEngine(
         cfg, params, max_seq=prompt_len + new_tokens,
         sampling=SamplingParams(temperature=0.7, top_k=7))  # ref defaults
@@ -186,12 +189,32 @@ def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
     out = {
         "model": name,
         "decode_tokens_per_sec": round(decode_tps, 2),
+        # per decode STEP (the fused scan advances the whole batch one
+        # position per step, so steps/s = tok/s / batch) — the number the
+        # large-batch roofline-erosion analysis decomposes: cache-read
+        # bytes grow with batch while weight bytes stay fixed
+        "decode_step_ms": round(1000.0 * batch / decode_tps, 3),
         "prefill_tokens_per_sec": round(prefill_tps, 2),
         "prefill_round_ms": [round(r * 1000, 1) for r in rounds],
         "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
-        "dtype": "int8" if quant else cfg.dtype_name,
+        "dtype": mode if mode else cfg.dtype_name,
     }
-    return _with_bandwidth(out, params.nbytes(), _device_kind())
+    out = _with_bandwidth(out, params.nbytes(), _device_kind())
+    # cache-READ traffic estimate per second: each decode step attends
+    # the whole valid context, so cache bytes grow linearly with batch
+    # while the weight stream stays fixed — the decomposition behind the
+    # large-batch roofline erosion (achieved_gbs counts weights only)
+    kv_bytes_per_pos = (cfg.num_layers * 2 * cfg.num_kv_heads
+                        * cfg.head_dim
+                        * (engine.kv_cache_dtype or cfg.dtype).itemsize)
+    avg_ctx = prompt_len + new_tokens / 2
+    steps_per_sec = decode_tps / batch
+    out["cache_read_gbs_est"] = round(
+        batch * avg_ctx * kv_bytes_per_pos * steps_per_sec / 1e9, 1)
+    if out.get("achieved_gbs"):
+        out["total_gbs_est"] = round(
+            out["achieved_gbs"] + out["cache_read_gbs_est"], 1)
+    return out
 
 
 def _weights_bytes_estimate(model: str) -> int:
@@ -206,9 +229,15 @@ def _weights_bytes_estimate(model: str) -> int:
         mlp *= cfg.num_experts
     per_layer = attn + mlp
     embed = cfg.vocab_size * H * (1 if cfg.tie_embeddings else 2)
-    bpp = 1 if cfg.quantization == "int8" else jnp_bytes(cfg.dtype_name)
-    # embeddings/head stay at the model dtype even under int8
-    return L * per_layer * bpp + embed * jnp_bytes(cfg.dtype_name)
+    if cfg.quantization == "int8":
+        bpp = 1.0
+    elif cfg.quantization == "int4":
+        # 2 weights/byte + f32 group scales (ops/quant.DEFAULT_INT4_GROUP)
+        bpp = 0.5 + 4.0 / 64
+    else:
+        bpp = jnp_bytes(cfg.dtype_name)
+    # embeddings/head stay at the model dtype even under quantization
+    return int(L * per_layer * bpp) + embed * jnp_bytes(cfg.dtype_name)
 
 
 def jnp_bytes(dtype_name: str) -> int:
@@ -223,8 +252,9 @@ HBM_CAP_GB = {"TPU v5 lite": 16.0, "TPU v5": 16.0, "TPU v4": 32.0,
 
 
 def _leg_flagship(model: str, batch: int, prompt_len: int, new_tokens: int,
-                  quant: bool) -> dict:
-    name = model + ("-int8" if quant else "")
+                  quant) -> dict:
+    mode = "int8" if quant is True else quant
+    name = model + (f"-{mode}" if mode else "")
     need = _weights_bytes_estimate(name)
     limit = _hbm_limit_bytes()
     if limit is None:
@@ -901,6 +931,23 @@ def _leg_planner_pipeline(model: str, batch: int, prompt_len: int,
 
 # ---------------------------------------------------------------------------
 # Leg dispatch (subprocess entry) + orchestrator
+def _leg_int4(model: str, flagship: str, batch: int, prompt_len: int,
+              new_tokens: int) -> dict:
+    """Weight-only int4 decode (ops/quant.QuantizedArray4): nibble-packed
+    weights at 2/byte + group-wise f32 scales = ~0.56 bytes/weight.
+    Decode streams every weight byte once per step, so at the
+    bandwidth-bound batch sizes int4 is the throughput configuration
+    ABOVE int8 — the ratio vs the headline_int8/flagship_int8 legs (same
+    shapes) is the packing payoff net of the in-feed unpack cost.
+    Reference analog: the -int8 export variants (data/Data.kt:19-33);
+    the reference has no int4 story."""
+    out = {"headline_int4": _bench_engine(model, batch, prompt_len,
+                                          new_tokens, quant="int4")}
+    out["flagship_int4"] = _leg_flagship(flagship, batch, prompt_len,
+                                         min(new_tokens, 64), quant="int4")
+    return out
+
+
 def _leg_moe(batch: int, prompt_len: int, new_tokens: int,
              moe_model: str = "mixtral-tpu-1b",
              dense_model: str = "mixtral-tpu-1b-dense") -> dict:
@@ -1062,6 +1109,9 @@ def run_leg(name: str, p: dict) -> dict:
             out = _leg_moe(batch, prompt_len, min(new_tokens, 64))
         elif name == "multimodal":
             out = _leg_multimodal(batch, min(new_tokens, 64))
+        elif name == "int4":
+            out = _leg_int4(model, flagship, batch, prompt_len,
+                            new_tokens)
         else:
             raise SystemExit(f"unknown leg {name!r}")
     except Exception as e:         # structured error, not a dead process
@@ -1248,7 +1298,7 @@ def main() -> None:
             "speculative", "prompt_lookup", "planner_pipeline",
             "long_context", "flagship_int8", "batching", "sweep",
             "flagship_bf16", "pipeline", "prefill_long", "moe",
-            "multimodal"]
+            "multimodal", "int4"]
     for skip_var, leg_names in (
             ("BENCH_SKIP_FLAGSHIP", ["flagship_int8", "flagship_bf16"]),
             ("BENCH_SKIP_PIPELINE", ["pipeline", "planner_pipeline"]),
@@ -1257,7 +1307,8 @@ def main() -> None:
                                     "batching"]),
             ("BENCH_SKIP_LONGCTX", ["long_context"]),
             ("BENCH_SKIP_PREFILL", ["prefill_long"]),
-            ("BENCH_SKIP_MOE_MM", ["moe", "multimodal"])):
+            ("BENCH_SKIP_MOE_MM", ["moe", "multimodal"]),
+            ("BENCH_SKIP_INT4", ["int4"])):
         if os.environ.get(skip_var, "") == "1":
             legs = [l for l in legs if l not in leg_names]
     only = os.environ.get("BENCH_ONLY")
@@ -1381,6 +1432,8 @@ def main() -> None:
             apply_measured_frac(extras.get(key, {}), measured)
         for pt in extras.get("sweep", {}).get("points", []):
             apply_measured_frac(pt, measured)
+        for sub in (extras.get("int4", {}) or {}).values():
+            apply_measured_frac(sub, measured)
 
     print(json.dumps({
         "metric": summary["metric"],

@@ -70,7 +70,7 @@ class ModelConfig:
     # expert-parallel dispatch capacity: slots per expert =
     # ceil(tokens * k / num_experts * factor); over-capacity tokens drop
     moe_capacity_factor: float = 2.0
-    # weight-only quantization: "none" | "int8" (ops/quant.py)
+    # weight-only quantization: "none" | "int8" | "int4" (ops/quant.py)
     quantization: str = "none"
 
     @property

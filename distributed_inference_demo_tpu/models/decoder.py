@@ -45,24 +45,28 @@ def _dense_init(rng, shape, dtype, scale=None):
                            jnp.dtype(dtype))
 
 
-@partial(jax.jit, static_argnames=("shape", "dtype"))
-def _init_quantized_layer(rng, scale, shape, dtype):
-    from ..ops.quant import quantize_array
+@partial(jax.jit, static_argnames=("shape", "dtype", "mode"))
+def _init_quantized_layer(rng, scale, shape, dtype, mode="int8"):
+    from ..ops.quant import quantize_array, quantize_array4
     w = _dense_init_jit(rng, scale, shape, dtype)
+    if mode == "int4":
+        qa = quantize_array4(w)
+        return qa.q, qa.scale
     qa = quantize_array(w, stacked=False)
     return qa.q, qa.scale
 
 
-def _init_quantized(rng, shape, dtype, scale=None):
-    """Init + int8-quantize one layer slice at a time.
+def _init_quantized(rng, shape, dtype, scale=None, mode="int8"):
+    """Init + quantize (int8 or int4) one layer slice at a time.
 
-    Peak HBM stays at the accumulating int8 footprint plus ONE layer's
-    float transient — never the full tensor at float width.  This is what
-    lets an int8 Llama-3-8B be random-initialized on a 16 GB chip whose
-    bf16 variant would not fit (the reference ships pre-quantized exports
-    instead, ``data/Data.kt:19-33``).
+    Peak HBM stays at the accumulating quantized footprint plus ONE
+    layer's float transient — never the full tensor at float width.
+    This is what lets an int8 Llama-3-8B be random-initialized on a
+    16 GB chip whose bf16 variant would not fit (the reference ships
+    pre-quantized exports instead, ``data/Data.kt:19-33``); int4 halves
+    the footprint again.
     """
-    from ..ops.quant import QuantizedArray
+    from ..ops.quant import QuantizedArray, QuantizedArray4
     L = shape[0]
     fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
     scale = jnp.float32(scale if scale is not None else float(fan_in) ** -0.5)
@@ -70,27 +74,34 @@ def _init_quantized(rng, shape, dtype, scale=None):
     qs, scales = [], []
     for i in range(L):
         q, s = _init_quantized_layer(keys[i], scale, tuple(shape[1:]),
-                                     jnp.dtype(dtype))
+                                     jnp.dtype(dtype), mode)
         qs.append(q)
         scales.append(s)
+    if mode == "int4":
+        from ..ops.quant import int4_group_for
+        return QuantizedArray4(q=jnp.stack(qs), scale=jnp.stack(scales),
+                               group=int4_group_for(shape[-2]))
     return QuantizedArray(q=jnp.stack(qs), scale=jnp.stack(scales))
 
 
 def init_layer_params(rng: jax.Array, cfg: ModelConfig, num_layers: int,
-                      quantize: bool = False) -> dict:
+                      quantize=False) -> dict:
     """Stacked per-layer weights, leading dim = num_layers.
 
-    With ``quantize``, each big matmul operand is generated and int8-
-    quantized layer-by-layer (``_init_quantized``), so peak memory stays
-    near the int8 footprint instead of materializing the whole tensor at
+    With ``quantize`` (True = "int8", or an explicit "int8"/"int4"
+    mode), each big matmul operand is generated and quantized
+    layer-by-layer (``_init_quantized``), so peak memory stays near the
+    quantized footprint instead of materializing the whole tensor at
     the float dtype first — this is what lets an int8 8B model be
-    random-initialized on a chip the bf16 variant would not fit on.
+    random-initialized on a chip the bf16 variant would not fit on
+    (int4 halves it again).
     """
     H, nh, nkv, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     I, L = cfg.intermediate_size, num_layers
     dt = cfg.dtype
 
-    big = _init_quantized if quantize else _dense_init
+    mode = "int8" if quantize is True else quantize
+    big = (partial(_init_quantized, mode=mode) if mode else _dense_init)
 
     keys = jax.random.split(rng, 16)
     p = {
@@ -127,8 +138,14 @@ def init_layer_params(rng: jax.Array, cfg: ModelConfig, num_layers: int,
 
 
 def init_full_params(rng: jax.Array, cfg: ModelConfig,
-                     quantize: bool = False) -> StageParams:
-    """Random-init full model as a single StageParams (stage 0 of 1)."""
+                     quantize=False) -> StageParams:
+    """Random-init full model as a single StageParams (stage 0 of 1).
+
+    ``quantize=True`` resolves to the config's own quantization mode
+    (int8 or int4), so ``get_model_config("x-int4")`` + ``quantize=True``
+    does the right thing without every caller re-deriving the mode."""
+    if quantize is True and cfg.quantization in ("int8", "int4"):
+        quantize = cfg.quantization
     k_emb, k_layers, k_head = jax.random.split(rng, 3)
     dt = cfg.dtype
     embed = {"tokens": _dense_init(k_emb, (cfg.vocab_size, cfg.hidden_size), dt,

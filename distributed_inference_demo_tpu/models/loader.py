@@ -284,11 +284,12 @@ def load_or_init(model_name: str, cfg: ModelConfig,
                                         cfg)
     else:
         # random path: quantize during init (layer-chunked) so peak HBM
-        # stays near the int8 footprint — an 8B -int8 config must be
-        # initializable on exactly the chips its bf16 tree would not fit.
+        # stays near the quantized footprint — an 8B -int8/-int4 config
+        # must be initializable on exactly the chips its bf16 tree would
+        # not fit.
         return init_full_params(
             jax.random.PRNGKey(seed), cfg,
-            quantize=quantize and cfg.quantization == "int8")
+            quantize=quantize and cfg.quantization in ("int8", "int4"))
     if not quantize:
         return params
     from ..ops.quant import maybe_quantize

@@ -74,14 +74,17 @@ MODEL_REGISTRY = {
 
 
 def get_model_config(name: str) -> ModelConfig:
-    """Resolve a model name; an ``-int8`` suffix selects weight-only int8
-    quantization (the reference's quantized exports, ``data/Data.kt:19-33``,
-    as a runtime transform — ops/quant.py)."""
+    """Resolve a model name; an ``-int8`` / ``-int4`` suffix selects
+    weight-only quantization (the reference's quantized exports,
+    ``data/Data.kt:19-33``, as a runtime transform — ops/quant.py; int4
+    is group-wise and packs two weights per byte)."""
     base = name
     quant = "none"
-    if name.endswith("-int8"):
-        base = name[: -len("-int8")]
-        quant = "int8"
+    for suffix in ("-int8", "-int4"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            quant = suffix[1:]
+            break
     if base not in MODEL_REGISTRY:
         raise KeyError(f"unknown model {name!r}; known: {sorted(MODEL_REGISTRY)}")
     cfg = MODEL_REGISTRY[base]

@@ -18,8 +18,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..models.decoder import stage_forward
-from ..ops.quant import QuantizedArray
-from .sharding import quant_scale_spec
+from ..ops.quant import QuantizedArray, QuantizedArray4
+from .sharding import quant4_specs, quant_scale_spec
 
 # expert stacks [L, E, H, I]: shard E over ep; everything else replicated
 _EP_LAYER_SPECS = {
@@ -42,6 +42,11 @@ def _ep_param_specs(params: StageParams) -> StageParams:
             spec = _EP_LAYER_SPECS.get(k, P())
             if isinstance(v, QuantizedArray):
                 out[k] = QuantizedArray(q=spec, scale=quant_scale_spec(spec))
+            elif isinstance(v, QuantizedArray4):
+                # ep slices the EXPERT axis; int4 packing lives on the
+                # input axis (-2), so the two compose (quant4_specs
+                # rejects only tp, which cuts the packed axis itself)
+                out[k] = quant4_specs(v, spec)
             else:
                 out[k] = spec
         return out

@@ -20,7 +20,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.base import ModelConfig, StageParams
-from ..ops.quant import QuantizedArray
+from ..ops.quant import QuantizedArray, QuantizedArray4
 
 
 # per-key PartitionSpec for the stacked layer dict; None entries = replicated
@@ -74,6 +74,24 @@ def _embed_specs(cfg: ModelConfig) -> dict:
     return specs
 
 
+def quant4_specs(v: QuantizedArray4, spec: P):
+    """Spec tree for a packed-int4 weight given its dense spec.
+
+    Nibble packing only changes SIZES along the input axis, so ``q``
+    inherits the dense spec unchanged; the group-wise scale inserts a
+    broadcast axis before the output axis (shape ``(..., in/g, 1,
+    out)``) and its group axis stays replicated.  Slicing the input or
+    output axes themselves (tp) would cut through nibble pairs and
+    group boundaries — callers must reject tp before calling."""
+    if any(s == "tp" for s in spec):
+        raise ValueError(
+            "int4 (nibble-packed) weights do not compose with tp meshes "
+            "yet — tensor-parallel slicing would cut through the packed "
+            "input axis; use int8 for tensor-parallel serving")
+    scale = P(*spec[:-2], None, None, spec[-1]) if len(spec) >= 2 else P()
+    return QuantizedArray4(q=spec, scale=scale, group=v.group)
+
+
 def quant_scale_spec(q_spec: P) -> P:
     """Scale spec matching ``quantize_array(stacked=True)`` layout.
 
@@ -112,6 +130,8 @@ def stage_param_spec_tree(params: StageParams, cfg: ModelConfig, *,
                 spec = strip_tp(spec)
             if isinstance(v, QuantizedArray):
                 out[k] = QuantizedArray(q=spec, scale=quant_scale_spec(spec))
+            elif isinstance(v, QuantizedArray4):
+                out[k] = quant4_specs(v, spec)
             else:
                 out[k] = spec
         return out

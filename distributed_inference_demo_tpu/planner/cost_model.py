@@ -52,9 +52,15 @@ class ModelCostProfile:
                 + sum(c.flops for c in self.layers))
 
 
-def _dtype_bytes(cfg: ModelConfig) -> int:
+def _dtype_bytes(cfg: ModelConfig) -> float:
     if cfg.quantization == "int8":
         return 1
+    if cfg.quantization == "int4":
+        # nibble-packed weights + f32 group scales
+        # (ops/quant.DEFAULT_INT4_GROUP) — mis-costing int4 at float
+        # width would make the planner reject placements that fit
+        from ..ops.quant import DEFAULT_INT4_GROUP
+        return 0.5 + 4.0 / DEFAULT_INT4_GROUP
     return {"float32": 4, "bfloat16": 2, "float16": 2}.get(cfg.dtype_name, 2)
 
 

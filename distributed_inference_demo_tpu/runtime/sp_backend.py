@@ -59,6 +59,11 @@ class SequenceParallelBackend:
         self.sp = int(mesh.shape["sp"])
         self._fns: "OrderedDict" = OrderedDict()
         self._lock = threading.Lock()
+        # counters + fn-cache bookkeeping get their OWN lock: generate()
+        # holds _lock for the whole device computation (minutes at 32k
+        # context), and GET /stats must answer DURING a request, not
+        # after it
+        self._stats_lock = threading.Lock()
         self._served = 0
         self._decode_seconds = 0.0
         self._tokens_out = 0
@@ -82,14 +87,19 @@ class SequenceParallelBackend:
     MAX_COMPILED_VARIANTS = 8
 
     def _fn(self, num_new: int):
-        fn = self._fns.get(num_new)
-        if fn is None:
-            fn = self._build(num_new)
+        # called with _lock held (one build at a time); the cache dict
+        # itself mutates under _stats_lock so stats() can snapshot it
+        # without waiting out a whole generation
+        with self._stats_lock:
+            fn = self._fns.get(num_new)
+            if fn is not None:
+                self._fns.move_to_end(num_new)
+                return fn
+        fn = self._build(num_new)
+        with self._stats_lock:
             self._fns[num_new] = fn
             while len(self._fns) > self.MAX_COMPILED_VARIANTS:
                 self._fns.popitem(last=False)
-        else:
-            self._fns.move_to_end(num_new)
         return fn
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
@@ -107,6 +117,7 @@ class SequenceParallelBackend:
                 toks = np.asarray(
                     fn(self.params, ids, jax.random.PRNGKey(seed)))
             dt = time.perf_counter() - t0
+        with self._stats_lock:
             self._served += 1
             self._decode_seconds += dt
             self._tokens_out += int(toks.size)
@@ -128,7 +139,9 @@ class SequenceParallelBackend:
             yield res.tokens[:, i]
 
     def stats(self) -> dict:
-        with self._lock:   # _fn() mutates the variant cache mid-request
+        # _stats_lock only: /stats must answer WHILE a long-context
+        # request holds the generation lock
+        with self._stats_lock:
             return {
                 "mode": "sequence_parallel",
                 "strategy": self.strategy,
@@ -141,6 +154,7 @@ class SequenceParallelBackend:
             }
 
     def reset_stats(self) -> None:
-        self._served = 0
-        self._decode_seconds = 0.0
-        self._tokens_out = 0
+        with self._stats_lock:
+            self._served = 0
+            self._decode_seconds = 0.0
+            self._tokens_out = 0

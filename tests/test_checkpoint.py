@@ -21,7 +21,8 @@ def _tree_equal(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
-@pytest.mark.parametrize("model", ["llama-test", "llama-test-int8"])
+@pytest.mark.parametrize("model", ["llama-test", "llama-test-int8",
+                                   "llama-test-int4"])
 def test_params_roundtrip(tmp_path, model):
     from distributed_inference_demo_tpu.ops.quant import maybe_quantize
     cfg = get_model_config(model)

@@ -99,11 +99,13 @@ def test_ep_capacity_drop(devices):
     np.testing.assert_array_equal(y[:, C:], np.zeros_like(y[:, C:]))
 
 
-@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("quant", [False, "int8", "int4"])
 def test_ep_stage_prefill_decode_parity(quant, devices):
     """Whole mixtral stage E-sliced over ep=2: prefill logits match the
-    single-device forward; one decode step on the sharded cache works."""
-    name = "mixtral-test" + ("-int8" if quant else "")
+    single-device forward; one decode step on the sharded cache works.
+    int8 AND packed int4 expert stacks slice over ep (the E axis is
+    orthogonal to int4's packed input axis)."""
+    name = "mixtral-test" + (f"-{quant}" if quant else "")
     cfg = get_model_config(name).replace(moe_capacity_factor=8.0)
     params = init_full_params(jax.random.PRNGKey(0), cfg, quantize=quant)
     spec = StageSpec(0, 1, 0, cfg.num_layers)

@@ -1,0 +1,135 @@
+"""Weight-only int4 (ops/quant.QuantizedArray4): packing exactness,
+error bounds, storage halving, engine integration, and the composition
+rules (pipeline slicing yes, EP yes, tp rejected loudly)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_inference_demo_tpu.models import get_model_config
+from distributed_inference_demo_tpu.models.base import (StageSpec,
+                                                        slice_stage)
+from distributed_inference_demo_tpu.models.decoder import init_full_params
+from distributed_inference_demo_tpu.ops.quant import (QuantizedArray4,
+                                                      maybe_quantize,
+                                                      quantize_array4)
+from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+GREEDY = SamplingParams(greedy=True)
+
+
+def test_pack_unpack_roundtrip_exact_on_grid():
+    """Values already on the int4 grid survive quantize->dequantize
+    bit-exactly (packing/unpacking is lossless; only rounding loses)."""
+    rng = np.random.default_rng(0)
+    grid = rng.integers(-7, 8, size=(6, 64, 16)).astype(np.float32)
+    scale = 0.25
+    w = jnp.asarray(grid * scale)
+    qa = quantize_array4(w, group=64)
+    np.testing.assert_allclose(np.asarray(qa.dequantize(jnp.float32)),
+                               np.asarray(w), rtol=0, atol=1e-6)
+
+
+def test_quantization_error_bounded_by_half_step():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(4, 128, 32)).astype(np.float32))
+    qa = quantize_array4(w)
+    dq = np.asarray(qa.dequantize(jnp.float32))
+    # per-group step = scale; rounding error <= scale/2 everywhere
+    step = np.asarray(qa.scale)                      # (4, 2, 1, 32)
+    err = np.abs(dq - np.asarray(w)).reshape(4, 2, 64, 32)
+    assert (err <= step / 2 + 1e-6).all()
+
+
+def test_logical_shape_and_storage_halving():
+    w = jnp.ones((8, 256, 64), jnp.float32)
+    qa = quantize_array4(w)
+    assert qa.shape == (8, 256, 64)
+    # packed bytes = half the element count; scales add 4/group per wt
+    n = 8 * 256 * 64
+    assert qa.q.nbytes == n // 2
+    assert qa.nbytes / n < 0.57
+
+
+def test_odd_input_dim_rejected():
+    with pytest.raises(ValueError, match="even"):
+        quantize_array4(jnp.ones((3, 5, 4)))
+
+
+def test_registry_int4_suffix():
+    cfg = get_model_config("llama-test-int4")
+    assert cfg.quantization == "int4"
+    assert get_model_config("llama-test").quantization == "none"
+
+
+def test_engine_generates_with_int4_weights():
+    """maybe_quantize(int4) + InferenceEngine: greedy decode runs,
+    outputs are valid ids, and repeated runs are deterministic."""
+    cfg = get_model_config("llama-test-int4")
+    params = maybe_quantize(
+        init_full_params(jax.random.PRNGKey(0), get_model_config(
+            "llama-test")), cfg)
+    eng = InferenceEngine(cfg, params, max_seq=32, sampling=GREEDY)
+    prompt = np.asarray([[3, 1, 4, 1, 5]])
+    a = eng.generate(prompt, 6).tokens
+    b = eng.generate(prompt, 6).tokens
+    np.testing.assert_array_equal(a, b)
+    assert ((a >= 0) & (a < cfg.vocab_size)).all()
+
+
+def test_layer_chunked_int4_init_matches_rewrap():
+    """init_full_params(quantize=True) on an -int4 config produces the
+    same tree structure (and group) as quantizing a dense init."""
+    cfg = get_model_config("llama-test-int4")
+    chunked = init_full_params(jax.random.PRNGKey(0), cfg, quantize=True)
+    wq = chunked.layers["wq"]
+    assert isinstance(wq, QuantizedArray4)
+    assert wq.shape == (cfg.num_layers, cfg.hidden_size,
+                        cfg.num_heads * cfg.head_dim)
+    rewrap = maybe_quantize(
+        init_full_params(jax.random.PRNGKey(0), get_model_config(
+            "llama-test")), cfg)
+    assert rewrap.layers["wq"].group == wq.group
+    assert rewrap.layers["wq"].q.shape == wq.q.shape
+    assert rewrap.layers["wq"].scale.shape == wq.scale.shape
+
+
+def test_stage_slicing_preserves_packing():
+    """Pipeline stage slicing cuts the LAYER axis; packed q and
+    group scales both carry it, so a 2-stage split decodes like the
+    full model."""
+    cfg = get_model_config("llama-test-int4")
+    params = init_full_params(jax.random.PRNGKey(0), cfg, quantize=True)
+    s0 = slice_stage(params, cfg, StageSpec(0, 2, 0, 2))
+    wq = s0.layers["wq"]
+    assert isinstance(wq, QuantizedArray4)
+    assert wq.shape[0] == 2 and wq.group == params.layers["wq"].group
+
+
+def test_tp_mesh_rejected_loudly():
+    from distributed_inference_demo_tpu.parallel import (MeshConfig,
+                                                         make_mesh)
+    from distributed_inference_demo_tpu.runtime.engine import (
+        shard_engine_params)
+
+    cfg = get_model_config("llama-test-int4")
+    params = init_full_params(jax.random.PRNGKey(0), cfg, quantize=True)
+    mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    with pytest.raises(ValueError, match="int4"):
+        shard_engine_params(params, cfg, mesh)
+
+
+def test_moe_int4_engine_runs():
+    """int4 quantizes the expert stacks too (E axis rides the leading
+    axes); the mixtral family engine decodes with packed experts."""
+    cfg = get_model_config("mixtral-test-int4")
+    params = init_full_params(jax.random.PRNGKey(0), cfg, quantize=True)
+    assert isinstance(params.layers["w_gate"], QuantizedArray4)
+    eng = InferenceEngine(cfg, params, max_seq=32, sampling=GREEDY)
+    toks = eng.generate(np.asarray([[3, 1, 4, 1]]), 4).tokens
+    assert ((toks >= 0) & (toks < cfg.vocab_size)).all()

@@ -53,6 +53,7 @@ LEG_BUDGETS = {
     "prefill_long": 1800,
     "moe": 1800,
     "multimodal": 1500,
+    "int4": 2400,
 }
 DEFAULT_LEGS = list(LEG_BUDGETS)
 
@@ -191,6 +192,8 @@ def merge(artifact: dict, leg: str, result: dict, params: dict) -> dict:
         for pt in (artifact["extras"].get("sweep", {}) or {}).get(
                 "points", []):
             bench.apply_measured_frac(pt, measured)
+        for sub in (artifact["extras"].get("int4", {}) or {}).values():
+            bench.apply_measured_frac(sub, measured)
     return artifact
 
 
