@@ -94,17 +94,11 @@ def _load_draft_for_mesh(args, mesh):
 def _build_spec_engine(args):
     """Construct the draft/verify SpeculativeEngine from CLI flags — the
     one site shared by ``generate --draft-model`` and
-    ``serve --draft-model``.  Returns None (after printing the error) for
-    flag combinations the speculative caches don't support."""
+    ``serve --draft-model``.  Every engine flag composes here
+    (--kv-cache-dtype, --prefill-chunk, --tp, --eos-id)."""
     from .models.registry import get_model_config
     from .runtime import SpeculativeEngine
 
-    if getattr(args, "prefill_chunk", 0):
-        # the draft/verify engines run whole-prompt prefill; silently
-        # ignoring the flag would defeat its memory-bounding purpose
-        print("--prefill-chunk is not supported with --draft-model",
-              file=sys.stderr)
-        return None
     cfg = get_model_config(args.model)
     params, mesh = _load_params_for_mesh(args, cfg)
     draft_cfg, draft_params = _load_draft_for_mesh(args, mesh)
@@ -113,7 +107,8 @@ def _build_spec_engine(args):
         max_seq=args.max_seq, sampling=_sampling_from_args(args),
         num_draft=args.num_draft, attn_backend=args.attn_backend,
         mesh=mesh, eos_id=getattr(args, "eos_id", None),
-        kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
+        prefill_chunk=getattr(args, "prefill_chunk", 0) or None)
 
 
 def _build_prompt_lookup_engine(args):
@@ -319,10 +314,7 @@ def cmd_serve(args) -> int:
     elif getattr(args, "draft_model", ""):
         from .runtime.speculative import SpeculativeBackend
 
-        engine = _build_spec_engine(args)
-        if engine is None:
-            return 1
-        backend = SpeculativeBackend(engine)
+        backend = SpeculativeBackend(_build_spec_engine(args))
         print(f"SERVE_SPECULATIVE {args.model} draft={args.draft_model} "
               f"k={args.num_draft}", flush=True)
     elif getattr(args, "prompt_lookup", False):
@@ -677,8 +669,6 @@ def cmd_generate(args) -> int:
         # speculative decoding: the draft model proposes, the target
         # verifies (runtime/speculative.py); shares every engine flag
         spec = _build_spec_engine(args)
-        if spec is None:
-            return 1
         res, stats = spec.generate(ids, args.max_new_tokens, seed=args.seed)
     else:
         _, engine = _build_engine(args)
@@ -817,7 +807,7 @@ def cmd_bench(args) -> int:
         # this comparison is for)
         spec = (_build_prompt_lookup_engine(args) if want_pld
                 else _build_spec_engine(args))
-        if spec is None:
+        if spec is None:     # prompt-lookup builder still rejects flags
             return 1
         from .runtime import InferenceEngine
         engine = InferenceEngine(

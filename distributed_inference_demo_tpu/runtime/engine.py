@@ -72,6 +72,79 @@ class GenerationResult:
         return total / self.seconds if self.seconds > 0 else float("nan")
 
 
+def make_chunk_programs(fwd):
+    """``(chunk_mid, chunk_last)`` jitted programs over a forward seam —
+    ONE factory shared by InferenceEngine and SpeculativeEngine (which
+    builds a pair per model), so the two engines' chunk programs cannot
+    drift and :func:`run_chunked_prefill` has one set of semantics."""
+
+    @partial(jax.jit, donate_argnums=(2,))
+    def chunk_mid(params, ids, cache, start):
+        """One non-final prompt chunk: extend the cache, drop logits."""
+        b, s = ids.shape
+        pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
+        _, cache = fwd(params, ids, cache, pos, True)
+        return cache
+
+    @partial(jax.jit, donate_argnums=(2,))
+    def chunk_last(params, ids, cache, start, gather_idx):
+        """Final (possibly pad-tailed) chunk: logits at the prompt's
+        true last position."""
+        b, s = ids.shape
+        pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
+        logits, cache = fwd(params, ids, cache, pos, False)
+        last = jax.lax.dynamic_index_in_dim(logits, gather_idx, axis=1,
+                                            keepdims=False)
+        return last, cache
+
+    return chunk_mid, chunk_last
+
+
+def run_chunked_prefill(params, ids, cache, C: int, max_seq: int,
+                        chunk_mid, chunk_last=None):
+    """The chunked-prefill driver, shared by InferenceEngine and
+    SpeculativeEngine (which runs it once per model).
+
+    The prompt is zero-padded to a chunk multiple and every chunk runs
+    through the same compiled programs (mid + last) — one chunk shape
+    for ALL prompt lengths, short ones included.  The final chunk is
+    left-shifted when the padded length would spill past ``max_seq``
+    ("aligned last window"): the overlapped real tokens are recomputed
+    and rewritten at their own positions (same values — K/V depend only
+    on the prefix), so no pad slot is ever written beyond max_seq and
+    ``dynamic_update_slice`` can never clamp into valid entries.  The
+    cache's valid length is rewound to the true prompt length afterwards
+    so decode's first insert overwrites the first pad slot (stale-slot
+    invariant).
+
+    ``chunk_last=None`` runs the final chunk through ``chunk_mid`` too
+    and returns ``(None, cache)`` — the draft-model case, where only the
+    filled cache matters and no logits are needed."""
+    b, plen = ids.shape
+    n_chunks = -(-plen // C)
+    padded = jnp.zeros((b, n_chunks * C), jnp.int32)
+    padded = jax.lax.dynamic_update_slice(padded, ids, (0, 0))
+    for i in range(n_chunks - 1):
+        cache = chunk_mid(params, jax.lax.dynamic_slice_in_dim(
+            padded, i * C, C, axis=1), cache, jnp.int32(i * C))
+    start = min((n_chunks - 1) * C, max_seq - C)
+    # the left shift must apply to the cache WRITE offset too (the
+    # insert position is cache.length inside stage_forward), so the
+    # column==position invariant holds; with the buffer padded past
+    # max_seq (pad_cache_capacity) the old implicit
+    # dynamic_update_slice start-clamp no longer realizes it
+    cache = KVCache(cache.keys, cache.values, jnp.int32(start))
+    tail = jax.lax.dynamic_slice_in_dim(padded, start, C, axis=1)
+    if chunk_last is None:
+        cache = chunk_mid(params, tail, cache, jnp.int32(start))
+        last = None
+    else:
+        last, cache = chunk_last(params, tail, cache, jnp.int32(start),
+                                 jnp.int32(plen - 1 - start))
+    cache = KVCache(cache.keys, cache.values, jnp.int32(plen))
+    return last, cache
+
+
 def resolve_cache_dtype_backend(kv_cache_dtype, attn_backend: str):
     """The reduced-precision-cache rule, ONE owner for every engine
     (plain / speculative / prompt-lookup / batching): a reduced-dtype KV
@@ -194,27 +267,8 @@ class InferenceEngine:
             logits, cache = fwd(params, ids, cache, pos, True)
             return logits[:, -1], cache
 
-        @partial(jax.jit, donate_argnums=(2,))
-        def prefill_chunk_mid(params, ids, cache, start):
-            """One non-final prompt chunk: extend the cache, drop logits."""
-            b, s = ids.shape
-            pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
-            _, cache = fwd(params, ids, cache, pos, True)
-            return cache
-
-        @partial(jax.jit, donate_argnums=(2,))
-        def prefill_chunk_last(params, ids, cache, start, gather_idx):
-            """Final (possibly pad-tailed) chunk: logits at the prompt's
-            true last position."""
-            b, s = ids.shape
-            pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
-            logits, cache = fwd(params, ids, cache, pos, False)
-            last = jax.lax.dynamic_index_in_dim(logits, gather_idx, axis=1,
-                                                keepdims=False)
-            return last, cache
-
-        self._prefill_chunk_mid = prefill_chunk_mid
-        self._prefill_chunk_last = prefill_chunk_last
+        self._prefill_chunk_mid, self._prefill_chunk_last = \
+            make_chunk_programs(fwd)
 
         def _mask_eos(tok, done, eos):
             """Shared eos row-padding rule (eos < 0 = disabled); the eos id
@@ -305,44 +359,15 @@ class InferenceEngine:
 
     def _run_prefill(self, ids: jnp.ndarray, cache: KVCache):
         """Whole-prompt or chunked prefill → (last_logits [b, V], cache).
-
-        Chunked: the prompt is zero-padded to a chunk multiple and every
-        chunk runs through the same two compiled programs (mid + last) —
-        one chunk shape for ALL prompt lengths, short ones included.  The
-        final chunk is left-shifted when the padded length would spill
-        past the cache capacity ("aligned last window"): the overlapped
-        real tokens are recomputed and rewritten at their own positions
-        (same values — K/V depend only on the prefix), so no pad slot is
-        ever written beyond max_seq and ``dynamic_update_slice`` can
-        never clamp into valid entries.  The cache's valid length is
-        rewound to the true prompt length afterwards so decode's first
-        insert overwrites the first pad slot (pads beyond it stay masked
-        until overwritten — stale-slot invariant)."""
-        b, plen = ids.shape
+        Chunked semantics (padding, aligned last window, length rewind)
+        live in :func:`run_chunked_prefill`, shared with the
+        speculative engine."""
         C = self.prefill_chunk
         if C is None:
             return self._prefill(self.params, ids, cache)
-        n_chunks = -(-plen // C)
-        padded = jnp.zeros((b, n_chunks * C), jnp.int32)
-        padded = jax.lax.dynamic_update_slice(padded, ids, (0, 0))
-        for i in range(n_chunks - 1):
-            cache = self._prefill_chunk_mid(
-                self.params, jax.lax.dynamic_slice_in_dim(
-                    padded, i * C, C, axis=1),
-                cache, jnp.int32(i * C))
-        start = min((n_chunks - 1) * C, self.max_seq - C)
-        # the left shift must apply to the cache WRITE offset too (the
-        # insert position is cache.length inside stage_forward), so the
-        # column==position invariant holds; with the buffer padded past
-        # max_seq (pad_cache_capacity) the old implicit
-        # dynamic_update_slice start-clamp no longer realizes it
-        cache = KVCache(cache.keys, cache.values, jnp.int32(start))
-        last_logits, cache = self._prefill_chunk_last(
-            self.params, jax.lax.dynamic_slice_in_dim(
-                padded, start, C, axis=1),
-            cache, jnp.int32(start), jnp.int32(plen - 1 - start))
-        cache = KVCache(cache.keys, cache.values, jnp.int32(plen))
-        return last_logits, cache
+        return run_chunked_prefill(self.params, ids, cache, C,
+                                   self.max_seq, self._prefill_chunk_mid,
+                                   self._prefill_chunk_last)
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0, logprobs: bool = False) -> GenerationResult:

@@ -265,13 +265,19 @@ class SpeculativeEngine:
                  attn_backend: str = "auto",
                  mesh=None,
                  eos_id: Optional[int] = None,
-                 kv_cache_dtype=None):
+                 kv_cache_dtype=None,
+                 prefill_chunk: Optional[int] = None):
         """``kv_cache_dtype``: reduced-precision storage for BOTH the
         target and draft caches (same contract as InferenceEngine /
         ContinuousBatchingEngine: insert rounds via update_kv_cache's
         cast, attention upcasts to f32, the jnp attention path is
         forced) — greedy output matches a plain engine with the same
-        cache dtype bit-exactly."""
+        cache dtype bit-exactly.
+
+        ``prefill_chunk``: bound prefill activation memory on long
+        prompts by running BOTH models' prefill in fixed C-token chunks
+        (engine.run_chunked_prefill, once per model; the draft's final
+        chunk needs no logits).  Same semantics as InferenceEngine's."""
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
                 f"draft vocab ({draft_cfg.vocab_size}) != target vocab "
@@ -285,6 +291,11 @@ class SpeculativeEngine:
         self.sampling = sampling
         self.num_draft = num_draft
         self.eos_id = eos_id
+        if prefill_chunk is not None and not (
+                1 <= prefill_chunk <= self.max_seq):
+            raise ValueError(
+                f"prefill_chunk must be in [1, max_seq={self.max_seq}]")
+        self.prefill_chunk = prefill_chunk
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.draft_spec = StageSpec(0, 1, 0, draft_cfg.num_layers)
         self.mesh = mesh
@@ -321,6 +332,14 @@ class SpeculativeEngine:
             t_logits, tcache = fwd_t(tparams, ids, tcache, pos, True)
             _, dcache = fwd_d(dparams, ids, dcache, pos, True)
             return t_logits[:, -1], tcache, dcache
+
+        # chunked-prefill programs (engine.run_chunked_prefill drives
+        # them; one mid+last pair for the target, mid-only for the
+        # draft — its final chunk needs no logits).  Shared factory with
+        # InferenceEngine so the programs cannot drift.
+        from .engine import make_chunk_programs
+        self._t_chunk_mid, self._t_chunk_last = make_chunk_programs(fwd_t)
+        self._d_chunk_mid, _ = make_chunk_programs(fwd_d)
 
         def one_round(tparams, dparams, last_tok, tcache, dcache, rng):
             """Draft K, verify K+1 in one target forward, accept/resample.
@@ -409,6 +428,23 @@ class SpeculativeEngine:
             dc = jax.device_put(dc, self._cache_sharding)
         return tc, dc
 
+    def _run_prefill_both(self, ids, tcache, dcache):
+        """(last_target_logits, tcache, dcache) — whole-prompt in one
+        fused program, or chunked per model (engine.run_chunked_prefill
+        semantics: zero-pad, aligned last window, length rewind)."""
+        C = self.prefill_chunk
+        if C is None:
+            return self._prefill_both(self.params, self.draft_params,
+                                      ids, tcache, dcache)
+        from .engine import run_chunked_prefill
+        last, tcache = run_chunked_prefill(
+            self.params, ids, tcache, C, self.max_seq,
+            self._t_chunk_mid, self._t_chunk_last)
+        _, dcache = run_chunked_prefill(
+            self.draft_params, ids, dcache, C, self.max_seq,
+            self._d_chunk_mid)
+        return last, tcache, dcache
+
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0,
                  rounds_per_dispatch: Optional[int] = None
@@ -429,8 +465,8 @@ class SpeculativeEngine:
 
         t0 = time.perf_counter()
         tcache, dcache = self.new_caches(b)
-        last_logits, tcache, dcache = self._prefill_both(
-            self.params, self.draft_params, ids, tcache, dcache)
+        last_logits, tcache, dcache = self._run_prefill_both(
+            ids, tcache, dcache)
         # first token comes from the target's prefill logits (the draft
         # never gets to choose a token unchecked)
         rng, sub = jax.random.split(rng)
@@ -478,8 +514,8 @@ class SpeculativeEngine:
         stats = stats_out if stats_out is not None else SpecStats()
 
         tcache, dcache = self.new_caches(b)
-        last_logits, tcache, dcache = self._prefill_both(
-            self.params, self.draft_params, ids, tcache, dcache)
+        last_logits, tcache, dcache = self._run_prefill_both(
+            ids, tcache, dcache)
         rng, sub = jax.random.split(rng)
         last_tok = sample_logits(last_logits, sub, self.sampling)
         first = np.asarray(last_tok)

@@ -72,6 +72,43 @@ def test_fp8_kv_greedy_matches_fp8_engine(params, draft_params):
                           kv_cache_dtype="float8_e4m3fn")
 
 
+@pytest.mark.parametrize("plen", [5, 8, 9, 17])
+def test_chunked_prefill_matches_whole(params, draft_params, plen):
+    """Spec decode with prefill_chunk (C=8, both models chunked) must be
+    bit-identical to whole-prompt spec prefill for every remainder
+    shape: plen < C, == C, == C+1, spanning 3 chunks."""
+    sampling = SamplingParams(greedy=True)
+    whole = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                              max_seq=64, sampling=sampling, num_draft=4)
+    chunked = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                                max_seq=64, sampling=sampling,
+                                num_draft=4, prefill_chunk=8)
+    prompt = (np.arange(2 * plen).reshape(2, plen) % 199).astype(np.int32)
+    want, _ = whole.generate(prompt, 12)
+    got, _ = chunked.generate(prompt, 12)
+    np.testing.assert_array_equal(want.tokens, got.tokens)
+
+
+def test_chunked_prefill_padded_past_capacity(params, draft_params):
+    """Aligned-last-window regression shape: the chunk-padded prompt
+    would spill past max_seq; the left shift must keep spec decode
+    bit-identical (both caches)."""
+    sampling = SamplingParams(greedy=True)
+    whole = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                              max_seq=24, sampling=sampling, num_draft=3)
+    chunked = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                                max_seq=24, sampling=sampling,
+                                num_draft=3, prefill_chunk=8)
+    plen = 19                       # pads to 24 == max_seq - shift window
+    prompt = (np.arange(plen).reshape(1, plen) % 199).astype(np.int32)
+    want, _ = whole.generate(prompt, 5)
+    got, _ = chunked.generate(prompt, 5)
+    np.testing.assert_array_equal(want.tokens, got.tokens)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
+                          max_seq=24, sampling=sampling, prefill_chunk=0)
+
+
 def test_greedy_matches_across_dispatch_sizes(params, draft_params):
     """Rounds-per-dispatch is a pure batching knob: R=1 and R=8 agree."""
     sampling = SamplingParams(greedy=True)
