@@ -114,15 +114,11 @@ def _build_spec_engine(args):
 def _build_prompt_lookup_engine(args):
     """Construct the draft-free PromptLookupEngine from CLI flags — the one
     site shared by ``generate --prompt-lookup`` and
-    ``serve --prompt-lookup``.  Returns None (after printing the error)
-    for flag combinations it doesn't support."""
+    ``serve --prompt-lookup``.  Every engine flag composes here
+    (--kv-cache-dtype, --prefill-chunk, --tp, --eos-id)."""
     from .models.registry import get_model_config
     from .runtime.prompt_lookup import PromptLookupEngine
 
-    if getattr(args, "prefill_chunk", 0):
-        print("--prefill-chunk is not supported with --prompt-lookup",
-              file=sys.stderr)
-        return None
     cfg = get_model_config(args.model)
     params, mesh = _load_params_for_mesh(args, cfg)
     return PromptLookupEngine(
@@ -130,7 +126,8 @@ def _build_prompt_lookup_engine(args):
         sampling=_sampling_from_args(args), num_draft=args.num_draft,
         attn_backend=args.attn_backend, mesh=mesh,
         eos_id=getattr(args, "eos_id", None),
-        kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
+        prefill_chunk=getattr(args, "prefill_chunk", 0) or None)
 
 
 def _build_engine(args):
@@ -320,10 +317,7 @@ def cmd_serve(args) -> int:
     elif getattr(args, "prompt_lookup", False):
         from .runtime.speculative import SpeculativeBackend
 
-        engine = _build_prompt_lookup_engine(args)
-        if engine is None:
-            return 1
-        backend = SpeculativeBackend(engine)
+        backend = SpeculativeBackend(_build_prompt_lookup_engine(args))
         print(f"SERVE_PROMPT_LOOKUP {args.model} k={args.num_draft}",
               flush=True)
     else:
@@ -662,8 +656,6 @@ def cmd_generate(args) -> int:
         # draft-free speculation: n-gram lookup over the context proposes,
         # the target verifies (runtime/prompt_lookup.py)
         pld = _build_prompt_lookup_engine(args)
-        if pld is None:
-            return 1
         res, stats = pld.generate(ids, args.max_new_tokens, seed=args.seed)
     elif getattr(args, "draft_model", ""):
         # speculative decoding: the draft model proposes, the target
@@ -807,8 +799,6 @@ def cmd_bench(args) -> int:
         # this comparison is for)
         spec = (_build_prompt_lookup_engine(args) if want_pld
                 else _build_spec_engine(args))
-        if spec is None:     # prompt-lookup builder still rejects flags
-            return 1
         from .runtime import InferenceEngine
         engine = InferenceEngine(
             spec.cfg, spec.params, max_seq=args.max_seq,

@@ -72,6 +72,15 @@ class GenerationResult:
         return total / self.seconds if self.seconds > 0 else float("nan")
 
 
+def validate_prefill_chunk(prefill_chunk, max_seq: int):
+    """The chunk-size rule, ONE owner for every engine that accepts
+    ``prefill_chunk`` (plain / speculative / prompt-lookup)."""
+    if prefill_chunk is not None and not (1 <= prefill_chunk <= max_seq):
+        raise ValueError(
+            f"prefill_chunk must be in [1, max_seq={max_seq}]")
+    return prefill_chunk
+
+
 def make_chunk_programs(fwd):
     """``(chunk_mid, chunk_last)`` jitted programs over a forward seam —
     ONE factory shared by InferenceEngine and SpeculativeEngine (which
@@ -211,11 +220,8 @@ class InferenceEngine:
         self.sampling = sampling
         self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
-        if prefill_chunk is not None and not (
-                1 <= prefill_chunk <= self.max_seq):
-            raise ValueError(
-                f"prefill_chunk must be in [1, max_seq={self.max_seq}]")
-        self.prefill_chunk = prefill_chunk
+        self.prefill_chunk = validate_prefill_chunk(prefill_chunk,
+                                                    self.max_seq)
         self.mesh = mesh
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         from ..parallel.tensor import resolve_tp_attn_backend

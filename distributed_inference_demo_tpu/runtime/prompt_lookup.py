@@ -93,12 +93,15 @@ class PromptLookupEngine:
                  attn_backend: str = "auto",
                  mesh=None,
                  eos_id: Optional[int] = None,
-                 kv_cache_dtype=None):
+                 kv_cache_dtype=None,
+                 prefill_chunk: Optional[int] = None):
         """``mesh``: tp mesh — the target forward runs sharded (see
         InferenceEngine); proposal matching stays replicated VPU work.
         ``kv_cache_dtype``: reduced-precision cache storage, same
         contract as InferenceEngine (insert rounds, attention upcasts,
-        jnp path forced)."""
+        jnp path forced).  ``prefill_chunk``: C-token chunked prefill
+        (engine.run_chunked_prefill semantics; the proposer's history
+        buffer is host-seeded from the ids and unaffected)."""
         if num_draft < 1:
             raise ValueError("num_draft must be >= 1")
         self.cfg, self.params = cfg, params
@@ -108,6 +111,9 @@ class PromptLookupEngine:
         self.eos_id = eos_id
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.mesh = mesh
+        from .engine import validate_prefill_chunk
+        self.prefill_chunk = validate_prefill_chunk(prefill_chunk,
+                                                    self.max_seq)
 
         from ..parallel.tensor import resolve_tp_attn_backend
         from .engine import resolve_cache_dtype_backend
@@ -177,6 +183,8 @@ class PromptLookupEngine:
             return em, ms, last_tok, cache, history, hist_len, rng
 
         self._prefill, self._rounds, self._cap = prefill, rounds, cap
+        from .engine import make_chunk_programs
+        self._chunk_mid, self._chunk_last = make_chunk_programs(fwd)
 
     # ------------------------------------------------------------------
 
@@ -188,7 +196,13 @@ class PromptLookupEngine:
                                dtype=self.kv_cache_dtype)
         if self._cache_sharding is not None:
             cache = jax.device_put(cache, self._cache_sharding)
-        last_logits, cache = self._prefill(self.params, ids, cache)
+        if self.prefill_chunk is None:
+            last_logits, cache = self._prefill(self.params, ids, cache)
+        else:
+            from .engine import run_chunked_prefill
+            last_logits, cache = run_chunked_prefill(
+                self.params, ids, cache, self.prefill_chunk, self.max_seq,
+                self._chunk_mid, self._chunk_last)
         rng, sub = jax.random.split(rng)
         last_tok = sample_logits(last_logits, sub, self.sampling)
         history = jnp.zeros((b, self._cap), jnp.int32)

@@ -291,11 +291,9 @@ class SpeculativeEngine:
         self.sampling = sampling
         self.num_draft = num_draft
         self.eos_id = eos_id
-        if prefill_chunk is not None and not (
-                1 <= prefill_chunk <= self.max_seq):
-            raise ValueError(
-                f"prefill_chunk must be in [1, max_seq={self.max_seq}]")
-        self.prefill_chunk = prefill_chunk
+        from .engine import validate_prefill_chunk
+        self.prefill_chunk = validate_prefill_chunk(prefill_chunk,
+                                                    self.max_seq)
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.draft_spec = StageSpec(0, 1, 0, draft_cfg.num_layers)
         self.mesh = mesh

@@ -59,6 +59,21 @@ def test_fp8_kv_greedy_matches_fp8_engine(params):
                            kv_cache_dtype="float8_e4m3fn")
 
 
+@pytest.mark.parametrize("plen", [5, 8, 17])
+def test_chunked_prefill_matches_whole(params, oracle, plen):
+    """prefill_chunk (C=8) must keep prompt-lookup decode bit-identical
+    to whole-prompt prefill (the history buffer is host-seeded and
+    unaffected by chunking)."""
+    whole = PromptLookupEngine(CFG, params, max_seq=64, sampling=GREEDY,
+                               num_draft=4)
+    chunked = PromptLookupEngine(CFG, params, max_seq=64, sampling=GREEDY,
+                                 num_draft=4, prefill_chunk=8)
+    prompt = (np.arange(plen).reshape(1, plen) % 199).astype(np.int32)
+    want, _ = whole.generate(prompt, 10)
+    got, _ = chunked.generate(prompt, 10)
+    np.testing.assert_array_equal(want.tokens, got.tokens)
+
+
 def test_lookup_accelerates_self_repetition(params, oracle):
     """Greedy decode of a tiny random model falls into loops; once the
     loop is in the history the lookup proposer should ride it, emitting
