@@ -164,7 +164,9 @@ def cmd_serve(args) -> int:
                                    ("--batch-slots",
                                     getattr(args, "batch_slots", 0)),
                                    ("--sp",
-                                    getattr(args, "sp", 1) > 1)] if on]
+                                    getattr(args, "sp", 1) > 1),
+                                   ("--vision",
+                                    getattr(args, "vision", False))] if on]
     # --batch-slots composes with --draft-model OR --prompt-lookup
     # (speculative decoding inside the slot loop — the production serving
     # shape); every other pairing stays an explicit error
@@ -274,6 +276,50 @@ def cmd_serve(args) -> int:
             strategy=args.sp_strategy, sampling=_sampling_from_args(args))
         print(f"SERVE_SP {args.model} sp={args.sp} "
               f"strategy={args.sp_strategy} max_seq={args.max_seq}",
+              flush=True)
+    elif getattr(args, "vision", False):
+        # LLaVA-style multimodal serving: ViT tower + projector in front
+        # of the decoder; /generate takes an optional "image" field and
+        # text-only requests run the plain engine path unchanged
+        import jax as _jax
+
+        from .models.registry import get_model_config
+        from .models.vision import VisionConfig, init_vision_params
+        from .runtime.multimodal import MultimodalBackend, MultimodalEngine
+
+        unsupported = [flag for flag, on in [
+            ("--kv-cache-dtype", bool(getattr(args, "kv_cache_dtype", ""))),
+            ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
+            ("--tp", getattr(args, "tp", 1) > 1)] if on]
+        if unsupported:
+            print(f"{'/'.join(unsupported)} not supported with --vision",
+                  file=sys.stderr)
+            return 1
+        cfg = get_model_config(args.model)
+        if args.vision_preset == "llava15":
+            vcfg = VisionConfig(image_size=336, patch_size=14,
+                                hidden_size=1024, num_layers=24,
+                                num_heads=16, intermediate_size=4096,
+                                dtype_name="bfloat16")
+        else:     # "small": a CLIP-base-like tower for modest decoders
+            vcfg = VisionConfig(image_size=224, patch_size=14,
+                                hidden_size=256, num_layers=6,
+                                num_heads=8, intermediate_size=1024,
+                                dtype_name="bfloat16")
+        params = _load_full_params(args, cfg)
+        # vision weights are random-init (no ViT checkpoint format is
+        # wired yet); the geometry and serving surface are real.  Seeded
+        # from --weights-seed like every other weight init, so the same
+        # seed reproduces the model regardless of the sampling --seed
+        vparams = init_vision_params(_jax.random.PRNGKey(args.weights_seed),
+                                     vcfg, cfg.hidden_size)
+        backend = MultimodalBackend(MultimodalEngine(
+            cfg, params, vcfg, vparams, max_seq=args.max_seq,
+            sampling=_sampling_from_args(args),
+            eos_id=getattr(args, "eos_id", None),
+            attn_backend=args.attn_backend))
+        print(f"SERVE_VISION {args.model} tower={args.vision_preset} "
+              f"image={vcfg.image_size} patches={vcfg.num_patches}",
               flush=True)
     elif getattr(args, "batch_slots", 0):
         from .models.registry import get_model_config
@@ -950,6 +996,14 @@ def main(argv=None) -> int:
                         "KV kept on device for automatic prefix reuse "
                         "(0 disables; each entry costs up to a "
                         "prompt-bucket of KV in HBM)")
+    s.add_argument("--vision", action="store_true",
+                   help="LLaVA-style multimodal serving: /generate takes "
+                        "an optional 'image' field ([H][W][C] floats); "
+                        "text-only requests serve unchanged")
+    s.add_argument("--vision-preset", default="small",
+                   choices=["small", "llava15"],
+                   help="ViT tower geometry: small = 224px/6 layers, "
+                        "llava15 = 336px/24 layers (weights random-init)")
     _add_sp_args(s)
     _add_draft_args(s)
     s.set_defaults(fn=cmd_serve)

@@ -189,9 +189,22 @@ class InferenceHTTPServer:
                     max_new = int(req.get("max_new_tokens",
                                           outer.default_max_new))
                     seed = int(req.get("seed", 0))
+                    image = req.get("image")
                 except (ValueError, KeyError) as e:
                     self._json(400, {"error": str(e)})
                     return
+                if image is not None:
+                    # honor-or-reject: only a multimodal backend takes
+                    # an image, and images don't stream (the fused
+                    # multimodal program emits all tokens at once)
+                    if req.get("stream"):
+                        self._json(501, {"error": "image input does not "
+                                                  "support stream"})
+                        return
+                    if not _accepts_kwarg(outer.backend.generate, "image"):
+                        self._json(501, {"error": "backend does not "
+                                                  "support image input"})
+                        return
                 try:
                     if req.get("stream"):
                         want_lp = bool(req.get("logprobs"))
@@ -205,6 +218,8 @@ class InferenceHTTPServer:
                         self._stream(ids, max_new, seed, logprobs=want_lp)
                     else:
                         kwargs = {}
+                        if image is not None:
+                            kwargs["image"] = image
                         if req.get("logprobs"):
                             if not _accepts_kwarg(outer.backend.generate,
                                                   "logprobs"):

@@ -22,6 +22,7 @@ pieces:
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, Optional
 
@@ -104,6 +105,91 @@ class MultimodalEngine:
         return GenerationResult(tokens=toks, prompt_len=seq,
                                 num_new=max_new_tokens,
                                 seconds=time.perf_counter() - t0)
+
+
+class MultimodalBackend:
+    """``serve --vision``: MultimodalEngine behind InferenceHTTPServer.
+
+    POST /generate gains an optional ``image`` field — nested JSON
+    floats shaped [H][W][C] (one image broadcast to the prompt batch of
+    1) or [b][H][W][C] — prepended to the prompt as projected patches,
+    LLaVA-style.  Requests WITHOUT an image run the wrapped text engine
+    unchanged, so one server serves both modalities.  Shape and batch
+    mismatches are ValueErrors (HTTP 400 with the expected tower
+    geometry spelled out).  The reference has no multimodal path at all
+    (BASELINE config #5 is this framework's addition)."""
+
+    def __init__(self, engine: MultimodalEngine):
+        self.mm = engine
+        self._counts_lock = threading.Lock()
+        self._served = {"text": 0, "image": 0}
+
+    @property
+    def max_seq(self) -> int:
+        return self.mm.engine.max_seq
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 seed: int = 0, image=None,
+                 logprobs: bool = False) -> GenerationResult:
+        ids = np.asarray(prompt_ids, np.int32)
+        if image is None:
+            # text-only requests run the wrapped engine's FULL surface
+            # (incl. logprobs) unchanged
+            with self._counts_lock:
+                self._served["text"] += 1
+            return self.mm.engine.generate(ids, max_new_tokens, seed=seed,
+                                           logprobs=logprobs)
+        if logprobs:
+            raise ValueError(
+                "logprobs is not supported with image input")
+        images = np.asarray(image, np.float32)
+        if images.ndim == 3:
+            images = images[None]
+        vcfg = self.mm.vcfg
+        want = (vcfg.image_size, vcfg.image_size, vcfg.channels)
+        if images.ndim != 4 or images.shape[1:] != want:
+            raise ValueError(
+                f"image must be [H][W][C] or [b][H][W][C] with shape "
+                f"{want} for this tower, got {images.shape}")
+        if images.shape[0] != ids.shape[0]:
+            raise ValueError(
+                f"image batch {images.shape[0]} != prompt batch "
+                f"{ids.shape[0]}")
+        with self._counts_lock:
+            self._served["image"] += 1
+        return self.mm.generate(images, ids, max_new_tokens, seed=seed)
+
+    def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                        seed: int = 0, logprobs: bool = False):
+        """Text-only streaming delegates to the wrapped engine (image +
+        stream is rejected at the HTTP layer — the fused multimodal
+        program emits all tokens at once)."""
+        with self._counts_lock:
+            self._served["text"] += 1
+        return self.mm.engine.generate_stream(
+            np.asarray(prompt_ids, np.int32), max_new_tokens, seed=seed,
+            logprobs=logprobs)
+
+    def classify(self, prompt_ids: np.ndarray, label_token_ids):
+        return self.mm.engine.classify(np.asarray(prompt_ids, np.int32),
+                                       label_token_ids)
+
+    def stats(self) -> dict:
+        vcfg = self.mm.vcfg
+        with self._counts_lock:
+            served = dict(self._served)
+        return {
+            "mode": "multimodal",
+            "image_size": vcfg.image_size,
+            "patches_per_image": vcfg.num_patches,
+            "vit_layers": vcfg.num_layers,
+            "requests_text": served["text"],
+            "requests_image": served["image"],
+        }
+
+    def reset_stats(self) -> None:
+        with self._counts_lock:
+            self._served = {"text": 0, "image": 0}
 
 
 class VisionWorker:

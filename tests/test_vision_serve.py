@@ -1,0 +1,185 @@
+"""``serve --vision`` — the multimodal HTTP surface.
+
+Image+text requests through MultimodalBackend must match
+MultimodalEngine.generate exactly; text-only requests must match the
+plain engine; shape/batch mismatches are clean 400s; image against a
+non-multimodal backend is an honest 501.
+"""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_inference_demo_tpu import cli
+from distributed_inference_demo_tpu.models import get_model_config
+from distributed_inference_demo_tpu.models.decoder import init_full_params
+from distributed_inference_demo_tpu.models.vision import (
+    VisionConfig, init_vision_params)
+from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+from distributed_inference_demo_tpu.runtime import InferenceEngine
+from distributed_inference_demo_tpu.runtime.http_server import (
+    InferenceHTTPServer)
+from distributed_inference_demo_tpu.runtime.multimodal import (
+    MultimodalBackend, MultimodalEngine)
+
+GREEDY = SamplingParams(greedy=True)
+VCFG = VisionConfig(image_size=32, patch_size=16, hidden_size=32,
+                    num_layers=2, num_heads=2, intermediate_size=64)
+
+
+def _req(server, method, path, body=None):
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=60)
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+@pytest.fixture(scope="module")
+def vision_server():
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    vparams = init_vision_params(jax.random.PRNGKey(1), VCFG,
+                                 cfg.hidden_size)
+    mm = MultimodalEngine(cfg, params, VCFG, vparams, max_seq=64,
+                          sampling=GREEDY)
+    server = InferenceHTTPServer(MultimodalBackend(mm), port=0,
+                                 model_name="llama-test")
+    server.start()
+    yield server, mm
+    server.shutdown()
+
+
+def test_image_request_matches_engine(vision_server):
+    server, mm = vision_server
+    img = np.full((32, 32, 3), 0.25, np.float32)
+    prompt = [[5, 17, 42, 7]]
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": prompt, "image": img.tolist(),
+                         "max_new_tokens": 5})
+    assert status == 200
+    got = json.loads(data)["tokens"]
+    want = mm.generate(img[None], np.asarray(prompt), 5).tokens.tolist()
+    assert got == want
+
+
+def test_text_only_matches_plain_engine(vision_server):
+    server, mm = vision_server
+    prompt = [[5, 17, 42, 7]]
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": prompt, "max_new_tokens": 5})
+    assert status == 200
+    plain = InferenceEngine(mm.cfg, mm.engine.params, max_seq=64,
+                            sampling=GREEDY)
+    want = plain.generate(np.asarray(prompt), 5).tokens.tolist()
+    assert json.loads(data)["tokens"] == want
+
+
+def test_bad_image_shapes_are_400(vision_server):
+    server, _ = vision_server
+    prompt = [[5, 17, 42, 7]]
+    bad = np.zeros((16, 16, 3), np.float32).tolist()   # wrong size
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": prompt, "image": bad,
+                         "max_new_tokens": 4})
+    assert status == 400
+    assert "32" in json.loads(data)["error"]
+    # batch mismatch: 2 images for a 1-row prompt
+    two = np.zeros((2, 32, 32, 3), np.float32).tolist()
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": prompt, "image": two,
+                         "max_new_tokens": 4})
+    assert status == 400
+    assert "batch" in json.loads(data)["error"]
+
+
+def test_image_stream_rejected_501(vision_server):
+    server, _ = vision_server
+    img = np.zeros((32, 32, 3), np.float32).tolist()
+    status, _ = _req(server, "POST", "/generate",
+                     {"prompt_ids": [[1, 2]], "image": img,
+                      "max_new_tokens": 4, "stream": True})
+    assert status == 501
+
+
+def test_image_against_text_backend_is_501():
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(cfg, params, max_seq=64, sampling=GREEDY)
+    server = InferenceHTTPServer(engine, port=0, model_name="llama-test")
+    server.start()
+    try:
+        img = np.zeros((32, 32, 3), np.float32).tolist()
+        status, data = _req(server, "POST", "/generate",
+                            {"prompt_ids": [[1, 2]], "image": img,
+                             "max_new_tokens": 4})
+        assert status == 501
+        assert "image" in json.loads(data)["error"]
+    finally:
+        server.shutdown()
+
+
+def test_text_only_full_surface_delegates(vision_server):
+    """Streaming, logprobs, and /classify all work text-only against a
+    --vision server — the wrapped engine's surface is not narrowed."""
+    server, mm = vision_server
+    prompt = [[5, 17, 42, 7]]
+    # streaming
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=60)
+    conn.request("POST", "/generate",
+                 body=json.dumps({"prompt_ids": prompt,
+                                  "max_new_tokens": 4, "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    lines = [json.loads(line) for line in resp.read().decode().splitlines()
+             if line.strip()]
+    conn.close()
+    plain = InferenceEngine(mm.cfg, mm.engine.params, max_seq=64,
+                            sampling=GREEDY)
+    want = plain.generate(np.asarray(prompt), 4).tokens[0].tolist()
+    assert [line["tokens"][0] for line in lines] == want
+    # logprobs
+    status, data = _req(server, "POST", "/generate",
+                        {"prompt_ids": prompt, "max_new_tokens": 4,
+                         "logprobs": True})
+    assert status == 200
+    assert len(json.loads(data)["logprobs"][0]) == 4
+    # logprobs WITH an image is a clean 400 (unsupported, not silent)
+    img = np.zeros((32, 32, 3), np.float32).tolist()
+    status, _ = _req(server, "POST", "/generate",
+                     {"prompt_ids": prompt, "image": img,
+                      "max_new_tokens": 4, "logprobs": True})
+    assert status == 400
+    # classify
+    status, data = _req(server, "POST", "/classify",
+                        {"prompt_ids": prompt, "label_token_ids": [5, 9]})
+    assert status == 200
+
+
+def test_vision_stats(vision_server):
+    server, _ = vision_server
+    status, data = _req(server, "GET", "/stats")
+    assert status == 200
+    body = json.loads(data)
+    assert body["mode"] == "multimodal"
+    assert body["patches_per_image"] == VCFG.num_patches
+
+
+def test_vision_serve_mode_pairing_rules(capsys):
+    base = ["serve", "--model", "llama-test", "--vision"]
+    assert cli.main(base + ["--batch-slots", "2"]) == 1
+    assert cli.main(base + ["--draft-model", "llama-test"]) == 1
+    assert cli.main(base + ["--sp", "2"]) == 1
+    assert cli.main(base + ["--chain", "w@127.0.0.1:1"]) == 1
+    assert cli.main(base + ["--tp", "2"]) == 1
+    assert cli.main(base + ["--kv-cache-dtype", "float8_e4m3fn"]) == 1
+    err = capsys.readouterr().err
+    assert "--vision" in err or "--kv-cache-dtype" in err
