@@ -325,11 +325,6 @@ def cmd_serve(args) -> int:
         from .models.registry import get_model_config
         from .runtime.batching import ContinuousBatchingEngine
 
-        if getattr(args, "prefill_chunk", 0):
-            # the batching engine buckets prompts itself (prompt_buckets)
-            print("--prefill-chunk is not supported with --batch-slots "
-                  "(admission already buckets prompts)", file=sys.stderr)
-            return 1
         cfg = get_model_config(args.model)
         sampling = _sampling_from_args(args)
         params, mesh = _load_params_for_mesh(args, cfg)
@@ -346,7 +341,8 @@ def cmd_serve(args) -> int:
             eos_id=getattr(args, "eos_id", None),
             draft_cfg=draft_cfg, draft_params=draft_params,
             num_draft=args.num_draft, prompt_lookup=pld,
-            decode_block=args.decode_block)
+            decode_block=args.decode_block,
+            prefill_chunk=getattr(args, "prefill_chunk", 0) or None)
         print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
               f"prefix_cache={args.prefix_cache_size} "
               f"tp={getattr(args, 'tp', 1)}"
@@ -904,7 +900,9 @@ def _add_engine_args(ap):
     ap.add_argument("--prefill-chunk", type=int, default=0,
                     help="process prompts in fixed chunks of N tokens "
                          "(bounds prefill activation memory on long "
-                         "prompts; 0 = whole-prompt prefill)")
+                         "prompts; with --batch-slots it also bounds the "
+                         "decode stall a long admission imposes on "
+                         "in-flight rows; 0 = whole-prompt prefill)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over the first N local "
                          "devices (Megatron-sliced weights, kv-head-"

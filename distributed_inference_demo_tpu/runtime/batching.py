@@ -58,7 +58,8 @@ from ..models.base import (KVCache, ModelConfig, StageParams,
                            StageSpec, pad_cache_capacity)
 from ..models.decoder import stage_forward
 from ..ops.sampling import SamplingParams, filtered_logits, sample_logits
-from .engine import GenerationResult, check_capacity
+from .engine import (GenerationResult, check_capacity,
+                     make_chunk_programs, validate_prefill_chunk)
 from .speculative import verify_emit_per_row
 
 
@@ -123,7 +124,8 @@ class ContinuousBatchingEngine:
                  draft_params: Optional[StageParams] = None,
                  num_draft: int = 4,
                  prompt_lookup: bool = False,
-                 decode_block: int = 1):
+                 decode_block: int = 1,
+                 prefill_chunk: Optional[int] = None):
         """``prefix_cache_size``: LRU entries of full-prompt KV kept on
         device for automatic prefix reuse (0 disables).  A new prompt
         sharing >= ``min_prefix_len`` leading tokens with a cached one
@@ -166,7 +168,22 @@ class ContinuousBatchingEngine:
         throughput mode for high-dispatch-latency devices).
         Admission/cancel latency grows to <= N steps/rounds; greedy
         output is unchanged (sampled streams differ from N=1 —
-        per-request seeds are not honored either way, see above)."""
+        per-request seeds are not honored either way, see above).
+
+        ``prefill_chunk``: chunked ADMISSION — a prompt longer than C
+        tokens prefills in C-token dispatches instead of one
+        bucket-wide forward, and between chunks the scheduler runs one
+        decode step (or speculative round) for the slots already in
+        flight.  This bounds the decode stall a long prompt imposes on
+        its batch-mates to one chunk's latency (the vLLM-style
+        "chunked prefill" scheduling property), on top of the
+        activation-memory bound the engines' chunked prefill gives.
+        Greedy output is unchanged: chunk boundaries only split where
+        K/V is written, and the admitted row samples its first token
+        from the same full-context logits (same invariant as
+        InferenceEngine's chunked path, runtime/engine.py).  The
+        draft-side admission prefill (speculative mode) stays one
+        dispatch — the draft is small by construction."""
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -178,6 +195,8 @@ class ContinuousBatchingEngine:
         self.num_draft = num_draft
         self.prompt_lookup = prompt_lookup
         self.decode_block = decode_block
+        self.prefill_chunk = validate_prefill_chunk(prefill_chunk,
+                                                    self.max_seq)
         if decode_block < 1:
             raise ValueError("decode_block must be >= 1")
         if prompt_lookup and draft_cfg is not None:
@@ -311,6 +330,12 @@ class ContinuousBatchingEngine:
             lengths = lengths.at[slot].set(new_len)
             last_tok = last_tok.at[slot].set(new_tok)
             return ck, cv, lengths, last_tok
+
+        # mid-chunk program for chunked admission: the SHARED factory
+        # (engine.make_chunk_programs — one owner of chunk semantics), so
+        # non-final chunks extend the row cache without materializing
+        # logits or sampling (XLA drops the LM head entirely)
+        self._chunk_mid, _ = make_chunk_programs(fwd)
 
         self._step, self._prefill, self._admit = step, prefill, admit
         self._multi_step = multi_step
@@ -530,6 +555,7 @@ class ContinuousBatchingEngine:
         self._min_prefix_len = max(1, min_prefix_len)
         self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
+        self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
 
         if self.decode_block > 1:
             # compile BOTH round-count variants now: the non-fused
@@ -664,6 +690,9 @@ class ContinuousBatchingEngine:
         """Scheduler counters for the HTTP ``/stats`` surface."""
         out = {"slots": self.max_batch, "steps": self._step_count,
                "prefix_cache": dict(self.prefix_stats)}
+        if self.prefill_chunk is not None:
+            out["chunked_prefill"] = {"chunk": self.prefill_chunk,
+                                      **self.chunk_stats}
         if self._spec_step is not None or self._pld_step is not None:
             s = self.spec_stats
             out["speculative"] = {
@@ -678,6 +707,7 @@ class ContinuousBatchingEngine:
         self._step_count = 0
         self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
         self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
+        self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
 
     def close(self):
         self._running = False
@@ -751,6 +781,35 @@ class ContinuousBatchingEngine:
             self.prefix_stats["misses"] += 1
 
         suffix = req.prompt[start:]
+        C = self.prefill_chunk
+        if C is not None:
+            # chunked admission: full C-token chunks stream into the row
+            # cache first via the logits-free mid-chunk program (only the
+            # FINAL forward samples the request's first token), and slots
+            # already in flight get one decode step/round between chunks
+            # so a long prompt never stalls its batch-mates for more than
+            # one chunk's latency.  Intermediate chunks are always full,
+            # so the next chunk overwrites the previous dispatch's padded
+            # tail exactly (stale-slot invariant).
+            while len(suffix) > C:
+                if req.cancelled:
+                    # bound cancel latency to one chunk, same property
+                    # the interleaving gives decode
+                    self._fail_request(req, None)
+                    return
+                head = jnp.asarray(np.asarray(suffix[:C], np.int32)[None])
+                row = self._chunk_mid(
+                    self.params, head,
+                    KVCache(row_k, row_v, jnp.zeros((), jnp.int32)),
+                    jnp.int32(start))
+                row_k, row_v = row.keys, row.values
+                start += C
+                suffix = suffix[C:]
+                self.chunk_stats["chunks"] += 1
+                self._sweep_cancelled()
+                if any(s is not None for s in self._slots):
+                    self._step_active(1)
+                    self.chunk_stats["interleaved_steps"] += 1
         bucket = self._bucket(len(suffix))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(suffix)] = suffix
@@ -841,6 +900,62 @@ class ContinuousBatchingEngine:
             if req is not None:
                 self._fail_request(req, err)
 
+    def _sweep_cancelled(self) -> None:
+        """Free the slots of requests cancelled mid-flight — run once per
+        scheduler iteration and between admission chunks, so a cancel's
+        latency is bounded by one step/chunk either way."""
+        for i, req in enumerate(self._slots):
+            if req is not None and req.cancelled:
+                self._fail_request(req, None)
+                self._slots[i] = None
+
+    def _step_active(self, rounds: int) -> None:
+        """Run ``rounds`` lockstep decode steps (plain mode) or
+        draft/verify rounds (speculative / prompt-lookup modes) over the
+        currently occupied slots and record the emitted tokens.  Shared
+        by the scheduler loop and chunked admission's between-chunk
+        interleaving (``prefill_chunk``)."""
+        active_mask = np.array([s is not None for s in self._slots])
+        self._rng, sub = jax.random.split(self._rng)
+        if self._pld_step is not None or self._spec_step is not None:
+            if self._pld_step is not None:
+                (self._ck, self._cv, self._history, self._lengths,
+                 tok, em, ns) = self._pld_step(
+                    self.params, self._ck, self._cv, self._history,
+                    self._lengths, self._last_tok,
+                    jnp.asarray(active_mask), sub, rounds)
+            else:
+                (self._ck, self._cv, self._dck, self._dcv,
+                 self._lengths, tok, em, ns) = self._spec_step(
+                    self.params, self.draft_params, self._ck,
+                    self._cv, self._dck, self._dcv, self._lengths,
+                    self._last_tok, jnp.asarray(active_mask), sub,
+                    rounds)
+            self._last_tok = tok
+            em_np, ns_np = np.asarray(em), np.asarray(ns)
+            for r in range(rounds):
+                self._drain_spec_blocks(em_np[r], ns_np[r])
+        elif rounds > 1:
+            (self._ck, self._cv, self._lengths, tok,
+             blocks) = self._multi_step(
+                self.params, self._ck, self._cv, self._lengths,
+                self._last_tok, jnp.asarray(active_mask), sub,
+                rounds)
+            self._last_tok = tok
+            self._step_count += rounds
+            self._record_row_blocks(
+                np.asarray(blocks), np.full(len(self._slots), rounds))
+        else:
+            self._ck, self._cv, self._lengths, tok = self._step(
+                self.params, self._ck, self._cv, self._lengths,
+                self._last_tok, jnp.asarray(active_mask), sub)
+            self._last_tok = tok
+            tok_np = np.asarray(tok)
+            self._step_count += 1
+            for i, req in enumerate(self._slots):
+                if req is not None:
+                    self._record_token(i, req, int(tok_np[i]))
+
     def _loop(self):
         try:
             self._loop_body()
@@ -875,62 +990,18 @@ class ContinuousBatchingEngine:
                     self._admit_request(free.pop(0), req)
                 except BaseException as e:  # surface to the waiter
                     self._fail_request(req, e)
-            # free the slots of requests cancelled mid-flight
-            for i, req in enumerate(self._slots):
-                if req is not None and req.cancelled:
-                    self._fail_request(req, None)
-                    self._slots[i] = None
+            self._sweep_cancelled()
             if not any(self._slots):
                 continue
 
-            active_mask = np.array([s is not None for s in self._slots])
-            self._rng, sub = jax.random.split(self._rng)
             # fuse a block whenever no admission could land anyway:
             # queue empty, OR every slot busy (the saturated regime is
             # exactly where the fused path pays — a queue backlog must
             # not silently disable it)
+            all_busy = all(s is not None for s in self._slots)
             fuse = (self.decode_block > 1
-                    and (self._queue.empty() or active_mask.all()))
-            rounds = self.decode_block if fuse else 1
-            if self._pld_step is not None or self._spec_step is not None:
-                if self._pld_step is not None:
-                    (self._ck, self._cv, self._history, self._lengths,
-                     tok, em, ns) = self._pld_step(
-                        self.params, self._ck, self._cv, self._history,
-                        self._lengths, self._last_tok,
-                        jnp.asarray(active_mask), sub, rounds)
-                else:
-                    (self._ck, self._cv, self._dck, self._dcv,
-                     self._lengths, tok, em, ns) = self._spec_step(
-                        self.params, self.draft_params, self._ck,
-                        self._cv, self._dck, self._dcv, self._lengths,
-                        self._last_tok, jnp.asarray(active_mask), sub,
-                        rounds)
-                self._last_tok = tok
-                em_np, ns_np = np.asarray(em), np.asarray(ns)
-                for r in range(rounds):
-                    self._drain_spec_blocks(em_np[r], ns_np[r])
-            elif fuse:
-                (self._ck, self._cv, self._lengths, tok,
-                 blocks) = self._multi_step(
-                    self.params, self._ck, self._cv, self._lengths,
-                    self._last_tok, jnp.asarray(active_mask), sub,
-                    self.decode_block)
-                self._last_tok = tok
-                self._step_count += self.decode_block
-                self._record_row_blocks(
-                    np.asarray(blocks),
-                    np.full(len(self._slots), self.decode_block))
-            else:
-                self._ck, self._cv, self._lengths, tok = self._step(
-                    self.params, self._ck, self._cv, self._lengths,
-                    self._last_tok, jnp.asarray(active_mask), sub)
-                self._last_tok = tok
-                tok_np = np.asarray(tok)
-                self._step_count += 1
-                for i, req in enumerate(self._slots):
-                    if req is not None:
-                        self._record_token(i, req, int(tok_np[i]))
+                    and (self._queue.empty() or all_busy))
+            self._step_active(self.decode_block if fuse else 1)
 
         # drain: fail anything still queued or in flight
         self._drain_all(RuntimeError("engine closed while request in flight"))

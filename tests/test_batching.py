@@ -711,3 +711,116 @@ def test_decode_block_composes_with_speculation(params, draft_params,
                                   **kw) as eng:
         got = eng.submit(prompt, 20).wait(timeout=300)
         np.testing.assert_array_equal(got, list(ref[:5]))
+
+
+# ---------------------------------------------------------------------------
+# chunked admission (prefill_chunk x batch slots)
+
+def test_chunked_admission_matches_engine(params, oracle):
+    """A prompt longer than the chunk admits in C-token dispatches; the
+    request's tokens are bit-identical to the unchunked engine (chunk
+    boundaries only split where K/V is written)."""
+    prompt = list(range(2, 25))                    # 23 tokens, C=8 -> 2+tail
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
+                                  prefill_chunk=8) as eng:
+        got = eng.submit(prompt, 12).wait(timeout=300)
+        np.testing.assert_array_equal(got, expected(oracle, prompt, 12))
+        st = eng.stats()["chunked_prefill"]
+        assert st == {"chunk": 8, "chunks": 2, "interleaved_steps": 0}
+
+
+def test_chunked_admission_interleaves_decode(params, oracle):
+    """While a long prompt admits chunk-by-chunk, in-flight slots keep
+    decoding between chunks — and both requests stay bit-exact."""
+    long_prompt = list(range(1, 20))               # 19 tokens, C=4 -> 4+tail
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
+                                  prefill_chunk=4) as eng:
+        first = eng.submit([5, 4, 3, 2], 40)
+        deadline = time.monotonic() + 240
+        while len(first.tokens) < 5:               # provably mid-flight
+            assert time.monotonic() < deadline, "first request stalled"
+            time.sleep(0.01)
+        second = eng.submit(long_prompt, 10)
+        np.testing.assert_array_equal(second.wait(timeout=300),
+                                      expected(oracle, long_prompt, 10))
+        np.testing.assert_array_equal(first.wait(timeout=300),
+                                      expected(oracle, [5, 4, 3, 2], 40))
+        st = eng.stats()["chunked_prefill"]
+        assert st["chunks"] == 4 and st["interleaved_steps"] == 4
+
+
+def test_chunked_admission_composes_with_prefix_cache(params, oracle):
+    """Prefix reuse shortens the suffix; what remains still chunks, and
+    the divergent-tail request stays exact."""
+    base = list(range(2, 34))                      # 32 tokens
+    tail = base[:24] + [7, 9, 11, 13, 2, 4, 6, 8]  # 24 shared + 8 new
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
+                                  prefill_chunk=8, min_prefix_len=8) as eng:
+        np.testing.assert_array_equal(
+            eng.submit(base, 8).wait(timeout=300),
+            expected(oracle, base, 8))
+        np.testing.assert_array_equal(
+            eng.submit(tail, 8).wait(timeout=300),
+            expected(oracle, tail, 8))
+        assert eng.prefix_stats["hits"] == 1
+        # 32/8 = 4 full chunks minus the sampled tail bucket, then the
+        # reused-prefix request chunks only its 8-token suffix (0 full
+        # chunks — it fits one final dispatch)
+        assert eng.stats()["chunked_prefill"]["chunks"] == 3
+
+
+@pytest.mark.parametrize("mode", ["draft", "pld"])
+def test_chunked_admission_composes_with_speculation(params, draft_params,
+                                                     oracle, mode):
+    """Chunked target-side admission under both speculative proposers:
+    interleaved rounds between chunks, bit-exact output."""
+    kw = (dict(draft_cfg=DRAFT_CFG, draft_params=draft_params)
+          if mode == "draft" else dict(prompt_lookup=True))
+    long_prompt = list(range(3, 22))               # 19 tokens, C=8 -> 2+tail
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
+                                  num_draft=3, prefill_chunk=8, **kw) as eng:
+        a = eng.submit([5, 4, 3, 2], 30)
+        deadline = time.monotonic() + 240
+        while len(a.tokens) < 3:
+            assert time.monotonic() < deadline, "first request stalled"
+            time.sleep(0.01)
+        b = eng.submit(long_prompt, 10)
+        np.testing.assert_array_equal(b.wait(timeout=300),
+                                      expected(oracle, long_prompt, 10))
+        np.testing.assert_array_equal(a.wait(timeout=300),
+                                      expected(oracle, [5, 4, 3, 2], 30))
+        assert eng.stats()["chunked_prefill"]["interleaved_steps"] >= 1
+
+
+def test_chunked_admission_rejects_bad_chunk(params):
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatchingEngine(CFG, params, max_seq=96,
+                                 prefill_chunk=0)
+
+
+def test_chunked_admission_cancel_bounded_by_one_chunk(params):
+    """A request cancelled while its prompt is still admitting stops at
+    the next chunk boundary: the remaining chunks never run and the
+    request finishes cleanly with no tokens."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
+                                  prefill_chunk=4) as eng:
+        orig = eng._chunk_mid
+        box, armed = {}, threading.Event()
+
+        def hook(*a, **k):
+            out = orig(*a, **k)
+            armed.wait(timeout=60)
+            box["req"].cancelled = True      # cancel after chunk #1 lands
+            return out
+
+        eng._chunk_mid = hook
+        box["req"] = eng.submit(list(range(1, 20)), 10)   # 4 full chunks
+        armed.set()
+        got = box["req"].wait(timeout=300)
+        assert got.size == 0 and box["req"].error is None
+        assert eng.stats()["chunked_prefill"]["chunks"] == 1
