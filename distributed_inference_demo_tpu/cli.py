@@ -273,7 +273,8 @@ def cmd_serve(args) -> int:
         params = _load_full_params(args, cfg)
         backend = SequenceParallelBackend(
             cfg, params, mesh, max_seq=args.max_seq,
-            strategy=args.sp_strategy, sampling=_sampling_from_args(args))
+            strategy=args.sp_strategy, sampling=_sampling_from_args(args),
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
         print(f"SERVE_SP {args.model} sp={args.sp} "
               f"strategy={args.sp_strategy} max_seq={args.max_seq}",
               flush=True)
@@ -736,8 +737,8 @@ def _generate_sp(args, ids, tokenizer) -> int:
     unsupported = _sp_unsupported_flags(args)
     if unsupported:
         # the sp generate fns own their attention/cache strategy and have
-        # no eos/dtype/chunk plumbing — reject loudly rather than
-        # silently ignoring the flags
+        # no eos/chunk plumbing — reject loudly rather than silently
+        # ignoring the flags
         print(f"{'/'.join(unsupported)} not supported with --sp",
               file=sys.stderr)
         return 1
@@ -751,16 +752,19 @@ def _generate_sp(args, ids, tokenizer) -> int:
     validate_sp_prompt(ids.shape[1], args.sp, args.max_seq,
                        args.max_new_tokens)
     sampling = _sampling_from_args(args)
+    kv_dtype = getattr(args, "kv_cache_dtype", None) or None
     if args.sp_strategy == "ring":
         from .parallel.sequence import make_sp_generate_fn
         gen = make_sp_generate_fn(cfg, mesh, max_seq=args.max_seq,
                                   num_new_tokens=args.max_new_tokens,
-                                  sampling=sampling)
+                                  sampling=sampling,
+                                  kv_cache_dtype=kv_dtype)
     else:
         from .parallel.ulysses import make_ulysses_generate_fn
         gen = make_ulysses_generate_fn(cfg, mesh, max_seq=args.max_seq,
                                        num_new_tokens=args.max_new_tokens,
-                                       sampling=sampling)
+                                       sampling=sampling,
+                                       kv_cache_dtype=kv_dtype)
     params = _load_full_params(args, cfg)
     t0 = _time.perf_counter()
     with mesh:
@@ -931,7 +935,6 @@ def _sp_unsupported_flags(args) -> list:
     cannot drift.  Rejected loudly rather than silently ignored."""
     return [flag for flag, on in [
         ("--eos-id", getattr(args, "eos_id", None) is not None),
-        ("--kv-cache-dtype", bool(getattr(args, "kv_cache_dtype", ""))),
         ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
         ("--attn-backend", args.attn_backend != "auto")] if on]
 

@@ -111,7 +111,8 @@ def validate_sp_prompt(plen: int, sp: int, max_seq: int,
 
 def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
                         num_new_tokens: int,
-                        sampling: Optional[SamplingParams] = None):
+                        sampling: Optional[SamplingParams] = None,
+                        kv_cache_dtype=None):
     """Build a jitted ``fn(params, prompt_ids, rng) -> tokens`` that runs
     ring-attention prefill + sp-sharded-cache decode over ``mesh``'s sp axis.
 
@@ -119,10 +120,21 @@ def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
     to a chunk multiple before calling) and
     ``prompt_len + num_new_tokens <= max_seq`` with ``max_seq % sp == 0``.
     Returns [batch, num_new_tokens] int32; greedy when ``sampling`` is None.
+
+    ``kv_cache_dtype``: reduced-precision storage for the sequence-sharded
+    cache (e.g. "float8_e4m3fn") — at long context the cache IS the memory
+    bill, so this is where reduced precision pays most.  Same contract as
+    every engine (one owner: runtime/engine.resolve_cache_dtype_backend):
+    attention reads what the cache stores, so ring prefill rounds K/V
+    through the cache dtype before attending — greedy output matches a
+    single-device engine with the same cache dtype.
     """
     sp = mesh.shape["sp"]
     if max_seq % sp:
         raise ValueError(f"max_seq={max_seq} not divisible by sp={sp}")
+    from ..runtime.engine import resolve_cache_dtype_backend
+    kv_dtype, _ = resolve_cache_dtype_backend(kv_cache_dtype, "jnp")
+    cache_dtype = kv_dtype if kv_dtype is not None else cfg.dtype
     s_loc = max_seq // sp
     spec = StageSpec(0, 1, 0, cfg.num_layers)
     sampling = sampling or SamplingParams(greedy=True)
@@ -135,12 +147,19 @@ def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
         # ---- prefill: ring attention over the prompt chunks -------------
         def prefill_attn(q, k, v, kc, vc, pos, cache_start, slopes):
             kc, vc = update_kv_cache(kc, vc, k, v, jnp.zeros((), jnp.int32))
+            if kv_dtype is not None:
+                # attention reads what the cache stores (the engines'
+                # reduced-precision contract): round K/V through the
+                # cache dtype so prefill attends the same values decode
+                # will read back from the fp8 shards
+                k = k.astype(kv_dtype).astype(cfg.dtype)
+                v = v.astype(kv_dtype).astype(cfg.dtype)
             out = ring_self_attention(q, k, v, "sp", slopes=slopes)
             return out, kc, vc
 
         shape = (spec.num_layers, b, cfg.num_kv_heads, s_loc, cfg.head_dim)
-        cache = KVCache(keys=jnp.zeros(shape, cfg.dtype),
-                        values=jnp.zeros(shape, cfg.dtype),
+        cache = KVCache(keys=jnp.zeros(shape, cache_dtype),
+                        values=jnp.zeros(shape, cache_dtype),
                         length=jnp.zeros((), jnp.int32))
         positions = jnp.broadcast_to(idx * chunk + jnp.arange(chunk),
                                      (b, chunk))

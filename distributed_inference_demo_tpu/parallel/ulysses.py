@@ -38,7 +38,8 @@ from .sequence import _decode_scan, _sample_first_token, _wrap_sp_body
 
 def make_ulysses_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
                              num_new_tokens: int,
-                             sampling: Optional[SamplingParams] = None):
+                             sampling: Optional[SamplingParams] = None,
+                             kv_cache_dtype=None):
     """Build a jitted ``fn(params, prompt_ids, rng) -> tokens``: Ulysses
     prefill + head-sharded-cache decode over ``mesh``'s sp axis.
 
@@ -46,12 +47,21 @@ def make_ulysses_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
     ``num_heads % sp == 0``, ``num_kv_heads % sp == 0``,
     ``prompt_len + num_new_tokens <= max_seq``.  Greedy when ``sampling``
     is None; returns [batch, num_new_tokens] int32.
+
+    ``kv_cache_dtype``: reduced-precision storage for the head-sharded
+    cache — Ulysses attention (prefill AND decode) already reads from the
+    cache, so the engines' "attend what the cache stores" contract holds
+    with no extra rounding step (``update_kv_cache`` casts on insert,
+    ``ops.attention`` upcasts on read).
     """
     sp = mesh.shape["sp"]
     if cfg.num_heads % sp or cfg.num_kv_heads % sp:
         raise ValueError(
             f"ulysses needs num_heads ({cfg.num_heads}) and num_kv_heads "
             f"({cfg.num_kv_heads}) divisible by sp={sp}")
+    from ..runtime.engine import resolve_cache_dtype_backend
+    kv_dtype, _ = resolve_cache_dtype_backend(kv_cache_dtype, "jnp")
+    cache_dtype = kv_dtype if kv_dtype is not None else cfg.dtype
     spec = StageSpec(0, 1, 0, cfg.num_layers)
     body_spec = StageSpec(0, 2, 0, cfg.num_layers)  # no head at prefill
     sampling = sampling or SamplingParams(greedy=True)
@@ -95,8 +105,8 @@ def make_ulysses_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
             return out, kc, vc
 
         shape = (spec.num_layers, b, nkv_loc, max_seq, hd)
-        cache = KVCache(keys=jnp.zeros(shape, cfg.dtype),
-                        values=jnp.zeros(shape, cfg.dtype),
+        cache = KVCache(keys=jnp.zeros(shape, cache_dtype),
+                        values=jnp.zeros(shape, cache_dtype),
                         length=jnp.zeros((), jnp.int32))
         positions = jnp.broadcast_to(idx * chunk + jnp.arange(chunk),
                                      (b, chunk))

@@ -46,7 +46,8 @@ class SequenceParallelBackend:
 
     def __init__(self, cfg: ModelConfig, params, mesh, *, max_seq: int,
                  strategy: str = "ring",
-                 sampling: Optional[SamplingParams] = None):
+                 sampling: Optional[SamplingParams] = None,
+                 kv_cache_dtype: Optional[str] = None):
         if strategy not in STRATEGIES:
             raise ValueError(f"unknown sp strategy {strategy!r}; "
                              f"known: {STRATEGIES}")
@@ -56,6 +57,7 @@ class SequenceParallelBackend:
         self.max_seq = max_seq
         self.strategy = strategy
         self.sampling = sampling
+        self.kv_cache_dtype = kv_cache_dtype
         self.sp = int(mesh.shape["sp"])
         self._fns: "OrderedDict" = OrderedDict()
         self._lock = threading.Lock()
@@ -78,7 +80,8 @@ class SequenceParallelBackend:
         make = (make_sp_generate_fn if self.strategy == "ring"
                 else make_ulysses_generate_fn)
         return make(self.cfg, self.mesh, max_seq=self.max_seq,
-                    num_new_tokens=num_new, sampling=self.sampling)
+                    num_new_tokens=num_new, sampling=self.sampling,
+                    kv_cache_dtype=self.kv_cache_dtype)
 
     # each distinct max_new_tokens is its own jitted program (the decode
     # scan's trip count is baked in); the cache is LRU-bounded so a

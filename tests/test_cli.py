@@ -431,9 +431,17 @@ def test_cli_generate_sp_matches_plain():
         rc, out = _run_cli(argv + ["--sp", "2", "--sp-strategy", strategy])
         assert rc == 0
         assert json.loads(out)["tokens"] == json.loads(plain)["tokens"]
+    # --kv-cache-dtype composes with --sp: parity vs the plain engine
+    # with the SAME reduced cache dtype (attention reads what the cache
+    # stores on both sides)
+    rc, plain_fp8 = _run_cli(argv + ["--kv-cache-dtype", "float8_e4m3fn"])
+    assert rc == 0
+    rc, out = _run_cli(argv + ["--sp", "2",
+                               "--kv-cache-dtype", "float8_e4m3fn"])
+    assert rc == 0
+    assert json.loads(out)["tokens"] == json.loads(plain_fp8)["tokens"]
     # flags the sp paths have no plumbing for are rejected loudly
-    for extra in (["--eos-id", "7"], ["--kv-cache-dtype", "float8_e4m3fn"],
-                  ["--attn-backend", "jnp"]):
+    for extra in (["--eos-id", "7"], ["--attn-backend", "jnp"]):
         rc, _ = _run_cli(argv + ["--sp", "2"] + extra)
         assert rc == 1
     # 15 tokens don't shard over sp=2

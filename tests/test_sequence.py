@@ -143,3 +143,27 @@ def test_sp_generate_rejects_bad_shapes(sp_mesh):
         gen(params, jnp.zeros((1, 18), jnp.int32), jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="max_seq"):
         gen(params, jnp.zeros((1, 32), jnp.int32), jax.random.PRNGKey(0))
+
+
+def test_sp_generate_fp8_cache_matches_fp8_engine(sp_mesh):
+    """Reduced-precision sequence-sharded cache: greedy output matches a
+    single-device engine storing its cache in the same dtype (attention
+    reads what the cache stores, on both sides)."""
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    b, plen, num_new, max_seq = 2, 16, 8, 32
+    prompt = np.asarray(
+        np.random.RandomState(11).randint(0, cfg.vocab_size, (b, plen)),
+        np.int32)
+    want = InferenceEngine(
+        cfg, params, max_seq=max_seq, sampling=SamplingParams(greedy=True),
+        kv_cache_dtype="float8_e4m3fn").generate(prompt, num_new).tokens
+
+    gen = make_sp_generate_fn(cfg, sp_mesh, max_seq=max_seq,
+                              num_new_tokens=num_new,
+                              kv_cache_dtype="float8_e4m3fn")
+    got = gen(params, jnp.asarray(prompt), jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(got), want)

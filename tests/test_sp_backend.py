@@ -136,6 +136,23 @@ def test_sp_serve_mode_pairing_rules(capsys):
     assert cli.main(base + ["--prompt-lookup"]) == 1
     assert cli.main(base + ["--chain", "w@127.0.0.1:1"]) == 1
     assert cli.main(base + ["--tp", "2"]) == 1
-    assert cli.main(base + ["--kv-cache-dtype", "float8_e4m3fn"]) == 1
+    assert cli.main(base + ["--eos-id", "7"]) == 1
     err = capsys.readouterr().err
-    assert "--kv-cache-dtype" in err
+    assert "--eos-id" in err
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_sp_backend_fp8_cache_matches_fp8_engine(strategy):
+    """serve --sp --kv-cache-dtype: the backend's reduced-precision cache
+    matches the fp8 single-device engine token for token."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.asarray([[5, 17, 42, 7, 9, 2, 30, 11]], np.int32)
+    want = InferenceEngine(
+        cfg, params, max_seq=32, sampling=GREEDY,
+        kv_cache_dtype="float8_e4m3fn").generate(prompt, 6).tokens
+    backend = SequenceParallelBackend(
+        cfg, params, local_sp_mesh(2), max_seq=32, strategy=strategy,
+        sampling=GREEDY, kv_cache_dtype="float8_e4m3fn")
+    got = backend.generate(prompt, 6).tokens
+    np.testing.assert_array_equal(got, want)

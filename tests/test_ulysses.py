@@ -67,3 +67,35 @@ def test_ulysses_rejects_bad_configs(devices):
         gen(params, np.zeros((1, 7), np.int32), jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="max_seq"):
         gen(params, np.zeros((1, 14), np.int32), jax.random.PRNGKey(0))
+
+
+def test_ulysses_fp8_cache_matches_fp8_engine(devices):
+    """Reduced-precision head-sharded cache: greedy parity vs the fp8
+    single-device engine (Ulysses attention already reads from the cache,
+    so the contract needs no extra rounding step)."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.asarray(
+        np.random.RandomState(13).randint(0, cfg.vocab_size, (2, 8)),
+        np.int32)
+    want = InferenceEngine(
+        cfg, params, max_seq=32, sampling=GREEDY,
+        kv_cache_dtype="float8_e4m3fn").generate(prompt, 6).tokens
+
+    mesh = make_mesh(MeshConfig(sp=2), devices)
+    gen = make_ulysses_generate_fn(cfg, mesh, max_seq=32, num_new_tokens=6,
+                                   sampling=GREEDY,
+                                   kv_cache_dtype="float8_e4m3fn")
+    with mesh:
+        got = np.asarray(gen(params, prompt, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ulysses_fp8_rejects_pallas_backend(devices):
+    """The one-owner reduced-precision rule also guards the sp paths: an
+    explicit Pallas kernel request with a reduced cache dtype errors in
+    resolve_cache_dtype_backend before any program is built."""
+    from distributed_inference_demo_tpu.runtime.engine import (
+        resolve_cache_dtype_backend)
+    with pytest.raises(ValueError, match="attn_backend"):
+        resolve_cache_dtype_backend("float8_e4m3fn", "flash")
