@@ -46,6 +46,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import List, Optional
@@ -178,6 +179,13 @@ class ContinuousBatchingEngine:
         its batch-mates to one chunk's latency (the vLLM-style
         "chunked prefill" scheduling property), on top of the
         activation-memory bound the engines' chunked prefill gives.
+        Admission is resumable scheduler state, not an inline loop:
+        while one prompt streams its chunks, other queued requests keep
+        admitting into free slots past it (no head-of-line blocking);
+        further chunk-needing prompts wait their turn in arrival order.
+        Streaming starts even while every slot is busy — only the final
+        sampling prefill waits for a slot, so a long prompt's chunks
+        overlap the busy batch's decode.
         Greedy output is unchanged: chunk boundaries only split where
         K/V is written, and the admitted row samples its first token
         from the same full-context logits (same invariant as
@@ -556,6 +564,14 @@ class ContinuousBatchingEngine:
         self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
         self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
+        # resumable chunked admission: at most ONE prompt streams its
+        # chunks at a time (scheduler state, advanced one dispatch per
+        # loop iteration).  _pending holds popped-but-unserved requests:
+        # chunk-needing prompts waiting their streaming turn, and short
+        # prompts waiting for a free slot — served FIFO each iteration,
+        # with serviceable requests passing blocked ones
+        self._adm: Optional[dict] = None
+        self._pending: "deque[Request]" = deque()
 
         if self.decode_block > 1:
             # compile BOTH round-count variants now: the non-fused
@@ -763,53 +779,118 @@ class ContinuousBatchingEngine:
         while len(self._prefix_cache) > self._prefix_cache_size:
             self._prefix_cache.popitem(last=False)
 
-    def _admit_request(self, slot: int, req: Request):
-        plen = len(req.prompt)
-
-        start = 0
+    def _row_for(self, req: Request):
+        """(start, row_k, row_v) for a fresh admission: a zero row, or a
+        prefix-cache hit preloaded with the shared prefix's K/V."""
         if self._prefix_cache_size:
             m, key = self._longest_cached_prefix(req.prompt)
             if m >= self._min_prefix_len:
                 pk, pv = self._prefix_cache[key]
                 self._prefix_cache.move_to_end(key)   # LRU touch
                 row_k, row_v = self._load_prefix(pk, pv)
-                start = m
                 self.prefix_stats["hits"] += 1
                 self.prefix_stats["tokens_reused"] += m
-        if start == 0:
-            row_k, row_v = self._zero_row()
-            self.prefix_stats["misses"] += 1
+                return m, row_k, row_v
+        row_k, row_v = self._zero_row()
+        self.prefix_stats["misses"] += 1
+        return 0, row_k, row_v
 
-        suffix = req.prompt[start:]
+    def _needs_stream(self, req: Request) -> bool:
+        """Does this prompt need the one-at-a-time chunk stream, or can
+        it admit in a single dispatch?  Classified by the EFFECTIVE
+        suffix (a prefix-cache hit may shrink a long prompt to one
+        dispatch — it must not wait behind an unrelated stream).  Pure
+        peek: hit/miss accounting stays with ``_row_for``.
+
+        The decision is memoized on the request (``_stream_cls``): a
+        blocked request is NOT rescanned against the prefix cache every
+        scheduler iteration — it keeps its first classification, the
+        same point-in-time semantics the pre-resumable code had at
+        admission time."""
         C = self.prefill_chunk
-        if C is not None:
-            # chunked admission: full C-token chunks stream into the row
-            # cache first via the logits-free mid-chunk program (only the
-            # FINAL forward samples the request's first token), and slots
-            # already in flight get one decode step/round between chunks
-            # so a long prompt never stalls its batch-mates for more than
-            # one chunk's latency.  Intermediate chunks are always full,
-            # so the next chunk overwrites the previous dispatch's padded
-            # tail exactly (stale-slot invariant).
-            while len(suffix) > C:
-                if req.cancelled:
-                    # bound cancel latency to one chunk, same property
-                    # the interleaving gives decode
-                    self._fail_request(req, None)
-                    return
-                head = jnp.asarray(np.asarray(suffix[:C], np.int32)[None])
+        if C is None:
+            return False
+        cls = getattr(req, "_stream_cls", None)
+        if cls is not None:
+            return cls
+        needs = len(req.prompt) > C
+        if needs and self._prefix_cache_size:
+            m, _ = self._longest_cached_prefix(req.prompt)
+            if m >= self._min_prefix_len and len(req.prompt) - m <= C:
+                needs = False
+        req._stream_cls = needs
+        return needs
+
+    def _admit_request(self, slot: int, req: Request):
+        start, row_k, row_v = self._row_for(req)
+        self._finish_admission(slot, req, start, row_k, row_v,
+                               req.prompt[start:])
+
+    def _start_admission(self, req: Request) -> None:
+        """Park a chunk-needing prompt as the in-progress admission the
+        scheduler advances one dispatch per iteration (chunked admission
+        is resumable state, NOT an inline loop: between dispatches the
+        loop keeps decoding in-flight rows AND admitting other queued
+        requests into free slots, so a long prompt head-blocks
+        neither)."""
+        try:
+            start, row_k, row_v = self._row_for(req)
+        except BaseException as e:
+            self._fail_request(req, e)
+            return
+        self._adm = {"req": req, "start": start, "row_k": row_k,
+                     "row_v": row_v, "suffix": req.prompt[start:]}
+
+    def _advance_admission(self, free: list) -> None:
+        """One dispatch of the in-progress admission: the next C-token
+        chunk through the logits-free mid-chunk program, or — once the
+        remainder fits one dispatch — the sampling final prefill into a
+        free slot (parked until one frees).  Intermediate chunks are
+        always full, so the next chunk overwrites the previous
+        dispatch's padded tail exactly (stale-slot invariant)."""
+        a = self._adm
+        if a is None:
+            return
+        req, C = a["req"], self.prefill_chunk
+        if req.cancelled:
+            # bound cancel latency to one chunk, same property the
+            # interleaving gives decode
+            self._adm = None
+            self._fail_request(req, None)
+            return
+        if len(a["suffix"]) > C:
+            try:
+                head = jnp.asarray(
+                    np.asarray(a["suffix"][:C], np.int32)[None])
                 row = self._chunk_mid(
                     self.params, head,
-                    KVCache(row_k, row_v, jnp.zeros((), jnp.int32)),
-                    jnp.int32(start))
-                row_k, row_v = row.keys, row.values
-                start += C
-                suffix = suffix[C:]
-                self.chunk_stats["chunks"] += 1
-                self._sweep_cancelled()
-                if any(s is not None for s in self._slots):
-                    self._step_active(1)
-                    self.chunk_stats["interleaved_steps"] += 1
+                    KVCache(a["row_k"], a["row_v"],
+                            jnp.zeros((), jnp.int32)),
+                    jnp.int32(a["start"]))
+            except BaseException as e:
+                # a per-request failure fails that request, never the
+                # engine — same contract as every other admission
+                # dispatch ("surface to the waiter")
+                self._adm = None
+                self._fail_request(req, e)
+                return
+            a["row_k"], a["row_v"] = row.keys, row.values
+            a["start"] += C
+            a["suffix"] = a["suffix"][C:]
+            self.chunk_stats["chunks"] += 1
+        elif free:
+            self._adm = None
+            try:
+                self._finish_admission(free.pop(0), req, a["start"],
+                                       a["row_k"], a["row_v"], a["suffix"])
+            except BaseException as e:
+                self._fail_request(req, e)
+
+    def _finish_admission(self, slot: int, req: Request, start: int,
+                          row_k, row_v, suffix) -> None:
+        """The sampling final prefill + slot install, shared by one-shot
+        admission and the last dispatch of a chunked one."""
+        plen = len(req.prompt)
         bucket = self._bucket(len(suffix))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(suffix)] = suffix
@@ -887,11 +968,17 @@ class ContinuousBatchingEngine:
         req.done.set()
 
     def _drain_all(self, err: BaseException):
-        """Fail every in-flight slot and queued request with ``err``."""
+        """Fail every in-flight slot, mid-admission, backlogged, and
+        queued request with ``err``."""
         for i, req in enumerate(self._slots):
             if req is not None:
                 self._fail_request(req, err)
                 self._slots[i] = None
+        if self._adm is not None:
+            self._fail_request(self._adm["req"], err)
+            self._adm = None
+        while self._pending:
+            self._fail_request(self._pending.popleft(), err)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -972,35 +1059,62 @@ class ContinuousBatchingEngine:
 
     def _loop_body(self):
         while self._running:
-            # admit as many queued requests as there are free slots
             free = [i for i, s in enumerate(self._slots) if s is None]
-            timeout = None if not any(self._slots) else 0.0
-            while free:
+            # one dispatch of the in-progress chunked admission (if any)
+            self._advance_admission(free)
+            # block for work only when truly idle: nothing decoding, no
+            # admission mid-stream, nothing waiting to be served
+            timeout = (None if not (any(self._slots) or self._adm
+                                    or self._pending)
+                       else 0.0)
+            # drain newly queued requests behind the already-pending ones
+            while True:
                 try:
                     req = self._queue.get(timeout=timeout)
                 except queue.Empty:
                     break
+                timeout = 0.0
                 if req is None:            # close() sentinel
                     break
-                timeout = 0.0
-                if req.cancelled:          # dropped while queued
+                self._pending.append(req)
+            # serve pending FIFO: a chunk-needing prompt starts streaming
+            # slot-FREE (only its final sampling prefill needs a slot, so
+            # its chunks overlap busy decode); short prompts admit into
+            # free slots.  Serviceable requests pass blocked ones.
+            still: "deque[Request]" = deque()
+            for req in self._pending:
+                if req.cancelled:          # dropped while waiting
                     self._fail_request(req, None)
-                    continue
-                try:
-                    self._admit_request(free.pop(0), req)
-                except BaseException as e:  # surface to the waiter
-                    self._fail_request(req, e)
+                elif self._needs_stream(req):
+                    if self._adm is None:
+                        self._start_admission(req)  # consumes no slot
+                    else:
+                        still.append(req)  # one stream at a time
+                elif free:
+                    try:
+                        self._admit_request(free.pop(0), req)
+                    except BaseException as e:  # surface to the waiter
+                        self._fail_request(req, e)
+                else:
+                    still.append(req)      # waiting for a slot
+            self._pending = still
             self._sweep_cancelled()
             if not any(self._slots):
                 continue
 
-            # fuse a block whenever no admission could land anyway:
-            # queue empty, OR every slot busy (the saturated regime is
-            # exactly where the fused path pays — a queue backlog must
-            # not silently disable it)
+            # fuse a block whenever no admission DISPATCH could land
+            # anyway: an admission mid-stream always lands one per
+            # iteration, so streaming disables fusing outright (its next
+            # chunk must not wait out a fused block — time-to-first-token
+            # beats peak decode throughput for the stream's duration);
+            # otherwise fuse when nothing is waiting, or when every slot
+            # is busy (the saturated regime is exactly where the fused
+            # path pays — a backlog must not silently disable it)
             all_busy = all(s is not None for s in self._slots)
-            fuse = (self.decode_block > 1
-                    and (self._queue.empty() or all_busy))
+            fuse = (self.decode_block > 1 and self._adm is None
+                    and (not self._pending or all_busy))
+            if self._adm is not None:
+                self.chunk_stats["interleaved_steps"] += 1
             self._step_active(self.decode_block if fuse else 1)
 
         # drain: fail anything still queued or in flight

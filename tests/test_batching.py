@@ -748,7 +748,10 @@ def test_chunked_admission_interleaves_decode(params, oracle):
         np.testing.assert_array_equal(first.wait(timeout=300),
                                       expected(oracle, [5, 4, 3, 2], 40))
         st = eng.stats()["chunked_prefill"]
-        assert st["chunks"] == 4 and st["interleaved_steps"] == 4
+        # one interleaved step on the iteration that parks the admission,
+        # then one after each of the 4 streamed chunks (the finish
+        # iteration clears the admission before stepping)
+        assert st["chunks"] == 4 and st["interleaved_steps"] == 5
 
 
 def test_chunked_admission_composes_with_prefix_cache(params, oracle):
@@ -824,3 +827,119 @@ def test_chunked_admission_cancel_bounded_by_one_chunk(params):
         got = box["req"].wait(timeout=300)
         assert got.size == 0 and box["req"].error is None
         assert eng.stats()["chunked_prefill"]["chunks"] == 1
+
+
+def test_chunked_admission_no_head_of_line_blocking(params, oracle):
+    """A short request submitted behind a long chunk-streaming admission
+    admits into a free slot and COMPLETES while the long prompt is still
+    admitting — chunked admission is resumable scheduler state, not an
+    inline loop.  The chunk hook snapshots (chunks_done, short_done) on
+    the scheduler thread, so the ordering check is race-free."""
+    long_prompt = list(range(1, 42))               # 41 tokens, C=4 -> 10+tail
+    short = [8, 8, 1]
+    seen = []
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
+                                  prefill_chunk=4) as eng:
+        orig = eng._chunk_mid
+        box = {}
+
+        def hook(*a, **k):
+            done = bool(box and box["short"].done.is_set())
+            seen.append((eng.chunk_stats["chunks"], done))
+            return orig(*a, **k)
+
+        eng._chunk_mid = hook
+        a = eng.submit(long_prompt, 6)
+        box["short"] = eng.submit(short, 2)
+        np.testing.assert_array_equal(a.wait(timeout=300),
+                                      expected(oracle, long_prompt, 6))
+        np.testing.assert_array_equal(box["short"].wait(timeout=300),
+                                      expected(oracle, short, 2))
+        assert len(seen) == 10
+        # the short request finished while the long admission still had
+        # chunks to stream (it needs 2 scheduler iterations; the long
+        # admission spans 11)
+        assert any(done for _, done in seen[:10])
+
+
+def test_chunked_admission_streams_while_slots_busy(params, oracle):
+    """Chunk streaming needs no free slot: with every slot decoding, a
+    long prompt's chunks run anyway (overlapping busy decode) and only
+    the final sampling prefill waits for a slot to free."""
+    long_prompt = list(range(1, 20))               # 19 tokens, C=4 -> 4+tail
+    busy_at_chunk = []
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=1,
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
+                                  prefill_chunk=4) as eng:
+        orig = eng._chunk_mid
+
+        def hook(*a, **k):
+            busy_at_chunk.append(eng._slots[0] is not None)
+            return orig(*a, **k)
+
+        eng._chunk_mid = hook
+        a = eng.submit([5, 4, 3, 2], 40)           # holds the only slot
+        b = eng.submit(long_prompt, 6)
+        np.testing.assert_array_equal(a.wait(timeout=300),
+                                      expected(oracle, [5, 4, 3, 2], 40))
+        np.testing.assert_array_equal(b.wait(timeout=300),
+                                      expected(oracle, long_prompt, 6))
+        assert busy_at_chunk == [True] * 4
+
+
+def test_chunked_admission_failure_fails_only_that_request(params, oracle):
+    """A dispatch failure while streaming chunks fails THAT request and
+    leaves the engine serving (the per-request error contract every
+    other admission dispatch honors)."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
+                                  prefill_chunk=4) as eng:
+        def boom(*a, **k):
+            raise RuntimeError("injected chunk failure")
+
+        eng._chunk_mid = boom
+        a = eng.submit([5, 4, 3, 2], 4)            # short: never chunks
+        b = eng.submit(list(range(1, 20)), 4)      # chunk-needing
+        np.testing.assert_array_equal(a.wait(timeout=300),
+                                      expected(oracle, [5, 4, 3, 2], 4))
+        with pytest.raises(RuntimeError, match="injected chunk failure"):
+            b.wait(timeout=300)
+        c = eng.submit([8, 8, 1], 3)               # engine still alive
+        np.testing.assert_array_equal(c.wait(timeout=300),
+                                      expected(oracle, [8, 8, 1], 3))
+
+
+def test_chunked_admission_prefix_hit_passes_streaming_prompt(params,
+                                                              oracle):
+    """A long prompt whose cached prefix shrinks it to ONE dispatch must
+    not wait behind an unrelated chunk stream: classification uses the
+    effective suffix, so it admits (and completes) mid-stream."""
+    base = list(range(2, 34))                      # 32 tokens -> cached
+    hit = base[:28] + [7, 9, 11]                   # 31 tokens, suffix 3
+    streamer = list(range(100, 141))               # 41 tokens, C=4 -> 10+tail
+    seen = []
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
+                                  prefill_chunk=4, min_prefix_len=8) as eng:
+        np.testing.assert_array_equal(eng.submit(base, 4).wait(timeout=300),
+                                      expected(oracle, base, 4))
+        orig = eng._chunk_mid
+        box = {}
+
+        def hook(*a, **k):
+            done = bool(box and box["hit"].done.is_set())
+            seen.append(done)
+            return orig(*a, **k)
+
+        eng._chunk_mid = hook
+        a = eng.submit(streamer, 4)
+        box["hit"] = eng.submit(hit, 2)
+        np.testing.assert_array_equal(a.wait(timeout=300),
+                                      expected(oracle, streamer, 4))
+        np.testing.assert_array_equal(box["hit"].wait(timeout=300),
+                                      expected(oracle, hit, 2))
+        # the prefix-hit request finished while the streamer still had
+        # chunks left (base's 8 chunks ran before the hook armed)
+        assert any(seen)
+        assert eng.prefix_stats["hits"] == 1
