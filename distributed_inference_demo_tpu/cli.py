@@ -52,7 +52,8 @@ def _sampling_from_args(args):
     from .ops.sampling import SamplingParams
     if args.greedy:
         return SamplingParams(greedy=True)
-    return SamplingParams(temperature=args.temperature, top_k=args.top_k)
+    return SamplingParams(temperature=args.temperature, top_k=args.top_k,
+                          min_p=getattr(args, "min_p", 0.0))
 
 
 def _tp_mesh_from_args(args):
@@ -409,6 +410,7 @@ def cmd_server(args) -> int:
         checkpoint=args.checkpoint, weights_seed=args.weights_seed,
         max_seq=args.max_seq, max_new_tokens=args.max_new_tokens,
         greedy=args.greedy, temperature=args.temperature, top_k=args.top_k,
+        min_p=getattr(args, "min_p", 0.0),
         bind_host=args.bind_host, http_host=args.http_host,
         http_port=args.http_port, collect_window=args.collect_window,
         collect_timeout=args.collect_timeout,
@@ -477,6 +479,7 @@ def cmd_worker(args) -> int:
     ap.add_argument("--greedy", action="store_true")
     ap.add_argument("--temperature", type=float, default=0.7)
     ap.add_argument("--top-k", type=int, default=7)
+    ap.add_argument("--min-p", type=float, default=0.0)
     ap.add_argument("--step-timeout", type=float, default=120.0)
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over this host's first N "
@@ -489,7 +492,8 @@ def cmd_worker(args) -> int:
     cfg = get_model_config(a.model)
     full = init_full_params(jax.random.PRNGKey(a.weights_seed), cfg)
     sampling = SamplingParams(greedy=True) if a.greedy else \
-        SamplingParams(temperature=a.temperature, top_k=a.top_k)
+        SamplingParams(temperature=a.temperature, top_k=a.top_k,
+                       min_p=a.min_p)
     layer_end = a.layer_end if a.layer_end >= 0 else cfg.num_layers
     spec = StageSpec(a.stage_id, a.num_stages, a.layer_start, layer_end)
     from .parallel.mesh import local_tp_mesh
@@ -890,6 +894,11 @@ def _add_engine_args(ap):
     ap.add_argument("--greedy", action="store_true")
     ap.add_argument("--temperature", type=float, default=0.7)
     ap.add_argument("--top-k", type=int, default=7)
+    ap.add_argument("--min-p", type=float, default=0.0,
+                    help="min-p filter: keep tokens with probability >= "
+                         "min_p * max_prob on the temperature-scaled "
+                         "distribution (0 disables; composes with top-k "
+                         "and top-p)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--attn-backend", default="auto",
                     choices=["auto", "flash", "flash-interpret", "jnp"])

@@ -17,13 +17,23 @@ import jax.numpy as jnp
 
 
 @partial(jax.tree_util.register_dataclass,
-         data_fields=[], meta_fields=["temperature", "top_k", "top_p", "greedy"])
+         data_fields=[], meta_fields=["temperature", "top_k", "top_p",
+                                      "min_p", "greedy"])
 @dataclass(frozen=True)
 class SamplingParams:
     temperature: float = 0.7   # reference default: BackgroundService.java:113
     top_k: int = 7             # reference default k=7
     top_p: float = 1.0
+    min_p: float = 0.0         # keep tokens with prob >= min_p * max_prob
     greedy: bool = False
+
+    def __post_init__(self):
+        # min_p > 1 would mask even the max-probability token (the fused
+        # and full-vocab paths then disagree on a meaningless output);
+        # reject at construction, where the CLI renders it as a one-line
+        # config error
+        if not 0.0 <= self.min_p <= 1.0:
+            raise ValueError(f"min_p must be in [0, 1], got {self.min_p}")
 
 
 def kth_largest(logits: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -138,6 +148,17 @@ def filtered_logits(logits: jnp.ndarray,
         threshold = jnp.min(jnp.where(jnp.isfinite(cutoff), cutoff, jnp.inf),
                             axis=-1, keepdims=True)
         logits = jnp.where(logits < threshold, -jnp.inf, logits)
+
+    if params.min_p > 0.0:
+        # min-p: keep tokens whose probability is >= min_p * max_prob on
+        # the temperature-scaled distribution.  prob_i / prob_max =
+        # exp(logit_i - logit_max), so the filter is a pure max + compare
+        # — no sort, no cumsum (why min-p scales where top-p doesn't).
+        # The max logit survives every earlier mask, so the threshold is
+        # order-independent w.r.t. top-k/top-p.
+        thr = (jnp.max(logits, axis=-1, keepdims=True)
+               + jnp.log(params.min_p))
+        logits = jnp.where(logits < thr, -jnp.inf, logits)
     return logits
 
 
@@ -165,6 +186,15 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array,
         # the [batch, k] values: no full-vocab f32 cast or divide pass
         vals, idx = topk_vals_idx(logits, k)
         vals = _temperature_scaled(vals, params)
+        if params.min_p > 0.0:
+            # vals are descending, so vals[..., :1] IS the global max
+            # logit — the same threshold filtered_logits computes over
+            # the full vocab (tokens min-p would mask outside the top-k
+            # are already excluded), keeping the two paths
+            # distribution-identical
+            vals = jnp.where(
+                vals < vals[..., :1] + jnp.log(params.min_p),
+                -jnp.inf, vals)
         choice = jax.random.categorical(rng, vals, axis=-1)
         return jnp.take_along_axis(
             idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)

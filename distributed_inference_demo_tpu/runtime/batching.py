@@ -563,6 +563,7 @@ class ContinuousBatchingEngine:
         self._min_prefix_len = max(1, min_prefix_len)
         self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
+        self._prefix_epoch = 0      # bumped on prefix-cache content change
         self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
         # resumable chunked admission: at most ONE prompt streams its
         # chunks at a time (scheduler state, advanced one dispatch per
@@ -778,6 +779,9 @@ class ContinuousBatchingEngine:
                                    row_v[:, :, :, :cols, :])
         while len(self._prefix_cache) > self._prefix_cache_size:
             self._prefix_cache.popitem(last=False)
+        # content changed (store and/or eviction): stale _needs_stream
+        # memos must re-classify
+        self._prefix_epoch += 1
 
     def _row_for(self, req: Request):
         """(start, row_k, row_v) for a fresh admission: a zero row, or a
@@ -802,23 +806,25 @@ class ContinuousBatchingEngine:
         dispatch — it must not wait behind an unrelated stream).  Pure
         peek: hit/miss accounting stays with ``_row_for``.
 
-        The decision is memoized on the request (``_stream_cls``): a
-        blocked request is NOT rescanned against the prefix cache every
-        scheduler iteration — it keeps its first classification, the
-        same point-in-time semantics the pre-resumable code had at
-        admission time."""
+        The decision is memoized on the request (``_stream_cls``),
+        validated against the prefix cache's mutation epoch: a blocked
+        request is NOT rescanned every scheduler iteration, but a
+        store/eviction invalidates the memo — a classification must
+        never outlive the cache entry it relied on (an evicted prefix
+        would otherwise send a long prompt down the one-dispatch path,
+        voiding the chunked activation-memory bound)."""
         C = self.prefill_chunk
         if C is None:
             return False
         cls = getattr(req, "_stream_cls", None)
-        if cls is not None:
-            return cls
+        if cls is not None and cls[0] == self._prefix_epoch:
+            return cls[1]
         needs = len(req.prompt) > C
         if needs and self._prefix_cache_size:
             m, _ = self._longest_cached_prefix(req.prompt)
             if m >= self._min_prefix_len and len(req.prompt) - m <= C:
                 needs = False
-        req._stream_cls = needs
+        req._stream_cls = (self._prefix_epoch, needs)
         return needs
 
     def _admit_request(self, slot: int, req: Request):

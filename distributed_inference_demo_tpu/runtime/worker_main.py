@@ -35,7 +35,8 @@ def build_worker(args):
     full = init_full_params(jax.random.PRNGKey(args.weights_seed), cfg)
     params = slice_stage(full, cfg, spec)
     sampling = SamplingParams(greedy=True) if args.greedy else \
-        SamplingParams(temperature=args.temperature, top_k=args.top_k)
+        SamplingParams(temperature=args.temperature, top_k=args.top_k,
+                       min_p=args.min_p)
     # pipeline x tensor parallelism: this stage runs tp-sharded over its
     # host's first N local devices; the wire stays [b, s, H]
     from ..parallel.mesh import local_tp_mesh
@@ -81,6 +82,7 @@ def main(argv=None) -> int:
     ap.add_argument("--greedy", action="store_true")
     ap.add_argument("--temperature", type=float, default=0.7)
     ap.add_argument("--top-k", type=int, default=7)
+    ap.add_argument("--min-p", type=float, default=0.0)
     ap.add_argument("--step-timeout", type=float, default=120.0)
     ap.add_argument("--kv-cache-dtype", default="",
                     help="reduced-precision KV cache storage for this "

@@ -62,7 +62,8 @@ class ServerApp:
                  checkpoint: str = "", weights_seed: int = 0,
                  max_seq: int = 256, max_new_tokens: int = 40,
                  greedy: bool = False, temperature: float = 0.7,
-                 top_k: int = 7, bind_host: str = "127.0.0.1",
+                 top_k: int = 7, min_p: float = 0.0,
+                 bind_host: str = "127.0.0.1",
                  http_host: str = "127.0.0.1", http_port: int = 0,
                  collect_window: float = 10.0,
                  collect_timeout: float = 120.0,
@@ -80,6 +81,7 @@ class ServerApp:
         self.greedy = greedy
         self.temperature = temperature
         self.top_k = top_k
+        self.min_p = min_p
         self.bind_host = bind_host
         self.http_host = http_host
         self.http_port = http_port
@@ -104,7 +106,8 @@ class ServerApp:
         from .ops.sampling import SamplingParams
         if self.greedy:
             return SamplingParams(greedy=True)
-        return SamplingParams(temperature=self.temperature, top_k=self.top_k)
+        return SamplingParams(temperature=self.temperature,
+                              top_k=self.top_k, min_p=self.min_p)
 
     def _collect_devices(self, pool) -> List:
         """Reference collection-window semantics (``server.py:709-762``):
@@ -224,7 +227,8 @@ class ServerApp:
             stage_ranges=self.plan.stage_ranges,
             mesh_axes={}, sampling=(
                 {"greedy": 1.0} if self.greedy else
-                {"temperature": self.temperature, "top_k": self.top_k}),
+                {"temperature": self.temperature, "top_k": self.top_k,
+                 "min_p": self.min_p}),
             plan_version=self.plan.plan_version,
             kv_cache_dtype=self.kv_cache_dtype)
         lifecycle = LifecycleServer(config, artifact_provider,
@@ -375,7 +379,8 @@ def run_auto_worker(registry: str, device_id: str,
     s = config.sampling
     sampling = (SamplingParams(greedy=True) if s.get("greedy") else
                 SamplingParams(temperature=s.get("temperature", 0.7),
-                               top_k=int(s.get("top_k", 7))))
+                               top_k=int(s.get("top_k", 7)),
+                               min_p=s.get("min_p", 0.0)))
     runtime = StageRuntime(cfg, spec, params, max_seq=config.max_seq,
                            sampling=sampling,
                            kv_cache_dtype=config.kv_cache_dtype)

@@ -218,3 +218,36 @@ def test_topk_fused_draw_matches_filtered_distribution():
         sigma = np.sqrt(p_exact[tok] * (1 - p_exact[tok]) / draws.size)
         assert abs(freq[tok] - p_exact[tok]) < 3 * sigma + 1e-9, (
             tok, freq[tok], p_exact[tok])
+
+
+def test_min_p_filter_and_fused_draw_agree():
+    """min-p keeps tokens with prob >= min_p * max_prob on the scaled
+    distribution; the full-vocab filter and the fused small-k draw must
+    produce the same candidate set."""
+    from distributed_inference_demo_tpu.ops.sampling import filtered_logits
+    logits = jnp.asarray([[0.0, 5.0, 4.9, 1.0, -3.0]])
+    # temp 1.0: threshold = 5 + ln(0.5) ~= 4.31 -> only tokens 1, 2 survive
+    params = SamplingParams(temperature=1.0, top_k=0, min_p=0.5)
+    f = np.asarray(filtered_logits(logits, params))[0]
+    assert np.isfinite(f[[1, 2]]).all()
+    assert not np.isfinite(f[[0, 3, 4]]).any()
+    # fused small-k path (top_k set): identical candidate set
+    pk = SamplingParams(temperature=1.0, top_k=4, min_p=0.5)
+    f2 = np.asarray(filtered_logits(logits, pk))[0]
+    assert np.isfinite(f2[[1, 2]]).all()
+    assert not np.isfinite(f2[[0, 3, 4]]).any()
+    for s in range(30):
+        tok = int(sample_logits(logits, jax.random.PRNGKey(s), pk)[0])
+        assert tok in (1, 2)
+    # min_p=1.0 degenerates to argmax-only regardless of rng
+    only_max = SamplingParams(temperature=1.0, top_k=0, min_p=1.0)
+    for s in range(5):
+        assert int(sample_logits(logits, jax.random.PRNGKey(s),
+                                 only_max)[0]) == 1
+
+
+def test_min_p_range_validated():
+    with pytest.raises(ValueError, match="min_p"):
+        SamplingParams(min_p=1.5)
+    with pytest.raises(ValueError, match="min_p"):
+        SamplingParams(min_p=-0.1)
