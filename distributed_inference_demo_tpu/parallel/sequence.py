@@ -134,12 +134,35 @@ def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
         raise ValueError(f"max_seq={max_seq} not divisible by sp={sp}")
     from ..runtime.engine import resolve_cache_dtype_backend
     kv_dtype, _ = resolve_cache_dtype_backend(kv_cache_dtype, "jnp")
-    cache_dtype = kv_dtype if kv_dtype is not None else cfg.dtype
     s_loc = max_seq // sp
     spec = StageSpec(0, 1, 0, cfg.num_layers)
     sampling = sampling or SamplingParams(greedy=True)
+    prefill_core, step_core = _make_ring_cores(cfg, spec, s_loc, sampling,
+                                               kv_dtype)
 
     def body(params, ids, rng):
+        carry, rng = prefill_core(params, ids, rng)
+        tok0 = carry[-1]
+
+        def step(c, r):
+            return step_core(params, c, r)
+
+        return _decode_scan(step, carry, rng, num_new_tokens, tok0)
+
+    return _wrap_sp_body(body, mesh, sp, max_seq, num_new_tokens)
+
+
+def _make_ring_cores(cfg: ModelConfig, spec: StageSpec, s_loc: int,
+                     sampling: SamplingParams, kv_dtype):
+    """``(prefill_core, step_core)`` — the ring-sp math, shared by the
+    fused generate fn and the step-split stream fns so the two programs
+    cannot drift.  Both run INSIDE the sp ``shard_map``.  The decode
+    carry is ``(keys, values, kv_pos, plen, length, tok)``: ``plen``
+    rides along explicitly so a decode dispatch needs no prompt shape
+    (the fused path closes over it; the stream path cannot)."""
+    cache_dtype = kv_dtype if kv_dtype is not None else cfg.dtype
+
+    def prefill_core(params, ids, rng):
         n = jax.lax.axis_size("sp")
         idx = jax.lax.axis_index("sp")
         b, chunk = ids.shape
@@ -171,49 +194,128 @@ def make_sp_generate_fn(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
                                       positions, attn_impl=prefill_attn)
         kv_pos = jnp.where(jnp.arange(s_loc) < chunk,
                            idx * chunk + jnp.arange(s_loc), -1).astype(jnp.int32)
-        length = jnp.asarray(n * chunk, jnp.int32)
+        plen = jnp.asarray(n * chunk, jnp.int32)
 
         tok0, rng = _sample_first_token(params, cfg, hidden, idx, n, rng,
                                         sampling)
+        return (cache.keys, cache.values, kv_pos, plen, plen, tok0), rng
 
+    def step_core(params, carry, step_rng):
         # ---- decode: sharded cache + lse-combined partial attention -----
-        def step(carry, step_rng):
-            kc_all, vc_all, kv_pos, length, tok = carry
-            # stateless round-robin placement, derived from the carry: the
-            # d-th decoded token (d = length - prompt_len) lands on rank
-            # d % n at slot chunk + d // n.
-            d = length - n * chunk
-            is_owner = idx == d % n
-            slot = chunk + d // n
-            kv_pos_new = jnp.where(
-                is_owner, _dynamic_set1(kv_pos, slot, length), kv_pos)
-            pos = jnp.broadcast_to(length, (b, 1))
+        kc_all, vc_all, kv_pos, plen, length, tok = carry
+        n = jax.lax.axis_size("sp")
+        idx = jax.lax.axis_index("sp")
+        b = tok.shape[0]
+        chunk = plen // n
+        # stateless round-robin placement, derived from the carry: the
+        # d-th decoded token (d = length - prompt_len) lands on rank
+        # d % n at slot chunk + d // n.
+        d = length - plen
+        is_owner = idx == d % n
+        slot = chunk + d // n
+        kv_pos_new = jnp.where(
+            is_owner, _dynamic_set1(kv_pos, slot, length), kv_pos)
+        pos = jnp.broadcast_to(length, (b, 1))
 
-            def dec_attn(q, k, v, kc, vc, pos_, cache_start, slopes):
-                # kc/vc: [b, nkv, s_loc, hd] head-major; the new token's
-                # k/v arrive as [b, 1, nkv, hd] — transpose to cache layout
-                k_t = k.transpose(0, 2, 1, 3).astype(kc.dtype)
-                v_t = v.transpose(0, 2, 1, 3).astype(vc.dtype)
-                old_k = jax.lax.dynamic_slice(
-                    kc, (0, 0, slot, 0), (b, kc.shape[1], 1, kc.shape[3]))
-                old_v = jax.lax.dynamic_slice(
-                    vc, (0, 0, slot, 0), (b, vc.shape[1], 1, vc.shape[3]))
-                k_ins = jnp.where(is_owner, k_t, old_k)
-                v_ins = jnp.where(is_owner, v_t, old_v)
-                kc = jax.lax.dynamic_update_slice(kc, k_ins, (0, 0, slot, 0))
-                vc = jax.lax.dynamic_update_slice(vc, v_ins, (0, 0, slot, 0))
-                out = sp_decode_attention(q, kc, vc, kv_pos_new, pos_, "sp",
-                                          slopes=slopes)
-                return out, kc, vc
+        def dec_attn(q, k, v, kc, vc, pos_, cache_start, slopes):
+            # kc/vc: [b, nkv, s_loc, hd] head-major; the new token's
+            # k/v arrive as [b, 1, nkv, hd] — transpose to cache layout
+            k_t = k.transpose(0, 2, 1, 3).astype(kc.dtype)
+            v_t = v.transpose(0, 2, 1, 3).astype(vc.dtype)
+            old_k = jax.lax.dynamic_slice(
+                kc, (0, 0, slot, 0), (b, kc.shape[1], 1, kc.shape[3]))
+            old_v = jax.lax.dynamic_slice(
+                vc, (0, 0, slot, 0), (b, vc.shape[1], 1, vc.shape[3]))
+            k_ins = jnp.where(is_owner, k_t, old_k)
+            v_ins = jnp.where(is_owner, v_t, old_v)
+            kc = jax.lax.dynamic_update_slice(kc, k_ins, (0, 0, slot, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v_ins, (0, 0, slot, 0))
+            out = sp_decode_attention(q, kc, vc, kv_pos_new, pos_, "sp",
+                                      slopes=slopes)
+            return out, kc, vc
 
-            cache = KVCache(kc_all, vc_all, length)
-            logits, cache = stage_forward(params, cfg, spec, tok[:, None],
-                                          cache, pos, attn_impl=dec_attn)
-            nxt = sample_logits(logits[:, -1, :], step_rng, sampling)
-            return ((cache.keys, cache.values, kv_pos_new, length + 1, nxt),
-                    nxt)
+        cache = KVCache(kc_all, vc_all, length)
+        logits, cache = stage_forward(params, cfg, spec, tok[:, None],
+                                      cache, pos, attn_impl=dec_attn)
+        nxt = sample_logits(logits[:, -1, :], step_rng, sampling)
+        return ((cache.keys, cache.values, kv_pos_new, plen, length + 1,
+                 nxt), nxt)
 
-        carry = (cache.keys, cache.values, kv_pos, length, tok0)
-        return _decode_scan(step, carry, rng, num_new_tokens, tok0)
+    return prefill_core, step_core
 
-    return _wrap_sp_body(body, mesh, sp, max_seq, num_new_tokens)
+
+def make_sp_stream_fns(cfg: ModelConfig, mesh: Mesh, *, max_seq: int,
+                       block: int,
+                       sampling: Optional[SamplingParams] = None,
+                       kv_cache_dtype=None):
+    """``(prefill_fn, decode_fn)`` — the step-SPLIT ring-sp programs for
+    INCREMENTAL long-context serving (runtime/sp_backend.py streaming):
+
+    - ``prefill_fn(params, prompt_ids, rng) -> (*state, rng)`` runs ring
+      prefill and samples token #1 (``state[-1]``); the returned state
+      (sequence-sharded cache, kv position map, lengths, last token)
+      stays on device, sharded.
+    - ``decode_fn(params, *state, rng) -> (*state, toks[b, block])``
+      advances ``block`` tokens in one dispatch (cache buffers donated).
+
+    Same math as :func:`make_sp_generate_fn` (one core factory,
+    ``_make_ring_cores``) — greedy streams are bit-identical to the
+    fused fn.  One compiled pair serves EVERY ``max_new_tokens`` (the
+    fused fn bakes its trip count into the program); first-token latency
+    is one prefill dispatch instead of the whole generation.  Sampled
+    streams draw per-block sub-rngs, so they are equally distributed but
+    not sequence-identical to the fused fn (the engines' streaming
+    contract).  A final partial block may scan past ``max_new``: the
+    surplus steps write only into slots the discarded tokens own
+    (the caller takes ``toks[:, :remaining]`` and drops the state)."""
+    sp = mesh.shape["sp"]
+    if max_seq % sp:
+        raise ValueError(f"max_seq={max_seq} not divisible by sp={sp}")
+    if block < 1:
+        raise ValueError("block must be >= 1")
+    from ..runtime.engine import resolve_cache_dtype_backend
+    kv_dtype, _ = resolve_cache_dtype_backend(kv_cache_dtype, "jnp")
+    s_loc = max_seq // sp
+    spec = StageSpec(0, 1, 0, cfg.num_layers)
+    sampling = sampling or SamplingParams(greedy=True)
+    prefill_core, step_core = _make_ring_cores(cfg, spec, s_loc, sampling,
+                                               kv_dtype)
+
+    cache_spec = P(None, None, None, "sp", None)
+    state_specs = (cache_spec, cache_spec, P("sp"), P(), P(), P())
+    return _wrap_stream_fns(prefill_core, step_core, mesh, state_specs,
+                            block)
+
+
+def _wrap_stream_fns(prefill_core, step_core, mesh: Mesh, state_specs,
+                     block: int):
+    """shard_map + jit scaffolding shared by BOTH strategies' stream-fn
+    factories (one owner, like ``_wrap_sp_body`` for the fused fns):
+    a prefill program emitting the sharded decode state, and a
+    donated-cache decode program scanning ``block`` steps per dispatch.
+    ``state_specs`` lead with the two cache buffers (donated)."""
+
+    def prefill_body(params, ids, rng):
+        carry, rng = prefill_core(params, ids, rng)
+        return (*carry, rng)
+
+    def decode_body(params, *state_rng):
+        state, rng = state_rng[:-1], state_rng[-1]
+
+        def step(c, r):
+            return step_core(params, c, r)
+
+        carry, toks = jax.lax.scan(step, state,
+                                   jax.random.split(rng, block))
+        return (*carry, jnp.swapaxes(toks, 0, 1))       # [b, block]
+
+    prefill_fn = jax.jit(jax.shard_map(
+        prefill_body, mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P()),
+        out_specs=(*state_specs, P()), check_vma=False))
+    decode_fn = jax.jit(jax.shard_map(
+        decode_body, mesh=mesh,
+        in_specs=(P(), *state_specs, P()),
+        out_specs=(*state_specs, P()), check_vma=False),
+        donate_argnums=(1, 2))
+    return prefill_fn, decode_fn

@@ -60,6 +60,7 @@ class SequenceParallelBackend:
         self.kv_cache_dtype = kv_cache_dtype
         self.sp = int(mesh.shape["sp"])
         self._fns: "OrderedDict" = OrderedDict()
+        self._stream_pair = None
         self._lock = threading.Lock()
         # counters + fn-cache bookkeeping get their OWN lock: generate()
         # holds _lock for the whole device computation (minutes at 32k
@@ -127,19 +128,81 @@ class SequenceParallelBackend:
         return GenerationResult(tokens=toks, prompt_len=ids.shape[1],
                                 num_new=num_new, seconds=dt)
 
+    # tokens per streaming decode dispatch: large enough to amortize
+    # dispatch latency (the block runs as one fused scan), small enough
+    # that chunks reach the client every few steps
+    STREAM_BLOCK = 8
+
+    def _stream_fns(self):
+        """The step-split (prefill_fn, decode_fn) pair — built once; ONE
+        compiled pair serves every max_new_tokens (unlike the fused fns,
+        which bake their trip count)."""
+        if self._stream_pair is None:
+            from ..parallel.sequence import make_sp_stream_fns
+            from ..parallel.ulysses import make_ulysses_stream_fns
+            make = (make_sp_stream_fns if self.strategy == "ring"
+                    else make_ulysses_stream_fns)
+            self._stream_pair = make(
+                self.cfg, self.mesh, max_seq=self.max_seq,
+                block=self.STREAM_BLOCK, sampling=self.sampling,
+                kv_cache_dtype=self.kv_cache_dtype)
+        return self._stream_pair
+
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         seed: int = 0):
-        """Step-wise view over the fused sp program (the chat REPL and
-        ``stream: true`` requests need one).  The whole generation runs
-        in ONE dispatch — the sp decode is a fused scan — then tokens
-        stream per step, so first-token latency equals full-generation
-        latency.  Acceptable at long context, where prefill dominates
-        end-to-end time; true incremental sp streaming would need a
-        step-split program.  Validation errors surface on the first
+        """TRUE incremental sp streaming: one prefill dispatch yields
+        token #1 immediately, then each STREAM_BLOCK-token decode
+        dispatch yields as it lands — first-token latency is the prefill,
+        not the whole generation.  The device lock is taken per DISPATCH
+        and released before every yield, so a slow or stalled client
+        never blocks other requests; concurrent streams interleave their
+        block dispatches (each stream's state buffers are its own).
+        Greedy streams are bit-identical to ``generate``; sampled streams
+        are equally distributed but draw per-block sub-rngs (the engines'
+        streaming contract).  Validation errors surface on the first
         ``next()`` (a clean 400), like every other backend."""
-        res = self.generate(prompt_ids, max_new_tokens, seed=seed)
-        for i in range(res.tokens.shape[1]):
-            yield res.tokens[:, i]
+        import jax
+
+        ids = np.asarray(prompt_ids, dtype=np.int32)
+        num_new = int(max_new_tokens)
+        validate_sp_prompt(ids.shape[1], self.sp, self.max_seq, num_new)
+        emitted, device_s = 0, 0.0
+        try:
+            # the device lock is held per DISPATCH, never across a yield:
+            # a client that stops reading suspends the generator with the
+            # lock RELEASED, so other requests (and streams) keep serving
+            # — their programs touch none of this stream's state buffers
+            with self._lock:
+                pf, dec = self._stream_fns()
+                t0 = time.perf_counter()
+                with self.mesh:
+                    out = pf(self.params, ids, jax.random.PRNGKey(seed))
+                device_s += time.perf_counter() - t0
+            state, rng = list(out[:-1]), out[-1]
+            yield np.asarray(state[-1])             # token #1
+            emitted = 1
+            while emitted < num_new:
+                rng, sub = jax.random.split(rng)
+                with self._lock:
+                    t0 = time.perf_counter()
+                    with self.mesh:
+                        out = dec(self.params, *state, sub)
+                    device_s += time.perf_counter() - t0
+                state, toks = list(out[:-1]), np.asarray(out[-1])
+                take = min(self.STREAM_BLOCK, num_new - emitted)
+                for j in range(take):
+                    yield toks[:, j]
+                    emitted += 1
+        finally:
+            # an abandoned stream (client disconnect, gen.close()) still
+            # spent device time and emitted tokens: count what happened.
+            # A stream that failed before its first token counts nothing,
+            # matching generate()'s success-only accounting.
+            if emitted:
+                with self._stats_lock:
+                    self._served += 1
+                    self._decode_seconds += device_s
+                    self._tokens_out += emitted * ids.shape[0]
 
     def stats(self) -> dict:
         # _stats_lock only: /stats must answer WHILE a long-context

@@ -156,3 +156,66 @@ def test_sp_backend_fp8_cache_matches_fp8_engine(strategy):
         sampling=GREEDY, kv_cache_dtype="float8_e4m3fn")
     got = backend.generate(prompt, 6).tokens
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_sp_stream_fns_greedy_parity_and_partial_block(strategy):
+    """The step-split stream path is bit-identical to the fused
+    generate() for greedy decoding, including a final PARTIAL block
+    (num_new % block != 0) and the capacity edge plen + num_new ==
+    max_seq (surplus scan steps write only into discarded slots)."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    backend = SequenceParallelBackend(
+        cfg, params, local_sp_mesh(2), max_seq=32, strategy=strategy,
+        sampling=GREEDY)
+    backend.STREAM_BLOCK = 4
+    prompt = np.asarray(
+        np.random.RandomState(3).randint(0, cfg.vocab_size, (1, 16)),
+        np.int32)
+    for num_new in (3, 6, 13, 16):      # < block, partial, multi, == cap
+        want = backend.generate(prompt, num_new).tokens
+        got = np.stack(
+            list(backend.generate_stream(prompt, num_new)), axis=1)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_sp_stream_fp8_cache_matches_fp8_engine():
+    """Streaming composes with the reduced-precision sp cache."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.asarray([[5, 17, 42, 7, 9, 2, 30, 11]], np.int32)
+    want = InferenceEngine(
+        cfg, params, max_seq=32, sampling=GREEDY,
+        kv_cache_dtype="float8_e4m3fn").generate(prompt, 6).tokens
+    backend = SequenceParallelBackend(
+        cfg, params, local_sp_mesh(2), max_seq=32, strategy="ring",
+        sampling=GREEDY, kv_cache_dtype="float8_e4m3fn")
+    backend.STREAM_BLOCK = 4
+    got = np.stack(list(backend.generate_stream(prompt, 6)), axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sp_stream_is_incremental():
+    """One compiled pair serves every max_new_tokens, and the first
+    token arrives after ONE prefill dispatch (the generator yields
+    before any decode block runs)."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    backend = SequenceParallelBackend(
+        cfg, params, local_sp_mesh(2), max_seq=32, strategy="ring",
+        sampling=GREEDY)
+    backend.STREAM_BLOCK = 4
+    prompt = np.asarray([[5, 17, 42, 7, 9, 2, 30, 11]], np.int32)
+    gen = backend.generate_stream(prompt, 12)
+    first = next(gen)
+    assert first.shape == (1,)
+    gen.close()                          # abandon mid-stream: lock freed
+    # the backend is still serviceable after an abandoned stream
+    res = backend.generate(prompt, 4)
+    assert res.tokens.shape == (1, 4)
+    # different max_new values reuse the one compiled pair
+    assert backend._stream_pair is not None
+    got6 = np.stack(list(backend.generate_stream(prompt, 6)), axis=1)
+    got9 = np.stack(list(backend.generate_stream(prompt, 9)), axis=1)
+    np.testing.assert_array_equal(got6, got9[:, :6])
