@@ -264,7 +264,7 @@ def cmd_serve(args) -> int:
         if getattr(args, "tp", 1) > 1:
             print("--sp is exclusive with --tp", file=sys.stderr)
             return 1
-        unsupported = _sp_unsupported_flags(args)
+        unsupported = _sp_unsupported_flags(args, allow_eos=True)
         if unsupported:
             print(f"{'/'.join(unsupported)} not supported with --sp",
                   file=sys.stderr)
@@ -275,7 +275,8 @@ def cmd_serve(args) -> int:
         backend = SequenceParallelBackend(
             cfg, params, mesh, max_seq=args.max_seq,
             strategy=args.sp_strategy, sampling=_sampling_from_args(args),
-            kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None)
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
+            eos_id=getattr(args, "eos_id", None))
         print(f"SERVE_SP {args.model} sp={args.sp} "
               f"strategy={args.sp_strategy} max_seq={args.max_seq}",
               flush=True)
@@ -938,12 +939,16 @@ def _add_sp_args(p) -> None:
                         "attention (needs heads divisible by N)")
 
 
-def _sp_unsupported_flags(args) -> list:
-    """Engine flags the sp generate fns have no plumbing for — one rule
-    shared by ``generate --sp`` and ``serve --sp`` so the two surfaces
-    cannot drift.  Rejected loudly rather than silently ignored."""
+def _sp_unsupported_flags(args, allow_eos: bool = False) -> list:
+    """Engine flags the sp paths have no plumbing for — one rule shared
+    by ``generate --sp`` and ``serve --sp`` so the two surfaces cannot
+    drift.  Rejected loudly rather than silently ignored.  ``serve``
+    passes ``allow_eos=True``: its backend honors eos via the step-split
+    stream programs; the one-shot generate fns are fused with a baked
+    trip count and cannot."""
     return [flag for flag, on in [
-        ("--eos-id", getattr(args, "eos_id", None) is not None),
+        ("--eos-id", not allow_eos
+         and getattr(args, "eos_id", None) is not None),
         ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
         ("--attn-backend", args.attn_backend != "auto")] if on]
 

@@ -47,7 +47,8 @@ class SequenceParallelBackend:
     def __init__(self, cfg: ModelConfig, params, mesh, *, max_seq: int,
                  strategy: str = "ring",
                  sampling: Optional[SamplingParams] = None,
-                 kv_cache_dtype: Optional[str] = None):
+                 kv_cache_dtype: Optional[str] = None,
+                 eos_id: Optional[int] = None):
         if strategy not in STRATEGIES:
             raise ValueError(f"unknown sp strategy {strategy!r}; "
                              f"known: {STRATEGIES}")
@@ -58,6 +59,7 @@ class SequenceParallelBackend:
         self.strategy = strategy
         self.sampling = sampling
         self.kv_cache_dtype = kv_cache_dtype
+        self.eos_id = eos_id
         self.sp = int(mesh.shape["sp"])
         self._fns: "OrderedDict" = OrderedDict()
         self._stream_pair = None
@@ -114,6 +116,21 @@ class SequenceParallelBackend:
         num_new = int(max_new_tokens)
         # ValueError renders as HTTP 400 with the rule spelled out
         validate_sp_prompt(ids.shape[1], self.sp, self.max_seq, num_new)
+        if self.eos_id is not None:
+            # eos early stop rides the step-split stream programs (the
+            # fused fn has a baked trip count and no eos plumbing):
+            # rows past their eos pad with eos, and decode dispatches
+            # STOP once every row finished — at long context that skips
+            # real compute, not just output.  Stats are recorded by the
+            # stream itself.
+            box = [0.0]
+            steps = list(self._stream(ids, num_new, seed, box))
+            toks = np.full((ids.shape[0], num_new), self.eos_id, np.int32)
+            toks[:, :len(steps)] = np.stack(steps, axis=1)
+            # device-only seconds, like the fused path (wall-clock would
+            # fold in lock waits from interleaved streams)
+            return GenerationResult(tokens=toks, prompt_len=ids.shape[1],
+                                    num_new=num_new, seconds=box[0])
         with self._lock:
             fn = self._fn(num_new)
             t0 = time.perf_counter()
@@ -161,10 +178,17 @@ class SequenceParallelBackend:
         are equally distributed but draw per-block sub-rngs (the engines'
         streaming contract).  Validation errors surface on the first
         ``next()`` (a clean 400), like every other backend."""
+        yield from self._stream(np.asarray(prompt_ids, np.int32),
+                                int(max_new_tokens), seed, [0.0])
+
+    def _stream(self, ids: np.ndarray, num_new: int, seed: int,
+                device_s_box: list):
+        """generate_stream's body; ``device_s_box[0]`` accumulates pure
+        device-dispatch seconds so the eos ``generate()`` path can report
+        the same device-only timing the fused path does (wall-clock would
+        fold in lock contention from interleaved streams)."""
         import jax
 
-        ids = np.asarray(prompt_ids, dtype=np.int32)
-        num_new = int(max_new_tokens)
         validate_sp_prompt(ids.shape[1], self.sp, self.max_seq, num_new)
         emitted, device_s = 0, 0.0
         try:
@@ -172,6 +196,20 @@ class SequenceParallelBackend:
             # a client that stops reading suspends the generator with the
             # lock RELEASED, so other requests (and streams) keep serving
             # — their programs touch none of this stream's state buffers
+            eos = self.eos_id
+            done = np.zeros((ids.shape[0],), bool)
+
+            def mask_row_eos(tok):
+                """The engines' row-wise eos rule (engine._mask_eos),
+                applied host-side between dispatches: finished rows pad
+                with eos; returns (masked tok, all rows finished)."""
+                nonlocal done
+                if eos is None:
+                    return tok, False
+                tok = np.where(done, eos, tok)
+                done = done | (tok == eos)
+                return tok, bool(done.all())
+
             with self._lock:
                 pf, dec = self._stream_fns()
                 t0 = time.perf_counter()
@@ -179,20 +217,28 @@ class SequenceParallelBackend:
                     out = pf(self.params, ids, jax.random.PRNGKey(seed))
                 device_s += time.perf_counter() - t0
             state, rng = list(out[:-1]), out[-1]
-            yield np.asarray(state[-1])             # token #1
+            tok, stop = mask_row_eos(np.asarray(state[-1]))
+            yield tok                               # token #1
             emitted = 1
-            while emitted < num_new:
+            while emitted < num_new and not stop:
                 rng, sub = jax.random.split(rng)
                 with self._lock:
                     t0 = time.perf_counter()
                     with self.mesh:
                         out = dec(self.params, *state, sub)
                     device_s += time.perf_counter() - t0
+                    device_s_box[0] = device_s
                 state, toks = list(out[:-1]), np.asarray(out[-1])
-                take = min(self.STREAM_BLOCK, num_new - emitted)
+                # per-dispatch width comes from the COMPILED program's
+                # output, not the mutable STREAM_BLOCK attribute (the
+                # cached pair keeps its build-time block forever)
+                take = min(toks.shape[1], num_new - emitted)
                 for j in range(take):
-                    yield toks[:, j]
+                    tok, stop = mask_row_eos(toks[:, j])
+                    yield tok
                     emitted += 1
+                    if stop:
+                        break
         finally:
             # an abandoned stream (client disconnect, gen.close()) still
             # spent device time and emitted tokens: count what happened.

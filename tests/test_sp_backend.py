@@ -136,9 +136,9 @@ def test_sp_serve_mode_pairing_rules(capsys):
     assert cli.main(base + ["--prompt-lookup"]) == 1
     assert cli.main(base + ["--chain", "w@127.0.0.1:1"]) == 1
     assert cli.main(base + ["--tp", "2"]) == 1
-    assert cli.main(base + ["--eos-id", "7"]) == 1
+    assert cli.main(base + ["--prefill-chunk", "4"]) == 1
     err = capsys.readouterr().err
-    assert "--eos-id" in err
+    assert "--prefill-chunk" in err
 
 
 @pytest.mark.parametrize("strategy", ["ring", "ulysses"])
@@ -219,3 +219,28 @@ def test_sp_stream_is_incremental():
     got6 = np.stack(list(backend.generate_stream(prompt, 6)), axis=1)
     got9 = np.stack(list(backend.generate_stream(prompt, 9)), axis=1)
     np.testing.assert_array_equal(got6, got9[:, :6])
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_sp_backend_eos_matches_engine_and_stops_early(strategy):
+    """eos on the sp backend: generate() pads finished rows with eos
+    exactly like the single-device engine, and the stream stops
+    dispatching once every row finished (fewer yielded steps)."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.asarray([[5, 17, 42, 7, 9, 2, 30, 11]], np.int32)
+    # choose the 3rd greedy token as eos: stop arrives mid-generation
+    ref = InferenceEngine(cfg, params, max_seq=32,
+                          sampling=GREEDY).generate(prompt, 10).tokens
+    eos = int(ref[0, 2])
+    want = InferenceEngine(cfg, params, max_seq=32, sampling=GREEDY,
+                           eos_id=eos).generate(prompt, 10).tokens
+    backend = SequenceParallelBackend(
+        cfg, params, local_sp_mesh(2), max_seq=32, strategy=strategy,
+        sampling=GREEDY, eos_id=eos)
+    backend.STREAM_BLOCK = 4
+    got = backend.generate(prompt, 10)
+    np.testing.assert_array_equal(got.tokens, want)
+    steps = list(backend.generate_stream(prompt, 10))
+    assert len(steps) == 3 and int(steps[-1][0]) == eos
+    np.testing.assert_array_equal(np.stack(steps, axis=1), want[:, :3])
