@@ -92,6 +92,7 @@ class Request:
     prompt: np.ndarray                 # [s] int32
     max_new: int
     tokens: List[int] = field(default_factory=list)
+    lps: List[float] = field(default_factory=list)   # logprobs (plain mode)
     stream: "queue.Queue" = field(default_factory=queue.Queue)
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
@@ -241,6 +242,14 @@ class ContinuousBatchingEngine:
         fwd, self._cache_sharding = make_forward_seam(
             cfg, self.spec, mesh, params, attn_impl=slot_attention_impl)
 
+        def _emitted_logprob(logits, tok):
+            """Raw log-softmax of the emitted token (the engines'
+            OpenAI-style convention, engine.py decode) — one [B, V]
+            reduction per step, a rounding error next to the forward."""
+            return jnp.take_along_axis(
+                jax.nn.log_softmax(logits.astype(jnp.float32), -1),
+                tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+
         def one_step(params, cache, lengths, last_tok, active, rng):
             """One lockstep decode step over all slots — the shared core
             of the per-step jit and the fused multi-step scan."""
@@ -249,15 +258,16 @@ class ContinuousBatchingEngine:
                                 True)
             tok = sample_logits(logits[:, 0], rng, samp_)
             tok = jnp.where(active, tok, last_tok)
+            lp = _emitted_logprob(logits[:, 0], tok)
             lengths = lengths + active.astype(jnp.int32)
-            return cache, lengths, tok
+            return cache, lengths, tok, lp
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def step(params, ck, cv, lengths, last_tok, active, rng):
             cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
-            cache, lengths, tok = one_step(params, cache, lengths,
-                                           last_tok, active, rng)
-            return cache.keys, cache.values, lengths, tok
+            cache, lengths, tok, lp = one_step(params, cache, lengths,
+                                               last_tok, active, rng)
+            return cache.keys, cache.values, lengths, tok, lp
 
         @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(7,))
         def multi_step(params, ck, cv, lengths, last_tok, active, rng,
@@ -273,15 +283,16 @@ class ContinuousBatchingEngine:
 
             def body(carry, sub):
                 cache, lengths, tok = carry
-                cache, lengths, tok = one_step(params, cache, lengths,
-                                               tok, active, sub)
-                return (cache, lengths, tok), tok
+                cache, lengths, tok, lp = one_step(params, cache, lengths,
+                                                   tok, active, sub)
+                return (cache, lengths, tok), (tok, lp)
 
-            (cache, lengths, tok), toks = jax.lax.scan(
+            (cache, lengths, tok), (toks, lps) = jax.lax.scan(
                 body, (cache, lengths, last_tok),
                 jax.random.split(rng, num_steps))
             return (cache.keys, cache.values, lengths, tok,
-                    jnp.swapaxes(toks, 0, 1))          # [B, num_steps]
+                    jnp.swapaxes(toks, 0, 1),          # [B, num_steps]
+                    jnp.swapaxes(lps, 0, 1))
 
         @partial(jax.jit, donate_argnums=(3, 4))
         def prefill(params, ids, start, row_k, row_v, real_len, rng):
@@ -300,7 +311,8 @@ class ContinuousBatchingEngine:
             last = jax.lax.dynamic_index_in_dim(
                 logits, real_len - 1, axis=1, keepdims=False)  # [1, V]
             tok = sample_logits(last, rng, samp_)
-            return cache.keys, cache.values, tok[0]
+            lp = _emitted_logprob(last, tok)
+            return cache.keys, cache.values, tok[0], lp[0]
 
         # rows are born on their kv-head shards under a mesh (out_shardings
         # None = unconstrained) so admission never pays a reshard into the
@@ -601,12 +613,12 @@ class ContinuousBatchingEngine:
                             warm_rng, n_r)
                 elif n_r > 1:
                     (self._ck, self._cv, self._lengths, self._last_tok,
-                     _) = self._multi_step(
+                     _, _) = self._multi_step(
                         self.params, self._ck, self._cv, self._lengths,
                         self._last_tok, idle, warm_rng, n_r)
                 else:
                     (self._ck, self._cv, self._lengths,
-                     self._last_tok) = self._step(
+                     self._last_tok, _) = self._step(
                         self.params, self._ck, self._cv, self._lengths,
                         self._last_tok, idle, warm_rng)
 
@@ -639,13 +651,24 @@ class ContinuousBatchingEngine:
         return req
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
-                 seed: int = 0,
-                 timeout: Optional[float] = None) -> GenerationResult:
+                 seed: int = 0, timeout: Optional[float] = None,
+                 logprobs: bool = False) -> GenerationResult:
         """Engine-surface convenience: submit each row as its own request
         (they batch with whatever else is in flight) and wait for all.
         ``seed`` is accepted for surface compatibility but not honored —
         see the module docstring.  On ``timeout`` the requests are
-        cancelled (slots freed) before TimeoutError propagates."""
+        cancelled (slots freed) before TimeoutError propagates.
+
+        ``logprobs=True`` additionally returns each emitted token's raw
+        log-softmax probability (the engines' OpenAI-style convention) —
+        plain slot decoding only; the speculative proposers' verify
+        rounds do not score emitted tokens.  Rows that finished early
+        pad logprobs with 0.0 alongside their eos-padded tokens."""
+        if logprobs and (self._spec_step is not None
+                         or self._pld_step is not None):
+            raise ValueError(
+                "logprobs are not supported with speculative slot "
+                "decoding (draft or prompt-lookup proposers)")
         ids = np.asarray(prompt_ids)
         if ids.ndim == 1:
             ids = ids[None, :]
@@ -660,11 +683,15 @@ class ContinuousBatchingEngine:
         width = max(len(r) for r in rows)
         pad_id = self.eos_id if self.eos_id is not None else 0
         toks = np.full((len(rows), width), pad_id, np.int32)
+        lps = np.zeros((len(rows), width), np.float32) if logprobs else None
         for i, r in enumerate(rows):
             toks[i, :len(r)] = r
+            if logprobs:
+                lps[i, :len(r)] = reqs[i].lps
         return GenerationResult(tokens=toks, prompt_len=ids.shape[1],
                                 num_new=width,
-                                seconds=time.perf_counter() - t0)
+                                seconds=time.perf_counter() - t0,
+                                logprobs=lps)
 
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         seed: int = 0):
@@ -901,7 +928,7 @@ class ContinuousBatchingEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(suffix)] = suffix
         self._rng, sub = jax.random.split(self._rng)
-        row_k, row_v, tok = self._prefill(
+        row_k, row_v, tok, lp0 = self._prefill(
             self.params, jnp.asarray(padded), jnp.int32(start),
             row_k, row_v, jnp.int32(len(suffix)), sub)
         self._prefix_store(req.prompt, row_k, row_v)
@@ -927,21 +954,28 @@ class ContinuousBatchingEngine:
                 self._history, jnp.asarray(hpad), jnp.int32(slot),
                 jnp.int32(plen), tok.astype(jnp.int32))
         self._slots[slot] = req
-        self._record_token(slot, req, int(tok))
+        # lps stay empty (not a stale 1-entry list) in the speculative
+        # modes, whose drains never score emitted tokens
+        plain = self._spec_step is None and self._pld_step is None
+        self._record_token(slot, req, int(tok),
+                           float(lp0) if plain else None)
 
-    def _record_row_blocks(self, em_np, counts) -> None:
+    def _record_row_blocks(self, em_np, counts, lps_np=None) -> None:
         """Record per-row emitted token blocks into the slots' requests
         (``counts[i]`` tokens from row i), stopping a row the moment it
         finishes (max_new/eos frees the slot mid-block — the stale-slot
         guard shared by the speculative rounds and the fused
-        decode-block path)."""
+        decode-block path).  ``lps_np``: matching per-token logprobs
+        (plain mode; the speculative drains pass none)."""
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
             for j in range(int(counts[i])):
                 if self._slots[i] is None:
                     break              # row hit max_new or eos mid-block
-                self._record_token(i, req, int(em_np[i, j]))
+                self._record_token(
+                    i, req, int(em_np[i, j]),
+                    None if lps_np is None else float(lps_np[i, j]))
 
     def _drain_spec_blocks(self, em_np, ns_np) -> None:
         """Record one speculative round's per-row emitted blocks +
@@ -957,8 +991,11 @@ class ContinuousBatchingEngine:
             sum(int(ns_np[i]) - 1 for i in live))
         self._record_row_blocks(em_np, ns_np)
 
-    def _record_token(self, slot: int, req: Request, tok: int):
+    def _record_token(self, slot: int, req: Request, tok: int,
+                      lp: Optional[float] = None):
         req.tokens.append(tok)
+        if lp is not None:
+            req.lps.append(lp)
         req.stream.put(tok)
         hit_eos = self.eos_id is not None and tok == self.eos_id
         if len(req.tokens) >= req.max_new or hit_eos:
@@ -1030,24 +1067,26 @@ class ContinuousBatchingEngine:
                 self._drain_spec_blocks(em_np[r], ns_np[r])
         elif rounds > 1:
             (self._ck, self._cv, self._lengths, tok,
-             blocks) = self._multi_step(
+             blocks, lps) = self._multi_step(
                 self.params, self._ck, self._cv, self._lengths,
                 self._last_tok, jnp.asarray(active_mask), sub,
                 rounds)
             self._last_tok = tok
             self._step_count += rounds
             self._record_row_blocks(
-                np.asarray(blocks), np.full(len(self._slots), rounds))
+                np.asarray(blocks), np.full(len(self._slots), rounds),
+                np.asarray(lps))
         else:
-            self._ck, self._cv, self._lengths, tok = self._step(
+            self._ck, self._cv, self._lengths, tok, lp = self._step(
                 self.params, self._ck, self._cv, self._lengths,
                 self._last_tok, jnp.asarray(active_mask), sub)
             self._last_tok = tok
-            tok_np = np.asarray(tok)
+            tok_np, lp_np = np.asarray(tok), np.asarray(lp)
             self._step_count += 1
             for i, req in enumerate(self._slots):
                 if req is not None:
-                    self._record_token(i, req, int(tok_np[i]))
+                    self._record_token(i, req, int(tok_np[i]),
+                                       float(lp_np[i]))
 
     def _loop(self):
         try:

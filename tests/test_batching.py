@@ -943,3 +943,82 @@ def test_chunked_admission_prefix_hit_passes_streaming_prompt(params,
         # chunks left (base's 8 chunks ran before the hook armed)
         assert any(seen)
         assert eng.prefix_stats["hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# per-token logprobs (plain slot decoding)
+
+def test_logprobs_match_engine(params, oracle):
+    """generate(logprobs=True) scores emitted tokens with the same raw
+    log-softmax the plain engine reports."""
+    prompt = [3, 14, 15, 92, 65]
+    want = oracle.generate(np.asarray(prompt)[None, :], 10, logprobs=True)
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY,
+                                  prompt_buckets=(16,)) as eng:
+        got = eng.generate(np.asarray([prompt]), 10, logprobs=True)
+        np.testing.assert_array_equal(got.tokens, want.tokens)
+        np.testing.assert_allclose(got.logprobs, want.logprobs,
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_logprobs_with_decode_block(params, oracle):
+    """The fused multi-step path records block logprobs identically."""
+    prompt = [3, 14, 15, 92, 65]
+    want = oracle.generate(np.asarray(prompt)[None, :], 9, logprobs=True)
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  decode_block=4) as eng:
+        got = eng.generate(np.asarray([prompt]), 9, logprobs=True)
+        np.testing.assert_array_equal(got.tokens, want.tokens)
+        np.testing.assert_allclose(got.logprobs, want.logprobs,
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_logprobs_rejected_with_speculation(params, draft_params):
+    with spec_engine(params, draft_params) as eng:
+        with pytest.raises(ValueError, match="logprobs"):
+            eng.generate(np.asarray([[1, 2, 3]]), 4, logprobs=True)
+
+
+def test_http_logprobs_over_batching_backend(params, oracle):
+    """POST /generate {"logprobs": true} against the batching backend
+    returns per-token logprobs (501 before this surface existed)."""
+    import http.client
+    import json as _json
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        InferenceHTTPServer)
+
+    prompt = [3, 14, 15, 92, 65]
+    want = oracle.generate(np.asarray(prompt)[None, :], 6, logprobs=True)
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY,
+                                  prompt_buckets=(16,)) as eng:
+        server = InferenceHTTPServer(eng, port=0, model_name="llama-test")
+        server.start()
+        try:
+            conn = http.client.HTTPConnection(server.host, server.port,
+                                              timeout=300)
+            conn.request("POST", "/generate",
+                         body=_json.dumps({"prompt_ids": [prompt],
+                                           "max_new_tokens": 6,
+                                           "logprobs": True}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = _json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200, body
+            assert body["tokens"] == want.tokens.tolist()
+            np.testing.assert_allclose(body["logprobs"],
+                                       want.logprobs, atol=1e-3)
+        finally:
+            server.shutdown()
+
+
+def test_logprobs_empty_in_spec_mode(params, draft_params):
+    """Speculative requests keep lps EMPTY (no stale admission entry):
+    tokens and lps can never silently misalign if the guard is relaxed."""
+    with spec_engine(params, draft_params) as eng:
+        req = eng.submit([3, 14, 15], 5)
+        req.wait(timeout=300)
+        assert req.lps == [] and len(req.tokens) == 5
