@@ -611,25 +611,31 @@ def test_pld_exclusive_with_draft(params):
 # randomized soak: scheduler races under a mixed workload
 
 
-@pytest.mark.parametrize("mode", ["plain", "draft", "pld"])
+@pytest.mark.parametrize("mode", ["plain", "draft", "pld", "chunked",
+                                  "chunked-draft"])
 def test_soak_random_workload(params, draft_params, oracle, mode):
     """30 requests with random lengths, ~20% random cancellations, and
     staggered submission against 3 slots: every surviving request must
     stay bit-exact (fuzz for admission/drain/cancel races in the
-    scheduler, across all three proposer modes)."""
+    scheduler, across the proposer modes AND chunked admission — the
+    chunked modes use longer prompts so the resumable stream, its
+    backlog, and cancel-mid-stream all churn)."""
     rng = np.random.default_rng(42)
     kw = {}
-    if mode == "draft":
+    if mode in ("draft", "chunked-draft"):
         kw = dict(draft_cfg=DRAFT_CFG, draft_params=draft_params,
                   num_draft=3)
     elif mode == "pld":
         kw = dict(prompt_lookup=True, num_draft=3)
+    if mode.startswith("chunked"):
+        kw["prefill_chunk"] = 4
+    max_plen = 25 if mode.startswith("chunked") else 9
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=3,
-                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  sampling=GREEDY, prompt_buckets=(16, 64),
                                   **kw) as eng:
         reqs = []
         for _ in range(30):
-            plen = int(rng.integers(1, 9))
+            plen = int(rng.integers(1, max_plen))
             n = int(rng.integers(1, 20))
             prompt = rng.integers(0, 250, size=(plen,)).tolist()
             r = eng.submit(prompt, n)
