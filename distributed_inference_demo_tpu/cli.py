@@ -300,22 +300,43 @@ def cmd_serve(args) -> int:
             return 1
         cfg = get_model_config(args.model)
         if args.vision_preset == "llava15":
+            # the CLIP-ViT-L/14-336 geometry LLaVA-1.5 ships, faithful:
+            # class token, pre-layernorm, projection biases, quick_gelu,
+            # penultimate-layer feature select — HF CLIP/LLaVA vision
+            # checkpoints load via --vision-checkpoint without
+            # reinterpretation
             vcfg = VisionConfig(image_size=336, patch_size=14,
                                 hidden_size=1024, num_layers=24,
                                 num_heads=16, intermediate_size=4096,
-                                dtype_name="bfloat16")
+                                dtype_name="bfloat16", clip_arch=True,
+                                feature_layer=-2, hidden_act="quick_gelu")
+        elif args.vision_preset == "clip-test":
+            # tiny faithful tower for tests/drives (same arch flags as
+            # llava15, checkpoint-loadable at toy scale)
+            vcfg = VisionConfig(image_size=28, patch_size=14,
+                                hidden_size=32, num_layers=3,
+                                num_heads=4, intermediate_size=64,
+                                dtype_name="float32", clip_arch=True,
+                                feature_layer=-2, hidden_act="quick_gelu")
         else:     # "small": a CLIP-base-like tower for modest decoders
             vcfg = VisionConfig(image_size=224, patch_size=14,
                                 hidden_size=256, num_layers=6,
                                 num_heads=8, intermediate_size=1024,
                                 dtype_name="bfloat16")
         params = _load_full_params(args, cfg)
-        # vision weights are random-init (no ViT checkpoint format is
-        # wired yet); the geometry and serving surface are real.  Seeded
-        # from --weights-seed like every other weight init, so the same
-        # seed reproduces the model regardless of the sampling --seed
-        vparams = init_vision_params(_jax.random.PRNGKey(args.weights_seed),
-                                     vcfg, cfg.hidden_size)
+        if getattr(args, "vision_checkpoint", ""):
+            from .models.loader import load_vision_params
+            vparams = load_vision_params(args.vision_checkpoint, vcfg,
+                                         cfg.hidden_size,
+                                         seed=args.weights_seed)
+        else:
+            # without a checkpoint the tower is seeded random init; the
+            # geometry and serving surface are real.  Seeded from
+            # --weights-seed like every other weight init, so the same
+            # seed reproduces the model regardless of the sampling --seed
+            vparams = init_vision_params(
+                _jax.random.PRNGKey(args.weights_seed), vcfg,
+                cfg.hidden_size)
         backend = MultimodalBackend(MultimodalEngine(
             cfg, params, vcfg, vparams, max_seq=args.max_seq,
             sampling=_sampling_from_args(args),
@@ -1016,9 +1037,17 @@ def main(argv=None) -> int:
                         "an optional 'image' field ([H][W][C] floats); "
                         "text-only requests serve unchanged")
     s.add_argument("--vision-preset", default="small",
-                   choices=["small", "llava15"],
+                   choices=["small", "llava15", "clip-test"],
                    help="ViT tower geometry: small = 224px/6 layers, "
-                        "llava15 = 336px/24 layers (weights random-init)")
+                        "llava15 = CLIP-ViT-L/14-336 faithful (class "
+                        "token, pre-layernorm, quick_gelu, penultimate "
+                        "feature select), clip-test = tiny faithful "
+                        "tower for tests")
+    s.add_argument("--vision-checkpoint", default="",
+                   help="safetensors dir with HF CLIP/LLaVA vision tower "
+                        "weights (vision_model.* names; LLaVA's "
+                        "multi_modal_projector loads too when present); "
+                        "empty = seeded random init")
     _add_sp_args(s)
     _add_draft_args(s)
     s.set_defaults(fn=cmd_serve)

@@ -294,3 +294,138 @@ def load_or_init(model_name: str, cfg: ModelConfig,
         return params
     from ..ops.quant import maybe_quantize
     return maybe_quantize(params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# vision tower (CLIP-ViT / LLaVA checkpoints)
+
+def vision_params_from_clip_state_dict(raw: Dict[str, np.ndarray], vcfg,
+                                       decoder_hidden: int,
+                                       seed: int = 0) -> dict:
+    """Map an HF CLIP vision tower (``vision_model.*`` names — standalone
+    ``CLIPVisionModel`` exports and LLaVA bundles alike) onto the stacked
+    ``models/vision.py`` layout.  Requires ``vcfg.clip_arch`` (the class
+    token / pre-layernorm / projection-bias geometry those checkpoints
+    ship).  The LLaVA ``multi_modal_projector`` weights are mapped when
+    present; otherwise the projector stays seed-initialized (a plain CLIP
+    export has no projector into the decoder's space).
+
+    HF stores linears as [out, in]; ours are [in, out] matmul operands,
+    hence the transposes.  The patch "conv" [H, C, p, p] flattens to our
+    patchify order (row-in-patch, col-in-patch, channel) via
+    ``transpose(2, 3, 1, 0)``.
+    """
+    import jax as _jax
+
+    from .vision import init_vision_params
+
+    if not vcfg.clip_arch:
+        raise ValueError(
+            "CLIP checkpoints need VisionConfig(clip_arch=True) — the "
+            "plain tower has no class token / pre-layernorm to load into")
+
+    def get(name):
+        return _get(raw, name, prefixes=(
+            "vision_model.",                         # CLIPVisionModel
+            "vision_tower.vision_model.",            # LLaVA bundles
+            "model.vision_tower.vision_model.", ""))
+
+    dt = vcfg.dtype
+    L = vcfg.num_layers
+    layer_map = {
+        "layer_norm1.weight": ("norm1_w", False),
+        "layer_norm1.bias": ("norm1_b", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.q_proj.bias": ("bq", False),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.k_proj.bias": ("bk", False),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.v_proj.bias": ("bv", False),
+        "self_attn.out_proj.weight": ("wo", True),
+        "self_attn.out_proj.bias": ("bo", False),
+        "layer_norm2.weight": ("norm2_w", False),
+        "layer_norm2.bias": ("norm2_b", False),
+        "mlp.fc1.weight": ("w_up", True),
+        "mlp.fc1.bias": ("b_up", False),
+        "mlp.fc2.weight": ("w_down", True),
+        "mlp.fc2.bias": ("b_down", False),
+    }
+    layers: Dict[str, list] = {}
+    for i in range(L):
+        for hf_name, (ours, transpose) in layer_map.items():
+            w = get(f"encoder.layers.{i}.{hf_name}")
+            layers.setdefault(ours, []).append(w.T if transpose else w)
+    stacked = {k: jnp.asarray(np.stack(v), dt) for k, v in layers.items()}
+
+    patch = get("embeddings.patch_embedding.weight")     # [H, C, p, p]
+    p_ = vcfg.patch_size
+    patch = patch.transpose(2, 3, 1, 0).reshape(
+        p_ * p_ * vcfg.channels, vcfg.hidden_size)
+
+    # projector seed-init as the fallback; checkpoint weights overwrite
+    out = init_vision_params(_jax.random.PRNGKey(seed), vcfg,
+                             decoder_hidden)
+    out.update({
+        "patch_embed": jnp.asarray(patch, dt),
+        "pos_embed": jnp.asarray(
+            get("embeddings.position_embedding.weight"), dt),
+        "cls_embed": jnp.asarray(
+            get("embeddings.class_embedding").reshape(-1), dt),
+        "pre_norm_w": jnp.asarray(get("pre_layrnorm.weight"), dt),
+        "pre_norm_b": jnp.asarray(get("pre_layrnorm.bias"), dt),
+        "post_norm_w": jnp.asarray(get("post_layernorm.weight"), dt),
+        "post_norm_b": jnp.asarray(get("post_layernorm.bias"), dt),
+        "layers": stacked,
+    })
+    for hf_name, ours, transpose in (
+            ("multi_modal_projector.linear_1.weight", "proj_w1", True),
+            ("multi_modal_projector.linear_1.bias", "proj_b1", False),
+            ("multi_modal_projector.linear_2.weight", "proj_w2", True),
+            ("multi_modal_projector.linear_2.bias", "proj_b2", False)):
+        for prefix in ("", "model."):
+            if prefix + hf_name in raw:
+                w = np.asarray(raw[prefix + hf_name])
+                out[ours] = jnp.asarray(w.T if transpose else w, dt)
+                break
+    if out["pos_embed"].shape[0] != vcfg.num_positions:
+        raise ValueError(
+            f"checkpoint position table has {out['pos_embed'].shape[0]} "
+            f"rows; VisionConfig expects {vcfg.num_positions} "
+            f"(image {vcfg.image_size} / patch {vcfg.patch_size} + cls)")
+    # a projector sized for a different decoder must fail HERE with the
+    # shapes spelled out, not as an XLA dot error on the first request
+    want1 = (vcfg.hidden_size, decoder_hidden)
+    want2 = (decoder_hidden, decoder_hidden)
+    if (out["proj_w1"].shape != want1 or out["proj_w2"].shape != want2):
+        raise ValueError(
+            f"checkpoint projector maps {out['proj_w1'].shape} -> "
+            f"{out['proj_w2'].shape}; this tower/decoder pairing needs "
+            f"{want1} -> {want2} (decoder hidden {decoder_hidden})")
+    return out
+
+
+_VISION_KEY_PREFIXES = ("vision_model.", "vision_tower.",
+                        "model.vision_tower.", "multi_modal_projector.",
+                        "model.multi_modal_projector.")
+
+
+def load_vision_params(path: str, vcfg, decoder_hidden: int,
+                       seed: int = 0) -> dict:
+    """CLIP/LLaVA vision weights from a safetensors checkpoint dir.
+
+    Only vision-tower / projector keys are materialized — pointing this
+    at a full LLaVA bundle must not copy the language model's weights
+    into host RAM just to extract the tower (``safe_open`` lists keys
+    lazily)."""
+    from safetensors import safe_open
+    tensors: Dict[str, np.ndarray] = {}
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            for key in f.keys():
+                if key.startswith(_VISION_KEY_PREFIXES):
+                    tensors[key] = f.get_tensor(key)
+    return vision_params_from_clip_state_dict(tensors, vcfg,
+                                              decoder_hidden, seed=seed)

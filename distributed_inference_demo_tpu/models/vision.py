@@ -32,12 +32,26 @@ from .decoder import _dense_init
 @partial(jax.tree_util.register_dataclass, data_fields=[],
          meta_fields=["image_size", "patch_size", "channels", "hidden_size",
                       "num_layers", "num_heads", "intermediate_size",
-                      "norm_eps", "dtype_name"])
+                      "norm_eps", "dtype_name", "clip_arch",
+                      "feature_layer", "hidden_act"])
 @dataclass(frozen=True)
 class VisionConfig:
     """ViT architecture description (defaults ≈ a small CLIP-style tower;
     llava-1.5 scale would be image 336 / patch 14 / hidden 1024 / 24
-    layers)."""
+    layers).
+
+    ``clip_arch``: the CLIP-ViT-faithful variant — a learned class token
+    prepended to the patch sequence (position embeddings gain one row),
+    a layernorm over the embeddings before the encoder (CLIP's
+    ``pre_layrnorm``), and q/k/v/out projection biases.  This is the
+    geometry HF CLIP checkpoints ship, so weights load without
+    reinterpretation (``loader.vision_params_from_clip_state_dict``).
+
+    ``feature_layer``: which encoder output feeds the projector.  -1 =
+    all layers + the final layernorm (the plain tower).  -2 = LLaVA-1.5
+    feature select: stop one encoder layer EARLY, no final layernorm,
+    and (under ``clip_arch``) drop the class token from the features —
+    the projector still sees ``num_patches`` positions either way."""
 
     image_size: int = 64
     patch_size: int = 16
@@ -48,6 +62,18 @@ class VisionConfig:
     intermediate_size: int = 512
     norm_eps: float = 1e-5
     dtype_name: str = "float32"
+    clip_arch: bool = False
+    feature_layer: int = -1
+    hidden_act: str = "gelu"   # "gelu" | "quick_gelu" (original CLIP)
+
+    def __post_init__(self):
+        if self.hidden_act not in ("gelu", "quick_gelu"):
+            raise ValueError(f"unknown hidden_act {self.hidden_act!r}")
+        if self.feature_layer not in (-1, -2):
+            raise ValueError("feature_layer must be -1 (full tower) or "
+                             "-2 (LLaVA-1.5 feature select)")
+        if self.feature_layer == -2 and self.num_layers < 2:
+            raise ValueError("feature_layer=-2 needs >= 2 encoder layers")
 
     @property
     def dtype(self):
@@ -56,6 +82,12 @@ class VisionConfig:
     @property
     def num_patches(self) -> int:
         return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def num_positions(self) -> int:
+        """Rows of the position-embedding table (the class token adds
+        one under ``clip_arch``)."""
+        return self.num_patches + (1 if self.clip_arch else 0)
 
     @property
     def head_dim(self) -> int:
@@ -76,15 +108,19 @@ def init_vision_params(rng: jax.Array, vcfg: VisionConfig,
         "wk": _dense_init(ks[1], (L, H, H), dt),
         "wv": _dense_init(ks[2], (L, H, H), dt),
         "wo": _dense_init(ks[3], (L, H, H), dt),
+        # projection biases: zeros in the plain tower (a no-op there),
+        # loaded from the checkpoint under clip_arch
+        "bq": jnp.zeros((L, H), dt), "bk": jnp.zeros((L, H), dt),
+        "bv": jnp.zeros((L, H), dt), "bo": jnp.zeros((L, H), dt),
         "norm2_w": jnp.ones((L, H), dt), "norm2_b": jnp.zeros((L, H), dt),
         "w_up": _dense_init(ks[4], (L, H, I), dt),
         "b_up": jnp.zeros((L, I), dt),
         "w_down": _dense_init(ks[5], (L, I, H), dt),
         "b_down": jnp.zeros((L, H), dt),
     }
-    return {
+    out = {
         "patch_embed": _dense_init(ks[6], (p * p * c, H), dt),
-        "pos_embed": _dense_init(ks[7], (vcfg.num_patches, H), dt,
+        "pos_embed": _dense_init(ks[7], (vcfg.num_positions, H), dt,
                                  scale=0.02),
         "layers": layers,
         "post_norm_w": jnp.ones((H,), dt),
@@ -95,6 +131,11 @@ def init_vision_params(rng: jax.Array, vcfg: VisionConfig,
         "proj_w2": _dense_init(ks[9], (decoder_hidden, decoder_hidden), dt),
         "proj_b2": jnp.zeros((decoder_hidden,), dt),
     }
+    if vcfg.clip_arch:
+        out["cls_embed"] = _dense_init(ks[10], (H,), dt, scale=0.02)
+        out["pre_norm_w"] = jnp.ones((H,), dt)
+        out["pre_norm_b"] = jnp.zeros((H,), dt)
+    return out
 
 
 def _patchify(images: jnp.ndarray, vcfg: VisionConfig) -> jnp.ndarray:
@@ -111,33 +152,60 @@ def _encoder_layer(vcfg: VisionConfig, lp: dict, x: jnp.ndarray):
     b, s, H = x.shape
     nh, hd = vcfg.num_heads, vcfg.head_dim
     h = layer_norm(x, lp["norm1_w"], lp["norm1_b"], vcfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(b, s, nh, hd)
-    k = (h @ lp["wk"]).reshape(b, s, nh, hd)
-    v = (h @ lp["wv"]).reshape(b, s, nh, hd)
+    q = (h @ lp["wq"] + lp["bq"]).reshape(b, s, nh, hd)
+    k = (h @ lp["wk"] + lp["bk"]).reshape(b, s, nh, hd)
+    v = (h @ lp["wv"] + lp["bv"]).reshape(b, s, nh, hd)
     # bidirectional attention: no mask, f32 softmax
     s_ = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
     s_ = s_ / jnp.sqrt(jnp.asarray(hd, jnp.float32))
     a = jax.nn.softmax(s_, axis=-1).astype(x.dtype)
     o = jnp.einsum("bnqk,bknd->bqnd", a, v).reshape(b, s, nh * hd)
-    x = x + o @ lp["wo"]
+    x = x + o @ lp["wo"] + lp["bo"]
     h = layer_norm(x, lp["norm2_w"], lp["norm2_b"], vcfg.norm_eps)
-    h = jax.nn.gelu((h @ lp["w_up"] + lp["b_up"]).astype(jnp.float32))
+    h = (h @ lp["w_up"] + lp["b_up"]).astype(jnp.float32)
+    # original CLIP towers ship quick_gelu (x * sigmoid(1.702 x)); exact
+    # gelu everywhere else
+    h = (h * jax.nn.sigmoid(1.702 * h) if vcfg.hidden_act == "quick_gelu"
+         else jax.nn.gelu(h))
     return x + (h.astype(x.dtype) @ lp["w_down"] + lp["b_down"]), None
 
 
 def vision_forward(params: dict, vcfg: VisionConfig,
                    images: jnp.ndarray) -> jnp.ndarray:
     """ViT + projector: [b, H, W, C] images -> [b, num_patches, decoder_H]
-    hidden states ready for the decoder's pre-embedded input path."""
+    hidden states ready for the decoder's pre-embedded input path.
+
+    Under ``clip_arch`` the class token is prepended before the encoder
+    and dropped from the features (LLaVA's "default" select strategy),
+    so the output sequence length is ``num_patches`` regardless of
+    architecture.  ``feature_layer=-2`` skips the LAST encoder layer and
+    the final layernorm entirely (LLaVA-1.5 reads the penultimate
+    hidden state — HF ``hidden_states[-2]``)."""
     x = _patchify(images.astype(vcfg.dtype), vcfg)
-    x = x @ params["patch_embed"] + params["pos_embed"][None]
+    x = x @ params["patch_embed"]
+    if vcfg.clip_arch:
+        cls = jnp.broadcast_to(params["cls_embed"],
+                               (x.shape[0], 1, vcfg.hidden_size))
+        x = jnp.concatenate([cls.astype(x.dtype), x], axis=1)
+    x = x + params["pos_embed"][None]
+    if vcfg.clip_arch:
+        x = layer_norm(x, params["pre_norm_w"], params["pre_norm_b"],
+                       vcfg.norm_eps)
 
     def body(x, lp):
         return _encoder_layer(vcfg, lp, x)
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
-    x = layer_norm(x, params["post_norm_w"], params["post_norm_b"],
-                   vcfg.norm_eps)
+    layers = params["layers"]
+    if vcfg.feature_layer == -2:
+        # run all but the last encoder layer; its weights stay loaded
+        # (checkpoint-faithful) but never execute
+        layers = jax.tree.map(lambda a: a[:-1], layers)
+    x, _ = jax.lax.scan(body, x, layers)
+    if vcfg.feature_layer == -1:
+        x = layer_norm(x, params["post_norm_w"], params["post_norm_b"],
+                       vcfg.norm_eps)
+    if vcfg.clip_arch:
+        x = x[:, 1:]                   # drop the class token's feature
     h = jax.nn.gelu((x @ params["proj_w1"] + params["proj_b1"]
                      ).astype(jnp.float32)).astype(x.dtype)
     return h @ params["proj_w2"] + params["proj_b2"]

@@ -174,3 +174,58 @@ def test_checkpoint_to_serving_e2e(name, tmp_path):
     assert rc == 0
     got = _json.loads(buf.getvalue())["tokens"][0]
     assert got == want
+
+
+# ---------------------------------------------------------------------------
+# vision tower vs HF CLIPVisionModel (the LLaVA stage-0 geometry)
+
+def _tiny_clip():
+    from distributed_inference_demo_tpu.models.vision import VisionConfig
+    vcfg = VisionConfig(image_size=28, patch_size=14, hidden_size=32,
+                        num_layers=3, num_heads=4, intermediate_size=64,
+                        dtype_name="float32", clip_arch=True,
+                        feature_layer=-2, hidden_act="quick_gelu")
+    hf_cfg = transformers.CLIPVisionConfig(
+        image_size=28, patch_size=14, hidden_size=32,
+        num_hidden_layers=3, num_attention_heads=4, intermediate_size=64,
+        hidden_act="quick_gelu", layer_norm_eps=vcfg.norm_eps)
+    model = transformers.CLIPVisionModel(hf_cfg).float().eval()
+    return vcfg, model
+
+
+def test_vision_tower_matches_clip():
+    """clip_arch + feature_layer=-2 reproduces HF hidden_states[-2] minus
+    the class token — the exact feature LLaVA-1.5 projects.  The weights
+    travel through the checkpoint mapper, so this also pins the state
+    dict name/transpose mapping.  The (seed-initialized) projector is
+    applied to the HF features with the same jnp math, so any feature
+    mismatch surfaces as an output mismatch."""
+    from distributed_inference_demo_tpu.models.loader import (
+        vision_params_from_clip_state_dict)
+    from distributed_inference_demo_tpu.models.vision import vision_forward
+
+    vcfg, model = _tiny_clip()
+    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    params = vision_params_from_clip_state_dict(sd, vcfg, decoder_hidden=16)
+    rs = np.random.RandomState(0)
+    pixels = rs.randn(2, 28, 28, 3).astype(np.float32)
+    with torch.no_grad():
+        hf = model(pixel_values=torch.from_numpy(
+            pixels.transpose(0, 3, 1, 2)), output_hidden_states=True)
+    want = hf.hidden_states[-2][:, 1:].numpy()          # drop cls
+
+    got = np.asarray(vision_forward(params, vcfg, jnp.asarray(pixels)))
+    h = jnp.asarray(want) @ params["proj_w1"] + params["proj_b1"]
+    h = jax.nn.gelu(h.astype(jnp.float32)).astype(jnp.float32)
+    expected = np.asarray(h @ params["proj_w2"] + params["proj_b2"])
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+def test_vision_clip_rejects_plain_tower():
+    from distributed_inference_demo_tpu.models.loader import (
+        vision_params_from_clip_state_dict)
+    from distributed_inference_demo_tpu.models.vision import VisionConfig
+    vcfg = VisionConfig(image_size=28, patch_size=14, hidden_size=32,
+                        num_layers=2, num_heads=4, intermediate_size=64)
+    with pytest.raises(ValueError, match="clip_arch"):
+        vision_params_from_clip_state_dict({}, vcfg, decoder_hidden=16)
