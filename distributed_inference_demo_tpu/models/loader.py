@@ -43,8 +43,11 @@ _LLAMA_LAYER_MAP = {
 }
 
 
-def load_safetensors_dir(path: str) -> Dict[str, np.ndarray]:
-    """Read all *.safetensors files in a checkpoint directory."""
+def load_safetensors_dir(path: str, key_filter=None) -> Dict[str, np.ndarray]:
+    """Read *.safetensors files in a checkpoint directory.  With a
+    ``key_filter`` predicate only matching tensors are materialized
+    (``safe_open`` lists keys lazily — a caller extracting one submodule
+    from a large bundle never copies the rest into host RAM)."""
     from safetensors import safe_open
     tensors: Dict[str, np.ndarray] = {}
     files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
@@ -53,7 +56,8 @@ def load_safetensors_dir(path: str) -> Dict[str, np.ndarray]:
     for fname in files:
         with safe_open(os.path.join(path, fname), framework="np") as f:
             for key in f.keys():
-                tensors[key] = f.get_tensor(key)
+                if key_filter is None or key_filter(key):
+                    tensors[key] = f.get_tensor(key)
     return tensors
 
 
@@ -415,17 +419,8 @@ def load_vision_params(path: str, vcfg, decoder_hidden: int,
 
     Only vision-tower / projector keys are materialized — pointing this
     at a full LLaVA bundle must not copy the language model's weights
-    into host RAM just to extract the tower (``safe_open`` lists keys
-    lazily)."""
-    from safetensors import safe_open
-    tensors: Dict[str, np.ndarray] = {}
-    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
-    if not files:
-        raise FileNotFoundError(f"no .safetensors files under {path}")
-    for fname in files:
-        with safe_open(os.path.join(path, fname), framework="np") as f:
-            for key in f.keys():
-                if key.startswith(_VISION_KEY_PREFIXES):
-                    tensors[key] = f.get_tensor(key)
+    into host RAM just to extract the tower."""
+    tensors = load_safetensors_dir(
+        path, key_filter=lambda k: k.startswith(_VISION_KEY_PREFIXES))
     return vision_params_from_clip_state_dict(tensors, vcfg,
                                               decoder_hidden, seed=seed)

@@ -298,21 +298,57 @@ class InferenceHTTPServer:
                     self.wfile.write(f"{len(data):x}\r\n".encode())
                     self.wfile.write(data + b"\r\n")
 
+                # INCREMENTAL detokenization state, per row: the "text"
+                # field carries the delta of the FULL-sequence decode
+                # (per-token decode garbles multi-token UTF-8 and drops
+                # sentencepiece inter-token spaces); a trailing U+FFFD is
+                # held back until its continuation bytes arrive
+                row_toks: dict = {}
+                row_emitted: dict = {}
+
+                def row_text(r, tok):
+                    row_toks.setdefault(r, []).append(int(tok))
+                    full = outer.tokenizer.decode(row_toks[r])
+                    safe = full
+                    while safe.endswith("�"):
+                        safe = safe[:-1]
+                    piece = safe[len(row_emitted.get(r, "")):]
+                    row_emitted[r] = safe
+                    return piece
+
                 def emit(i, item):
                     toks, lps = item if logprobs else (item, None)
                     line = {"step": i, "tokens": np.asarray(toks).tolist()}
                     if lps is not None:
                         line["logprobs"] = _round_lps(np.asarray(lps))
                     if outer.tokenizer is not None:
-                        line["text"] = [outer.tokenizer.decode([t])
-                                        for t in np.asarray(toks).tolist()]
+                        line["text"] = [row_text(r, t) for r, t in
+                                        enumerate(np.asarray(toks).tolist())]
                     chunk((json.dumps(line) + "\n").encode("utf-8"))
 
+                n_steps = 0
                 try:
                     if first is not None:
                         emit(0, first)
+                        n_steps = 1
                         for i, item in enumerate(gen, start=1):
                             emit(i, item)
+                            n_steps = i + 1
+                    if outer.tokenizer is not None and row_toks:
+                        # flush text held back by the U+FFFD guard: a
+                        # stream ending on a split (or genuinely
+                        # replacement-decoding) token must not silently
+                        # drop its final characters
+                        rows = max(row_toks) + 1
+                        rem = []
+                        for r in range(rows):
+                            full = outer.tokenizer.decode(
+                                row_toks.get(r, []))
+                            rem.append(full[len(row_emitted.get(r, "")):])
+                        if any(rem):
+                            chunk((json.dumps(
+                                {"step": n_steps, "tokens": [],
+                                 "text": rem}) + "\n").encode("utf-8"))
                 except OSError:
                     return      # client went away; the socket is dead
                 except Exception as e:

@@ -477,3 +477,34 @@ def test_http_batching_with_prompt_lookup(http_server):
     finally:
         server.shutdown()
         backend.close()
+
+
+def test_chat_streaming_detok_holds_back_split_utf8(monkeypatch):
+    """Incremental detokenization: a multi-byte UTF-8 char split across
+    two tokens renders once, complete — never as replacement chars."""
+    import io
+    from contextlib import redirect_stdout
+
+    class FakeTok:
+        def encode(self, text):
+            return [1]
+
+        def decode(self, ids, skip_special=True):
+            frag = {1: b"a", 2: b"\xc3", 3: b"\xa9"}   # 2+3 = "é"
+            return b"".join(frag[int(i)] for i in ids).decode(
+                "utf-8", errors="replace")
+
+    def fake_stream(host, port, payload):
+        yield {"step": 0, "tokens": [2]}
+        yield {"step": 1, "tokens": [3]}
+
+    monkeypatch.setattr(cli, "_load_tokenizer", lambda p: FakeTok())
+    monkeypatch.setattr(cli, "stream_generate", fake_stream)
+    monkeypatch.setattr(cli.sys, "stdin", io.StringIO("hi\n/quit\n"))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["chat", "--url", "http://127.0.0.1:1",
+                       "--tokenizer", "fake"])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "é" in out and "�" not in out

@@ -130,10 +130,13 @@ def test_text_streaming_over_pipeline(served_pipeline):
     lines = [json.loads(l) for l in data.decode().strip().splitlines()]
     ids = tok.encode("hello world")
     want = engine.generate(np.asarray([ids], np.int32), 4).tokens
-    assert [l["tokens"][0] for l in lines] == want[0].tolist()
-    # per-step text chunks decode the same ids
-    assert [l["text"][0] for l in lines] == [tok.decode([t])
-                                             for t in want[0].tolist()]
+    token_lines = [l for l in lines if l["tokens"]]
+    assert [l["tokens"][0] for l in token_lines] == want[0].tolist()
+    # streamed text is INCREMENTAL: the concatenated deltas equal the
+    # full-sequence decode (per-token decode would garble multi-token
+    # UTF-8 and drop sentencepiece inter-token spaces)
+    assert "".join(l["text"][0] for l in lines) == \
+        tok.decode(want[0].tolist())
 
 
 def test_chat_repl_text_against_pipeline(served_pipeline, monkeypatch,
@@ -157,5 +160,6 @@ def test_chat_repl_text_against_pipeline(served_pipeline, monkeypatch,
     assert rc == 0
     ids = tok.encode("hello world")
     want = engine.generate(np.asarray([ids], np.int32), 4).tokens
-    rendered = "".join(tok.decode([t]) for t in want[0].tolist())
-    assert rendered in buf.getvalue()
+    # incremental detokenization renders the FULL-sequence decode (the
+    # per-token join would drop sentencepiece's inter-token spaces)
+    assert tok.decode(want[0].tolist()) in buf.getvalue()
