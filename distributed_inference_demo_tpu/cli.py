@@ -622,36 +622,24 @@ def cmd_chat(args) -> int:
                 payload["prompt"] = prompt   # server-side tokenizer
 
         try:
-            toks, emitted = [], ""
+            # incremental detokenization (tokenizer.StreamDetokenizer —
+            # one owner of the boundary/holdback rules, shared with the
+            # server's streaming "text" field)
+            from .tokenizer import StreamDetokenizer
+            detok = (StreamDetokenizer(tokenizer)
+                     if tokenizer is not None else None)
             for item in stream_generate(host, port, payload):
                 if "text" in item:
                     piece = item["text"][0]
-                elif tokenizer is not None:
-                    # INCREMENTAL detokenization: decode the whole
-                    # sequence and emit the delta.  Per-token decode
-                    # would garble multi-token UTF-8 sequences and drop
-                    # sentencepiece's inter-token spaces.  A trailing
-                    # U+FFFD means a split UTF-8 sequence still waiting
-                    # for its continuation bytes — hold it back.  (The
-                    # re-decode is linear per step; a windowed delta
-                    # would have to re-implement every scheme's boundary
-                    # rules — metaspace strips position-0 spaces — for a
-                    # cost that only matters far past chat lengths.)
-                    toks.append(int(item["tokens"][0]))
-                    full = tokenizer.decode(toks)
-                    while full.endswith("�"):
-                        full = full[:-1]
-                    piece, emitted = full[len(emitted):], full
+                elif detok is not None:
+                    piece = detok.push(int(item["tokens"][0]))
                 else:
                     piece = ("" if item["step"] == 0 else " ") + \
                         str(item["tokens"][0])
                 sys.stdout.write(piece)
                 sys.stdout.flush()
-            if tokenizer is not None and toks:
-                # flush what the U+FFFD holdback kept: the final token
-                # may legitimately decode to a replacement char (or end
-                # mid-sequence) and must still print
-                sys.stdout.write(tokenizer.decode(toks)[len(emitted):])
+            if detok is not None:
+                sys.stdout.write(detok.flush())
                 sys.stdout.flush()
         except (ConnectionError, OSError, RuntimeError,
                 http.client.HTTPException, json.JSONDecodeError) as e:

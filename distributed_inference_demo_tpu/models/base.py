@@ -34,8 +34,9 @@ import jax.numpy as jnp
                       "num_heads", "num_kv_heads", "intermediate_size",
                       "max_seq_len", "rope_theta", "norm_eps", "dtype_name",
                       "tie_embeddings", "use_alibi", "use_rope",
-                      "attn_layernorm", "num_experts", "experts_per_token",
-                      "moe_capacity_factor", "quantization"])
+                      "attn_layernorm", "attn_qkv_bias", "num_experts",
+                      "experts_per_token", "moe_capacity_factor",
+                      "quantization"])
 @dataclass(frozen=True)
 class ModelConfig:
     """Static, hashable architecture description shared by all model families.
@@ -64,6 +65,9 @@ class ModelConfig:
     use_rope: bool = True
     # bloom uses LayerNorm (with bias); llama uses RMSNorm
     attn_layernorm: bool = False
+    # qwen2-style: q/k/v projections carry biases (RMSNorm model, so
+    # independent of attn_layernorm, which implies ALL attention biases)
+    attn_qkv_bias: bool = False
     # MoE (mixtral): 0 experts means dense MLP
     num_experts: int = 0
     experts_per_token: int = 2

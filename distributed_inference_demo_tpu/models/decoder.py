@@ -115,10 +115,11 @@ def init_layer_params(rng: jax.Array, cfg: ModelConfig, num_layers: int,
     if cfg.attn_layernorm:  # bloom: LayerNorm has bias; linears have bias
         p["attn_norm_b"] = jnp.zeros((L, H), dt)
         p["mlp_norm_b"] = jnp.zeros((L, H), dt)
+        p["bo"] = jnp.zeros((L, H), dt)
+    if cfg.attn_layernorm or cfg.attn_qkv_bias:  # + qwen2: qkv-only bias
         p["bq"] = jnp.zeros((L, nh * hd), dt)
         p["bk"] = jnp.zeros((L, nkv * hd), dt)
         p["bv"] = jnp.zeros((L, nkv * hd), dt)
-        p["bo"] = jnp.zeros((L, H), dt)
     if cfg.num_experts > 0:  # mixtral MoE
         E = cfg.num_experts
         p["router"] = _dense_init(keys[4], (L, H, E), dt)
@@ -349,7 +350,7 @@ def _layer(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
     q = dense(h, lp["wq"], "bsh,hd->bsd")
     k = dense(h, lp["wk"], "bsh,hd->bsd")
     v = dense(h, lp["wv"], "bsh,hd->bsd")
-    if cfg.attn_layernorm:
+    if cfg.attn_layernorm or cfg.attn_qkv_bias:
         # bq/bk/bv are column-sharded with their weights under TP
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(b, s, nh, hd)

@@ -73,11 +73,18 @@ def llama_params_from_state_dict(raw: Dict[str, np.ndarray],
                                  cfg: ModelConfig) -> StageParams:
     """Map a llama-family HF state dict (``model.layers.{i}.*`` names) onto
     the stacked layout.  HF stores linears as [out, in]; ours are [in, out]
-    einsum operands, hence the transposes."""
+    einsum operands, hence the transposes.  Also serves qwen2 (identical
+    names + ``self_attn.{q,k,v}_proj.bias`` under ``attn_qkv_bias``)."""
     dt = cfg.dtype
+    layer_map = dict(_LLAMA_LAYER_MAP)
+    if cfg.attn_qkv_bias:
+        layer_map.update({
+            "self_attn.q_proj.bias": ("bq", False),
+            "self_attn.k_proj.bias": ("bk", False),
+            "self_attn.v_proj.bias": ("bv", False)})
     layers: Dict[str, list] = {}
     for i in range(cfg.num_layers):
-        for hf_name, (ours, transpose) in _LLAMA_LAYER_MAP.items():
+        for hf_name, (ours, transpose) in layer_map.items():
             w = _get(raw, f"layers.{i}.{hf_name}")
             if transpose:
                 w = w.T
@@ -189,6 +196,7 @@ def mixtral_params_from_state_dict(raw: Dict[str, np.ndarray],
 
 _SD_MAPPERS = {
     "llama": llama_params_from_state_dict,
+    "qwen2": llama_params_from_state_dict,   # same names + qkv biases
     "bloom": bloom_params_from_state_dict,
     "mixtral": mixtral_params_from_state_dict,
 }

@@ -36,6 +36,19 @@ MODEL_REGISTRY = {
         family="llama", vocab_size=128256, hidden_size=4096, num_layers=32,
         num_heads=32, num_kv_heads=8, intermediate_size=14336,
         max_seq_len=8192, rope_theta=500000.0),
+    # --- qwen2 family (llama block + qkv biases; beyond-reference
+    # breadth: the catalog pattern extends to new HF families without a
+    # new decoder) ---
+    "qwen2.5-7b": ModelConfig(
+        family="qwen2", vocab_size=152064, hidden_size=3584, num_layers=28,
+        num_heads=28, num_kv_heads=4, intermediate_size=18944,
+        max_seq_len=32768, rope_theta=1000000.0, norm_eps=1e-6,
+        attn_qkv_bias=True),
+    "qwen2.5-0.5b": ModelConfig(
+        family="qwen2", vocab_size=151936, hidden_size=896, num_layers=24,
+        num_heads=14, num_kv_heads=2, intermediate_size=4864,
+        max_seq_len=32768, rope_theta=1000000.0, norm_eps=1e-6,
+        attn_qkv_bias=True, tie_embeddings=True),
     # --- mixtral MoE (BASELINE.json config 4) ---
     "mixtral-8x7b": ModelConfig(
         family="mixtral", vocab_size=32000, hidden_size=4096, num_layers=32,
@@ -61,6 +74,10 @@ MODEL_REGISTRY = {
         family="llama", vocab_size=256, hidden_size=64, num_layers=4,
         num_heads=4, num_kv_heads=2, intermediate_size=128, max_seq_len=128,
         dtype_name="float32"),
+    "qwen2-test": ModelConfig(
+        family="qwen2", vocab_size=256, hidden_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=2, intermediate_size=128, max_seq_len=128,
+        attn_qkv_bias=True, dtype_name="float32"),
     "bloom-test": ModelConfig(
         family="bloom", vocab_size=256, hidden_size=64, num_layers=4,
         num_heads=4, num_kv_heads=4, intermediate_size=256, max_seq_len=128,

@@ -298,23 +298,17 @@ class InferenceHTTPServer:
                     self.wfile.write(f"{len(data):x}\r\n".encode())
                     self.wfile.write(data + b"\r\n")
 
-                # INCREMENTAL detokenization state, per row: the "text"
-                # field carries the delta of the FULL-sequence decode
-                # (per-token decode garbles multi-token UTF-8 and drops
-                # sentencepiece inter-token spaces); a trailing U+FFFD is
-                # held back until its continuation bytes arrive
-                row_toks: dict = {}
-                row_emitted: dict = {}
+                # incremental detokenization, per row: the "text" field
+                # carries printable deltas (tokenizer.StreamDetokenizer —
+                # one owner of the boundary/holdback rules, shared with
+                # the chat REPL)
+                from ..tokenizer import StreamDetokenizer
+                detoks: dict = {}
 
                 def row_text(r, tok):
-                    row_toks.setdefault(r, []).append(int(tok))
-                    full = outer.tokenizer.decode(row_toks[r])
-                    safe = full
-                    while safe.endswith("�"):
-                        safe = safe[:-1]
-                    piece = safe[len(row_emitted.get(r, "")):]
-                    row_emitted[r] = safe
-                    return piece
+                    if r not in detoks:
+                        detoks[r] = StreamDetokenizer(outer.tokenizer)
+                    return detoks[r].push(tok)
 
                 def emit(i, item):
                     toks, lps = item if logprobs else (item, None)
@@ -334,17 +328,13 @@ class InferenceHTTPServer:
                         for i, item in enumerate(gen, start=1):
                             emit(i, item)
                             n_steps = i + 1
-                    if outer.tokenizer is not None and row_toks:
+                    if outer.tokenizer is not None and detoks:
                         # flush text held back by the U+FFFD guard: a
                         # stream ending on a split (or genuinely
                         # replacement-decoding) token must not silently
                         # drop its final characters
-                        rows = max(row_toks) + 1
-                        rem = []
-                        for r in range(rows):
-                            full = outer.tokenizer.decode(
-                                row_toks.get(r, []))
-                            rem.append(full[len(row_emitted.get(r, "")):])
+                        rem = [detoks[r].flush() if r in detoks else ""
+                               for r in range(max(detoks) + 1)]
                         if any(rem):
                             chunk((json.dumps(
                                 {"step": n_steps, "tokens": [],

@@ -583,3 +583,41 @@ class _HFAdapter:
 
     def vocab_size(self) -> int:
         return self._tok.get_vocab_size()
+
+
+class StreamDetokenizer:
+    """Incremental detokenization for streaming surfaces — ONE owner of
+    the boundary rules (the HTTP server's per-row "text" deltas and the
+    chat REPL both use it; a per-token ``decode([t])`` would garble
+    multi-token UTF-8 and drop sentencepiece inter-token spaces).
+
+    ``push(tok)`` returns the newly printable delta of the full-sequence
+    decode, holding back a trailing U+FFFD (a split UTF-8 sequence still
+    waiting for its continuation bytes).  ``flush()`` returns whatever
+    the holdback kept once the stream ends — the final token may
+    legitimately decode to a replacement char.  The re-decode is linear
+    per step; a windowed delta would have to re-implement every scheme's
+    boundary rules (metaspace strips position-0 spaces) for a cost that
+    only matters far past chat lengths."""
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._ids = []
+        self._emitted = ""
+
+    def push(self, tok: int) -> str:
+        self._ids.append(int(tok))
+        full = self._tok.decode(self._ids)
+        while full.endswith("�"):
+            full = full[:-1]
+        piece = full[len(self._emitted):]
+        self._emitted = full
+        return piece
+
+    def flush(self) -> str:
+        if not self._ids:
+            return ""
+        full = self._tok.decode(self._ids)
+        piece = full[len(self._emitted):]
+        self._emitted = full
+        return piece

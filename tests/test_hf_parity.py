@@ -30,7 +30,18 @@ from distributed_inference_demo_tpu.models.loader import (  # noqa: E402
 def _hf_model(name):
     """Build the HF twin of one of our tiny test configs."""
     cfg = get_model_config(name)
-    if cfg.family == "llama":
+    if cfg.family in ("llama", "qwen2"):
+        if cfg.family == "qwen2":
+            hf_cfg = transformers.Qwen2Config(
+                vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+                num_hidden_layers=cfg.num_layers,
+                num_attention_heads=cfg.num_heads,
+                num_key_value_heads=cfg.num_kv_heads,
+                intermediate_size=cfg.intermediate_size,
+                max_position_embeddings=cfg.max_seq_len,
+                rms_norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
+                tie_word_embeddings=cfg.tie_embeddings)
+            return cfg, transformers.Qwen2ForCausalLM(hf_cfg).float().eval()
         hf_cfg = transformers.LlamaConfig(
             vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
             num_hidden_layers=cfg.num_layers,
@@ -80,7 +91,7 @@ def _hf_logits(model, ids):
 
 PROMPT = np.array([[5, 17, 42, 7, 99, 3, 12, 56, 200, 131]], dtype=np.int32)
 
-FAMILIES = ["llama-test", "bloom-test", "mixtral-test"]
+FAMILIES = ["llama-test", "qwen2-test", "bloom-test", "mixtral-test"]
 
 
 @pytest.mark.parametrize("name", FAMILIES)
