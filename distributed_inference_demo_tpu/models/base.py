@@ -36,7 +36,8 @@ import jax.numpy as jnp
                       "tie_embeddings", "use_alibi", "use_rope",
                       "attn_layernorm", "attn_qkv_bias", "num_experts",
                       "experts_per_token", "moe_capacity_factor",
-                      "quantization"])
+                      "quantization", "head_dim_override", "embed_scale",
+                      "mlp_act"])
 @dataclass(frozen=True)
 class ModelConfig:
     """Static, hashable architecture description shared by all model families.
@@ -68,6 +69,11 @@ class ModelConfig:
     # qwen2-style: q/k/v projections carry biases (RMSNorm model, so
     # independent of attn_layernorm, which implies ALL attention biases)
     attn_qkv_bias: bool = False
+    # gemma: head_dim decoupled from hidden/heads (0 = derive), embedding
+    # scaled by sqrt(hidden), and a non-silu gated-MLP activation
+    head_dim_override: int = 0
+    embed_scale: bool = False
+    mlp_act: str = "silu"      # "silu" | "gelu_tanh" (gemma)
     # MoE (mixtral): 0 experts means dense MLP
     num_experts: int = 0
     experts_per_token: int = 2
@@ -83,7 +89,7 @@ class ModelConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.hidden_size // self.num_heads
+        return self.head_dim_override or self.hidden_size // self.num_heads
 
     def replace(self, **kw) -> "ModelConfig":
         return dataclasses.replace(self, **kw)

@@ -177,6 +177,11 @@ def embed_tokens(params: StageParams, cfg: ModelConfig,
     lookup + bloom's embedding LayerNorm).  The single source shared by the
     ids path of ``stage_forward`` and multimodal prefix construction."""
     x = params.embed["tokens"][ids]
+    if cfg.embed_scale:
+        # gemma scales embeddings by sqrt(H), with the normalizer cast to
+        # the activation dtype FIRST (HF semantics — the rounding is part
+        # of the checkpoint's numerics)
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
     if "norm_w" in params.embed:  # bloom embedding LayerNorm
         x = layer_norm(x, params.embed["norm_w"], params.embed["norm_b"],
                        cfg.norm_eps)
@@ -205,7 +210,10 @@ def _mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
         return out + lp["b_down"]
     gate = dense(x, lp["w_gate"], "bsh,hi->bsi")
     up = dense(x, lp["w_up"], "bsh,hi->bsi")
-    h = (jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)).astype(x.dtype)
+    gate = gate.astype(jnp.float32)
+    act = (jax.nn.gelu(gate, approximate=True)
+           if cfg.mlp_act == "gelu_tanh" else jax.nn.silu(gate))
+    h = (act * up.astype(jnp.float32)).astype(x.dtype)
     out = dense(h, lp["w_down"], "bsi,ih->bsh")
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)

@@ -194,9 +194,30 @@ def mixtral_params_from_state_dict(raw: Dict[str, np.ndarray],
                        lm_head=lm_head)
 
 
+def gemma_params_from_state_dict(raw: Dict[str, np.ndarray],
+                                 cfg: ModelConfig) -> StageParams:
+    """Gemma: llama names end to end, but every RMSNorm applies
+    ``(1 + w)`` — fold the +1 into the stored weights HERE so the
+    decoder keeps one rms_norm rule for all families (a random-init
+    gemma's ones-init norms equal HF w=0, the checkpoint identity)."""
+    p = llama_params_from_state_dict(raw, cfg)
+    layers = dict(p.layers)
+    # fold in FLOAT32 and keep the folded vectors f32: HF computes
+    # (1 + w.float()) exactly, and a bf16 re-round of the sum would lose
+    # mantissa bits on every norm weight (norm vectors are tiny — the
+    # f32 residency costs nothing; rms_norm consumes any dtype)
+    for key in ("attn_norm_w", "mlp_norm_w"):
+        layers[key] = layers[key].astype(jnp.float32) + 1.0
+    final_norm = dict(p.final_norm)
+    final_norm["w"] = final_norm["w"].astype(jnp.float32) + 1.0
+    return StageParams(layers=layers, embed=p.embed,
+                       final_norm=final_norm, lm_head=p.lm_head)
+
+
 _SD_MAPPERS = {
     "llama": llama_params_from_state_dict,
     "qwen2": llama_params_from_state_dict,   # same names + qkv biases
+    "gemma": gemma_params_from_state_dict,
     "bloom": bloom_params_from_state_dict,
     "mixtral": mixtral_params_from_state_dict,
 }

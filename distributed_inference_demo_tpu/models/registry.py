@@ -49,6 +49,20 @@ MODEL_REGISTRY = {
         num_heads=14, num_kv_heads=2, intermediate_size=4864,
         max_seq_len=32768, rope_theta=1000000.0, norm_eps=1e-6,
         attn_qkv_bias=True, tie_embeddings=True),
+    # --- gemma family (RMSNorm(1+w) folded at load, sqrt(H) embedding
+    # scale, GeGLU, decoupled head_dim; gemma-2b is MQA) ---
+    "gemma-7b": ModelConfig(
+        family="gemma", vocab_size=256000, hidden_size=3072, num_layers=28,
+        num_heads=16, num_kv_heads=16, intermediate_size=24576,
+        max_seq_len=8192, rope_theta=10000.0, norm_eps=1e-6,
+        tie_embeddings=True, head_dim_override=256, embed_scale=True,
+        mlp_act="gelu_tanh"),
+    "gemma-2b": ModelConfig(
+        family="gemma", vocab_size=256000, hidden_size=2048, num_layers=18,
+        num_heads=8, num_kv_heads=1, intermediate_size=16384,
+        max_seq_len=8192, rope_theta=10000.0, norm_eps=1e-6,
+        tie_embeddings=True, head_dim_override=256, embed_scale=True,
+        mlp_act="gelu_tanh"),
     # --- mixtral MoE (BASELINE.json config 4) ---
     "mixtral-8x7b": ModelConfig(
         family="mixtral", vocab_size=32000, hidden_size=4096, num_layers=32,
@@ -78,6 +92,11 @@ MODEL_REGISTRY = {
         family="qwen2", vocab_size=256, hidden_size=64, num_layers=4,
         num_heads=4, num_kv_heads=2, intermediate_size=128, max_seq_len=128,
         attn_qkv_bias=True, dtype_name="float32"),
+    "gemma-test": ModelConfig(
+        family="gemma", vocab_size=256, hidden_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=1, intermediate_size=128, max_seq_len=128,
+        tie_embeddings=True, head_dim_override=32, embed_scale=True,
+        mlp_act="gelu_tanh", norm_eps=1e-6, dtype_name="float32"),
     "bloom-test": ModelConfig(
         family="bloom", vocab_size=256, hidden_size=64, num_layers=4,
         num_heads=4, num_kv_heads=4, intermediate_size=256, max_seq_len=128,

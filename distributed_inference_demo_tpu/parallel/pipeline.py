@@ -59,15 +59,13 @@ def _grad_sync_axes(params: StageParams, cfg: ModelConfig, use_tp: bool):
 
 def _embed(params: StageParams, cfg: ModelConfig,
            ids: jnp.ndarray) -> jnp.ndarray:
-    """Token embedding (+ bloom's embedding LayerNorm), shared by the
-    training and generation pipelines; every rank holds the replicated
-    embed table and masks its *use* by rank role."""
-    x = params.embed["tokens"][ids]
-    if cfg.family == "bloom":
-        from ..ops.norms import layer_norm
-        x = layer_norm(x, params.embed["norm_w"], params.embed["norm_b"],
-                       cfg.norm_eps)
-    return x.astype(cfg.dtype)
+    """Token embedding, shared by the training and generation pipelines;
+    every rank holds the replicated embed table and masks its *use* by
+    rank role.  Delegates to ``decoder.embed_tokens`` — the ONE owner of
+    the embedding pipeline (bloom's LayerNorm, gemma's sqrt(H) scale) so
+    the pipeline path cannot drift from single-stage serving."""
+    from ..models.decoder import embed_tokens
+    return embed_tokens(params, cfg, ids).astype(cfg.dtype)
 
 
 def _head(params: StageParams, cfg: ModelConfig, h: jnp.ndarray,

@@ -73,10 +73,14 @@ def model_cost_profile(cfg: ModelConfig, ctx: int = 1024) -> ModelCostProfile:
     wb = _dtype_bytes(cfg)
     act = 2 * h  # bf16 hidden row on the wire per token
 
-    # attention weights: q (h*h), k,v (h * kvh*hd each), o (h*h)
-    attn_params = h * h + 2 * h * kvh * hd + h * h
-    # mlp weights: gated (3 matrices) for llama/mixtral-expert, 2 for bloom
-    gated = cfg.family in ("llama", "mixtral")
+    # attention weights: q (h * nh*hd), k,v (h * kvh*hd each),
+    # o (nh*hd * h) — nh*hd != h when head_dim is decoupled (gemma)
+    qo = h * cfg.num_heads * hd
+    attn_params = qo + 2 * h * kvh * hd + qo
+    # mlp weights: 2 matrices for bloom's dense GELU MLP, 3 for every
+    # gated family (llama/qwen2/gemma SwiGLU-or-GeGLU, mixtral experts)
+    # — mirrors decoder._mlp's branch exactly
+    gated = cfg.family != "bloom"
     mlp_params_dense = (3 if gated else 2) * h * inter
     if cfg.num_experts > 0:
         mlp_params = cfg.num_experts * mlp_params_dense + h * cfg.num_experts

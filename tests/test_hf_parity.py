@@ -53,6 +53,20 @@ def _hf_model(name):
             attention_bias=False, mlp_bias=False,
             tie_word_embeddings=cfg.tie_embeddings)
         model = transformers.LlamaForCausalLM(hf_cfg)
+    elif cfg.family == "gemma":
+        hf_cfg = transformers.GemmaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            num_key_value_heads=cfg.num_kv_heads,
+            intermediate_size=cfg.intermediate_size,
+            head_dim=cfg.head_dim, hidden_act="gelu_pytorch_tanh",
+            hidden_activation="gelu_pytorch_tanh",
+            max_position_embeddings=cfg.max_seq_len,
+            rms_norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
+            tie_word_embeddings=cfg.tie_embeddings,
+            attention_bias=False)
+        model = transformers.GemmaForCausalLM(hf_cfg)
     elif cfg.family == "bloom":
         hf_cfg = transformers.BloomConfig(
             vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
@@ -91,7 +105,8 @@ def _hf_logits(model, ids):
 
 PROMPT = np.array([[5, 17, 42, 7, 99, 3, 12, 56, 200, 131]], dtype=np.int32)
 
-FAMILIES = ["llama-test", "qwen2-test", "bloom-test", "mixtral-test"]
+FAMILIES = ["llama-test", "qwen2-test", "gemma-test", "bloom-test",
+            "mixtral-test"]
 
 
 @pytest.mark.parametrize("name", FAMILIES)

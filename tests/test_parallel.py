@@ -304,3 +304,28 @@ def test_init_multihost_single_process():
     assert out.returncode == 0, out.stderr[-2000:]
     line = [l for l in out.stdout.splitlines() if l.startswith("NDEV")][0]
     assert line.split()[1:] == ["1", "1"] or int(line.split()[1]) >= 1
+
+
+def test_pipeline_generate_gemma_embed_scale(devices):
+    """Regression: the pipeline's embedding path must include gemma's
+    sqrt(H) normalizer (it delegates to decoder.embed_tokens — one owner
+    — so the manual pipeline cannot drift from single-stage serving)."""
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.parallel.pipeline import (
+        make_pipeline_generate_fn)
+    from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+    cfg = get_model_config("gemma-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    greedy = SamplingParams(greedy=True)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 1, 8), 0,
+                             cfg.vocab_size, jnp.int32)
+    engine = InferenceEngine(cfg, params, max_seq=32, sampling=greedy)
+    want = np.stack([engine.generate(np.asarray(ids[m]), 5).tokens
+                     for m in range(2)])
+    mesh = make_mesh(MeshConfig(pp=2), devices[:2])
+    gen = make_pipeline_generate_fn(cfg, mesh, max_seq=32,
+                                    num_new_tokens=5, sampling=greedy)
+    with mesh:
+        got = np.asarray(gen(params, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
