@@ -93,6 +93,10 @@ class Request:
     max_new: int
     tokens: List[int] = field(default_factory=list)
     lps: List[float] = field(default_factory=list)   # logprobs (plain mode)
+    # latency markers (perf_counter seconds), set by submit()/scheduler
+    t_submit: float = 0.0
+    t_first: float = 0.0
+    t_done: float = 0.0
     stream: "queue.Queue" = field(default_factory=queue.Queue)
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
@@ -585,6 +589,12 @@ class ContinuousBatchingEngine:
         # with serviceable requests passing blocked ones
         self._adm: Optional[dict] = None
         self._pending: "deque[Request]" = deque()
+        # completed-request latency reservoirs (seconds), bounded FIFO —
+        # the /stats percentile source (reference analog: the per-stage
+        # timer story, runtime/stats.py)
+        self._lat = {"ttft": deque(maxlen=512), "e2e": deque(maxlen=512),
+                     "per_token": deque(maxlen=512)}
+        self._completed = 0
 
         if self.decode_block > 1:
             # compile BOTH round-count variants now: the non-fused
@@ -643,7 +653,8 @@ class ContinuousBatchingEngine:
             # admission records the first sampled token unconditionally,
             # so a 0-token request would still produce one
             raise ValueError("max_new_tokens must be >= 1")
-        req = Request(prompt=prompt, max_new=max_new_tokens)
+        req = Request(prompt=prompt, max_new=max_new_tokens,
+                      t_submit=time.perf_counter())
         with self._submit_lock:
             if not self._running:
                 raise RuntimeError("engine is closed")
@@ -732,8 +743,22 @@ class ContinuousBatchingEngine:
 
     def stats(self) -> dict:
         """Scheduler counters for the HTTP ``/stats`` surface."""
+        import copy as _copy
+
+        from .stats import _percentile
         out = {"slots": self.max_batch, "steps": self._step_count,
                "prefix_cache": dict(self.prefix_stats)}
+        # completed is the MONOTONIC count; the reservoirs are bounded
+        # (the last 512 samples feed the percentiles).  deque.__copy__ is
+        # atomic under the GIL — plain iteration would race the
+        # scheduler thread's appends and raise "deque mutated".
+        lat = {"completed": self._completed}
+        for name, res in self._lat.items():
+            xs = sorted(_copy.copy(res))   # one sort; _percentile's own
+            if xs:                         # sort is then O(n) on sorted
+                lat[f"{name}_p50_ms"] = round(_percentile(xs, 50) * 1e3, 3)
+                lat[f"{name}_p95_ms"] = round(_percentile(xs, 95) * 1e3, 3)
+        out["latency"] = lat
         if self.prefill_chunk is not None:
             out["chunked_prefill"] = {"chunk": self.prefill_chunk,
                                       **self.chunk_stats}
@@ -752,6 +777,9 @@ class ContinuousBatchingEngine:
         self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
         self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
         self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
+        self._completed = 0
+        for res in self._lat.values():
+            res.clear()
 
     def close(self):
         self._running = False
@@ -996,9 +1024,18 @@ class ContinuousBatchingEngine:
         req.tokens.append(tok)
         if lp is not None:
             req.lps.append(lp)
+        if len(req.tokens) == 1:
+            req.t_first = time.perf_counter()
         req.stream.put(tok)
         hit_eos = self.eos_id is not None and tok == self.eos_id
         if len(req.tokens) >= req.max_new or hit_eos:
+            req.t_done = time.perf_counter()
+            self._completed += 1
+            self._lat["ttft"].append(req.t_first - req.t_submit)
+            self._lat["e2e"].append(req.t_done - req.t_submit)
+            if len(req.tokens) > 1:
+                self._lat["per_token"].append(
+                    (req.t_done - req.t_first) / (len(req.tokens) - 1))
             req.stream.put(None)
             req.done.set()
             self._slots[slot] = None

@@ -1028,3 +1028,22 @@ def test_logprobs_empty_in_spec_mode(params, draft_params):
         req = eng.submit([3, 14, 15], 5)
         req.wait(timeout=300)
         assert req.lps == [] and len(req.tokens) == 5
+
+
+def test_stats_latency_percentiles(params):
+    """/stats reports TTFT / e2e / per-token latency percentiles from
+    completed requests (the reference's self-measured timer story at the
+    batching surface)."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY,
+                                  prompt_buckets=(16,)) as eng:
+        for _ in range(3):
+            eng.submit([5, 4, 3], 4).wait(timeout=300)
+        lat = eng.stats()["latency"]
+        assert lat["completed"] == 3
+        for k in ("ttft_p50_ms", "ttft_p95_ms", "e2e_p50_ms",
+                  "e2e_p95_ms", "per_token_p50_ms", "per_token_p95_ms"):
+            assert lat[k] > 0
+        assert lat["ttft_p50_ms"] <= lat["e2e_p50_ms"]
+        eng.reset_stats()
+        assert eng.stats()["latency"]["completed"] == 0
