@@ -1047,3 +1047,39 @@ def test_stats_latency_percentiles(params):
         assert lat["ttft_p50_ms"] <= lat["e2e_p50_ms"]
         eng.reset_stats()
         assert eng.stats()["latency"]["completed"] == 0
+
+
+def test_everything_on_composition(params, draft_params, oracle):
+    """The maximal serving stack in ONE engine: tensor parallelism x
+    fp8 KV cache x speculative decoding x chunked (resumable) admission
+    x fused decode blocks x prefix cache — greedy output bit-identical
+    to the plain engine with the same cache dtype.  Every pairwise
+    composition has its own test; this pins the full product."""
+    from distributed_inference_demo_tpu.parallel import MeshConfig, make_mesh
+    from distributed_inference_demo_tpu.runtime.engine import (
+        shard_engine_params)
+
+    oracle_fp8 = InferenceEngine(CFG, params, max_seq=96, sampling=GREEDY,
+                                 kv_cache_dtype="float8_e4m3fn")
+    mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    sharded = shard_engine_params(params, CFG, mesh)
+    dsharded = shard_engine_params(draft_params, DRAFT_CFG, mesh)
+    long_prompt = list(range(2, 21))               # 19 tokens, C=8 -> 2+tail
+    with ContinuousBatchingEngine(
+            CFG, sharded, max_seq=96, max_batch=2, sampling=GREEDY,
+            prompt_buckets=(16, 64), mesh=mesh,
+            kv_cache_dtype="float8_e4m3fn",
+            draft_cfg=DRAFT_CFG, draft_params=dsharded, num_draft=3,
+            decode_block=2, prefill_chunk=8, min_prefix_len=4) as eng:
+        a = eng.submit([5, 4, 3, 2], 12)
+        b = eng.submit(long_prompt, 8)
+        np.testing.assert_array_equal(
+            a.wait(timeout=600),
+            oracle_fp8.generate(np.asarray([[5, 4, 3, 2]]), 12).tokens[0])
+        np.testing.assert_array_equal(
+            b.wait(timeout=600),
+            oracle_fp8.generate(np.asarray([long_prompt]), 8).tokens[0])
+        st = eng.stats()
+        assert st["chunked_prefill"]["chunks"] == 2
+        assert st["speculative"]["rounds"] >= 1
+        assert st["latency"]["completed"] == 2
