@@ -205,6 +205,37 @@ class InferenceHTTPServer:
                         self._json(501, {"error": "backend does not "
                                                   "support image input"})
                         return
+                stop = req.get("stop")
+                if stop is not None:
+                    if isinstance(stop, str):
+                        stop = [stop]
+                    if (not isinstance(stop, list) or not stop
+                            or not all(isinstance(s, str) and s
+                                       for s in stop)):
+                        self._json(400, {
+                            "error": "stop must be a non-empty string "
+                                     "or list of non-empty strings"})
+                        return
+                    # honor-or-reject: stop strings need server-side
+                    # text, and compose with the plain blocking path
+                    unsupported = [w for w, on in [
+                        ("a server-side tokenizer (none attached)",
+                         outer.tokenizer is None),
+                        ("stream", bool(req.get("stream"))),
+                        ("logprobs", bool(req.get("logprobs"))),
+                        ("image", image is not None)] if on]
+                    if unsupported:
+                        self._json(501, {
+                            "error": "stop does not support "
+                                     + ", ".join(unsupported)})
+                        return
+                    try:
+                        self._generate_stop(ids, max_new, seed, stop)
+                    except ValueError as e:
+                        self._json(400, {"error": str(e)})
+                    except Exception as e:
+                        self._json(500, {"error": str(e)})
+                    return
                 try:
                     if req.get("stream"):
                         want_lp = bool(req.get("logprobs"))
@@ -266,6 +297,78 @@ class InferenceHTTPServer:
                     self._json(400, {"error": str(e)})
                 except Exception as e:      # stalled pipeline etc. -> 500
                     self._json(500, {"error": str(e)})
+
+            def _generate_stop(self, ids, max_new, seed, stop):
+                """Blocking generation with STOP SEQUENCES: rows end at
+                the earliest occurrence of any stop string (which is
+                excluded from the output — the OpenAI convention), and
+                the batch stops consuming once every row finished
+                (stream backends with resumable dispatches skip the
+                remaining decode; fused/pipeline backends finish their
+                in-flight program in the background).  Rows are matched
+                on their incrementally detokenized text
+                (StreamDetokenizer — a stop split across tokens matches
+                when it completes).  Tokens truncate to the set that
+                PRODUCED the reported text (they may decode slightly
+                past it when the detokenizer held back a split UTF-8
+                sequence at the cut — never short of it); rows are
+                RAGGED.  ``stop_reason`` per row: "stop", "eos" (the
+                backend's eos ended the row first; the eos token is
+                included, engine convention), or "length"."""
+                import bisect
+
+                from ..tokenizer import StreamDetokenizer
+
+                gen = outer.backend.generate_stream(ids, max_new,
+                                                    seed=seed)
+                b = len(ids)
+                eos = getattr(outer.backend, "eos_id", None)
+                detoks = [StreamDetokenizer(outer.tokenizer)
+                          for _ in range(b)]
+                texts = [""] * b
+                toks = [[] for _ in range(b)]
+                lens = [[] for _ in range(b)]   # cum text len per token
+                done = [False] * b
+                reason = ["length"] * b
+
+                def match(r):
+                    hits = [texts[r].find(s) for s in stop
+                            if s in texts[r]]
+                    if not hits:
+                        return False
+                    m = min(hits)
+                    # keep every token needed to produce text[:m]: up to
+                    # the first whose cumulative visible text reaches m
+                    keep = bisect.bisect_left(lens[r], m) + 1
+                    toks[r] = toks[r][:min(keep, len(toks[r]))]
+                    texts[r] = texts[r][:m]
+                    done[r], reason[r] = True, "stop"
+                    return True
+
+                for item in gen:
+                    arr = np.asarray(item).reshape(-1).tolist()
+                    for r in range(b):
+                        if done[r]:
+                            continue
+                        toks[r].append(int(arr[r]))
+                        texts[r] += detoks[r].push(arr[r])
+                        lens[r].append(len(texts[r]))
+                        if not match(r) and eos is not None \
+                                and int(arr[r]) == eos:
+                            # natural termination beats budget: a row
+                            # past its eos only pads (engine _mask_eos)
+                            done[r], reason[r] = True, "eos"
+                    if all(done):
+                        gen.close()
+                        break
+                for r in range(b):
+                    if not done[r]:
+                        texts[r] += detoks[r].flush()
+                        if lens[r]:
+                            lens[r][-1] = len(texts[r])
+                        match(r)
+                self._json(200, {"tokens": toks, "text": texts,
+                                 "stop_reason": reason})
 
             def _stream(self, ids, max_new, seed, logprobs=False):
                 # pull the FIRST step before committing to 200 + chunked:

@@ -163,3 +163,114 @@ def test_chat_repl_text_against_pipeline(served_pipeline, monkeypatch,
     # incremental detokenization renders the FULL-sequence decode (the
     # per-token join would drop sentencepiece's inter-token spaces)
     assert tok.decode(want[0].tolist()) in buf.getvalue()
+
+
+def test_stop_sequences():
+    """POST /generate {"stop": [...]}: rows end at the earliest stop
+    string, which is excluded from the output (OpenAI convention);
+    tokens truncate consistently with the text; unmatched requests
+    report stop_reason "length" with the full output.  Uses a
+    full-vocab-coverage tokenizer so every generated id decodes."""
+    pieces = [("<unk>", 0.0, UNKNOWN), ("<s>", 0.0, CONTROL),
+              ("</s>", 0.0, CONTROL)]
+    pieces += [(f"\u2581w{i}", -float(i % 7 + 1), NORMAL)
+               for i in range(253)]
+    tok = Tokenizer.from_sentencepiece(build_model_proto(pieces))
+    cfg = get_model_config(MODEL)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(cfg, params, max_seq=64, sampling=GREEDY)
+    server = InferenceHTTPServer(engine, port=0, tokenizer=tok,
+                                 model_name=MODEL)
+    server.start()
+    try:
+        prompt = [5, 17, 42, 7]
+        want = engine.generate(np.asarray([prompt], np.int32),
+                               8).tokens[0]
+        want_text = tok.decode(want.tolist())
+        assert len(want_text) >= 8
+        mid = len(want_text) // 2
+        stop_str = want_text[mid:mid + 3]
+        assert stop_str
+
+        status, data = _post(server, "/generate",
+                             {"prompt_ids": [prompt],
+                              "max_new_tokens": 8, "stop": [stop_str]})
+        assert status == 200
+        body = json.loads(data)
+        assert body["stop_reason"] == ["stop"]
+        assert stop_str not in body["text"][0]
+        assert body["text"][0] == want_text[:want_text.find(stop_str)]
+        # kept tokens PRODUCE the reported text (they may decode past
+        # it at a held-back boundary, never short of it)
+        assert tok.decode(body["tokens"][0]).startswith(body["text"][0])
+
+        # no match anywhere -> full generation, reason "length"
+        status, data = _post(server, "/generate",
+                             {"prompt_ids": [prompt],
+                              "max_new_tokens": 8,
+                              "stop": ["\x00never\x00"]})
+        body = json.loads(data)
+        assert status == 200 and body["stop_reason"] == ["length"]
+        assert body["tokens"][0] == want.tolist()
+        assert body["text"][0] == want_text
+
+        # honor-or-reject: stop + stream is a clean 501; bad stop a 400
+        status, data = _post(server, "/generate",
+                             {"prompt_ids": [prompt],
+                              "max_new_tokens": 2,
+                              "stop": ["a"], "stream": True})
+        assert status == 501 and b"stop" in data
+        status, _ = _post(server, "/generate",
+                          {"prompt_ids": [prompt], "max_new_tokens": 2,
+                           "stop": [""]})
+        assert status == 400
+    finally:
+        server.shutdown()
+
+
+def test_stop_needs_tokenizer():
+    """A tokenizer-less server rejects stop strings with a clean 501."""
+    cfg = get_model_config(MODEL)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(cfg, params, max_seq=64, sampling=GREEDY)
+    server = InferenceHTTPServer(engine, port=0, model_name=MODEL)
+    server.start()
+    try:
+        status, data = _post(server, "/generate",
+                             {"prompt_ids": [[1, 2]],
+                              "max_new_tokens": 2, "stop": ["x"]})
+        assert status == 501 and b"tokenizer" in data
+    finally:
+        server.shutdown()
+
+
+def test_stop_reports_eos_reason():
+    """A row that terminates on the backend's eos before any stop match
+    reports stop_reason "eos" (not "length") and keeps only its real
+    tokens — no eos padding accumulates while other rows run."""
+    pieces = [("<unk>", 0.0, UNKNOWN), ("<s>", 0.0, CONTROL),
+              ("</s>", 0.0, CONTROL)]
+    pieces += [(f"▁w{i}", -float(i % 7 + 1), NORMAL)
+               for i in range(253)]
+    tok = Tokenizer.from_sentencepiece(build_model_proto(pieces))
+    cfg = get_model_config(MODEL)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    plain = InferenceEngine(cfg, params, max_seq=64, sampling=GREEDY)
+    prompt = [5, 17, 42, 7]
+    ref = plain.generate(np.asarray([prompt], np.int32), 8).tokens[0]
+    eos = int(ref[3])                       # stops after 4 real tokens
+    engine = InferenceEngine(cfg, params, max_seq=64, sampling=GREEDY,
+                             eos_id=eos)
+    server = InferenceHTTPServer(engine, port=0, tokenizer=tok,
+                                 model_name=MODEL)
+    server.start()
+    try:
+        status, data = _post(server, "/generate",
+                             {"prompt_ids": [prompt],
+                              "max_new_tokens": 8,
+                              "stop": ["\x00never\x00"]})
+        body = json.loads(data)
+        assert status == 200 and body["stop_reason"] == ["eos"]
+        assert body["tokens"][0] == ref[:4].tolist()
+    finally:
+        server.shutdown()
