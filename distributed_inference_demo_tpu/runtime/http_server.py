@@ -36,6 +36,144 @@ def _round_lps(row) -> list:
     return [round(float(x), 6) for x in row]
 
 
+class StopMatcher:
+    """Incremental stop-sequence matching over streamed text — one owner
+    for the blocking and streaming ``stop`` paths.
+
+    ``feed(piece) -> (emittable, matched)``: ``emittable`` is the text
+    that can be released to the client NOW — everything before the
+    longest trailing run that is still a prefix of some stop string
+    (streaming must never emit characters it would have to retract when
+    the stop completes a step later).  When a stop completes,
+    ``matched`` is True, ``pos`` is the cut position (start of the
+    earliest match across all stop strings), and ``emittable`` carries
+    exactly the remaining pre-stop text."""
+
+    def __init__(self, stop):
+        self.stop = list(stop)
+        # only the UNEMITTED tail is buffered: emitted text was released
+        # precisely because the holdback proved no future stop can start
+        # inside it, so matching stays O(piece + longest_stop) per feed
+        # and memory stays bounded regardless of generation length.
+        self._buf = ""
+        self._base = 0                  # absolute offset of _buf[0]
+        self.pos: Optional[int] = None  # absolute cut position
+
+    def feed(self, piece: str):
+        if self.pos is not None:
+            return "", True
+        self._buf += piece
+        hits = [self._buf.find(s) for s in self.stop if s in self._buf]
+        if hits:
+            m = min(hits)
+            self.pos = self._base + m
+            out = self._buf[:m]
+            self._base += m
+            self._buf = ""
+            return out, True
+        hold = max((k for s in self.stop for k in range(1, len(s))
+                    if self._buf.endswith(s[:k])), default=0)
+        safe_end = len(self._buf) - hold
+        if safe_end > 0:
+            out = self._buf[:safe_end]
+            self._base += safe_end
+            self._buf = self._buf[safe_end:]
+            return out, False
+        return "", False
+
+    def flush(self) -> str:
+        """Release any held-back text once the stream ends unmatched."""
+        if self.pos is not None:
+            return ""
+        out, self._buf = self._buf, ""
+        self._base += len(out)
+        return out
+
+
+class _StopSession:
+    """The per-row decode/match/cut core shared by the BLOCKING and
+    STREAMING stop paths (one owner — the eos-flush and token-truncation
+    rules must not fork).  ``consume(item)`` processes one step's [b]
+    tokens and returns per-row emittable text; ``finish()`` flushes rows
+    that ran to length.  Results: ``toks`` (truncated, ragged),
+    ``texts``, ``reason`` ("stop" | "eos" | "length"), ``done``."""
+
+    def __init__(self, tokenizer, stop, b: int, eos):
+        from ..tokenizer import StreamDetokenizer
+        self.eos = eos
+        self.detoks = [StreamDetokenizer(tokenizer) for _ in range(b)]
+        self.matchers = [StopMatcher(stop) for _ in range(b)]
+        self.texts = [""] * b
+        self.toks = [[] for _ in range(b)]
+        self.lens = [[] for _ in range(b)]   # cum text len per token
+        self.done = [False] * b
+        self.reason = ["length"] * b
+        self.b = b
+
+    def _cut(self, r: int) -> None:
+        """Apply a completed match: truncate text at the cut and keep
+        every token needed to produce it (up to the first whose
+        cumulative visible text reaches the cut)."""
+        import bisect
+        m = self.matchers[r].pos
+        keep = bisect.bisect_left(self.lens[r], m) + 1
+        self.toks[r] = self.toks[r][:min(keep, len(self.toks[r]))]
+        self.texts[r] = self.texts[r][:m]
+        self.done[r], self.reason[r] = True, "stop"
+
+    def _push(self, r: int, raw: str) -> None:
+        self.texts[r] += raw
+        if self.lens[r]:
+            self.lens[r][-1] = len(self.texts[r])
+
+    def consume(self, item) -> list:
+        arr = np.asarray(item).reshape(-1).tolist()
+        pieces = [""] * self.b
+        for r in range(self.b):
+            if self.done[r]:
+                continue
+            self.toks[r].append(int(arr[r]))
+            raw = self.detoks[r].push(arr[r])
+            self.texts[r] += raw
+            self.lens[r].append(len(self.texts[r]))
+            pieces[r], matched = self.matchers[r].feed(raw)
+            if matched:
+                self._cut(r)
+            elif self.eos is not None and int(arr[r]) == self.eos:
+                # natural termination beats budget (a row past its eos
+                # only pads — engine _mask_eos); the detokenizer may
+                # still hold back chars from EARLIER tokens: flush them
+                # through the matcher so they are neither lost nor
+                # allowed to complete a stop unnoticed
+                tail = self.detoks[r].flush()
+                self._push(r, tail)
+                extra, matched = self.matchers[r].feed(tail)
+                pieces[r] += extra
+                if matched:
+                    self._cut(r)
+                else:
+                    pieces[r] += self.matchers[r].flush()
+                    self.done[r], self.reason[r] = True, "eos"
+        return pieces
+
+    def finish(self) -> list:
+        """Flush detok + matcher holdback for rows that ran to length
+        (a stop may still complete inside the flushed tail)."""
+        pieces = [""] * self.b
+        for r in range(self.b):
+            if self.done[r]:
+                continue
+            tail = self.detoks[r].flush()
+            self._push(r, tail)
+            piece, matched = self.matchers[r].feed(tail)
+            if matched:
+                self._cut(r)
+                pieces[r] = piece
+            else:
+                pieces[r] = piece + self.matchers[r].flush()
+        return pieces
+
+
 def _accepts_kwarg(fn, name: str) -> bool:
     """Duck-typed capability check: does ``fn`` accept ``name=``?  True
     for an explicit parameter OR a **kwargs catch-all (wrapper backends
@@ -217,17 +355,19 @@ class InferenceHTTPServer:
                                      "or list of non-empty strings"})
                         return
                     # honor-or-reject: stop strings need server-side
-                    # text, and compose with the plain blocking path
+                    # text; they compose with blocking AND streaming
                     unsupported = [w for w, on in [
                         ("a server-side tokenizer (none attached)",
                          outer.tokenizer is None),
-                        ("stream", bool(req.get("stream"))),
                         ("logprobs", bool(req.get("logprobs"))),
                         ("image", image is not None)] if on]
                     if unsupported:
                         self._json(501, {
                             "error": "stop does not support "
                                      + ", ".join(unsupported)})
+                        return
+                    if req.get("stream"):
+                        self._stream_stop(ids, max_new, seed, stop)
                         return
                     try:
                         self._generate_stop(ids, max_new, seed, stop)
@@ -305,70 +445,96 @@ class InferenceHTTPServer:
                 the batch stops consuming once every row finished
                 (stream backends with resumable dispatches skip the
                 remaining decode; fused/pipeline backends finish their
-                in-flight program in the background).  Rows are matched
-                on their incrementally detokenized text
-                (StreamDetokenizer — a stop split across tokens matches
-                when it completes).  Tokens truncate to the set that
-                PRODUCED the reported text (they may decode slightly
-                past it when the detokenizer held back a split UTF-8
-                sequence at the cut — never short of it); rows are
+                in-flight program in the background).  Matching, token
+                truncation, and eos handling live in ONE owner shared
+                with the streaming path (_StopSession); rows are
                 RAGGED.  ``stop_reason`` per row: "stop", "eos" (the
                 backend's eos ended the row first; the eos token is
                 included, engine convention), or "length"."""
-                import bisect
-
-                from ..tokenizer import StreamDetokenizer
-
                 gen = outer.backend.generate_stream(ids, max_new,
                                                     seed=seed)
-                b = len(ids)
-                eos = getattr(outer.backend, "eos_id", None)
-                detoks = [StreamDetokenizer(outer.tokenizer)
-                          for _ in range(b)]
-                texts = [""] * b
-                toks = [[] for _ in range(b)]
-                lens = [[] for _ in range(b)]   # cum text len per token
-                done = [False] * b
-                reason = ["length"] * b
-
-                def match(r):
-                    hits = [texts[r].find(s) for s in stop
-                            if s in texts[r]]
-                    if not hits:
-                        return False
-                    m = min(hits)
-                    # keep every token needed to produce text[:m]: up to
-                    # the first whose cumulative visible text reaches m
-                    keep = bisect.bisect_left(lens[r], m) + 1
-                    toks[r] = toks[r][:min(keep, len(toks[r]))]
-                    texts[r] = texts[r][:m]
-                    done[r], reason[r] = True, "stop"
-                    return True
-
+                ses = _StopSession(outer.tokenizer, stop, len(ids),
+                                   getattr(outer.backend, "eos_id", None))
                 for item in gen:
-                    arr = np.asarray(item).reshape(-1).tolist()
-                    for r in range(b):
-                        if done[r]:
-                            continue
-                        toks[r].append(int(arr[r]))
-                        texts[r] += detoks[r].push(arr[r])
-                        lens[r].append(len(texts[r]))
-                        if not match(r) and eos is not None \
-                                and int(arr[r]) == eos:
-                            # natural termination beats budget: a row
-                            # past its eos only pads (engine _mask_eos)
-                            done[r], reason[r] = True, "eos"
-                    if all(done):
+                    ses.consume(item)
+                    if all(ses.done):
                         gen.close()
                         break
-                for r in range(b):
-                    if not done[r]:
-                        texts[r] += detoks[r].flush()
-                        if lens[r]:
-                            lens[r][-1] = len(texts[r])
-                        match(r)
-                self._json(200, {"tokens": toks, "text": texts,
-                                 "stop_reason": reason})
+                ses.finish()
+                self._json(200, {"tokens": ses.toks, "text": ses.texts,
+                                 "stop_reason": ses.reason})
+
+            def _stream_stop(self, ids, max_new, seed, stop):
+                """STREAMING generation with stop sequences: chunked
+                JSONL where each line carries per-row TEXT deltas only
+                (tokens would mislead — text is authoritative under
+                stop, and characters that might begin a stop string are
+                held back until they provably aren't part of one, so
+                nothing ever has to be retracted).  A final line carries
+                the truncated token rows + per-row ``stop_reason``."""
+                gen = outer.backend.generate_stream(ids, max_new,
+                                                    seed=seed)
+                first = None
+                try:
+                    first = next(gen)
+                except StopIteration:
+                    pass
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                except Exception as e:
+                    self._json(500, {"error": str(e)})
+                    return
+
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes) -> None:
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+
+                ses = _StopSession(outer.tokenizer, stop, len(ids),
+                                   getattr(outer.backend, "eos_id", None))
+                try:
+                    step = 0
+                    items = ([first] if first is not None else [])
+                    while True:
+                        for item in items:
+                            pieces = ses.consume(item)
+                            if any(pieces):
+                                chunk((json.dumps(
+                                    {"step": step, "text": pieces})
+                                    + "\n").encode("utf-8"))
+                            step += 1
+                        if all(ses.done):
+                            gen.close()
+                            break
+                        try:
+                            items = [next(gen)]
+                        except StopIteration:
+                            break
+                    tail = ses.finish()
+                    if any(tail):
+                        chunk((json.dumps({"step": step, "text": tail})
+                               + "\n").encode("utf-8"))
+                    chunk((json.dumps({"done": True, "tokens": ses.toks,
+                                       "stop_reason": ses.reason})
+                           + "\n").encode("utf-8"))
+                except OSError:
+                    return
+                except Exception as e:
+                    try:
+                        chunk((json.dumps({"error": str(e)}) + "\n")
+                              .encode("utf-8"))
+                    except OSError:
+                        return
+                try:
+                    chunk(b"")
+                    self.wfile.flush()
+                except OSError:
+                    pass
 
             def _stream(self, ids, max_new, seed, logprobs=False):
                 # pull the FIRST step before committing to 200 + chunked:

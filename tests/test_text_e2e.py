@@ -203,6 +203,7 @@ def test_stop_sequences():
         # kept tokens PRODUCE the reported text (they may decode past
         # it at a held-back boundary, never short of it)
         assert tok.decode(body["tokens"][0]).startswith(body["text"][0])
+        body_tokens, body_text = body["tokens"], body["text"][0]
 
         # no match anywhere -> full generation, reason "length"
         status, data = _post(server, "/generate",
@@ -214,12 +215,26 @@ def test_stop_sequences():
         assert body["tokens"][0] == want.tolist()
         assert body["text"][0] == want_text
 
-        # honor-or-reject: stop + stream is a clean 501; bad stop a 400
+        # STREAMING stop: emitted pieces concatenate to exactly the
+        # blocking path's text (stop-prefix holdback — nothing the
+        # client received is ever retracted), and the final line carries
+        # the same truncated tokens + reasons
         status, data = _post(server, "/generate",
                              {"prompt_ids": [prompt],
-                              "max_new_tokens": 2,
-                              "stop": ["a"], "stream": True})
-        assert status == 501 and b"stop" in data
+                              "max_new_tokens": 8,
+                              "stop": [stop_str], "stream": True})
+        assert status == 200
+        lines = [json.loads(l) for l in data.decode().splitlines()
+                 if l.strip()]
+        final = lines[-1]
+        assert final.get("done") is True
+        assert final["stop_reason"] == ["stop"]
+        assert final["tokens"] == body_tokens
+        streamed = "".join(l["text"][0] for l in lines[:-1])
+        assert streamed == body_text
+        assert stop_str not in streamed
+
+        # bad stop lists are a clean 400
         status, _ = _post(server, "/generate",
                           {"prompt_ids": [prompt], "max_new_tokens": 2,
                            "stop": [""]})
