@@ -607,6 +607,8 @@ def cmd_chat(args) -> int:
             break
 
         payload = {"max_new_tokens": args.max_new_tokens, "seed": args.seed}
+        if getattr(args, "stop", None):
+            payload["stop"] = args.stop
         if args.ids:
             try:
                 payload["prompt_ids"] = [[int(t) for t in line.split(",")]]
@@ -629,6 +631,13 @@ def cmd_chat(args) -> int:
             detok = (StreamDetokenizer(tokenizer)
                      if tokenizer is not None else None)
             for item in stream_generate(host, port, payload):
+                if "error" in item:
+                    # a mid-stream server failure arrives as an error
+                    # line; RuntimeError routes it to the REPL's
+                    # report-and-continue handler below
+                    raise RuntimeError(item["error"])
+                if item.get("done"):
+                    break              # stop-mode summary line
                 if "text" in item:
                     piece = item["text"][0]
                 elif detok is not None:
@@ -1101,6 +1110,10 @@ def main(argv=None) -> int:
                        "serve/server HTTP endpoint")
     c.add_argument("--url", default="http://127.0.0.1:5000")
     c.add_argument("--max-new-tokens", type=int, default=128)
+    c.add_argument("--stop", action="append", default=None,
+                   help="stop sequence (repeatable); needs a server-side "
+                        "tokenizer — generation ends at the earliest "
+                        "match, which is not rendered")
     c.add_argument("--tokenizer", default="",
                    help="local tokenizer.json for encode/decode (else the "
                         "server's tokenizer handles text)")

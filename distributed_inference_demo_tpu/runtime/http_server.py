@@ -474,76 +474,40 @@ class InferenceHTTPServer:
                 the truncated token rows + per-row ``stop_reason``."""
                 gen = outer.backend.generate_stream(ids, max_new,
                                                     seed=seed)
-                first = None
-                try:
-                    first = next(gen)
-                except StopIteration:
-                    pass
-                except ValueError as e:
-                    self._json(400, {"error": str(e)})
-                    return
-                except Exception as e:
-                    self._json(500, {"error": str(e)})
-                    return
 
-                self.send_response(200)
-                self.send_header("Content-Type", "application/jsonl")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-
-                def chunk(data: bytes) -> None:
-                    self.wfile.write(f"{len(data):x}\r\n".encode())
-                    self.wfile.write(data + b"\r\n")
-
-                ses = _StopSession(outer.tokenizer, stop, len(ids),
-                                   getattr(outer.backend, "eos_id", None))
-                try:
+                def lines(first, gen):
+                    import itertools
+                    ses = _StopSession(
+                        outer.tokenizer, stop, len(ids),
+                        getattr(outer.backend, "eos_id", None))
                     step = 0
-                    items = ([first] if first is not None else [])
-                    while True:
-                        for item in items:
-                            pieces = ses.consume(item)
-                            if any(pieces):
-                                chunk((json.dumps(
-                                    {"step": step, "text": pieces})
-                                    + "\n").encode("utf-8"))
-                            step += 1
+                    head = [] if first is None else [first]
+                    for item in itertools.chain(head, gen):
+                        pieces = ses.consume(item)
+                        if any(pieces):
+                            yield {"step": step, "text": pieces}
+                        step += 1
                         if all(ses.done):
                             gen.close()
                             break
-                        try:
-                            items = [next(gen)]
-                        except StopIteration:
-                            break
                     tail = ses.finish()
                     if any(tail):
-                        chunk((json.dumps({"step": step, "text": tail})
-                               + "\n").encode("utf-8"))
-                    chunk((json.dumps({"done": True, "tokens": ses.toks,
-                                       "stop_reason": ses.reason})
-                           + "\n").encode("utf-8"))
-                except OSError:
-                    return
-                except Exception as e:
-                    try:
-                        chunk((json.dumps({"error": str(e)}) + "\n")
-                              .encode("utf-8"))
-                    except OSError:
-                        return
-                try:
-                    chunk(b"")
-                    self.wfile.flush()
-                except OSError:
-                    pass
+                        yield {"step": step, "text": tail}
+                    yield {"done": True, "tokens": ses.toks,
+                           "stop_reason": ses.reason}
 
-            def _stream(self, ids, max_new, seed, logprobs=False):
-                # pull the FIRST step before committing to 200 + chunked:
-                # validation errors (capacity etc.) surface on first next()
-                # and must become a clean 400, not a status line spliced
-                # into an already-open chunked body.
-                kwargs = {"logprobs": True} if logprobs else {}
-                gen = outer.backend.generate_stream(ids, max_new, seed=seed,
-                                                    **kwargs)
+                self._stream_lines(gen, lines)
+
+            def _stream_lines(self, gen, lines_fn):
+                """ONE owner of the chunked-JSONL framing shared by the
+                plain and stop streaming paths: pull the FIRST backend
+                item before committing to 200 + chunked (validation
+                errors surface on first next() and must become a clean
+                400/500, not a status line spliced into an open chunked
+                body), then emit ``lines_fn(first, gen)``'s dict lines;
+                a mid-stream failure becomes an {"error": ...} line so
+                the framing stays intact, and the terminating chunk
+                always goes out."""
                 first = None
                 try:
                     first = next(gen)
@@ -567,47 +531,9 @@ class InferenceHTTPServer:
                     self.wfile.write(f"{len(data):x}\r\n".encode())
                     self.wfile.write(data + b"\r\n")
 
-                # incremental detokenization, per row: the "text" field
-                # carries printable deltas (tokenizer.StreamDetokenizer —
-                # one owner of the boundary/holdback rules, shared with
-                # the chat REPL)
-                from ..tokenizer import StreamDetokenizer
-                detoks: dict = {}
-
-                def row_text(r, tok):
-                    if r not in detoks:
-                        detoks[r] = StreamDetokenizer(outer.tokenizer)
-                    return detoks[r].push(tok)
-
-                def emit(i, item):
-                    toks, lps = item if logprobs else (item, None)
-                    line = {"step": i, "tokens": np.asarray(toks).tolist()}
-                    if lps is not None:
-                        line["logprobs"] = _round_lps(np.asarray(lps))
-                    if outer.tokenizer is not None:
-                        line["text"] = [row_text(r, t) for r, t in
-                                        enumerate(np.asarray(toks).tolist())]
-                    chunk((json.dumps(line) + "\n").encode("utf-8"))
-
-                n_steps = 0
                 try:
-                    if first is not None:
-                        emit(0, first)
-                        n_steps = 1
-                        for i, item in enumerate(gen, start=1):
-                            emit(i, item)
-                            n_steps = i + 1
-                    if outer.tokenizer is not None and detoks:
-                        # flush text held back by the U+FFFD guard: a
-                        # stream ending on a split (or genuinely
-                        # replacement-decoding) token must not silently
-                        # drop its final characters
-                        rem = [detoks[r].flush() if r in detoks else ""
-                               for r in range(max(detoks) + 1)]
-                        if any(rem):
-                            chunk((json.dumps(
-                                {"step": n_steps, "tokens": [],
-                                 "text": rem}) + "\n").encode("utf-8"))
+                    for line in lines_fn(first, gen):
+                        chunk((json.dumps(line) + "\n").encode("utf-8"))
                 except OSError:
                     return      # client went away; the socket is dead
                 except Exception as e:
@@ -623,6 +549,53 @@ class InferenceHTTPServer:
                     self.wfile.flush()
                 except OSError:
                     pass
+
+            def _stream(self, ids, max_new, seed, logprobs=False):
+                kwargs = {"logprobs": True} if logprobs else {}
+                gen = outer.backend.generate_stream(ids, max_new, seed=seed,
+                                                    **kwargs)
+
+                def lines(first, gen):
+                    import itertools
+
+                    # incremental detokenization, per row: the "text"
+                    # field carries printable deltas
+                    # (tokenizer.StreamDetokenizer — one owner of the
+                    # boundary/holdback rules, shared with the chat REPL)
+                    from ..tokenizer import StreamDetokenizer
+                    detoks: dict = {}
+
+                    def row_text(r, tok):
+                        if r not in detoks:
+                            detoks[r] = StreamDetokenizer(outer.tokenizer)
+                        return detoks[r].push(tok)
+
+                    n_steps = 0
+                    head = [] if first is None else [first]
+                    for i, item in enumerate(itertools.chain(head, gen)):
+                        toks, lps = item if logprobs else (item, None)
+                        line = {"step": i,
+                                "tokens": np.asarray(toks).tolist()}
+                        if lps is not None:
+                            line["logprobs"] = _round_lps(np.asarray(lps))
+                        if outer.tokenizer is not None:
+                            line["text"] = [
+                                row_text(r, t) for r, t in
+                                enumerate(np.asarray(toks).tolist())]
+                        yield line
+                        n_steps = i + 1
+                    if outer.tokenizer is not None and detoks:
+                        # flush text held back by the U+FFFD guard: a
+                        # stream ending on a split (or genuinely
+                        # replacement-decoding) token must not silently
+                        # drop its final characters
+                        rem = [detoks[r].flush() if r in detoks else ""
+                               for r in range(max(detoks) + 1)]
+                        if any(rem):
+                            yield {"step": n_steps, "tokens": [],
+                                   "text": rem}
+
+                self._stream_lines(gen, lines)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self.httpd.server_address

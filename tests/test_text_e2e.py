@@ -289,3 +289,49 @@ def test_stop_reports_eos_reason():
         assert body["tokens"][0] == ref[:4].tolist()
     finally:
         server.shutdown()
+
+
+def test_chat_repl_with_stop(monkeypatch, tmp_path):
+    """chat --stop renders the truncated text and survives the stream's
+    final summary line (uses a full-vocab-coverage tokenizer so every
+    generated id decodes)."""
+    pieces = [("<unk>", 0.0, UNKNOWN), ("<s>", 0.0, CONTROL),
+              ("</s>", 0.0, CONTROL)]
+    pieces += [(f"▁w{i}", -float(i % 7 + 1), NORMAL)
+               for i in range(253)]
+    blob = build_model_proto(pieces)
+    model_path = tmp_path / "full.model"
+    model_path.write_bytes(blob)
+    tok = Tokenizer.from_sentencepiece(blob)
+    cfg = get_model_config(MODEL)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(cfg, params, max_seq=64, sampling=GREEDY)
+    server = InferenceHTTPServer(engine, port=0, tokenizer=tok,
+                                 model_name=MODEL)
+    server.start()
+    try:
+        prompt_text = "w5 w17"
+        ids = tok.encode(prompt_text)
+        want = engine.generate(np.asarray([ids], np.int32), 6).tokens
+        full = tok.decode(want[0].tolist())
+        mid = len(full) // 2
+        stop_str = full[mid:mid + 2]
+        assert stop_str
+
+        import io
+        from contextlib import redirect_stdout
+        monkeypatch.setattr(cli.sys, "stdin",
+                            io.StringIO(f"{prompt_text}\n/quit\n"))
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli.main(["chat", "--url",
+                           f"http://{server.host}:{server.port}",
+                           "--max-new-tokens", "6", "--template", "{msg}",
+                           "--tokenizer", str(model_path),
+                           "--stop", stop_str])
+        assert rc == 0
+        out = buf.getvalue()
+        assert full[:full.find(stop_str)] in out
+        assert full not in out            # the stop really truncated
+    finally:
+        server.shutdown()
