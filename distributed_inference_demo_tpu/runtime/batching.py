@@ -708,38 +708,44 @@ class ContinuousBatchingEngine:
                         seed: int = 0):
         """Yield [batch] token arrays per step (HTTP streaming surface).
         Single-row streaming only batches trivially; multi-row prompts
-        stream in lockstep of the slowest admitted row."""
+        stream in lockstep of the slowest admitted row.  An ABANDONED
+        stream (client disconnect, or a stop-sequence early exit closing
+        the generator) cancels its in-flight requests, freeing their
+        slots after the current step instead of decoding to max_new."""
         ids = np.asarray(prompt_ids)
         if ids.ndim == 1:
             ids = ids[None, :]
         reqs = [self.submit(row, max_new_tokens) for row in ids]
         fetched = [[] for _ in reqs]
         finished = [False] * len(reqs)   # row's None sentinel was consumed
-        for step_i in range(max_new_tokens):
-            out = []
-            for i, r in enumerate(reqs):
-                while not finished[i] and len(fetched[i]) <= step_i:
-                    item = r.stream.get()
-                    if item is None:   # end sentinel: EOS, or a failure
-                        finished[i] = True
-                        if r.error is not None:
-                            # a scheduler/device failure must surface to
-                            # the streaming consumer, not end the stream
-                            # as a cleanly-truncated generation; free the
-                            # sibling rows' slots first (nobody will
-                            # drain them after the raise)
-                            for rr in reqs:
-                                rr.cancel()
-                            raise r.error
-                    else:
-                        fetched[i].append(item)
-                out.append(fetched[i][step_i]
-                           if step_i < len(fetched[i]) else None)
-            if all(o is None for o in out):
-                return
-            pad = self.eos_id if self.eos_id is not None else 0
-            yield np.asarray([pad if o is None else o for o in out],
-                             np.int32)
+        try:
+            for step_i in range(max_new_tokens):
+                out = []
+                for i, r in enumerate(reqs):
+                    while not finished[i] and len(fetched[i]) <= step_i:
+                        item = r.stream.get()
+                        if item is None:  # end sentinel: EOS, or failure
+                            finished[i] = True
+                            if r.error is not None:
+                                # a scheduler/device failure must surface
+                                # to the streaming consumer, not end the
+                                # stream as a cleanly-truncated
+                                # generation (siblings cancel in the
+                                # finally below)
+                                raise r.error
+                        else:
+                            fetched[i].append(item)
+                    out.append(fetched[i][step_i]
+                               if step_i < len(fetched[i]) else None)
+                if all(o is None for o in out):
+                    return
+                pad = self.eos_id if self.eos_id is not None else 0
+                yield np.asarray([pad if o is None else o for o in out],
+                                 np.int32)
+        finally:
+            for r in reqs:
+                if not r.done.is_set():
+                    r.cancel()
 
     def stats(self) -> dict:
         """Scheduler counters for the HTTP ``/stats`` surface."""

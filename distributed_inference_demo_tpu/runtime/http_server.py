@@ -475,14 +475,12 @@ class InferenceHTTPServer:
                 gen = outer.backend.generate_stream(ids, max_new,
                                                     seed=seed)
 
-                def lines(first, gen):
-                    import itertools
+                def lines(items, gen):
                     ses = _StopSession(
                         outer.tokenizer, stop, len(ids),
                         getattr(outer.backend, "eos_id", None))
                     step = 0
-                    head = [] if first is None else [first]
-                    for item in itertools.chain(head, gen):
+                    for item in items:
                         pieces = ses.consume(item)
                         if any(pieces):
                             yield {"step": step, "text": pieces}
@@ -507,7 +505,11 @@ class InferenceHTTPServer:
                 body), then emit ``lines_fn(first, gen)``'s dict lines;
                 a mid-stream failure becomes an {"error": ...} line so
                 the framing stays intact, and the terminating chunk
-                always goes out."""
+                always goes out.  ``lines_fn(items, gen)`` receives the
+                first item already spliced back into ``items`` (one
+                owner of that dance too); ``gen`` rides along only for
+                early ``gen.close()``."""
+                import itertools
                 first = None
                 try:
                     first = next(gen)
@@ -521,6 +523,8 @@ class InferenceHTTPServer:
                     # still before headers, so a clean 500 is possible
                     self._json(500, {"error": str(e)})
                     return
+                items = itertools.chain(
+                    [] if first is None else [first], gen)
 
                 self.send_response(200)
                 self.send_header("Content-Type", "application/jsonl")
@@ -532,7 +536,7 @@ class InferenceHTTPServer:
                     self.wfile.write(data + b"\r\n")
 
                 try:
-                    for line in lines_fn(first, gen):
+                    for line in lines_fn(items, gen):
                         chunk((json.dumps(line) + "\n").encode("utf-8"))
                 except OSError:
                     return      # client went away; the socket is dead
@@ -555,9 +559,7 @@ class InferenceHTTPServer:
                 gen = outer.backend.generate_stream(ids, max_new, seed=seed,
                                                     **kwargs)
 
-                def lines(first, gen):
-                    import itertools
-
+                def lines(items, gen):
                     # incremental detokenization, per row: the "text"
                     # field carries printable deltas
                     # (tokenizer.StreamDetokenizer — one owner of the
@@ -571,8 +573,7 @@ class InferenceHTTPServer:
                         return detoks[r].push(tok)
 
                     n_steps = 0
-                    head = [] if first is None else [first]
-                    for i, item in enumerate(itertools.chain(head, gen)):
+                    for i, item in enumerate(items):
                         toks, lps = item if logprobs else (item, None)
                         line = {"step": i,
                                 "tokens": np.asarray(toks).tolist()}
