@@ -1103,3 +1103,51 @@ def test_abandoned_stream_frees_slots(params):
         # below its 60-token budget (cancel lands at the next sweep, so
         # allow generous scheduler run-ahead without flaking)
         assert eng._step_count < 60
+
+
+def test_http_stop_over_batching_frees_budget(params):
+    """POST /generate with stop over the BATCHING backend: the early
+    exit closes the stream, which cancels the in-flight request — the
+    60-token budget is not decoded after the stop matched."""
+    import http.client
+    import json as _json
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        InferenceHTTPServer)
+
+    class EveryIdTok:
+        """Toy tokenizer: id -> ' t<id>' (full vocab coverage)."""
+        def encode(self, text):
+            return [1]
+
+        def decode(self, ids, skip_special=True):
+            return "".join(f" t{int(i)}" for i in ids)
+
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY,
+                                  prompt_buckets=(16,)) as eng:
+        prompt = [5, 4, 3, 2]
+        # learn the 3rd generated id, then stop on its text
+        first = eng.submit(prompt, 4).wait(timeout=300)
+        stop_str = f" t{int(first[2])}"
+        server = InferenceHTTPServer(eng, port=0, tokenizer=EveryIdTok(),
+                                     model_name="llama-test")
+        server.start()
+        try:
+            conn = http.client.HTTPConnection(server.host, server.port,
+                                              timeout=300)
+            conn.request("POST", "/generate",
+                         body=_json.dumps({"prompt_ids": [prompt],
+                                           "max_new_tokens": 60,
+                                           "stop": [stop_str]}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = _json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200, body
+            assert body["stop_reason"] == ["stop"]
+            assert body["tokens"][0] == [int(t) for t in first[:2]]
+            # the abandoned stream cancelled its request: nowhere near
+            # the 60-token budget was decoded
+            assert eng._step_count < 40
+        finally:
+            server.shutdown()
