@@ -508,3 +508,40 @@ def test_chat_streaming_detok_holds_back_split_utf8(monkeypatch):
     assert rc == 0
     out = buf.getvalue()
     assert "é" in out and "�" not in out
+
+
+def test_stop_matcher_fuzz():
+    """StopMatcher vs a whole-string reference over random texts, stop
+    sets, and chunkings: identical cut positions, and emitted text never
+    contains anything later retracted (the streaming holdback
+    guarantee)."""
+    import random
+
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        StopMatcher)
+
+    rng = random.Random(7)
+    for _ in range(300):
+        text = "".join(rng.choice("abc") for _ in range(rng.randint(0, 40)))
+        stops = ["".join(rng.choice("abc")
+                         for _ in range(rng.randint(1, 4)))
+                 for _ in range(rng.randint(1, 3))]
+        hits = [text.find(s) for s in stops if s in text]
+        ref_pos = min(hits) if hits else None
+
+        m = StopMatcher(stops)
+        outs, matched = [], False
+        i = 0
+        while i < len(text) and not matched:
+            j = i + rng.randint(1, 5)
+            out, matched = m.feed(text[i:j])
+            outs.append(out)
+            i = j
+        if matched:
+            assert m.pos == ref_pos
+            assert "".join(outs) == text[:ref_pos]
+        else:
+            assert ref_pos is None or ref_pos >= i  # not reached yet
+            if ref_pos is None:
+                outs.append(m.flush())
+                assert "".join(outs) == text
