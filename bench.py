@@ -1274,7 +1274,26 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--leg")
     ap.add_argument("--params")
+    ap.add_argument("--run-log", default=os.environ.get("BENCH_RUN_LOG",
+                                                        ""),
+                    help="append structured JSONL run-log events "
+                         "(telemetry/runlog) for this bench run; leg "
+                         "subprocesses inherit it via DWT_RUN_LOG and "
+                         "write per-pid siblings")
     args = ap.parse_args()
+
+    from distributed_inference_demo_tpu.telemetry.runlog import (
+        NULL, RunLog, set_run_log)
+    if args.run_log:
+        runlog = RunLog(args.run_log)
+        set_run_log(runlog)
+        # engines inside leg subprocesses log their per-request
+        # summaries next to ours (runlog suffixes the path per pid)
+        os.environ["DWT_RUN_LOG"] = args.run_log
+    else:
+        # don't install NULL: a leg subprocess must keep get_run_log()'s
+        # lazy DWT_RUN_LOG resolution (set by the orchestrator above)
+        runlog = NULL
 
     params = {
         "model": os.environ.get("BENCH_MODEL", "tinyllama-1.1b"),
@@ -1328,6 +1347,7 @@ def main() -> None:
         last = ((p_err or "").strip().splitlines() or ["?"])[-1]
         reason = f"device probe exited rc={rc}: {last}"
     if not backend_ok:
+        runlog.event("bench_abort", reason=reason)
         out = {
             "metric": "decode tokens/sec (backend unreachable)",
             "value": None, "unit": "tokens/sec", "vs_baseline": None,
@@ -1362,11 +1382,14 @@ def main() -> None:
     # slot/decode-block/speculative phases), each with its own compiles —
     # give it more rope than the single-engine legs
     leg_timeouts = {"batching": 1500}
+    runlog.event("bench_start", params=params, legs=legs)
     results = {}
     for leg in legs:
         left = deadline - time.monotonic()
         if left <= 120:    # a leg needs real budget (compiles alone are ~2m)
             results[leg] = {"error": "skipped: bench deadline reached"}
+            runlog.event("bench_leg", leg=leg, skipped=True,
+                         error=results[leg]["error"])
             continue
         t0 = time.perf_counter()
         results[leg] = _spawn_leg(leg, params,
@@ -1374,6 +1397,10 @@ def main() -> None:
                                               int(left)))
         if isinstance(results[leg], dict):
             results[leg]["leg_seconds"] = round(time.perf_counter() - t0, 1)
+        runlog.event("bench_leg", leg=leg,
+                     seconds=round(time.perf_counter() - t0, 1),
+                     error=(results[leg].get("error")
+                            if isinstance(results[leg], dict) else None))
 
     headline = results.get("headline", {})
     # headline may have errored; any leg that reached the device knows it
@@ -1435,6 +1462,11 @@ def main() -> None:
         for sub in (extras.get("int4", {}) or {}).values():
             apply_measured_frac(sub, measured)
 
+    runlog.event("bench_done", value=summary["value"],
+                 vs_baseline=summary["vs_baseline"],
+                 errored_legs=[k for k, v in results.items()
+                               if isinstance(v, dict) and "error" in v])
+    runlog.close()
     print(json.dumps({
         "metric": summary["metric"],
         "value": summary["value"],

@@ -157,6 +157,15 @@ def cmd_serve(args) -> int:
     chain (start the workers first with the ``worker`` subcommand)."""
     from .runtime.http_server import HeaderBackend, InferenceHTTPServer
 
+    if getattr(args, "run_log", ""):
+        from .telemetry.runlog import RunLog, set_run_log
+        rl = RunLog(args.run_log)
+        set_run_log(rl)
+        rl.event("serve_start", model=args.model,
+                 max_seq=args.max_seq,
+                 chain=bool(args.chain),
+                 batch_slots=getattr(args, "batch_slots", 0))
+
     modes = [name for name, on in [("--chain", args.chain),
                                    ("--draft-model",
                                     getattr(args, "draft_model", "")),
@@ -1066,6 +1075,10 @@ def main(argv=None) -> int:
                         "weights (vision_model.* names; LLaVA's "
                         "multi_modal_projector loads too when present); "
                         "empty = seeded random init")
+    s.add_argument("--run-log", default="",
+                   help="append structured JSONL run-log events "
+                        "(serve start + per-request engine summaries) "
+                        "to this path (telemetry/runlog)")
     _add_sp_args(s)
     _add_draft_args(s)
     s.set_defaults(fn=cmd_serve)

@@ -7,8 +7,12 @@ replacing the reference's ZeroMQ + hand-rolled binary framing
 (``utils.cpp:124-368``, ``Communication.java``).
 """
 
-from .wire import (DType, TensorMessage, deserialize_tensors,
-                   serialize_tensors, deserialize_token, serialize_token)
+from .wire import (DType, FLAG_TRACE_CONTEXT, TensorMessage,
+                   deserialize_tensors, serialize_tensors,
+                   serialize_tensors_traced, split_trace_context,
+                   deserialize_token, serialize_token)
 
-__all__ = ["DType", "TensorMessage", "serialize_tensors",
-           "deserialize_tensors", "serialize_token", "deserialize_token"]
+__all__ = ["DType", "FLAG_TRACE_CONTEXT", "TensorMessage",
+           "serialize_tensors", "serialize_tensors_traced",
+           "split_trace_context", "deserialize_tensors",
+           "serialize_token", "deserialize_token"]

@@ -31,7 +31,7 @@ from __future__ import annotations
 import enum
 import struct
 from dataclasses import dataclass
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -45,6 +45,16 @@ MAGIC = b"DWT1"
 VERSION = 1
 _HEADER = struct.Struct("<4sBBHI")          # magic, version, flags, rsv, n
 _TENSOR_HDR = struct.Struct("<BBHQ")        # dtype, ndims, rsv, nbytes
+
+# flags bit 0: the message carries a trace-context trailer — the LAST
+# tensor is a u64[2] of (trace_id, parent_span_id) appended by
+# serialize_tensors_traced and stripped by split_trace_context.  The
+# trailer is a perfectly ordinary tensor counted in ntensors, so decoders
+# that predate the bit (including native/codec.cc, which returns flags
+# verbatim and never interprets them) decode traced frames without
+# change; frames without the bit are byte-identical to the pre-trace
+# format.  Bits 1-7 stay reserved.
+FLAG_TRACE_CONTEXT = 0x01
 
 
 class DType(enum.IntEnum):
@@ -174,6 +184,47 @@ def deserialize_tensors(data: bytes) -> TensorMessage:
     if off != len(data):
         raise WireError(f"{len(data) - off} trailing bytes")
     return TensorMessage(tensors=out, flags=flags)
+
+
+def serialize_tensors_traced(arrays: Sequence[np.ndarray],
+                             trace_id: Optional[int],
+                             parent_span_id: int = 0,
+                             flags: int = 0) -> bytes:
+    """Encode ``arrays`` with an optional trace-context trailer.
+
+    ``trace_id=None`` is byte-identical to :func:`serialize_tensors`
+    (tracing off costs nothing on the wire); otherwise a u64[2]
+    ``[trace_id, parent_span_id]`` tensor is appended and
+    :data:`FLAG_TRACE_CONTEXT` set so the receiver can strip it with
+    :func:`split_trace_context`.
+    """
+    if trace_id is None:
+        return serialize_tensors(arrays, flags)
+    trailer = np.array([trace_id & _U64_MASK,
+                        parent_span_id & _U64_MASK], dtype="<u8")
+    return serialize_tensors(list(arrays) + [trailer],
+                             flags | FLAG_TRACE_CONTEXT)
+
+
+_U64_MASK = (1 << 64) - 1
+
+
+def split_trace_context(msg: TensorMessage):
+    """``(tensors, (trace_id, parent_span_id) | None)`` from a decoded
+    message.  Messages without :data:`FLAG_TRACE_CONTEXT` pass through
+    untouched; a set flag with a malformed trailer is a hard
+    :class:`WireError` (a half-stripped payload would silently shift
+    every tensor index downstream)."""
+    if not (msg.flags & FLAG_TRACE_CONTEXT):
+        return msg.tensors, None
+    if not msg.tensors:
+        raise WireError("trace-context flag set on an empty message")
+    trailer = msg.tensors[-1]
+    if trailer.dtype != np.dtype("<u8") or trailer.shape != (2,):
+        raise WireError(
+            f"malformed trace-context trailer: {trailer.dtype} "
+            f"{trailer.shape}")
+    return msg.tensors[:-1], (int(trailer[0]), int(trailer[1]))
 
 
 def serialize_token(token_id: int) -> bytes:

@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional
 
 import zmq
 
+from ..telemetry.runlog import get_run_log
 from .messages import Envelope, MsgType, decode, make
 from .router import RouterService
 
@@ -149,6 +150,11 @@ class LifecycleServer(RouterService):
         if msg.type == MsgType.READY:
             # Ready → Open: send the full config (Client.java:57-84)
             self.states[dev_id] = LifecycleState.OPEN
+            rl = get_run_log()
+            if rl.enabled:
+                rl.event("lifecycle", device=dev_id, state="open",
+                         model=self.config.model,
+                         num_devices=len(self.expected))
             return [make(MsgType.OPEN, config=self.config.to_payload())]
         if msg.type == MsgType.ARTIFACT_REQUEST:
             return self._artifact_chunk(dev_id, msg.get("name", ""),
@@ -166,6 +172,9 @@ class LifecycleServer(RouterService):
                 ready = all(
                     self.states.get(d) == LifecycleState.INITIALIZED
                     for d in self.expected)
+            rl = get_run_log()
+            if rl.enabled:
+                rl.event("lifecycle", device=dev_id, state="initialized")
             if ready:
                 self._broadcast_start()
             return []
@@ -174,6 +183,10 @@ class LifecycleServer(RouterService):
                 self.states[dev_id] = LifecycleState.FINISHED
                 done = all(self.states.get(d) == LifecycleState.FINISHED
                            for d in self.expected)
+            rl = get_run_log()
+            if rl.enabled:
+                rl.event("lifecycle", device=dev_id, state="finished",
+                         all_finished=done)
             if done:
                 self.all_finished.set()
             return [make(MsgType.CLOSE)]
@@ -219,6 +232,10 @@ class LifecycleServer(RouterService):
             for dev_id in self.expected:
                 self.states[dev_id] = LifecycleState.RUNNING
         self.all_running.set()
+        rl = get_run_log()
+        if rl.enabled:
+            rl.event("lifecycle", state="running",
+                     devices=sorted(self.expected))
         for dev_id in self.expected:   # serve-thread only (see send_to)
             self.send_to(dev_id, make(MsgType.START))
 

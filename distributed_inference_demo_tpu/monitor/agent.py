@@ -71,7 +71,7 @@ class MonitorAgent:
         if self.measure_flops and self._flops_cache is None:
             # measured once; hardware speed doesn't change between rounds
             self._flops_cache = flops_probe()
-        return {
+        report = {
             "latency": latency,
             "bandwidth": bandwidth,
             "memory": memory_info(),
@@ -79,6 +79,11 @@ class MonitorAgent:
             "platform": self.platform,
             "chips": self.chips,
         }
+        # mirror the round into this process's /metrics gauges
+        # (dwt_monitor_peer_* — the planner's inputs, scrapeable live)
+        from ..telemetry.catalog import record_monitor_round
+        record_monitor_round(report)
+        return report
 
     # -- protocol loop -----------------------------------------------------
 

@@ -753,6 +753,12 @@ class ContinuousBatchingEngine:
 
         from .stats import _percentile
         out = {"slots": self.max_batch, "steps": self._step_count,
+               # live occupancy for the /metrics gauges: submitted-but-
+               # unslotted requests vs slots mid-decode (racy reads of
+               # scheduler-owned state — gauges, not invariants)
+               "queue_depth": self._queue.qsize() + len(self._pending),
+               "active_slots": sum(1 for s in self._slots
+                                   if s is not None),
                "prefix_cache": dict(self.prefix_stats)}
         # completed is the MONOTONIC count; the reservoirs are bounded
         # (the last 512 samples feed the percentiles).  deque.__copy__ is

@@ -49,6 +49,7 @@ from ..comm import wire
 from ..comm.transport import BaseTransport
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..ops.sampling import SamplingParams, sample_logits
+from ..telemetry.tracing import TraceRecorder, new_trace_id
 from .stats import StageStats, timer
 
 log = logging.getLogger(__name__)
@@ -156,12 +157,14 @@ class PipelineWorker:
         self.next_id = next_id          # None on the tail
         self.header_id = header_id
         self.step_timeout = step_timeout
-        self.stats = StageStats(
-            role="tail" if runtime.spec.is_last else "worker")
+        role = "tail" if runtime.spec.is_last else "worker"
+        self.stats = StageStats(role=role)
+        self.tracer = TraceRecorder(f"{role}:{transport.device_id}")
+        self._last_wait: Optional[float] = None  # serve loop's recv wait
 
-    def _forward_control(self, tag: str) -> None:
+    def _forward_control(self, tag: str, payload: bytes = b"") -> None:
         if self.next_id is not None:
-            self.transport.send(self.next_id, tag, b"")
+            self.transport.send(self.next_id, tag, payload)
 
     # tag factories — overridable (the elastic runtime appends a reshard
     # epoch so stale pre-reshard traffic is identifiable and droppable)
@@ -184,7 +187,9 @@ class PipelineWorker:
                 log.info("worker %s: idle timeout, exiting",
                          self.transport.device_id)
                 return
-            self.stats.record_recv(time.perf_counter() - t0, len(payload))
+            wait = time.perf_counter() - t0
+            self.stats.record_recv(wait, len(payload))
+            self._last_wait = wait      # recv_wait span source (tracing)
             if not self.handle_message(tag, payload):
                 return
 
@@ -199,13 +204,31 @@ class PipelineWorker:
             self._forward_control(tag)
             return True
         if kind == "statsreq":
+            from ..comm.transport import TransportError
             snap = dict(self.stats.snapshot(include_samples=True),
                         device_id=self.transport.device_id,
                         seq=rest)  # echo the poll sequence id
-            self.transport.send(
-                self.header_id, f"statsrep:{self.transport.device_id}",
-                json.dumps(snap).encode("utf-8"))
-            self._forward_control(tag)
+            spans = None
+            if payload == b"spans":
+                # trace collection rides the stats poll: spans drain into
+                # the reply AT MOST ONCE (a reply missing the header's
+                # poll window is dropped there; only a locally failed
+                # send re-buffers them for the next poll)
+                spans = snap["spans"] = self.tracer.drain()
+            try:
+                self.transport.send(
+                    self.header_id,
+                    f"statsrep:{self.transport.device_id}",
+                    json.dumps(snap).encode("utf-8"))
+            except TransportError:
+                if spans:
+                    for s in spans:      # keep them for the next poll
+                        self.tracer.record(
+                            s["name"], s["trace_id"], s["parent_id"],
+                            ts=s["ts_us"] / 1e6, dur=s["dur_us"] / 1e6,
+                            span_id=s["span_id"], **(s.get("args") or {}))
+                raise
+            self._forward_control(tag, payload)
             return True
         if kind == "statsreset":
             self.stats.reset()
@@ -223,42 +246,83 @@ class PipelineWorker:
         self._run_and_forward(rid, step, payload)
         return True
 
-    def _run_and_forward(self, rid: int, step: int, payload: bytes) -> None:
-        with timer() as t_c:
-            [x] = wire.deserialize_tensors(payload).tensors
-            out = self.rt.run_chunk(rid, x)
-            if self.rt.spec.is_last:
-                body = wire.serialize_tensors(
-                    [self.rt.sample_tokens(rid, step, out)])
-                dest, tag = self.header_id, self._make_tok_tag(rid, step)
-            else:
-                body = wire.serialize_tensors([np.asarray(out)])
-                dest, tag = self.next_id, self._make_h_tag(rid, step)
-        self.stats.record_compute(t_c.seconds)
+    def _record_hop_spans(self, ctx, compute_span: int, t_wall: float,
+                          compute_s: float, rid: int, step: int) -> None:
+        """recv_wait + compute spans for one traced hop; ``compute_span``
+        was minted before serialization so the outbound trailer could
+        name it as the downstream parent."""
+        trace_id, parent = ctx
+        if self._last_wait is not None:
+            self.tracer.record("recv_wait", trace_id, parent,
+                               ts=t_wall - self._last_wait,
+                               dur=self._last_wait, rid=rid, step=step)
+            self._last_wait = None       # consumed; never double-reported
+        self.tracer.record("compute", trace_id, parent, ts=t_wall,
+                           dur=compute_s, span_id=compute_span,
+                           rid=rid, step=step)
+
+    def _traced_send(self, ctx, compute_span: int, dest: str, tag: str,
+                     body: bytes, rid: int, step: int) -> None:
+        t_send = time.time()
         with timer() as t_s:
             self.transport.send(dest, tag, body)
         self.stats.record_send(t_s.seconds, len(body))
+        if ctx is not None:
+            self.tracer.record("send", ctx[0], compute_span, ts=t_send,
+                               dur=t_s.seconds, rid=rid, step=step,
+                               dest=dest)
+
+    def _run_and_forward(self, rid: int, step: int, payload: bytes) -> None:
+        t_wall = time.time()
+        with timer() as t_c:
+            tensors, ctx = wire.split_trace_context(
+                wire.deserialize_tensors(payload))
+            [x] = tensors
+            out = self.rt.run_chunk(rid, x)
+            if self.rt.spec.is_last:
+                result = [self.rt.sample_tokens(rid, step, out)]
+                dest, tag = self.header_id, self._make_tok_tag(rid, step)
+            else:
+                result = [np.asarray(out)]
+                dest, tag = self.next_id, self._make_h_tag(rid, step)
+            compute_span = self.tracer.next_span_id() if ctx else 0
+            body = (wire.serialize_tensors_traced(result, ctx[0],
+                                                  compute_span)
+                    if ctx else wire.serialize_tensors(result))
+        self.stats.record_compute(t_c.seconds)
+        if ctx is not None:
+            self._record_hop_spans(ctx, compute_span, t_wall, t_c.seconds,
+                                   rid, step)
+        self._traced_send(ctx, compute_span, dest, tag, body, rid, step)
 
     def _run_classify(self, rid: int, payload: bytes) -> None:
         """Classification hop: payload = [chunk, label_token_ids].  The
         tail answers the header with argmax-over-label-logits indices
         (reference ``inference.cpp:220-270``); other stages forward."""
+        t_wall = time.time()
         with timer() as t_c:
-            x, label_ids = wire.deserialize_tensors(payload).tensors
+            tensors, ctx = wire.split_trace_context(
+                wire.deserialize_tensors(payload))
+            x, label_ids = tensors
             out = self.rt.run_chunk(rid, x)
             if self.rt.spec.is_last:
                 logits = np.asarray(out)        # [b, V] last position
                 sub = logits[:, label_ids.astype(np.int64)]
                 pred = np.argmax(sub, axis=-1).astype(np.int32)
-                body = wire.serialize_tensors([pred])
+                result = [pred]
                 dest, tag = self.header_id, f"ctok:{rid}"
             else:
-                body = wire.serialize_tensors([np.asarray(out), label_ids])
+                result = [np.asarray(out), label_ids]
                 dest, tag = self.next_id, f"c:{rid}"
+            compute_span = self.tracer.next_span_id() if ctx else 0
+            body = (wire.serialize_tensors_traced(result, ctx[0],
+                                                  compute_span)
+                    if ctx else wire.serialize_tensors(result))
         self.stats.record_compute(t_c.seconds)
-        with timer() as t_s:
-            self.transport.send(dest, tag, body)
-        self.stats.record_send(t_s.seconds, len(body))
+        if ctx is not None:
+            self._record_hop_spans(ctx, compute_span, t_wall, t_c.seconds,
+                                   rid, 0)
+        self._traced_send(ctx, compute_span, dest, tag, body, rid, 0)
 
 
 @dataclass
@@ -269,6 +333,7 @@ class _Request:
     tokens: List[np.ndarray] = None    # collected [b] arrays
     step: int = 0
     done: bool = False
+    trace_id: int = 0                  # telemetry: ring-propagated id
 
     def __post_init__(self):
         if self.tokens is None:
@@ -291,7 +356,11 @@ class PipelineHeader:
         self.step_timeout = step_timeout
         self._next_rid = 0
         self.stats = StageStats(role="header")
+        self.tracer = TraceRecorder(f"header:{transport.device_id}")
         self._sent_at: Dict[tuple, float] = {}  # (rid, step) -> send time
+        # (rid, step) -> (trace_id, send span id, epoch ts of send end);
+        # the ring_rtt span's start/identity when the token comes back
+        self._rtt_ctx: Dict[tuple, tuple] = {}
         self._next_stats_seq = 0
 
     # -- single-stage degenerate case is the engine's job, not ours --------
@@ -299,13 +368,22 @@ class PipelineHeader:
     def _make_h_tag(self, rid: int, step: int) -> str:
         return _h_tag(rid, step)
 
-    def _send_hidden(self, rid: int, step: int, hidden) -> None:
-        body = wire.serialize_tensors([np.asarray(hidden)])
+    def _send_hidden(self, rid: int, step: int, hidden,
+                     trace_id: int = 0, parent_id: int = 0) -> None:
+        send_span = self.tracer.next_span_id() if trace_id else 0
+        body = wire.serialize_tensors_traced(
+            [np.asarray(hidden)], trace_id or None, send_span)
+        t_send = time.time()
         with timer() as t_s:
             self.transport.send(self.next_id, self._make_h_tag(rid, step),
                                 body)
         self.stats.record_send(t_s.seconds, len(body))
         self._sent_at[(rid, step)] = time.perf_counter()
+        if trace_id:
+            self.tracer.record("send", trace_id, parent_id, ts=t_send,
+                               dur=t_s.seconds, span_id=send_span,
+                               rid=rid, step=step)
+            self._rtt_ctx[(rid, step)] = (trace_id, send_span, time.time())
 
     def _prefill_array(self, req: _Request) -> np.ndarray:
         """Stage-0 prefill input for this request — token ids by default;
@@ -314,17 +392,35 @@ class PipelineHeader:
         return req.prompt.astype(np.int32)
 
     def _launch(self, req: _Request) -> None:
+        t_wall = time.time()
         with timer() as t_c:
             hidden = self.rt.run_chunk(req.rid, self._prefill_array(req))
             hidden = np.asarray(hidden)
         self.stats.record_compute(t_c.seconds)
-        self._send_hidden(req.rid, 0, hidden)
+        parent = 0
+        if req.trace_id:
+            parent = self.tracer.record(
+                "compute", req.trace_id, ts=t_wall, dur=t_c.seconds,
+                rid=req.rid, step=0, phase="prefill")
+        self._send_hidden(req.rid, 0, hidden, req.trace_id, parent)
+
+    def _record_rtt(self, rid: int, step: int) -> None:
+        """Token (or classify reply) returned: close the ring-RTT timer
+        and its span."""
+        sent = self._sent_at.pop((rid, step), None)
+        rtt_ctx = self._rtt_ctx.pop((rid, step), None)
+        if sent is None:
+            return
+        dt = time.perf_counter() - sent
+        self.stats.record_rtt(dt)
+        if rtt_ctx is not None:
+            trace_id, send_span, ts0 = rtt_ctx
+            self.tracer.record("ring_rtt", trace_id, send_span, ts=ts0,
+                               dur=dt, rid=rid, step=step)
 
     def _advance(self, req: _Request, toks: np.ndarray) -> None:
         """Got step's tokens; either issue the next decode chunk or finish."""
-        sent = self._sent_at.pop((req.rid, req.step), None)
-        if sent is not None:
-            self.stats.record_rtt(time.perf_counter() - sent)
+        self._record_rtt(req.rid, req.step)
         req.tokens.append(toks)
         req.step += 1
         if req.step >= req.max_new_tokens or (
@@ -335,13 +431,21 @@ class PipelineHeader:
             self.rt.free(req.rid)
             self._sent_at = {k: v for k, v in self._sent_at.items()
                              if k[0] != req.rid}
+            self._rtt_ctx = {k: v for k, v in self._rtt_ctx.items()
+                             if k[0] != req.rid}
             return
+        t_wall = time.time()
         with timer() as t_c:
             hidden = self.rt.run_chunk(req.rid,
                                        toks[:, None].astype(np.int32))
             hidden = np.asarray(hidden)
         self.stats.record_compute(t_c.seconds)
-        self._send_hidden(req.rid, req.step, hidden)
+        parent = 0
+        if req.trace_id:
+            parent = self.tracer.record(
+                "compute", req.trace_id, ts=t_wall, dur=t_c.seconds,
+                rid=req.rid, step=req.step, phase="decode")
+        self._send_hidden(req.rid, req.step, hidden, req.trace_id, parent)
 
     def _make_requests(self, prompts: Sequence[np.ndarray],
                        max_new_tokens) -> List[_Request]:
@@ -366,7 +470,7 @@ class PipelineHeader:
                     f"{need} exceeds KV capacity {self.rt.max_seq}")
         pending = [
             _Request(rid=self._next_rid + i, prompt=np.asarray(p),
-                     max_new_tokens=mn)
+                     max_new_tokens=mn, trace_id=new_trace_id())
             for i, (p, mn) in enumerate(zip(prompts, per))]
         self._next_rid += len(pending)
         return pending
@@ -406,7 +510,9 @@ class PipelineHeader:
             req = in_flight.get(rid)
             if req is None:
                 continue
-            [toks] = wire.deserialize_tensors(payload).tensors
+            tensors, _ = wire.split_trace_context(
+                wire.deserialize_tensors(payload))
+            [toks] = tensors
             step = req.step
             self._advance(req, toks)
             if on_token is not None:
@@ -453,24 +559,36 @@ class PipelineHeader:
                     f"{self.rt.max_seq}")
         rids = list(range(self._next_rid, self._next_rid + len(prompts)))
         self._next_rid += len(prompts)
+        trace_ids = {rid: new_trace_id() for rid in rids}
         results: Dict[int, np.ndarray] = {}
         queue = list(zip(rids, prompts))
         in_flight: Dict[int, int] = {}   # rid -> queue index (for order)
 
         def launch(rid: int, prompt: np.ndarray) -> None:
+            trace_id = trace_ids[rid]
+            t_wall = time.time()
             with timer() as t_c:
                 hidden = self.rt.run_chunk(rid, prompt.astype(np.int32))
-                body = wire.serialize_tensors(
-                    [np.asarray(hidden), label_ids])
+                send_span = self.tracer.next_span_id()
+                body = wire.serialize_tensors_traced(
+                    [np.asarray(hidden), label_ids], trace_id, send_span)
             self.stats.record_compute(t_c.seconds)
+            parent = self.tracer.record(
+                "compute", trace_id, ts=t_wall, dur=t_c.seconds,
+                rid=rid, step=0, phase="classify")
+            t_send = time.time()
             with timer() as t_s:
                 self.transport.send(self.next_id, f"c:{rid}", body)
             self.stats.record_send(t_s.seconds, len(body))
+            self.tracer.record("send", trace_id, parent, ts=t_send,
+                               dur=t_s.seconds, span_id=send_span,
+                               rid=rid, step=0)
             # rtt tracked like generate steps: the tail records one
             # compute sample per classify hop, so the header must record
             # one rtt — otherwise mixed classify+generate workloads skew
             # the index-paired activation-hop estimate (stats.snapshot)
             self._sent_at[(rid, 0)] = time.perf_counter()
+            self._rtt_ctx[(rid, 0)] = (trace_id, send_span, time.time())
 
         while queue or in_flight:
             while queue and len(in_flight) < pool_size:
@@ -487,10 +605,10 @@ class PipelineHeader:
             rid = int(rest.split(":")[0])
             if rid not in in_flight:
                 continue
-            sent = self._sent_at.pop((rid, 0), None)
-            if sent is not None:
-                self.stats.record_rtt(time.perf_counter() - sent)
-            [pred] = wire.deserialize_tensors(payload).tensors
+            self._record_rtt(rid, 0)
+            tensors, _ = wire.split_trace_context(
+                wire.deserialize_tensors(payload))
+            [pred] = tensors
             results[rid] = pred.astype(np.int32)
             self.transport.send(self.next_id, f"end:{rid}", b"")
             self.rt.free(rid)
@@ -499,7 +617,8 @@ class PipelineHeader:
         return [results[r] for r in rids]
 
     def collect_stats(self, num_stages: int,
-                      timeout: float = 10.0) -> List[dict]:
+                      timeout: float = 10.0,
+                      include_spans: bool = False) -> List[dict]:
         """Poll every downstream stage for its stats snapshot.
 
         Sends ``statsreq`` down the chain; each stage replies directly to
@@ -507,11 +626,17 @@ class PipelineHeader:
         snapshot first, then one dict per responding stage (may be fewer
         than ``num_stages - 1`` on timeout).  Call outside of generation —
         replies share the transport with token traffic.
+
+        ``include_spans`` asks every stage to drain its trace spans into
+        the reply (the :meth:`collect_trace` path — at-most-once
+        delivery: a reply that misses this poll's window loses its
+        spans).
         """
         from ..comm.transport import TransportTimeout
         seq = str(self._next_stats_seq)
         self._next_stats_seq += 1
-        self.transport.send(self.next_id, f"statsreq:{seq}", b"")
+        self.transport.send(self.next_id, f"statsreq:{seq}",
+                            b"spans" if include_spans else b"")
         mine = dict(self.stats.snapshot(include_samples=True),
                     device_id=self.transport.device_id)
         # keyed by device_id + filtered by seq: a stale reply from an
@@ -536,11 +661,28 @@ class PipelineHeader:
                             tag)
         return [mine] + list(replies.values())
 
+    def collect_trace(self, num_stages: int,
+                      timeout: float = 10.0) -> dict:
+        """Drain every stage's spans (plus the header's own) and export
+        as a Chrome trace-event JSON object (Perfetto-loadable).  Spans
+        ride the ``statsreq`` control path, so like :meth:`collect_stats`
+        this must run outside of generation.  Draining means consecutive
+        calls return disjoint span sets; worker spans are at-most-once
+        (a stage whose reply misses the poll timeout loses that batch)."""
+        from ..telemetry.tracing import to_chrome_trace
+        stats = self.collect_stats(num_stages, timeout,
+                                   include_spans=True)
+        spans = self.tracer.drain()
+        for s in stats:
+            spans.extend(s.pop("spans", None) or [])
+        return to_chrome_trace(spans)
+
     def reset_stats(self) -> None:
         """Zero our counters and every downstream stage's (e.g. after a
         compile warmup, so benchmarks report steady state only)."""
         self.stats.reset()
         self._sent_at.clear()
+        self._rtt_ctx.clear()
         self.transport.send(self.next_id, "statsreset", b"")
 
     def shutdown_pipeline(self) -> None:

@@ -287,9 +287,10 @@ class ElasticHeader(PipelineHeader):
             gen = np.stack(req.tokens, axis=1).astype(np.int32)
             ids = np.concatenate([ids, gen], axis=1)
         hidden = self.rt.run_chunk(req.rid, ids)
-        self.transport.send(self.next_id,
-                            self._make_h_tag(req.rid, req.step),
-                            wire.serialize_tensors([np.asarray(hidden)]))
+        self.transport.send(
+            self.next_id, self._make_h_tag(req.rid, req.step),
+            wire.serialize_tensors_traced([np.asarray(hidden)],
+                                          req.trace_id or None))
 
     # -- the elastic run loop ----------------------------------------------
 
@@ -341,7 +342,8 @@ class ElasticHeader(PipelineHeader):
             req = in_flight.get(rid)
             if req is None or step != req.step:
                 continue       # duplicate or out-of-order token
-            [toks] = wire.deserialize_tensors(payload).tensors
+            [toks] = wire.split_trace_context(
+                wire.deserialize_tensors(payload))[0]
             if on_token is not None:
                 on_token(rid_to_index[rid], step, toks)
             try:

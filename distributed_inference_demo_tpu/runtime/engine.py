@@ -31,6 +31,7 @@ from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..models.decoder import stage_forward
 from ..ops.flash_attention import make_flash_attn_impl
 from ..ops.sampling import SamplingParams, sample_logits
+from ..telemetry.runlog import get_run_log
 
 
 def shard_engine_params(params: "StageParams", cfg: "ModelConfig", mesh):
@@ -400,9 +401,17 @@ class InferenceEngine:
         toks = np.asarray(toks)
         lps_np = np.asarray(lps) if logprobs else None
         dt = time.perf_counter() - t0
-        return GenerationResult(tokens=toks, prompt_len=plen,
-                                num_new=max_new_tokens, seconds=dt,
-                                logprobs=lps_np)
+        result = GenerationResult(tokens=toks, prompt_len=plen,
+                                  num_new=max_new_tokens, seconds=dt,
+                                  logprobs=lps_np)
+        rl = get_run_log()
+        if rl.enabled:   # per-request summary in the structured run log
+            rl.event("generate", engine=type(self).__name__,
+                     batch=b, prompt_len=plen,
+                     new_tokens=max_new_tokens,
+                     seconds=round(dt, 6),
+                     tokens_per_sec=round(result.tokens_per_second, 2))
+        return result
 
     def classify(self, prompt_ids: np.ndarray,
                  label_token_ids) -> np.ndarray:
@@ -421,7 +430,14 @@ class InferenceEngine:
         cache = self.new_cache(ids.shape[0])
         logits, _ = self._run_prefill(ids, cache)
         sub = np.asarray(logits)[:, label_ids]
-        return np.argmax(sub, axis=-1).astype(np.int32)
+        pred = np.argmax(sub, axis=-1).astype(np.int32)
+        rl = get_run_log()
+        if rl.enabled:
+            rl.event("classify", engine=type(self).__name__,
+                     batch=int(ids.shape[0]),
+                     prompt_len=int(ids.shape[1]),
+                     num_labels=int(label_ids.size))
+        return pred
 
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         seed: int = 0,

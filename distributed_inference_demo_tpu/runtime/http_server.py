@@ -9,6 +9,11 @@ inference request with ``"Inference not implemented yet"``
   byte counts, ring-RTT percentiles — the reference's
   ``commutimeArraySum``/``infertimeArraySum`` dump as an API,
   ``Communication.java:650-661``)
+- ``GET  /metrics``   — Prometheus text exposition (telemetry/catalog):
+  the same stage counters as /stats plus batching/speculative and
+  monitor series, scrapeable by a stock Prometheus
+- ``GET  /trace``     — Chrome trace-event JSON of the spans recorded
+  since the last call (pipeline backends only; load in Perfetto)
 - ``POST /generate``  — ``{"prompt_ids": [[...]], "max_new_tokens": N,
   "stream": false}`` → ``{"tokens": [[...]]}``; with ``"prompt": "text"``
   when a tokenizer is attached; ``"stream": true`` switches to chunked
@@ -25,10 +30,13 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
+
+from ..telemetry import catalog as _metrics
 
 
 def _round_lps(row) -> list:
@@ -201,6 +209,29 @@ class HeaderBackend:
             stages = self.header.collect_stats(self.num_stages)
         return {"stages": stages}
 
+    def export_trace(self) -> dict:
+        """Chrome trace JSON of all spans recorded since the last export
+        (header + every downstream stage, via the statsreq path)."""
+        with self._lock:
+            return self.header.collect_trace(self.num_stages)
+
+    def scrape_stats(self) -> dict:
+        """Like :meth:`stats` but BOUNDED end to end — a Prometheus
+        scrape runs on a schedule and must not stall behind an in-flight
+        generation (the request lock is held for a whole run) or a dead
+        stage (the stats poll waits ~10s per missing reply).  When the
+        pipeline is busy, return no stages: the scrape renders the
+        last-bridged series instead of going DOWN exactly while the
+        system is under the load telemetry exists to observe."""
+        if not self._lock.acquire(timeout=2.0):
+            return {"stages": []}
+        try:
+            stages = self.header.collect_stats(self.num_stages,
+                                               timeout=2.0)
+        finally:
+            self._lock.release()
+        return {"stages": stages}
+
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0):
         import time
@@ -276,7 +307,21 @@ class InferenceHTTPServer:
             def log_message(self, fmt, *args):   # quiet by default
                 pass
 
+            # known routes only: the route label must stay bounded — a
+            # client probing arbitrary paths must not mint one counter
+            # child (and one /metrics line) per junk URL forever
+            _ROUTES = frozenset((
+                "/health", "/stats", "/stats/reset", "/metrics", "/trace",
+                "/generate", "/classify"))
+
             def _json(self, code: int, obj: dict) -> None:
+                # counted BEFORE the body goes out: a client that reacts
+                # to this response with a /metrics scrape must see its
+                # own request (the scrape itself bypasses _json)
+                route = self.path.split("?")[0]
+                if route not in self._ROUTES:
+                    route = "other"
+                _metrics.HTTP_REQUESTS.inc(route=route, code=str(code))
                 body = json.dumps(obj).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -284,8 +329,39 @@ class InferenceHTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _metrics_scrape(self) -> None:
+                """Prometheus text exposition over the shared registry +
+                this backend's bridged series (telemetry/catalog)."""
+                try:
+                    text = _metrics.scrape(outer.backend)
+                    code = 200
+                except Exception as e:   # the scrape must never crash
+                    text = f"# scrape error: {e}\n"
+                    code = 500
+                body = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
-                if self.path == "/health":
+                if self.path.split("?")[0] == "/metrics":
+                    self._metrics_scrape()
+                elif self.path == "/trace":
+                    # spans recorded since the last /trace call, as
+                    # Chrome trace JSON (Perfetto-loadable)
+                    if hasattr(outer.backend, "export_trace"):
+                        try:
+                            self._json(200, outer.backend.export_trace())
+                        except Exception as e:
+                            self._json(500, {"error": str(e)})
+                    else:
+                        self._json(501, {"error": "backend has no trace "
+                                                  "export"})
+                elif self.path == "/health":
                     import jax
                     self._json(200, {
                         "status": "ok",
@@ -399,8 +475,13 @@ class InferenceHTTPServer:
                                              "logprobs"})
                                 return
                             kwargs["logprobs"] = True
+                        t_req = time.perf_counter()
                         res = outer.backend.generate(ids, max_new,
                                                      seed=seed, **kwargs)
+                        _metrics.HTTP_REQUEST_SECONDS.observe(
+                            time.perf_counter() - t_req, route="/generate")
+                        _metrics.HTTP_GENERATED_TOKENS.inc(
+                            int(res.tokens.size))
                         out = {"tokens": res.tokens.tolist()}
                         if getattr(res, "logprobs", None) is not None:
                             out["logprobs"] = [_round_lps(row)

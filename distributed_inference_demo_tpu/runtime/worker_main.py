@@ -90,13 +90,29 @@ def main(argv=None) -> int:
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over this host's first N "
                          "local devices (pipeline x tp)")
+    ap.add_argument("--metrics-port", type=int, default=-1,
+                    help="serve Prometheus GET /metrics on this port "
+                         "(0 = ephemeral, -1 = disabled); the header's "
+                         "main HTTP server has its own /metrics")
     args = ap.parse_args(argv)
 
     worker, transport = build_worker(args)
+    metrics_srv = None
+    if args.metrics_port >= 0:
+        from ..telemetry import MetricsHTTPServer
+        from ..telemetry import catalog as _catalog
+        metrics_srv = MetricsHTTPServer(
+            lambda: _catalog.render_worker(worker.stats, args.device_id),
+            host=args.bind_host, port=args.metrics_port)
+        metrics_srv.start()
+        print(f"METRICS_READY http://{metrics_srv.host}:"
+              f"{metrics_srv.port}/metrics", flush=True)
     print(f"WORKER_READY {args.device_id} {transport.address}", flush=True)
     try:
         worker.serve_forever()
     finally:
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
         transport.close()
     return 0
 

@@ -1,0 +1,31 @@
+"""Unified telemetry layer: request tracing, Prometheus metrics, run logs.
+
+Three integrated pieces (docs/DESIGN.md §7):
+
+- ``tracing``: per-request trace ids propagated across the ring via a
+  wire flags bit (``comm/wire.py``), per-stage spans, Chrome trace-event
+  export for Perfetto;
+- ``metrics``: a hand-rolled Prometheus registry (no new dependency) +
+  ``catalog``, the standard ``dwt_*`` series bridging StageStats,
+  batching/speculative counters, and monitor probes to ``GET /metrics``;
+- ``runlog``: structured JSONL run logs shared by bench, the engines,
+  and the control-plane lifecycle.
+
+``catalog`` is imported lazily by its consumers (it pulls in
+monitor.probes); importing this package stays dependency-light so the
+engine hot path can use ``runlog`` without dragging the control plane in.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricError,
+                      MetricsHTTPServer, REGISTRY, Registry)
+from .runlog import RunLog, get_run_log, new_run_id, set_run_log
+from .tracing import (TraceRecorder, new_trace_id, to_chrome_trace,
+                      write_chrome_trace)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricError", "MetricsHTTPServer",
+    "REGISTRY", "Registry",
+    "RunLog", "get_run_log", "new_run_id", "set_run_log",
+    "TraceRecorder", "new_trace_id", "to_chrome_trace",
+    "write_chrome_trace",
+]

@@ -1,0 +1,268 @@
+"""The repo's standard metric set, registered at import time.
+
+One place owns every Prometheus series name, its help text, and the
+mapping from the existing stats surfaces (``runtime/stats.StageStats``
+snapshots, ``runtime/batching`` scheduler counters, ``monitor/probes``
+measurements, HTTP handler events) onto those series.  Naming convention
+``dwt_<subsystem>_<name>_<unit>`` (+ ``_total`` on counters) is enforced
+by ``tools/check_metrics_names.py``, which walks :data:`metrics.REGISTRY`
+after importing this module.
+
+``scrape(backend)`` is the one entry point the HTTP handlers call: it
+refreshes snapshot-bridged series from the backend and renders the
+registry.
+"""
+
+from __future__ import annotations
+
+from .metrics import (LATENCY_BUCKETS_S, REGISTRY, counter, gauge,
+                      histogram)
+
+# -- stage (pipeline role) series, bridged from StageStats snapshots -------
+
+_STAGE_LABELS = ("role", "device")
+
+STAGE_STEPS = counter(
+    "dwt_stage_steps_total",
+    "Pipeline compute steps executed by this stage (prefill or decode "
+    "chunk)", _STAGE_LABELS)
+STAGE_RECV_WAIT = counter(
+    "dwt_stage_recv_wait_seconds_total",
+    "Seconds this stage spent blocked waiting for inbound ring messages",
+    _STAGE_LABELS)
+STAGE_COMPUTE = counter(
+    "dwt_stage_compute_seconds_total",
+    "Seconds of stage compute (deserialize + forward + serialize)",
+    _STAGE_LABELS)
+STAGE_SEND = counter(
+    "dwt_stage_send_seconds_total",
+    "Seconds spent in transport send calls", _STAGE_LABELS)
+STAGE_RECV_BYTES = counter(
+    "dwt_stage_recv_bytes_total",
+    "Bytes received from the ring by this stage", _STAGE_LABELS)
+STAGE_SENT_BYTES = counter(
+    "dwt_stage_sent_bytes_total",
+    "Bytes sent to the ring by this stage", _STAGE_LABELS)
+STAGE_RECV_MSGS = counter(
+    "dwt_stage_recv_messages_total",
+    "Ring messages received by this stage", _STAGE_LABELS)
+STAGE_SENT_MSGS = counter(
+    "dwt_stage_sent_messages_total",
+    "Ring messages sent by this stage", _STAGE_LABELS)
+STAGE_UPTIME = gauge(
+    "dwt_stage_uptime_seconds",
+    "Seconds since this stage's stats were created or reset",
+    _STAGE_LABELS)
+
+_STAGE_PCT = {}
+for _phase, _help in (("compute", "per-step stage compute latency"),
+                      ("ring_rtt", "header hidden-out to token-back ring "
+                                   "round trip")):
+    for _q in (50, 95, 99):
+        _STAGE_PCT[(_phase, _q)] = gauge(
+            f"dwt_stage_{_phase}_p{_q}_seconds",
+            f"p{_q} of {_help} (bounded reservoir)", _STAGE_LABELS)
+
+
+def update_stage_series(snapshots) -> None:
+    """Bridge StageStats ``snapshot()`` dicts (one per pipeline stage,
+    as returned by ``PipelineHeader.collect_stats`` / ``/stats``) onto
+    the ``dwt_stage_*`` series."""
+    for s in snapshots:
+        if not isinstance(s, dict) or "role" not in s:
+            continue
+        lab = {"role": s["role"], "device": s.get("device_id", "")}
+        STAGE_STEPS.set_cumulative(s.get("steps", 0), **lab)
+        STAGE_RECV_WAIT.set_cumulative(s.get("recv_wait_s", 0.0), **lab)
+        STAGE_COMPUTE.set_cumulative(s.get("compute_s", 0.0), **lab)
+        STAGE_SEND.set_cumulative(s.get("send_s", 0.0), **lab)
+        STAGE_RECV_BYTES.set_cumulative(s.get("bytes_in", 0), **lab)
+        STAGE_SENT_BYTES.set_cumulative(s.get("bytes_out", 0), **lab)
+        STAGE_RECV_MSGS.set_cumulative(s.get("messages_in", 0), **lab)
+        STAGE_SENT_MSGS.set_cumulative(s.get("messages_out", 0), **lab)
+        STAGE_UPTIME.set(s.get("uptime_s", 0.0), **lab)
+        for (phase, q), g in _STAGE_PCT.items():
+            v = s.get(f"{phase}_p{q}_ms")
+            # absent key = empty reservoir (fresh or just reset): the
+            # gauge must say "no data" (NaN), not keep reporting the
+            # pre-reset (e.g. compile-warmup) latency forever
+            g.set(v / 1e3 if v is not None else float("nan"), **lab)
+
+
+# -- batching / speculative series, bridged from scheduler counters --------
+
+BATCH_QUEUE_DEPTH = gauge(
+    "dwt_batching_queue_depth_requests",
+    "Requests admitted to the scheduler but not yet holding a slot "
+    "(submit queue + pending)")
+BATCH_ACTIVE = gauge(
+    "dwt_batching_active_slots",
+    "Slots currently decoding a request")
+BATCH_CAPACITY = gauge(
+    "dwt_batching_capacity_slots",
+    "Total decode slots in the continuous-batching pool")
+BATCH_STEPS = counter(
+    "dwt_batching_steps_total",
+    "Lockstep decode steps (or speculative rounds) executed by the slot "
+    "scheduler")
+BATCH_COMPLETED = counter(
+    "dwt_batching_completed_requests_total",
+    "Requests fully served by the slot scheduler")
+PREFIX_HITS = counter(
+    "dwt_batching_prefix_cache_hits_total",
+    "Prefix-cache lookups that reused a stored KV block")
+PREFIX_MISSES = counter(
+    "dwt_batching_prefix_cache_misses_total",
+    "Prefix-cache lookups that found no usable KV block")
+PREFIX_REUSED = counter(
+    "dwt_batching_prefix_reused_tokens_total",
+    "Prompt tokens whose prefill was skipped via the prefix cache")
+_BATCH_PCT = {
+    (name, q): gauge(
+        f"dwt_batching_{name}_p{q}_seconds",
+        f"p{q} {desc} over the last completed requests")
+    for name, desc in (("ttft", "time to first token"),
+                       ("e2e", "request end-to-end latency"),
+                       ("per_token", "per-output-token latency"))
+    for q in (50, 95)}
+
+SPEC_ROUNDS = counter(
+    "dwt_speculative_rounds_total",
+    "Draft/verify rounds executed (speculative or prompt-lookup)")
+SPEC_DRAFTED = counter(
+    "dwt_speculative_drafted_tokens_total",
+    "Draft tokens proposed to the verifier")
+SPEC_ACCEPTED = counter(
+    "dwt_speculative_accepted_tokens_total",
+    "Draft tokens accepted by the verifier (excl. bonus/resample)")
+SPEC_ACCEPT_RATIO = gauge(
+    "dwt_speculative_accept_ratio",
+    "accepted/drafted over the counters' lifetime (NaN until the first "
+    "draft)")
+
+
+def update_batching_series(stats: dict) -> None:
+    """Bridge ``ContinuousBatchingEngine.stats()`` (or any dict with the
+    same keys) onto the ``dwt_batching_*`` / ``dwt_speculative_*``
+    series."""
+    if "slots" in stats:
+        BATCH_CAPACITY.set(stats["slots"])
+    if "queue_depth" in stats:
+        BATCH_QUEUE_DEPTH.set(stats["queue_depth"])
+    if "active_slots" in stats:
+        BATCH_ACTIVE.set(stats["active_slots"])
+    if "steps" in stats:
+        BATCH_STEPS.set_cumulative(stats["steps"])
+    lat = stats.get("latency") or {}
+    if "completed" in lat:
+        BATCH_COMPLETED.set_cumulative(lat["completed"])
+    for (name, q), g in _BATCH_PCT.items():
+        v = lat.get(f"{name}_p{q}_ms")
+        # NaN on empty/reset reservoirs, as in update_stage_series
+        g.set(v / 1e3 if v is not None else float("nan"))
+    pc = stats.get("prefix_cache") or {}
+    if pc:
+        PREFIX_HITS.set_cumulative(pc.get("hits", 0))
+        PREFIX_MISSES.set_cumulative(pc.get("misses", 0))
+        PREFIX_REUSED.set_cumulative(pc.get("tokens_reused", 0))
+    sp = stats.get("speculative") or {}
+    if sp:
+        SPEC_ROUNDS.set_cumulative(sp.get("rounds", 0))
+        if "drafted" in sp:
+            SPEC_DRAFTED.set_cumulative(sp["drafted"])
+        if "accepted" in sp:
+            SPEC_ACCEPTED.set_cumulative(sp["accepted"])
+        if sp.get("acceptance_rate") is not None:
+            SPEC_ACCEPT_RATIO.set(sp["acceptance_rate"])
+
+
+# -- HTTP serving series (event-driven, not snapshot-bridged) --------------
+
+HTTP_REQUESTS = counter(
+    "dwt_http_requests_total",
+    "HTTP requests answered, by route and status code",
+    ("route", "code"))
+HTTP_REQUEST_SECONDS = histogram(
+    "dwt_http_request_seconds",
+    "Wall-clock latency of successful blocking inference requests",
+    ("route",), buckets=LATENCY_BUCKETS_S)
+HTTP_GENERATED_TOKENS = counter(
+    "dwt_http_generated_tokens_total",
+    "Tokens returned by successful /generate requests")
+
+
+# -- monitor series (probes.py measurements) -------------------------------
+
+MONITOR_MEMORY = gauge(
+    "dwt_monitor_host_memory_bytes",
+    "Host memory from /proc/meminfo, by kind (total/available)",
+    ("kind",))
+MONITOR_BANDWIDTH = gauge(
+    "dwt_monitor_peer_bandwidth_bytes_per_second",
+    "Last measured p2p flood bandwidth to a peer (monitor round)",
+    ("peer",))
+MONITOR_LATENCY = gauge(
+    "dwt_monitor_peer_latency_seconds",
+    "Last measured TCP connect RTT to a peer (monitor round)",
+    ("peer",))
+MONITOR_FLOPS = gauge(
+    "dwt_monitor_compute_flops_per_second",
+    "Measured matmul throughput of the local accelerator (flops probe)")
+
+
+def update_monitor_series() -> None:
+    """Refresh the host-memory gauges (cheap: one /proc read).  Peer
+    bandwidth/latency/flops update when the monitor agent measures
+    (:func:`record_monitor_round`)."""
+    from ..monitor.probes import memory_info
+    mem = memory_info()
+    MONITOR_MEMORY.set(mem.get("total", 0), kind="total")
+    MONITOR_MEMORY.set(mem.get("available", 0), kind="available")
+
+
+def record_monitor_round(report: dict) -> None:
+    """Feed one MonitorAgent ``measure_round`` report into the gauges."""
+    for peer, v in (report.get("bandwidth") or {}).items():
+        MONITOR_BANDWIDTH.set(v, peer=peer)
+    for peer, v in (report.get("latency") or {}).items():
+        MONITOR_LATENCY.set(v, peer=peer)
+    if report.get("flops"):
+        MONITOR_FLOPS.set(report["flops"])
+
+
+# -- the scrape entry point ------------------------------------------------
+
+def scrape(backend=None) -> str:
+    """Refresh snapshot-bridged series from ``backend`` (anything with a
+    ``stats()`` dict — a HeaderBackend, a ContinuousBatchingEngine, a
+    PipelineWorker's StageStats via ``render_worker``) and render the
+    registry.  A failing backend degrades to whatever already rendered —
+    a scrape must never 500 because the pipeline is mid-request.
+
+    Backends that poll remote stages prefer ``scrape_stats()`` (bounded
+    timeout) over ``stats()`` so a scheduled Prometheus scrape cannot
+    stall on a dead stage."""
+    update_monitor_series()
+    fn = getattr(backend, "scrape_stats", None) or getattr(
+        backend, "stats", None)
+    if fn is not None:
+        try:
+            snap = fn()
+        except Exception:
+            snap = None
+        if isinstance(snap, dict):
+            stages = snap.get("stages")
+            if isinstance(stages, list):
+                update_stage_series(stages)
+            else:
+                update_batching_series(snap)
+    return REGISTRY.render()
+
+
+def render_worker(stage_stats, device_id: str = "") -> str:
+    """Scrape provider for a standalone stage-worker process: bridge its
+    StageStats and render (``worker_main --metrics-port``)."""
+    update_monitor_series()
+    snap = dict(stage_stats.snapshot(), device_id=device_id)
+    update_stage_series([snap])
+    return REGISTRY.render()

@@ -1,0 +1,128 @@
+"""Structured JSONL run logs: one event per line, one file per run.
+
+Replaces scattered prints as the machine-readable record of a run: the
+bench harness, the engines, and the control-plane lifecycle all emit
+through one surface.  Every line is a self-contained JSON object::
+
+    {"ts": <epoch seconds>, "run_id": "...", "event": "<kind>", ...fields}
+
+Enabling: pass a path explicitly (``RunLog(path)`` + ``set_run_log``), use
+``serve --run-log`` / ``bench --run-log``, or set ``DWT_RUN_LOG=<path>``
+in the environment — any process in the deployment then appends to its
+own file (the path gets a ``.<pid>`` suffix when it would be shared, so
+workers never interleave partial lines with the header).  When nothing is
+configured, ``get_run_log()`` returns a no-op sink: instrumented hot paths
+cost one attribute check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import IO, Optional
+
+
+def new_run_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class RunLog:
+    """Append-only JSONL event sink.  Thread-safe; every event is one
+    ``write`` + ``flush`` so a crash loses at most the in-flight line."""
+
+    enabled = True
+
+    def __init__(self, path: Optional[str] = None,
+                 fileobj: Optional[IO[str]] = None,
+                 run_id: Optional[str] = None):
+        if (path is None) == (fileobj is None):
+            raise ValueError("RunLog needs exactly one of path/fileobj")
+        self.run_id = run_id or new_run_id()
+        self.path = path
+        # opened EAGERLY: a bad --run-log path must fail loudly at
+        # startup, not silently drop every event of the run
+        self._f = fileobj if fileobj is not None else open(
+            path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def event(self, kind: str, **fields) -> None:
+        rec = {"ts": round(time.time(), 6), "run_id": self.run_id,
+               "event": kind}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, default=str) + "\n"
+        except (TypeError, ValueError):
+            line = json.dumps({"ts": rec["ts"], "run_id": self.run_id,
+                               "event": kind,
+                               "error": "unserializable fields"}) + "\n"
+        with self._lock:
+            if self._f is None:
+                return          # closed
+            try:
+                self._f.write(line)
+                self._f.flush()
+            except (OSError, ValueError):
+                pass    # a full disk must never take down the serving loop
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None and self.path is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+
+class _NullRunLog:
+    """No-op sink returned when no run log is configured."""
+
+    enabled = False
+    run_id = ""
+
+    def event(self, kind: str, **fields) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+NULL = _NullRunLog()
+_default: object = None
+_default_lock = threading.Lock()
+
+
+def set_run_log(runlog) -> None:
+    """Install the process-default run log (``None`` restores the no-op)."""
+    global _default
+    with _default_lock:
+        _default = runlog
+
+
+def get_run_log():
+    """The process-default run log.  Lazily honors ``DWT_RUN_LOG``: the
+    first call in a process with the env var set opens
+    ``$DWT_RUN_LOG.<pid>`` (per-process files — concurrent workers must
+    not interleave lines in one file).  An unopenable env path degrades
+    to the no-op sink with one stderr warning — the env var is ambient
+    configuration and must not crash a serving hot path."""
+    global _default
+    if _default is not None:
+        return _default
+    with _default_lock:
+        if _default is None:
+            path = os.environ.get("DWT_RUN_LOG", "")
+            if path:
+                try:
+                    _default = RunLog(f"{path}.{os.getpid()}")
+                except OSError as e:
+                    import sys
+                    print(f"runlog: cannot open {path!r}: {e}; run-log "
+                          "events disabled", file=sys.stderr)
+                    _default = NULL
+            else:
+                _default = NULL
+    return _default

@@ -1,0 +1,67 @@
+"""Tier-1 hook for the metric-name lint (tools/check_metrics_names.py):
+the full standard series set (telemetry/catalog) must follow the
+``dwt_<subsystem>_<name>_<unit>`` convention with help text on every
+metric — a new metric with a bad name fails the suite, not a style
+review."""
+
+import importlib.util
+import pathlib
+
+from distributed_inference_demo_tpu.telemetry import catalog  # noqa: F401
+from distributed_inference_demo_tpu.telemetry.metrics import (
+    Counter, Gauge, REGISTRY, Registry)
+
+
+def _load_lint():
+    path = (pathlib.Path(__file__).resolve().parents[1] / "tools"
+            / "check_metrics_names.py")
+    spec = importlib.util.spec_from_file_location("check_metrics_names",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_standard_catalog_is_clean():
+    lint = _load_lint()
+    problems = lint.check_registry(REGISTRY)
+    assert problems == []
+
+
+def test_lint_catches_violations():
+    """The lint actually fires: a unitless name, a foreign prefix, a
+    counter without _total, and a gauge pretending to be a counter all
+    produce violations."""
+    lint = _load_lint()
+    reg = Registry()
+    reg.register(Counter("dwt_stage_emitted_tokens_total",
+                         "a clean counter"))
+    reg.register(Counter("dwt_stage_stuff", "no unit, no total"))
+    reg.register(Gauge("foo_bar_seconds", "foreign prefix"))
+    reg.register(Gauge("dwt_stage_bad_seconds_total",
+                       "gauge claiming _total"))
+    problems = lint.check_registry(reg)
+    assert not any("dwt_stage_emitted_tokens_total" in p
+                   for p in problems)
+    assert any("dwt_stage_stuff" in p and "_total" in p
+               for p in problems)
+    assert any("dwt_stage_stuff" in p and "unit" in p for p in problems)
+    assert any("foo_bar_seconds" in p for p in problems)
+    assert any("dwt_stage_bad_seconds_total" in p and "reserved"
+               in p for p in problems)
+
+
+def test_lint_requires_help_text():
+    """Help text is enforced at construction (MetricError) AND by the
+    lint for registries built another way."""
+    import pytest
+
+    from distributed_inference_demo_tpu.telemetry.metrics import \
+        MetricError
+    with pytest.raises(MetricError):
+        Counter("dwt_stage_x_bytes_total", "   ")
+
+
+def test_main_exits_clean():
+    lint = _load_lint()
+    assert lint.main() == 0

@@ -57,7 +57,9 @@ def test_percentile_helper():
     xs = list(range(1, 101))
     assert _percentile(xs, 50) == 50
     assert _percentile(xs, 95) == 95
+    assert _percentile(xs, 99) == 99
     assert _percentile([7.0], 95) == 7.0
+    assert _percentile([7.0], 99) == 7.0
 
 
 def test_pipeline_records_stats():
@@ -76,6 +78,7 @@ def test_pipeline_records_stats():
     assert "ring_rtt_p50_ms" in h and h["ring_rtt_p50_ms"] >= 0
     assert "ring_rtt_p95_ms" in h
     assert h["ring_rtt_p95_ms"] >= h["ring_rtt_p50_ms"]
+    assert h["ring_rtt_p99_ms"] >= h["ring_rtt_p95_ms"]
 
     stats = header.collect_stats(num_stages=3)
     header.shutdown_pipeline()

@@ -134,6 +134,91 @@ def test_malformed_rejected_both_impls(mutate):
         native_codec.deserialize_tensors(blob)
 
 
+# -- trace-context flag (telemetry) -----------------------------------------
+
+def test_trace_context_roundtrip_python():
+    arrays = [np.arange(6, dtype=np.float32).reshape(2, 3)]
+    blob = wire.serialize_tensors_traced(arrays, trace_id=0xDEADBEEF,
+                                         parent_span_id=42)
+    msg = wire.deserialize_tensors(blob)
+    assert msg.flags & wire.FLAG_TRACE_CONTEXT
+    tensors, ctx = wire.split_trace_context(msg)
+    assert ctx == (0xDEADBEEF, 42)
+    assert len(tensors) == 1
+    np.testing.assert_array_equal(tensors[0], arrays[0])
+
+
+def test_trace_context_u64_extremes():
+    huge = (1 << 64) - 1
+    blob = wire.serialize_tensors_traced([], trace_id=huge,
+                                         parent_span_id=huge)
+    _, ctx = wire.split_trace_context(wire.deserialize_tensors(blob))
+    assert ctx == (huge, huge)
+
+
+def test_untraced_frames_byte_identical():
+    """Frames without the trace bit are EXACTLY today's format — pinned
+    against a hand-computed golden blob, and serialize_tensors_traced
+    with trace_id=None is a byte-level no-op."""
+    a = np.arange(3, dtype=np.int32)
+    blob = wire.serialize_tensors([a])
+    golden = (b"DWT1" + bytes([1, 0]) + b"\x00\x00"      # ver, flags, rsv
+              + (1).to_bytes(4, "little")                # ntensors
+              + bytes([int(wire.DType.I32), 1]) + b"\x00\x00"
+              + (12).to_bytes(8, "little")               # nbytes
+              + (3).to_bytes(8, "little")                # dims
+              + a.tobytes())
+    assert blob == golden
+    assert wire.serialize_tensors_traced([a], None) == blob
+    msg = wire.deserialize_tensors(blob)
+    assert not (msg.flags & wire.FLAG_TRACE_CONTEXT)
+    tensors, ctx = wire.split_trace_context(msg)
+    assert ctx is None and len(tensors) == 1
+
+
+def test_trace_context_native_codec_ignores_flag_gracefully():
+    """The C++ decoder (native/codec.cc) predates the trace bit: it must
+    decode traced frames without change — flags preserved verbatim, the
+    trailer visible as an ordinary u64[2] tensor — so split_trace_context
+    works identically on either decoder's output."""
+    if not native_codec.available():
+        pytest.skip("native codec absent")
+    arrays = [np.arange(4, dtype=np.float32)]
+    blob = wire.serialize_tensors_traced(arrays, trace_id=7,
+                                         parent_span_id=9)
+    nat_msg = native_codec.deserialize_tensors(blob)
+    assert nat_msg.flags & wire.FLAG_TRACE_CONTEXT
+    assert len(nat_msg.tensors) == 2        # payload + trailer, ordinary
+    tensors, ctx = wire.split_trace_context(nat_msg)
+    assert ctx == (7, 9)
+    np.testing.assert_array_equal(tensors[0], arrays[0])
+
+
+def test_trace_context_native_python_byte_identical():
+    """Encoding the payload+trailer+flag through the native serializer
+    produces byte-identical wire output (both directions of compat)."""
+    if not native_codec.available():
+        pytest.skip("native codec absent")
+    arrays = [np.arange(4, dtype=np.float32)]
+    trailer = np.array([7, 9], dtype="<u8")
+    py = wire.serialize_tensors_traced(arrays, 7, 9)
+    nat = native_codec.serialize_tensors(
+        arrays + [trailer], flags=wire.FLAG_TRACE_CONTEXT)
+    assert py == nat
+
+
+def test_trace_flag_with_malformed_trailer_rejected():
+    # flag set but the last tensor is not a u64[2]: hard error, never a
+    # silently mis-split payload
+    blob = wire.serialize_tensors(
+        [np.arange(3, dtype=np.float32)], flags=wire.FLAG_TRACE_CONTEXT)
+    with pytest.raises(wire.WireError):
+        wire.split_trace_context(wire.deserialize_tensors(blob))
+    empty = wire.serialize_tensors([], flags=wire.FLAG_TRACE_CONTEXT)
+    with pytest.raises(wire.WireError):
+        wire.split_trace_context(wire.deserialize_tensors(empty))
+
+
 def test_token_roundtrip():
     for t in (0, 1, -1, 2**31 - 1, -(2**31)):
         assert wire.deserialize_token(wire.serialize_token(t)) == t

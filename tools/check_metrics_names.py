@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Metric-name lint: every registered Prometheus series must follow the
+repo convention (docs/DESIGN.md §7).
+
+Rules, checked against the default registry after importing
+``telemetry.catalog`` (which registers the full standard set at import
+time):
+
+1. names are ``dwt_<subsystem>_<rest>`` — the ``dwt_`` prefix namespaces
+   the repo and ``<subsystem>`` must be a known subsystem;
+2. the name ends in a recognized unit suffix (counters may follow the
+   unit with Prometheus's ``_total``); dimensionless gauges must say so
+   (``_ratio`` / bare count units like ``_slots``);
+3. every metric has non-empty help text (enforced structurally by
+   ``metrics.Metric`` — re-checked here so a future constructor bypass
+   still fails the lint);
+4. counters end in ``_total``; non-counters must NOT (the Prometheus
+   convention scrapers and recording rules rely on).
+
+Run standalone (``python tools/check_metrics_names.py``, exit 1 on
+violations) or via the tier-1 suite (``tests/test_metrics_names.py``).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List
+
+SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
+              "engine", "control"}
+
+# unit suffixes a metric name may end with (after stripping ``_total``).
+# Plain-count units (requests, tokens, ...) double as the unit for
+# occupancy gauges (queue depth in requests, capacity in slots).
+UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
+         "rounds", "hits", "misses", "slots", "spans", "entries",
+         "ratio", "bytes_per_second", "flops_per_second", "celsius",
+         "info"}
+
+
+def check_registry(registry) -> List[str]:
+    """Return a list of human-readable violations (empty = clean)."""
+    problems: List[str] = []
+    for m in registry.collect():
+        name = m.name
+        if not getattr(m, "help", "").strip():
+            problems.append(f"{name}: missing help text")
+        parts = name.split("_")
+        if parts[0] != "dwt" or len(parts) < 3:
+            problems.append(
+                f"{name}: must be dwt_<subsystem>_<name>_<unit>")
+            continue
+        if parts[1] not in SUBSYSTEMS:
+            problems.append(
+                f"{name}: unknown subsystem {parts[1]!r} (known: "
+                f"{sorted(SUBSYSTEMS)})")
+        is_counter = getattr(m, "type", "") == "counter"
+        stripped = parts[:-1] if parts[-1] == "total" else parts
+        if is_counter and parts[-1] != "total":
+            problems.append(f"{name}: counters must end in _total")
+        if not is_counter and parts[-1] == "total":
+            problems.append(
+                f"{name}: _total is reserved for counters "
+                f"(type is {m.type!r})")
+        # unit may be one or two tokens (bytes_per_second)
+        unit1 = stripped[-1]
+        unit3 = "_".join(stripped[-3:]) if len(stripped) >= 3 else ""
+        if unit1 not in UNITS and unit3 not in UNITS:
+            problems.append(
+                f"{name}: missing unit suffix (allowed: {sorted(UNITS)})")
+    return problems
+
+
+def main() -> int:
+    # repo root on sys.path when run as a script from anywhere
+    import pathlib
+    root = str(pathlib.Path(__file__).resolve().parents[1])
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from distributed_inference_demo_tpu.telemetry import catalog  # noqa: F401
+    from distributed_inference_demo_tpu.telemetry.metrics import REGISTRY
+
+    problems = check_registry(REGISTRY)
+    for p in problems:
+        print(f"METRIC LINT: {p}", file=sys.stderr)
+    if problems:
+        print(f"{len(problems)} metric naming violation(s)",
+              file=sys.stderr)
+        return 1
+    n = len(REGISTRY.collect())
+    print(f"metric names OK ({n} series checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
