@@ -143,9 +143,11 @@ def apply_measured_frac(leg, ceiling) -> None:
 
 
 def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
-                  quant=False) -> dict:
+                  quant=False, latency: bool = False) -> dict:
     """Single-chip decode + prefill throughput via InferenceEngine.
-    ``quant``: False | True (int8) | "int8" | "int4"."""
+    ``quant``: False | True (int8) | "int8" | "int4".  ``latency`` adds
+    per-request TTFT/TPOT percentiles (one extra compiled program — the
+    streamed step — so only the headline legs pay for it)."""
     import jax
     import numpy as np
     from distributed_inference_demo_tpu.models import get_model_config
@@ -199,6 +201,9 @@ def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
         "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
         "dtype": mode if mode else cfg.dtype_name,
     }
+    if latency:
+        out["latency"] = _latency_percentiles(engine, prompt[:1],
+                                              min(new_tokens, 16))
     out = _with_bandwidth(out, params.nbytes(), _device_kind())
     # cache-READ traffic estimate per second: each decode step attends
     # the whole valid context, so cache bytes grow linearly with batch
@@ -214,6 +219,43 @@ def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
     if out.get("achieved_gbs"):
         out["total_gbs_est"] = round(
             out["achieved_gbs"] + out["cache_read_gbs_est"], 1)
+    return out
+
+
+def _latency_percentiles(engine, prompt, new_tokens: int,
+                         requests: int = 8) -> dict:
+    """Per-request TTFT/TPOT p50/p95/p99 over ``requests`` sequential
+    single-row STREAMED generations (the SLO view of the same engine the
+    throughput numbers describe: TTFT = prefill + first streamed step,
+    TPOT = mean inter-token gap per request).  Feeds the
+    ``BENCH_SELF_*.json`` perf trajectory so latency regressions show up
+    per PR, not just tok/s."""
+    from distributed_inference_demo_tpu.runtime.stats import _percentile
+
+    ttfts, tpots = [], []
+    for i in range(requests):
+        t0 = time.perf_counter()
+        t_first = t_last = None
+        n = 0
+        for _ in engine.generate_stream(prompt, new_tokens, seed=i):
+            t_last = time.perf_counter()
+            if t_first is None:
+                t_first = t_last
+            n += 1
+        if t_first is None:
+            continue
+        if i == 0:
+            # first request compiles the streamed step: warmup, not data
+            continue
+        ttfts.append(t_first - t0)
+        if n > 1:
+            tpots.append((t_last - t_first) / (n - 1))
+    out = {"requests": len(ttfts), "new_tokens": new_tokens}
+    for name, xs in (("ttft", ttfts), ("tpot", tpots)):
+        xs = sorted(xs)
+        for q in (50, 95, 99):
+            out[f"{name}_p{q}_ms"] = (
+                round(_percentile(xs, q) * 1e3, 3) if xs else None)
     return out
 
 
@@ -1075,10 +1117,11 @@ def run_leg(name: str, p: dict) -> dict:
     flagship = p["flagship"]
     try:
         if name == "headline":
-            out = _bench_engine(model, batch, prompt_len, new_tokens)
+            out = _bench_engine(model, batch, prompt_len, new_tokens,
+                                latency=True)
         elif name == "headline_int8":
             out = _bench_engine(model, batch, prompt_len, new_tokens,
-                                quant=True)
+                                quant=True, latency=True)
         elif name == "sweep":
             out = _leg_sweep(model, prompt_len, new_tokens)
         elif name == "flagship_int8":

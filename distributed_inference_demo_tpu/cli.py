@@ -165,6 +165,18 @@ def cmd_serve(args) -> int:
                  max_seq=args.max_seq,
                  chain=bool(args.chain),
                  batch_slots=getattr(args, "batch_slots", 0))
+    # black-box capture for this serving process: anomaly/stall triggers
+    # and unhandled crashes dump bundles.  --postmortem-dir installs the
+    # writer explicitly; DWT_POSTMORTEM_DIR alone is honored too (the
+    # lazy get below resolves it), and EITHER configuration gets the
+    # crash handler — env-only capture must not silently lose crashes
+    from .telemetry import postmortem
+    if getattr(args, "postmortem_dir", ""):
+        postmortem.set_postmortem_writer(
+            postmortem.PostmortemWriter(args.postmortem_dir))
+    if postmortem.get_postmortem_writer() is not None:
+        postmortem.install_crash_handler(config={
+            k: v for k, v in vars(args).items() if k != "fn"})
 
     modes = [name for name, on in [("--chain", args.chain),
                                    ("--draft-model",
@@ -1079,6 +1091,11 @@ def main(argv=None) -> int:
                    help="append structured JSONL run-log events "
                         "(serve start + per-request engine summaries) "
                         "to this path (telemetry/runlog)")
+    s.add_argument("--postmortem-dir", default="",
+                   help="write postmortem bundles (flight-recorder ring "
+                        "+ metrics + trace + run-log tail) here on "
+                        "anomaly/stall/crash; equivalent to "
+                        "DWT_POSTMORTEM_DIR (docs/DESIGN.md §8)")
     _add_sp_args(s)
     _add_draft_args(s)
     s.set_defaults(fn=cmd_serve)

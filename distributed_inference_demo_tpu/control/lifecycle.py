@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional
 
 import zmq
 
+from ..telemetry.flightrecorder import get_flight_recorder
 from ..telemetry.runlog import get_run_log
 from .messages import Envelope, MsgType, decode, make
 from .router import RouterService
@@ -155,6 +156,8 @@ class LifecycleServer(RouterService):
                 rl.event("lifecycle", device=dev_id, state="open",
                          model=self.config.model,
                          num_devices=len(self.expected))
+            get_flight_recorder().record("lifecycle", device=dev_id,
+                                         state="open")
             return [make(MsgType.OPEN, config=self.config.to_payload())]
         if msg.type == MsgType.ARTIFACT_REQUEST:
             return self._artifact_chunk(dev_id, msg.get("name", ""),
@@ -175,6 +178,8 @@ class LifecycleServer(RouterService):
             rl = get_run_log()
             if rl.enabled:
                 rl.event("lifecycle", device=dev_id, state="initialized")
+            get_flight_recorder().record("lifecycle", device=dev_id,
+                                         state="initialized")
             if ready:
                 self._broadcast_start()
             return []
@@ -187,6 +192,9 @@ class LifecycleServer(RouterService):
             if rl.enabled:
                 rl.event("lifecycle", device=dev_id, state="finished",
                          all_finished=done)
+            get_flight_recorder().record("lifecycle", device=dev_id,
+                                         state="finished",
+                                         all_finished=done)
             if done:
                 self.all_finished.set()
             return [make(MsgType.CLOSE)]
@@ -236,6 +244,8 @@ class LifecycleServer(RouterService):
         if rl.enabled:
             rl.event("lifecycle", state="running",
                      devices=sorted(self.expected))
+        get_flight_recorder().record("lifecycle", state="running",
+                                     devices=sorted(self.expected))
         for dev_id in self.expected:   # serve-thread only (see send_to)
             self.send_to(dev_id, make(MsgType.START))
 

@@ -59,6 +59,9 @@ from ..models.base import (KVCache, ModelConfig, StageParams,
                            StageSpec, pad_cache_capacity)
 from ..models.decoder import stage_forward
 from ..ops.sampling import SamplingParams, filtered_logits, sample_logits
+from ..telemetry import postmortem
+from ..telemetry.anomaly import AnomalyMonitor
+from ..telemetry.flightrecorder import get_flight_recorder
 from .engine import (GenerationResult, check_capacity,
                      make_chunk_programs, validate_prefill_chunk)
 from .speculative import verify_emit_per_row
@@ -634,6 +637,16 @@ class ContinuousBatchingEngine:
 
         self._slots: List[Optional[Request]] = [None] * B
         self._queue: "queue.Queue" = queue.Queue()
+        self._flight = get_flight_recorder()
+        # online anomaly watch over the same stats() surface /stats
+        # serves; throttled to ~1 Hz inside the scheduler loop, and
+        # bundles only materialize when postmortem capture is configured
+        # (DWT_POSTMORTEM_DIR) — detection itself always feeds the
+        # dwt_anomaly_* series and the flight ring
+        self.anomaly = AnomalyMonitor(config={
+            "engine": type(self).__name__, "max_batch": max_batch,
+            "max_seq": self.max_seq, "decode_block": decode_block,
+            "prefill_chunk": prefill_chunk})
         self._running = True
         # serializes submit() against close(): no request can be enqueued
         # after close() returns, so none can slip past the shutdown drain
@@ -782,7 +795,19 @@ class ContinuousBatchingEngine:
                 "num_draft": self.num_draft, "rounds": s["rounds"],
                 "acceptance_rate": (round(s["accepted"] / s["drafted"], 4)
                                     if s["drafted"] else None)}
+        # anomaly watch rides every stats() reader as well as the
+        # scheduler loop: an HTTP /metrics scrape runs on its OWN thread,
+        # so the stalled-pipeline watchdog still observes (and fires)
+        # when the scheduler thread itself is wedged inside a dispatch.
+        # No recursion: the monitor's throttle window swallows the inner
+        # observation its own stats() build would trigger.
+        self.anomaly.observe(out)
         return out
+
+    def debug_state(self) -> dict:
+        """Backend fragment of ``GET /debugz``: anomaly-detector state
+        (thresholds, streaks, recent firings, bundles written)."""
+        return {"anomaly": self.anomaly.state()}
 
     def reset_stats(self) -> None:
         self._step_count = 0
@@ -994,6 +1019,9 @@ class ContinuousBatchingEngine:
                 self._history, jnp.asarray(hpad), jnp.int32(slot),
                 jnp.int32(plen), tok.astype(jnp.int32))
         self._slots[slot] = req
+        self._flight.record("batch_admit", slot=slot, prompt_len=plen,
+                            max_new=req.max_new,
+                            prefix_reused=start)
         # lps stay empty (not a stale 1-entry list) in the speculative
         # modes, whose drains never score emitted tokens
         plain = self._spec_step is None and self._pld_step is None
@@ -1051,6 +1079,9 @@ class ContinuousBatchingEngine:
             req.stream.put(None)
             req.done.set()
             self._slots[slot] = None
+            self._flight.record("batch_done", slot=slot,
+                                tokens=len(req.tokens),
+                                reason="eos" if hit_eos else "length")
 
     @staticmethod
     def _fail_request(req: Request, err: Optional[BaseException]):
@@ -1058,6 +1089,10 @@ class ContinuousBatchingEngine:
         req.error = err
         req.stream.put(None)
         req.done.set()
+        if err is not None:
+            get_flight_recorder().record(
+                "batch_fail", error=type(err).__name__,
+                tokens=len(req.tokens))
 
     def _drain_all(self, err: BaseException):
         """Fail every in-flight slot, mid-admission, backlogged, and
@@ -1147,12 +1182,25 @@ class ContinuousBatchingEngine:
             # The submit lock orders the drain after any submit that
             # already saw _running True — its request lands before the
             # drain runs, so none can slip past onto the dead thread.
+            # The flight ring holds the admissions/steps leading up to
+            # the failure; capture them before the drain mutates state.
+            self._flight.record("scheduler_crash",
+                                error=type(e).__name__, detail=str(e))
+            postmortem.trigger(
+                "scheduler_crash",
+                detail={"error": f"{type(e).__name__}: {e}",
+                        "active_slots": sum(1 for s in self._slots
+                                            if s is not None),
+                        "steps": self._step_count})
             with self._submit_lock:
                 self._running = False
                 self._drain_all(e)
 
     def _loop_body(self):
         while self._running:
+            # anomaly watch rides the loop (throttled internally; the
+            # stats() snapshot is only built when an observation is due)
+            self.anomaly.observe(self.stats)
             free = [i for i, s in enumerate(self._slots) if s is None]
             # one dispatch of the in-progress chunked admission (if any)
             self._advance_admission(free)

@@ -46,11 +46,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..comm import wire
-from ..comm.transport import BaseTransport
+from ..comm.transport import BaseTransport, TransportTimeout
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..ops.sampling import SamplingParams, sample_logits
-from ..telemetry.tracing import TraceRecorder, new_trace_id
-from .stats import StageStats, timer
+from ..telemetry import postmortem
+from ..telemetry.flightrecorder import get_flight_recorder
+from ..telemetry.tracing import SpanClock, TraceRecorder, new_trace_id
+from .stats import StageStats
 
 log = logging.getLogger(__name__)
 
@@ -160,7 +162,9 @@ class PipelineWorker:
         role = "tail" if runtime.spec.is_last else "worker"
         self.stats = StageStats(role=role)
         self.tracer = TraceRecorder(f"{role}:{transport.device_id}")
+        self.flight = get_flight_recorder()
         self._last_wait: Optional[float] = None  # serve loop's recv wait
+        self._last_wait_start: Optional[float] = None  # its wall start
 
     def _forward_control(self, tag: str, payload: bytes = b"") -> None:
         if self.next_id is not None:
@@ -177,9 +181,9 @@ class PipelineWorker:
     def serve_forever(self, idle_timeout: Optional[float] = None) -> None:
         """Loop until a ``stop`` message arrives; returns cleanly if
         ``idle_timeout``/step_timeout expires with no traffic at all."""
-        from ..comm.transport import TransportTimeout
         while True:
-            t0 = time.perf_counter()
+            t0_wall = time.time()       # recv_wait span start (wall clock
+            t0 = time.perf_counter()    # captured at open, never derived)
             try:
                 tag, payload = self.transport.recv_any(
                     timeout=idle_timeout or self.step_timeout)
@@ -190,6 +194,7 @@ class PipelineWorker:
             wait = time.perf_counter() - t0
             self.stats.record_recv(wait, len(payload))
             self._last_wait = wait      # recv_wait span source (tracing)
+            self._last_wait_start = t0_wall
             if not self.handle_message(tag, payload):
                 return
 
@@ -197,6 +202,8 @@ class PipelineWorker:
         """Process one message; returns False on ``stop``."""
         kind, _, rest = tag.partition(":")
         if kind == "stop":
+            self.flight.record("worker_stop",
+                               stage=self.transport.device_id)
             self._forward_control(tag)
             return False
         if kind == "end":
@@ -253,28 +260,37 @@ class PipelineWorker:
         name it as the downstream parent."""
         trace_id, parent = ctx
         if self._last_wait is not None:
-            self.tracer.record("recv_wait", trace_id, parent,
-                               ts=t_wall - self._last_wait,
+            # the wall start was captured at recv open (serve_forever) —
+            # never reconstructed as now-minus-duration across clocks
+            start = (self._last_wait_start
+                     if self._last_wait_start is not None
+                     else t_wall - self._last_wait)
+            self.tracer.record("recv_wait", trace_id, parent, ts=start,
                                dur=self._last_wait, rid=rid, step=step)
             self._last_wait = None       # consumed; never double-reported
+            self._last_wait_start = None
         self.tracer.record("compute", trace_id, parent, ts=t_wall,
                            dur=compute_s, span_id=compute_span,
                            rid=rid, step=step)
 
     def _traced_send(self, ctx, compute_span: int, dest: str, tag: str,
                      body: bytes, rid: int, step: int) -> None:
-        t_send = time.time()
-        with timer() as t_s:
+        t_s = SpanClock()
+        with t_s:
             self.transport.send(dest, tag, body)
         self.stats.record_send(t_s.seconds, len(body))
+        self.flight.record("hop_send", stage=self.transport.device_id,
+                           rid=rid, step=step, dest=dest,
+                           nbytes=len(body))
         if ctx is not None:
-            self.tracer.record("send", ctx[0], compute_span, ts=t_send,
-                               dur=t_s.seconds, rid=rid, step=step,
-                               dest=dest)
+            self.tracer.record("send", ctx[0], compute_span, clock=t_s,
+                               rid=rid, step=step, dest=dest)
 
     def _run_and_forward(self, rid: int, step: int, payload: bytes) -> None:
-        t_wall = time.time()
-        with timer() as t_c:
+        self.flight.record("hop_recv", stage=self.transport.device_id,
+                           rid=rid, step=step, nbytes=len(payload))
+        t_c = SpanClock()
+        with t_c:
             tensors, ctx = wire.split_trace_context(
                 wire.deserialize_tensors(payload))
             [x] = tensors
@@ -291,7 +307,7 @@ class PipelineWorker:
                     if ctx else wire.serialize_tensors(result))
         self.stats.record_compute(t_c.seconds)
         if ctx is not None:
-            self._record_hop_spans(ctx, compute_span, t_wall, t_c.seconds,
+            self._record_hop_spans(ctx, compute_span, t_c.ts, t_c.seconds,
                                    rid, step)
         self._traced_send(ctx, compute_span, dest, tag, body, rid, step)
 
@@ -299,8 +315,11 @@ class PipelineWorker:
         """Classification hop: payload = [chunk, label_token_ids].  The
         tail answers the header with argmax-over-label-logits indices
         (reference ``inference.cpp:220-270``); other stages forward."""
-        t_wall = time.time()
-        with timer() as t_c:
+        self.flight.record("hop_recv", stage=self.transport.device_id,
+                           rid=rid, step=0, nbytes=len(payload),
+                           classify=True)
+        t_c = SpanClock()
+        with t_c:
             tensors, ctx = wire.split_trace_context(
                 wire.deserialize_tensors(payload))
             x, label_ids = tensors
@@ -320,7 +339,7 @@ class PipelineWorker:
                     if ctx else wire.serialize_tensors(result))
         self.stats.record_compute(t_c.seconds)
         if ctx is not None:
-            self._record_hop_spans(ctx, compute_span, t_wall, t_c.seconds,
+            self._record_hop_spans(ctx, compute_span, t_c.ts, t_c.seconds,
                                    rid, 0)
         self._traced_send(ctx, compute_span, dest, tag, body, rid, 0)
 
@@ -357,6 +376,7 @@ class PipelineHeader:
         self._next_rid = 0
         self.stats = StageStats(role="header")
         self.tracer = TraceRecorder(f"header:{transport.device_id}")
+        self.flight = get_flight_recorder()
         self._sent_at: Dict[tuple, float] = {}  # (rid, step) -> send time
         # (rid, step) -> (trace_id, send span id, epoch ts of send end);
         # the ring_rtt span's start/identity when the token comes back
@@ -373,16 +393,18 @@ class PipelineHeader:
         send_span = self.tracer.next_span_id() if trace_id else 0
         body = wire.serialize_tensors_traced(
             [np.asarray(hidden)], trace_id or None, send_span)
-        t_send = time.time()
-        with timer() as t_s:
+        t_s = SpanClock()
+        with t_s:
             self.transport.send(self.next_id, self._make_h_tag(rid, step),
                                 body)
         self.stats.record_send(t_s.seconds, len(body))
+        self.flight.record("hop_send", stage=self.transport.device_id,
+                           rid=rid, step=step, dest=self.next_id,
+                           nbytes=len(body))
         self._sent_at[(rid, step)] = time.perf_counter()
         if trace_id:
-            self.tracer.record("send", trace_id, parent_id, ts=t_send,
-                               dur=t_s.seconds, span_id=send_span,
-                               rid=rid, step=step)
+            self.tracer.record("send", trace_id, parent_id, clock=t_s,
+                               span_id=send_span, rid=rid, step=step)
             self._rtt_ctx[(rid, step)] = (trace_id, send_span, time.time())
 
     def _prefill_array(self, req: _Request) -> np.ndarray:
@@ -392,15 +414,15 @@ class PipelineHeader:
         return req.prompt.astype(np.int32)
 
     def _launch(self, req: _Request) -> None:
-        t_wall = time.time()
-        with timer() as t_c:
+        t_c = SpanClock()
+        with t_c:
             hidden = self.rt.run_chunk(req.rid, self._prefill_array(req))
             hidden = np.asarray(hidden)
         self.stats.record_compute(t_c.seconds)
         parent = 0
         if req.trace_id:
             parent = self.tracer.record(
-                "compute", req.trace_id, ts=t_wall, dur=t_c.seconds,
+                "compute", req.trace_id, clock=t_c,
                 rid=req.rid, step=0, phase="prefill")
         self._send_hidden(req.rid, 0, hidden, req.trace_id, parent)
 
@@ -434,8 +456,8 @@ class PipelineHeader:
             self._rtt_ctx = {k: v for k, v in self._rtt_ctx.items()
                              if k[0] != req.rid}
             return
-        t_wall = time.time()
-        with timer() as t_c:
+        t_c = SpanClock()
+        with t_c:
             hidden = self.rt.run_chunk(req.rid,
                                        toks[:, None].astype(np.int32))
             hidden = np.asarray(hidden)
@@ -443,7 +465,7 @@ class PipelineHeader:
         parent = 0
         if req.trace_id:
             parent = self.tracer.record(
-                "compute", req.trace_id, ts=t_wall, dur=t_c.seconds,
+                "compute", req.trace_id, clock=t_c,
                 rid=req.rid, step=req.step, phase="decode")
         self._send_hidden(req.rid, req.step, hidden, req.trace_id, parent)
 
@@ -475,6 +497,25 @@ class PipelineHeader:
         self._next_rid += len(pending)
         return pending
 
+    def _stall_postmortem(self, phase: str) -> None:
+        """A ring step timed out with work in flight: record the stall
+        into the flight ring and capture a postmortem bundle naming the
+        requests still awaiting their reply — the offline analyzer
+        (``tools/postmortem.py``) pins the offending hop from the
+        ``hop_send``/``hop_recv`` events around each stalled (rid,
+        step)."""
+        in_flight = [[r, s] for r, s in sorted(self._sent_at.keys())]
+        self.flight.record("pipeline_stall",
+                           stage=self.transport.device_id, phase=phase,
+                           in_flight=in_flight,
+                           step_timeout_s=self.step_timeout)
+        postmortem.trigger(
+            "pipeline_stall",
+            detail={"stage": self.transport.device_id, "phase": phase,
+                    "in_flight": in_flight,
+                    "step_timeout_s": self.step_timeout},
+            spans=self.tracer.snapshot())
+
     def generate_many(self, prompts: Sequence[np.ndarray],
                       max_new_tokens: int,
                       pool_size: int = 1,
@@ -499,8 +540,12 @@ class PipelineHeader:
                 in_flight[req.rid] = req
                 self._launch(req)
             t0 = time.perf_counter()
-            tag, payload = self.transport.recv_any(
-                timeout=self.step_timeout)
+            try:
+                tag, payload = self.transport.recv_any(
+                    timeout=self.step_timeout)
+            except TransportTimeout:
+                self._stall_postmortem("generate")
+                raise
             self.stats.record_recv(time.perf_counter() - t0, len(payload))
             kind, _, rest = tag.partition(":")
             if kind != "tok":
@@ -510,6 +555,8 @@ class PipelineHeader:
             req = in_flight.get(rid)
             if req is None:
                 continue
+            self.flight.record("tok_recv", stage=self.transport.device_id,
+                               rid=rid, step=req.step)
             tensors, _ = wire.split_trace_context(
                 wire.deserialize_tensors(payload))
             [toks] = tensors
@@ -566,23 +613,26 @@ class PipelineHeader:
 
         def launch(rid: int, prompt: np.ndarray) -> None:
             trace_id = trace_ids[rid]
-            t_wall = time.time()
-            with timer() as t_c:
+            t_c = SpanClock()
+            with t_c:
                 hidden = self.rt.run_chunk(rid, prompt.astype(np.int32))
                 send_span = self.tracer.next_span_id()
                 body = wire.serialize_tensors_traced(
                     [np.asarray(hidden), label_ids], trace_id, send_span)
             self.stats.record_compute(t_c.seconds)
             parent = self.tracer.record(
-                "compute", trace_id, ts=t_wall, dur=t_c.seconds,
+                "compute", trace_id, clock=t_c,
                 rid=rid, step=0, phase="classify")
-            t_send = time.time()
-            with timer() as t_s:
+            t_s = SpanClock()
+            with t_s:
                 self.transport.send(self.next_id, f"c:{rid}", body)
             self.stats.record_send(t_s.seconds, len(body))
-            self.tracer.record("send", trace_id, parent, ts=t_send,
-                               dur=t_s.seconds, span_id=send_span,
-                               rid=rid, step=0)
+            self.flight.record("hop_send",
+                               stage=self.transport.device_id,
+                               rid=rid, step=0, dest=self.next_id,
+                               nbytes=len(body), classify=True)
+            self.tracer.record("send", trace_id, parent, clock=t_s,
+                               span_id=send_span, rid=rid, step=0)
             # rtt tracked like generate steps: the tail records one
             # compute sample per classify hop, so the header must record
             # one rtt — otherwise mixed classify+generate workloads skew
@@ -596,7 +646,12 @@ class PipelineHeader:
                 in_flight[rid] = rid
                 launch(rid, np.asarray(prompt))
             t0 = time.perf_counter()
-            tag, payload = self.transport.recv_any(timeout=self.step_timeout)
+            try:
+                tag, payload = self.transport.recv_any(
+                    timeout=self.step_timeout)
+            except TransportTimeout:
+                self._stall_postmortem("classify")
+                raise
             self.stats.record_recv(time.perf_counter() - t0, len(payload))
             kind, _, rest = tag.partition(":")
             if kind != "ctok":
@@ -605,6 +660,8 @@ class PipelineHeader:
             rid = int(rest.split(":")[0])
             if rid not in in_flight:
                 continue
+            self.flight.record("tok_recv", stage=self.transport.device_id,
+                               rid=rid, step=0, classify=True)
             self._record_rtt(rid, 0)
             tensors, _ = wire.split_trace_context(
                 wire.deserialize_tensors(payload))
@@ -632,7 +689,6 @@ class PipelineHeader:
         delivery: a reply that misses this poll's window loses its
         spans).
         """
-        from ..comm.transport import TransportTimeout
         seq = str(self._next_stats_seq)
         self._next_stats_seq += 1
         self.transport.send(self.next_id, f"statsreq:{seq}",

@@ -66,7 +66,13 @@ class DynamicBatchingHeaderBackend:
         self.num_stages = num_stages
         self.pool_size = max(1, pool_size)
         self.max_group = max(1, max_group)
+        from ..telemetry.anomaly import AnomalyMonitor
         self._queue: "queue.Queue" = queue.Queue()
+        # straggler watch over the polled stage snapshots, same wiring
+        # as HeaderBackend: the /stats // metrics poll drives detection
+        self.anomaly = AnomalyMonitor(config={
+            "backend": type(self).__name__, "num_stages": num_stages,
+            "pool_size": pool_size})
         self._running = True
         # serializes submissions against close(): nothing can land in the
         # queue after the drain ran, so no waiter can hang forever
@@ -117,8 +123,10 @@ class DynamicBatchingHeaderBackend:
         return pred
 
     def stats(self) -> dict:
-        return {"stages": self._command(
-            lambda h: h.collect_stats(self.num_stages))}
+        stages = self._command(
+            lambda h: h.collect_stats(self.num_stages))
+        self.anomaly.observe({"stages": stages})
+        return {"stages": stages}
 
     def reset_stats(self) -> None:
         self._command(lambda h: h.reset_stats())

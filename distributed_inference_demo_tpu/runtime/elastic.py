@@ -197,6 +197,8 @@ class ElasticHeader(PipelineHeader):
     def signal_failure(self, device_id: str) -> None:
         """Thread-safe: mark a device dead; the run loop reshards at its
         next poll.  Hook for ``DevicePoolManager.on_failure``."""
+        self.flight.record("device_failure", device=device_id,
+                           stage=self.transport.device_id)
         with self._failed_lock:
             if device_id not in self._failed:
                 self._failed.append(device_id)
@@ -230,6 +232,8 @@ class ElasticHeader(PipelineHeader):
         self.epoch += 1
         log.info("reshard (epoch %d): %s -> ranges %s", self.epoch, chain,
                  [(s.layer_start, s.layer_end) for s in specs])
+        self.flight.record("reshard", epoch=self.epoch, chain=list(chain),
+                           dead=list(dead))
 
         # push plans to workers (everyone but us), then collect acks;
         # stray data messages racing the reshard are dropped (their caches
@@ -327,6 +331,9 @@ class ElasticHeader(PipelineHeader):
                     timeout=self.poll_interval)
             except TransportTimeout:
                 if time.monotonic() - last_progress > self.step_timeout:
+                    # reshard couldn't save this run: black-box it like
+                    # the static header's step timeout
+                    self._stall_postmortem("generate")
                     raise TransportTimeout(
                         f"no progress for {self.step_timeout}s and no "
                         "failure signal; pipeline stalled")
@@ -342,6 +349,9 @@ class ElasticHeader(PipelineHeader):
             req = in_flight.get(rid)
             if req is None or step != req.step:
                 continue       # duplicate or out-of-order token
+            self.flight.record("tok_recv",
+                               stage=self.transport.device_id,
+                               rid=rid, step=step)
             [toks] = wire.split_trace_context(
                 wire.deserialize_tensors(payload))[0]
             if on_token is not None:

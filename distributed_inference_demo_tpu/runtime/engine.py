@@ -31,6 +31,7 @@ from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..models.decoder import stage_forward
 from ..ops.flash_attention import make_flash_attn_impl
 from ..ops.sampling import SamplingParams, sample_logits
+from ..telemetry.flightrecorder import get_flight_recorder
 from ..telemetry.runlog import get_run_log
 
 
@@ -411,6 +412,10 @@ class InferenceEngine:
                      new_tokens=max_new_tokens,
                      seconds=round(dt, 6),
                      tokens_per_sec=round(result.tokens_per_second, 2))
+        get_flight_recorder().record(
+            "engine_generate", engine=type(self).__name__, batch=b,
+            prompt_len=plen, new_tokens=max_new_tokens,
+            seconds=round(dt, 6))
         return result
 
     def classify(self, prompt_ids: np.ndarray,
@@ -437,6 +442,9 @@ class InferenceEngine:
                      batch=int(ids.shape[0]),
                      prompt_len=int(ids.shape[1]),
                      num_labels=int(label_ids.size))
+        get_flight_recorder().record(
+            "engine_classify", engine=type(self).__name__,
+            batch=int(ids.shape[0]), prompt_len=int(ids.shape[1]))
         return pred
 
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,

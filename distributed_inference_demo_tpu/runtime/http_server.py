@@ -198,15 +198,24 @@ class HeaderBackend:
     the HTTP handler (generate + generate_stream)."""
 
     def __init__(self, header, max_seq: int, num_stages: int = 2):
+        from ..telemetry.anomaly import AnomalyMonitor
         self.header = header
         self.max_seq = max_seq
         self.num_stages = num_stages
         self._lock = threading.Lock()   # one pipeline run at a time
+        # straggler watch over the polled stage snapshots: every /stats
+        # or /metrics collection feeds the detector, so a scheduled
+        # Prometheus scrape is what drives straggler-hop detection in
+        # production (no extra polling thread)
+        self.anomaly = AnomalyMonitor(config={
+            "backend": type(self).__name__, "num_stages": num_stages,
+            "max_seq": max_seq})
 
     def stats(self) -> dict:
         """Header snapshot + polled downstream stage snapshots."""
         with self._lock:
             stages = self.header.collect_stats(self.num_stages)
+        self.anomaly.observe({"stages": stages})
         return {"stages": stages}
 
     def export_trace(self) -> dict:
@@ -230,6 +239,7 @@ class HeaderBackend:
                                                timeout=2.0)
         finally:
             self._lock.release()
+        self.anomaly.observe({"stages": stages})
         return {"stages": stages}
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
@@ -288,6 +298,15 @@ class HeaderBackend:
         with self._lock:
             self.header.reset_stats()
 
+    def debug_state(self) -> dict:
+        """Backend fragment of ``GET /debugz``: the ring steps still
+        awaiting their reply (racy read of header-owned state — a
+        diagnostic peek, not an invariant) + straggler-detector state."""
+        sent = getattr(self.header, "_sent_at", {})
+        return {"num_stages": self.num_stages,
+                "in_flight": [[r, s] for r, s in sorted(sent.keys())],
+                "anomaly": self.anomaly.state()}
+
 
 class InferenceHTTPServer:
     """Threaded HTTP server over an engine-like backend."""
@@ -312,7 +331,7 @@ class InferenceHTTPServer:
             # child (and one /metrics line) per junk URL forever
             _ROUTES = frozenset((
                 "/health", "/stats", "/stats/reset", "/metrics", "/trace",
-                "/generate", "/classify"))
+                "/debugz", "/generate", "/classify"))
 
             def _json(self, code: int, obj: dict) -> None:
                 # counted BEFORE the body goes out: a client that reacts
@@ -375,6 +394,11 @@ class InferenceHTTPServer:
                         self._json(200, outer.backend.stats())
                     else:
                         self._json(200, {"stages": []})
+                elif self.path.split("?")[0] == "/debugz":
+                    try:
+                        self._json(200, outer._debugz())
+                    except Exception as e:
+                        self._json(500, {"error": str(e)})
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
@@ -682,6 +706,20 @@ class InferenceHTTPServer:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self.httpd.server_address
         self._thread: Optional[threading.Thread] = None
+
+    def _debugz(self) -> dict:
+        """``GET /debugz``: live black-box state — flight-recorder tail,
+        backend anomaly-detector state (when the backend has one), and
+        the postmortem bundles written so far.  Read-only and bounded:
+        an operator can hit it during an incident without touching the
+        pipeline (unlike /stats, it never polls remote stages)."""
+        from ..telemetry import flightrecorder, postmortem
+        out = {"flight": flightrecorder.debug_state()}
+        debug_state = getattr(self.backend, "debug_state", None)
+        if callable(debug_state):
+            out["backend"] = debug_state()
+        out["postmortem"] = postmortem.debug_state()
+        return out
 
     def _prompt_ids(self, req: dict) -> np.ndarray:
         if "prompt_ids" in req:

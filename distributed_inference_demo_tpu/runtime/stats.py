@@ -124,19 +124,7 @@ class StageStats:
         return out
 
 
-class _Timer:
-    """``with timer() as t: ...`` then ``t.seconds``."""
-
-    __slots__ = ("t0", "seconds")
-
-    def __enter__(self):
-        self.t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        self.seconds = time.perf_counter() - self.t0
-        return False
-
-
-def timer() -> _Timer:
-    return _Timer()
+# span timing lives in telemetry.tracing.SpanClock (wall-clock start
+# captured at open + perf_counter duration) — the old duration-only
+# ``timer()`` helper was removed with the SpanClock migration so future
+# instrumentation cannot reintroduce the wall/perf clock mixing.

@@ -96,18 +96,39 @@ def main(argv=None) -> int:
                          "main HTTP server has its own /metrics")
     args = ap.parse_args(argv)
 
+    # black-box capture: the flight ring is labeled with this stage's
+    # identity, and an unhandled crash dumps a postmortem bundle (when
+    # DWT_POSTMORTEM_DIR is configured) before the process dies
+    from ..telemetry import flightrecorder, postmortem
+    flightrecorder.get_flight_recorder().proc = args.device_id
+    postmortem.install_crash_handler(config=vars(args))
+
     worker, transport = build_worker(args)
     metrics_srv = None
     if args.metrics_port >= 0:
         from ..telemetry import MetricsHTTPServer
         from ..telemetry import catalog as _catalog
+
+        def _debugz() -> dict:
+            return {
+                "device_id": args.device_id,
+                "stats": worker.stats.snapshot(),
+                "flight": flightrecorder.debug_state(),
+                "postmortem": postmortem.debug_state(),
+            }
+
         metrics_srv = MetricsHTTPServer(
             lambda: _catalog.render_worker(worker.stats, args.device_id),
-            host=args.bind_host, port=args.metrics_port)
+            host=args.bind_host, port=args.metrics_port,
+            debug_provider=_debugz)
         metrics_srv.start()
         print(f"METRICS_READY http://{metrics_srv.host}:"
               f"{metrics_srv.port}/metrics", flush=True)
     print(f"WORKER_READY {args.device_id} {transport.address}", flush=True)
+    # no explicit except-and-trigger here: a serve-loop crash propagates
+    # to the sys.excepthook installed above, which writes the ONE crash
+    # bundle (an extra trigger in an except clause would double-capture
+    # the same exception and halve the pruned bundle history)
     try:
         worker.serve_forever()
     finally:

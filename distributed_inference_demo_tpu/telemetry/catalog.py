@@ -191,6 +191,38 @@ HTTP_GENERATED_TOKENS = counter(
     "Tokens returned by successful /generate requests")
 
 
+# -- flight recorder / anomaly series --------------------------------------
+
+FLIGHT_EVENTS = counter(
+    "dwt_flight_events_total",
+    "Events recorded into the process flight-recorder ring "
+    "(monotone: overwritten ring entries stay counted)")
+FLIGHT_BUFFER = gauge(
+    "dwt_flight_buffer_events",
+    "Events currently held in the flight-recorder ring")
+ANOMALY_EVENTS = counter(
+    "dwt_anomaly_events_total",
+    "Anomalies flagged by the online detectors, by kind "
+    "(straggler_hop, slo_ttft, slo_tpot, queue_saturation, "
+    "accept_collapse, pipeline_stall)", ("kind",))
+ANOMALY_LAST = gauge(
+    "dwt_anomaly_last_seconds",
+    "Epoch seconds of the most recent anomaly of each kind", ("kind",))
+ANOMALY_POSTMORTEMS = counter(
+    "dwt_anomaly_postmortem_bundles_total",
+    "Postmortem bundles written (anomaly triggers, ring stalls, and the "
+    "crash handler)")
+
+
+def update_flight_series() -> None:
+    """Bridge the process flight recorder's occupancy onto the
+    ``dwt_flight_*`` series (cheap: two locked reads)."""
+    from .flightrecorder import get_flight_recorder
+    fr = get_flight_recorder()
+    FLIGHT_EVENTS.set_cumulative(fr.total)
+    FLIGHT_BUFFER.set(len(fr))
+
+
 # -- monitor series (probes.py measurements) -------------------------------
 
 MONITOR_MEMORY = gauge(
@@ -243,6 +275,7 @@ def scrape(backend=None) -> str:
     timeout) over ``stats()`` so a scheduled Prometheus scrape cannot
     stall on a dead stage."""
     update_monitor_series()
+    update_flight_series()
     fn = getattr(backend, "scrape_stats", None) or getattr(
         backend, "stats", None)
     if fn is not None:
@@ -263,6 +296,7 @@ def render_worker(stage_stats, device_id: str = "") -> str:
     """Scrape provider for a standalone stage-worker process: bridge its
     StageStats and render (``worker_main --metrics-port``)."""
     update_monitor_series()
+    update_flight_series()
     snap = dict(stage_stats.snapshot(), device_id=device_id)
     update_stage_series([snap])
     return REGISTRY.render()

@@ -317,10 +317,14 @@ class MetricsHTTPServer:
     """Minimal threaded ``GET /metrics`` endpoint for processes that have
     no other HTTP surface (pipeline stage workers — the header's main
     server exposes /metrics itself).  ``provider()`` returns the rendered
-    text at scrape time."""
+    text at scrape time.  ``debug_provider()`` (optional) returns a dict
+    served as JSON at ``GET /debugz`` — live flight-recorder/anomaly
+    state for operators poking a single worker."""
 
     def __init__(self, provider: Callable[[], str],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 debug_provider: Optional[Callable[[], dict]] = None):
+        import json as _json
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         class Handler(BaseHTTPRequestHandler):
@@ -330,7 +334,21 @@ class MetricsHTTPServer:
                 pass
 
             def do_GET(self):
-                if self.path not in ("/metrics", "/metrics/"):
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                # query strings are ignored, matching the header HTTP
+                # server's routing (a cache-busting ?x=1 must not 404)
+                path = self.path.split("?")[0]
+                if (debug_provider is not None
+                        and path in ("/debugz", "/debugz/")):
+                    ctype = "application/json"
+                    try:
+                        body = _json.dumps(debug_provider(),
+                                           default=str).encode("utf-8")
+                        self.send_response(200)
+                    except Exception as e:
+                        body = _json.dumps({"error": str(e)}).encode()
+                        self.send_response(500)
+                elif path not in ("/metrics", "/metrics/"):
                     body = b"see /metrics\n"
                     self.send_response(404)
                 else:
@@ -340,9 +358,7 @@ class MetricsHTTPServer:
                     except Exception as e:      # scrape must never 500 the
                         body = f"# scrape error: {e}\n".encode()
                         self.send_response(500)  # worker loop
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; "
-                                 "charset=utf-8")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

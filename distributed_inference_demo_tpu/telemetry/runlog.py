@@ -31,22 +31,67 @@ def new_run_id() -> str:
 
 class RunLog:
     """Append-only JSONL event sink.  Thread-safe; every event is one
-    ``write`` + ``flush`` so a crash loses at most the in-flight line."""
+    ``write`` + ``flush`` so a crash loses at most the in-flight line.
+
+    ``max_bytes`` (or ``DWT_RUN_LOG_MAX_BYTES``) bounds the file for
+    long serving runs: when appending a line would push the file past
+    the limit, the current file rolls to ``<path>.1`` (replacing any
+    previous rollover) and a fresh file starts — at most two
+    generations, so disk stays O(2 x max_bytes) forever.  0 disables
+    rollover; fileobj-backed logs never roll (no path to rename)."""
 
     enabled = True
 
     def __init__(self, path: Optional[str] = None,
                  fileobj: Optional[IO[str]] = None,
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None,
+                 max_bytes: Optional[int] = None):
         if (path is None) == (fileobj is None):
             raise ValueError("RunLog needs exactly one of path/fileobj")
+        if max_bytes is None:
+            from ._env import env_int
+            max_bytes = env_int("DWT_RUN_LOG_MAX_BYTES", 0)
+        self.max_bytes = max(0, max_bytes)
         self.run_id = run_id or new_run_id()
         self.path = path
         # opened EAGERLY: a bad --run-log path must fail loudly at
         # startup, not silently drop every event of the run
         self._f = fileobj if fileobj is not None else open(
             path, "a", encoding="utf-8")
+        self._nbytes = 0
+        if path is not None:
+            try:
+                self._nbytes = os.path.getsize(path)
+            except OSError:
+                pass
         self._lock = threading.Lock()
+
+    def _maybe_roll(self, incoming: int) -> None:
+        """Roll the file when the next line would cross ``max_bytes``.
+        Caller holds the lock.  ``_nbytes > 0`` guards a line larger
+        than the whole budget: it lands in a fresh file instead of
+        rolling forever."""
+        if (self.path is None or not self.max_bytes
+                or self._nbytes + incoming <= self.max_bytes
+                or self._nbytes == 0):
+            return
+        # each step is isolated: a failed rename must not leave a CLOSED
+        # handle installed (every later event would silently die on it) —
+        # the reopen below runs regardless, so appending continues into
+        # whichever file the filesystem let us keep
+        try:
+            self._f.close()
+        except (OSError, ValueError):
+            pass
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass    # rename refused: reopen the (unrotated) file below
+        try:
+            self._f = open(self.path, "a", encoding="utf-8")
+            self._nbytes = os.path.getsize(self.path)
+        except OSError:
+            self._f = None    # event() treats None as closed
 
     def event(self, kind: str, **fields) -> None:
         rec = {"ts": round(time.time(), 6), "run_id": self.run_id,
@@ -58,12 +103,17 @@ class RunLog:
             line = json.dumps({"ts": rec["ts"], "run_id": self.run_id,
                                "event": kind,
                                "error": "unserializable fields"}) + "\n"
+        nbytes = len(line.encode("utf-8"))
         with self._lock:
             if self._f is None:
                 return          # closed
+            self._maybe_roll(nbytes)
+            if self._f is None:
+                return          # rollover reopen failed (disk/perm)
             try:
                 self._f.write(line)
                 self._f.flush()
+                self._nbytes += nbytes
             except (OSError, ValueError):
                 pass    # a full disk must never take down the serving loop
 

@@ -15,6 +15,12 @@ Timestamps are epoch microseconds (``time.time()``); durations come from
 nests exactly; across hosts it is as aligned as the hosts' clocks — good
 enough for "which hop ate the time", which is the question this exists to
 answer.
+
+The two clocks are never mixed: :class:`SpanClock` captures the
+wall-clock start ONCE at span open and measures the duration on
+``perf_counter``, so a span's start cannot drift when NTP steps the wall
+clock mid-span (reconstructing start as ``time.time() - dur`` at close
+would move it by exactly the step).
 """
 
 from __future__ import annotations
@@ -34,6 +40,37 @@ def new_trace_id() -> int:
     """Random nonzero 64-bit trace id (collision odds are irrelevant at
     any realistic request volume)."""
     return random.getrandbits(64) | 1
+
+
+class SpanClock:
+    """Span timing with the clocks kept apart: ``ts`` is the wall-clock
+    start captured once at construction (span open); ``seconds`` is the
+    elapsed ``perf_counter`` duration, frozen on first read or on context
+    exit.  The one timing helper for instrumented spans
+    (``with SpanClock() as t: ...`` then ``t.ts`` / ``t.seconds``)."""
+
+    __slots__ = ("ts", "_t0", "_dur")
+
+    def __init__(self):
+        self.ts = time.time()
+        self._t0 = time.perf_counter()
+        self._dur: Optional[float] = None
+
+    def stop(self) -> float:
+        if self._dur is None:
+            self._dur = time.perf_counter() - self._t0
+        return self._dur
+
+    @property
+    def seconds(self) -> float:
+        return self.stop()
+
+    def __enter__(self) -> "SpanClock":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
 
 
 class TraceRecorder:
@@ -59,12 +96,19 @@ class TraceRecorder:
 
     def record(self, name: str, trace_id: int, parent_id: int = 0,
                ts: Optional[float] = None, dur: float = 0.0,
-               span_id: Optional[int] = None, **args) -> int:
-        """Record a completed span.  ``ts`` is the epoch-seconds start
-        (default: now - dur); ``dur`` is seconds."""
+               span_id: Optional[int] = None,
+               clock: Optional[SpanClock] = None, **args) -> int:
+        """Record a completed span.  Preferred timing source is a
+        :class:`SpanClock` opened at span start (``clock=``); explicit
+        ``ts`` (epoch-seconds start) + ``dur`` (seconds) also work.  With
+        neither, ``ts`` defaults to the call time — NOT ``now - dur``,
+        which would reconstruct the start by mixing the wall clock with a
+        perf_counter duration and drift whenever NTP steps the clock."""
         sid = span_id if span_id is not None else self.next_span_id()
+        if clock is not None:
+            ts, dur = clock.ts, clock.seconds
         if ts is None:
-            ts = time.time() - dur
+            ts = time.time()
         span = {"name": name, "proc": self.proc,
                 "trace_id": int(trace_id), "span_id": int(sid),
                 "parent_id": int(parent_id),

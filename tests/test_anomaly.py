@@ -1,0 +1,294 @@
+"""Anomaly detectors under a fake clock: deterministic straggler, stall,
+and SLO-breach scenarios each fire exactly once (sustain + cooldown — no
+duplicate-trigger storms), and each produces exactly one postmortem
+bundle when a writer is configured."""
+
+import os
+
+import pytest
+
+from distributed_inference_demo_tpu.telemetry import postmortem
+from distributed_inference_demo_tpu.telemetry.anomaly import (
+    Anomaly, AnomalyDetector, AnomalyMonitor, Thresholds)
+from distributed_inference_demo_tpu.telemetry.flightrecorder import (
+    set_flight_recorder)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _isolate_globals():
+    set_flight_recorder(None)
+    postmortem.set_postmortem_writer(None)
+    os.environ.pop("DWT_POSTMORTEM_DIR", None)
+    yield
+    set_flight_recorder(None)
+    postmortem.set_postmortem_writer(None)
+
+
+TH = Thresholds(straggler_factor=3.0, straggler_min_ms=1.0,
+                ttft_slo_ms=100.0, tpot_slo_ms=50.0, queue_depth=16,
+                accept_floor=0.2, accept_min_drafted=40, stall_s=30.0,
+                sustain=3, cooldown_s=300.0)
+
+
+def _stages(slow_ms: float):
+    return [{"role": "header", "device_id": "h", "compute_p95_ms": 2.0},
+            {"role": "worker", "device_id": "w1", "compute_p95_ms": 2.0},
+            {"role": "tail", "device_id": "w2",
+             "compute_p95_ms": slow_ms}]
+
+
+def test_straggler_fires_once_then_cooldown():
+    clock = FakeClock()
+    det = AnomalyDetector(TH, clock=clock)
+    fired = []
+    for _ in range(10):
+        fired += det.observe({"stages": _stages(20.0)})
+        clock.advance(1.0)
+    assert len(fired) == 1                       # sustain=3, cooldown eats
+    [a] = fired                                  # the other 7 breaches
+    assert a.kind == "straggler_hop"
+    assert a.detail["device"] == "w2"
+    assert a.detail["compute_p95_ms"] == 20.0
+    # past the cooldown, a persisting straggler may fire again
+    clock.advance(400.0)
+    assert len(det.observe({"stages": _stages(20.0)})) == 1
+
+
+def test_straggler_fires_in_two_stage_ring():
+    """The default topology (header + one worker): the baseline is the
+    OTHER stage's p95, so a 2-stage ring's straggler can fire (a ring
+    median over all stages would be the straggler itself and never
+    could)."""
+    clock = FakeClock()
+    det = AnomalyDetector(TH, clock=clock)
+    two = [{"role": "header", "device_id": "h", "compute_p95_ms": 2.0},
+           {"role": "tail", "device_id": "w1", "compute_p95_ms": 40.0}]
+    fired = []
+    for _ in range(5):
+        fired += det.observe({"stages": two})
+        clock.advance(1.0)
+    assert [a.kind for a in fired] == ["straggler_hop"]
+    assert fired[0].detail["device"] == "w1"
+    assert fired[0].detail["ring_median_ms"] == 2.0
+
+
+def test_straggler_streak_resets_on_recovery():
+    clock = FakeClock()
+    det = AnomalyDetector(TH, clock=clock)
+    assert det.observe({"stages": _stages(20.0)}) == []
+    assert det.observe({"stages": _stages(20.0)}) == []
+    assert det.observe({"stages": _stages(2.0)}) == []   # recovered
+    assert det.observe({"stages": _stages(20.0)}) == []  # streak restarted
+    assert det.observe({"stages": _stages(20.0)}) == []
+
+
+def test_slo_breach_fires_once():
+    clock = FakeClock()
+    det = AnomalyDetector(TH, clock=clock)
+    stats = {"steps": 1, "latency": {"ttft_p95_ms": 250.0}}
+    fired = []
+    for _ in range(8):
+        stats = dict(stats, steps=stats["steps"] + 1)  # no stall noise
+        fired += det.observe(stats)
+        clock.advance(1.0)
+    assert [a.kind for a in fired] == ["slo_ttft"]
+    assert fired[0].detail == {"ttft_p95_ms": 250.0, "slo_ms": 100.0}
+
+
+def test_slo_disabled_when_zero():
+    det = AnomalyDetector(Thresholds(ttft_slo_ms=0.0, sustain=1),
+                          clock=FakeClock())
+    assert det.observe({"latency": {"ttft_p95_ms": 9999.0}}) == []
+
+
+def test_stall_watchdog_fires_once_per_window():
+    clock = FakeClock()
+    det = AnomalyDetector(TH, clock=clock)
+    stats = {"steps": 42, "active_slots": 3, "queue_depth": 0}
+    assert det.observe(stats) == []              # baseline observation
+    clock.advance(29.0)
+    assert det.observe(stats) == []              # inside the window
+    clock.advance(2.0)                           # 31 s frozen: fire
+    [a] = det.observe(stats)
+    assert a.kind == "pipeline_stall"
+    assert a.detail["steps"] == 42
+    assert a.detail["stalled_for_s"] >= 30.0
+    clock.advance(10.0)
+    assert det.observe(stats) == []              # cooldown: no storm
+    # progress resumes, then a NEW stall past the cooldown fires again
+    assert det.observe(dict(stats, steps=43)) == []
+    clock.advance(400.0)
+    [b] = det.observe(dict(stats, steps=43))
+    assert b.kind == "pipeline_stall"
+
+
+def test_stall_needs_work_in_flight():
+    clock = FakeClock()
+    det = AnomalyDetector(TH, clock=clock)
+    idle = {"steps": 42, "active_slots": 0, "queue_depth": 0}
+    det.observe(idle)
+    clock.advance(1000.0)
+    assert det.observe(idle) == []               # idle != stalled
+
+
+def test_stall_window_restarts_after_idle_period():
+    """Idle-then-resume must NOT fire instantly: the frozen-steps window
+    starts when work arrives, not when the engine last stepped."""
+    clock = FakeClock()
+    det = AnomalyDetector(TH, clock=clock)
+    det.observe({"steps": 42, "active_slots": 0, "queue_depth": 0})
+    clock.advance(600.0)                         # long idle stretch
+    det.observe({"steps": 42, "active_slots": 0, "queue_depth": 0})
+    clock.advance(1.0)
+    busy = {"steps": 42, "active_slots": 1, "queue_depth": 0}
+    assert det.observe(busy) == []               # healthy resume
+    clock.advance(10.0)
+    assert det.observe(busy) == []               # still inside window
+    clock.advance(25.0)                          # NOW 35s busy-frozen
+    [a] = det.observe(busy)
+    assert a.kind == "pipeline_stall"
+    assert a.detail["stalled_for_s"] < 60.0      # not the stale 600s
+
+
+def test_slo_streak_clears_when_metric_vanishes():
+    """Sustain means CONSECUTIVE: a stats-reset gap (the p95 disappears)
+    must restart the streak, not preserve two old breaches."""
+    clock = FakeClock()
+    det = AnomalyDetector(TH, clock=clock)
+    breach = {"steps": 1, "latency": {"ttft_p95_ms": 250.0}}
+    det.observe(dict(breach, steps=1))
+    det.observe(dict(breach, steps=2))           # streak = 2
+    det.observe({"steps": 3, "latency": {}})     # reservoir reset: gap
+    assert det.observe(dict(breach, steps=4)) == []   # streak restarted
+    assert det.observe(dict(breach, steps=5)) == []
+
+
+def test_queue_saturation_and_accept_collapse():
+    clock = FakeClock()
+    det = AnomalyDetector(TH, clock=clock)
+    bad = {"steps": 0, "queue_depth": 99,
+           "speculative": {"rounds": 100, "num_draft": 4,
+                           "acceptance_rate": 0.05}}
+    fired = []
+    for i in range(4):
+        fired += det.observe(dict(bad, steps=i))
+        clock.advance(1.0)
+    kinds = sorted(a.kind for a in fired)
+    assert kinds == ["accept_collapse", "queue_saturation"]
+
+
+def test_accept_collapse_needs_volume():
+    det = AnomalyDetector(Thresholds(sustain=1, accept_floor=0.2,
+                                     accept_min_drafted=400),
+                          clock=FakeClock())
+    assert det.observe({"speculative": {
+        "rounds": 10, "num_draft": 4, "acceptance_rate": 0.0}}) == []
+
+
+@pytest.mark.parametrize("scenario", ["straggler", "stall", "slo"])
+def test_each_scenario_produces_exactly_one_bundle(tmp_path, scenario):
+    """The acceptance bar: a deterministic fake-clock scenario drives
+    the monitor end to end and EXACTLY ONE postmortem bundle lands on
+    disk."""
+    clock = FakeClock()
+    postmortem.set_postmortem_writer(
+        postmortem.PostmortemWriter(str(tmp_path), clock=clock))
+    mon = AnomalyMonitor(AnomalyDetector(TH, clock=clock),
+                         min_interval_s=0.0, clock=clock,
+                         config={"scenario": scenario})
+    for i in range(20):
+        if scenario == "straggler":
+            stats = {"stages": _stages(20.0)}
+        elif scenario == "slo":
+            stats = {"steps": i, "latency": {"ttft_p95_ms": 250.0}}
+        else:                                    # stall
+            stats = {"steps": 7, "active_slots": 2, "queue_depth": 1}
+        mon.observe(stats)
+        clock.advance(5.0)
+    bundles = sorted(p for p in tmp_path.iterdir() if p.name.startswith(
+        "pm-"))
+    assert len(bundles) == 1, (scenario, bundles)
+    assert len(mon.bundles) == 1
+    import json
+    manifest = json.loads((bundles[0] / "manifest.json").read_text())
+    expected = {"straggler": "straggler_hop", "slo": "slo_ttft",
+                "stall": "pipeline_stall"}[scenario]
+    assert manifest["reason"] == expected
+    assert (bundles[0] / "flight.jsonl").exists()
+    assert (bundles[0] / "metrics.prom").exists()
+
+
+def test_monitor_throttles_and_accepts_callable():
+    clock = FakeClock()
+    calls = []
+
+    def stats():
+        calls.append(1)
+        return {"steps": len(calls)}
+
+    mon = AnomalyMonitor(AnomalyDetector(TH, clock=clock),
+                         min_interval_s=1.0, clock=clock)
+    mon.observe(stats)
+    mon.observe(stats)                           # throttled: not built
+    assert len(calls) == 1
+    clock.advance(2.0)
+    mon.observe(stats)
+    assert len(calls) == 2
+
+
+def test_header_backend_stats_poll_drives_straggler_detection(tmp_path):
+    """Production wiring for observe_stages: every HeaderBackend stats
+    collection (the /stats and /metrics poll path) feeds the straggler
+    detector, so a scheduled Prometheus scrape fires the anomaly and
+    writes the bundle."""
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        HeaderBackend)
+
+    postmortem.set_postmortem_writer(
+        postmortem.PostmortemWriter(str(tmp_path)))
+
+    class StubHeader:
+        def collect_stats(self, num_stages, timeout=10.0):
+            return [
+                {"role": "header", "device_id": "h",
+                 "compute_p95_ms": 2.0},
+                {"role": "worker", "device_id": "w1",
+                 "compute_p95_ms": 2.0},
+                {"role": "tail", "device_id": "w2",
+                 "compute_p95_ms": 40.0},
+            ]
+
+    backend = HeaderBackend(StubHeader(), max_seq=64, num_stages=3)
+    clock = FakeClock()
+    backend.anomaly = __import__(
+        "distributed_inference_demo_tpu.telemetry.anomaly",
+        fromlist=["AnomalyMonitor"]).AnomalyMonitor(
+        AnomalyDetector(TH, clock=clock), min_interval_s=0.0,
+        clock=clock, config={"backend": "HeaderBackend"})
+    for _ in range(5):
+        backend.stats()
+        clock.advance(5.0)
+    bundles = list(tmp_path.glob("pm-*"))
+    assert len(bundles) == 1
+    import json
+    manifest = json.loads((bundles[0] / "manifest.json").read_text())
+    assert manifest["reason"] == "straggler_hop"
+    assert manifest["detail"]["detail"]["device"] == "w2"
+    assert backend.debug_state()["anomaly"]["recent"]
+
+
+def test_anomaly_to_dict_round_trips():
+    a = Anomaly("straggler_hop", "warn", 12.5, {"device": "w2"})
+    assert a.to_dict() == {"kind": "straggler_hop", "severity": "warn",
+                           "ts": 12.5, "detail": {"device": "w2"}}

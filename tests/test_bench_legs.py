@@ -25,6 +25,18 @@ def test_leg_moe_structure_tiny():
     assert out["moe_vs_dense_decode"] > 0
 
 
+def test_bench_engine_latency_percentiles_tiny():
+    """The headline legs' TTFT/TPOT block (BENCH_SELF trajectory): real
+    percentiles, ordered, from the streamed per-request measurement."""
+    out = bench._bench_engine("llama-test", 2, 8, 4, latency=True)
+    lat = out["latency"]
+    assert lat["requests"] >= 1
+    for name in ("ttft", "tpot"):
+        p50, p95, p99 = (lat[f"{name}_p{q}_ms"] for q in (50, 95, 99))
+        assert p50 is not None and p50 > 0
+        assert p50 <= p95 <= p99
+
+
 def test_leg_multimodal_structure_tiny():
     out = bench._leg_multimodal(2, 4, scale="tiny",
                                 decoder_model="llama-test")

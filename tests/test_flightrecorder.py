@@ -1,0 +1,81 @@
+"""Flight recorder: bounded ring semantics, process default, and the
+``dwt_flight_*`` catalog bridge."""
+
+import threading
+
+import pytest
+
+from distributed_inference_demo_tpu.telemetry.flightrecorder import (
+    FlightRecorder, get_flight_recorder, set_flight_recorder)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_process_recorder():
+    set_flight_recorder(None)
+    yield
+    set_flight_recorder(None)
+
+
+def test_ring_bounded_keeps_newest():
+    fr = FlightRecorder(max_events=4)
+    for i in range(10):
+        fr.record("x", i=i)
+    assert len(fr) == 4
+    assert [e["i"] for e in fr.snapshot()] == [6, 7, 8, 9]
+    assert fr.total == 10                 # monotone across overwrites
+    assert [e["i"] for e in fr.tail(2)] == [8, 9]
+    assert len(fr.tail(100)) == 4
+
+
+def test_snapshot_does_not_drain():
+    """A postmortem capture must not blind the next one."""
+    fr = FlightRecorder(max_events=8)
+    fr.record("a")
+    assert len(fr.snapshot()) == 1
+    assert len(fr.snapshot()) == 1
+
+
+def test_events_carry_ts_kind_proc_and_fields():
+    t = [100.0]
+    fr = FlightRecorder(proc="w1", max_events=8, clock=lambda: t[0])
+    fr.record("hop_send", rid=3, step=7, dest="w2")
+    [e] = fr.snapshot()
+    assert e == {"ts": 100.0, "kind": "hop_send", "proc": "w1",
+                 "rid": 3, "step": 7, "dest": "w2"}
+
+
+def test_process_default_recorder_is_shared_and_resettable():
+    a = get_flight_recorder()
+    a.record("x")
+    assert get_flight_recorder() is a
+    custom = FlightRecorder(max_events=2)
+    set_flight_recorder(custom)
+    assert get_flight_recorder() is custom
+
+
+def test_thread_safety_totals():
+    fr = FlightRecorder(max_events=64)
+
+    def spam():
+        for _ in range(500):
+            fr.record("x")
+
+    threads = [threading.Thread(target=spam) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fr.total == 2000
+    assert len(fr) == 64
+
+
+def test_catalog_bridge_updates_flight_series():
+    from distributed_inference_demo_tpu.telemetry.catalog import (
+        FLIGHT_BUFFER, FLIGHT_EVENTS, update_flight_series)
+    fr = FlightRecorder(max_events=4)
+    set_flight_recorder(fr)
+    for i in range(6):
+        fr.record("x", i=i)
+    update_flight_series()
+    assert next(v for _, _, v in FLIGHT_EVENTS.samples()) == 6
+    assert next(v for _, _, v in FLIGHT_BUFFER.samples()) == 4

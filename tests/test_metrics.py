@@ -194,6 +194,41 @@ def test_worker_metrics_server():
         srv.shutdown()
 
 
+def test_worker_metrics_server_debugz():
+    """The worker-side GET /debugz (worker_main --metrics-port): JSON
+    flight/postmortem state next to the text /metrics scrape."""
+    from distributed_inference_demo_tpu.runtime.stats import StageStats
+    from distributed_inference_demo_tpu.telemetry import (
+        FlightRecorder, MetricsHTTPServer, set_flight_recorder)
+    from distributed_inference_demo_tpu.telemetry import catalog
+
+    fr = FlightRecorder(proc="w9", max_events=16)
+    set_flight_recorder(fr)
+    fr.record("hop_recv", rid=1, step=2)
+    st = StageStats("worker")
+
+    def debugz():
+        return {"device_id": "w9",
+                "flight": {"total": fr.total, "tail": fr.tail(8)}}
+
+    srv = MetricsHTTPServer(lambda: catalog.render_worker(st, "w9"),
+                            port=0, debug_provider=debugz)
+    srv.start()
+    try:
+        text, ctype = _get(f"http://{srv.host}:{srv.port}/debugz")
+        assert ctype.startswith("application/json")
+        dz = json.loads(text)
+        assert dz["device_id"] == "w9"
+        assert dz["flight"]["tail"][0]["kind"] == "hop_recv"
+        # the metrics path still serves text exposition alongside
+        text, ctype = _get(f"http://{srv.host}:{srv.port}/metrics")
+        assert ctype.startswith("text/plain")
+        parse_exposition(text)
+    finally:
+        srv.shutdown()
+        set_flight_recorder(None)
+
+
 # -- registry / class unit tests -------------------------------------------
 
 def test_counter_rejects_negative_and_duplicate_names():

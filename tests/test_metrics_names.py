@@ -28,6 +28,16 @@ def test_standard_catalog_is_clean():
     assert problems == []
 
 
+def test_required_flight_anomaly_series_registered():
+    """The flight-recorder/anomaly series must exist in the standard
+    catalog — their absence would read as a healthy quiet system."""
+    lint = _load_lint()
+    assert lint.check_required(REGISTRY) == []
+    names = {m.name for m in REGISTRY.collect()}
+    assert "dwt_anomaly_events_total" in names
+    assert "dwt_flight_buffer_events" in names
+
+
 def test_lint_catches_violations():
     """The lint actually fires: a unitless name, a foreign prefix, a
     counter without _total, and a gauge pretending to be a counter all

@@ -218,3 +218,138 @@ def test_trace_recorder_bounded_and_drains():
     chrome = to_chrome_trace(spans)
     assert len([e for e in chrome["traceEvents"]
                 if e.get("ph") == "X"]) == 4
+
+
+def test_span_clock_captures_wall_start_at_open():
+    """The NTP-step fix: a span's start is the wall clock captured at
+    span OPEN, never reconstructed as now-minus-duration at close."""
+    import time as _time
+
+    from distributed_inference_demo_tpu.telemetry.tracing import SpanClock
+
+    before = _time.time()
+    clk = SpanClock()
+    after = _time.time()
+    assert before <= clk.ts <= after
+    _time.sleep(0.02)
+    dur = clk.stop()
+    assert dur >= 0.02
+    assert clk.stop() == dur            # frozen after first read
+
+    rec = TraceRecorder("t")
+    rec.record("compute", trace_id=1, clock=clk)
+    [span] = rec.snapshot()
+    # recorded start == the OPEN capture, independent of record() time
+    assert span["ts_us"] == int(clk.ts * 1e6)
+    assert span["dur_us"] == int(dur * 1e6)
+
+
+def test_record_without_ts_stamps_call_time_not_now_minus_dur():
+    import time as _time
+
+    rec = TraceRecorder("t")
+    before = _time.time()
+    rec.record("x", trace_id=1, dur=5.0)    # no ts: stamped at call time
+    after = _time.time()
+    [span] = rec.snapshot()
+    assert int(before * 1e6) <= span["ts_us"] <= int(after * 1e6)
+
+
+def test_runlog_rollover_at_max_bytes(tmp_path):
+    """Satellite: RunLog rolls to <path>.1 at the byte budget instead of
+    growing without bound; the rotation boundary loses nothing."""
+    from distributed_inference_demo_tpu.telemetry.runlog import RunLog
+
+    path = tmp_path / "run.jsonl"
+    rl = RunLog(str(path), run_id="r", max_bytes=400)
+    for i in range(20):
+        rl.event("tick", i=i)
+    rl.close()
+    rolled = tmp_path / "run.jsonl.1"
+    assert rolled.exists(), "no rollover happened"
+    assert path.stat().st_size <= 400
+    assert rolled.stat().st_size <= 400
+    # the boundary is clean: every surviving line parses whole (nothing
+    # torn mid-rotation), and the two generations form one contiguous
+    # tail ending at the newest event (older generations are dropped by
+    # design — one spare bounds disk at 2 x max_bytes)
+    events = []
+    for p in (rolled, path):
+        for line in p.read_text().splitlines():
+            events.append(json.loads(line)["i"])
+    assert events == list(range(events[0], 20))
+
+
+def test_runlog_rollover_keeps_single_spare(tmp_path):
+    from distributed_inference_demo_tpu.telemetry.runlog import RunLog
+
+    path = tmp_path / "run.jsonl"
+    rl = RunLog(str(path), max_bytes=200)
+    for i in range(60):
+        rl.event("tick", i=i)
+    rl.close()
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert files == ["run.jsonl", "run.jsonl.1"]   # bounded: two files
+    last = json.loads(path.read_text().splitlines()[-1])
+    assert last["i"] == 59
+
+
+def test_runlog_oversized_line_lands_in_fresh_file(tmp_path):
+    from distributed_inference_demo_tpu.telemetry.runlog import RunLog
+
+    path = tmp_path / "run.jsonl"
+    rl = RunLog(str(path), max_bytes=100)
+    rl.event("small")
+    rl.event("big", blob="x" * 500)     # alone exceeds the whole budget
+    rl.close()
+    lines = path.read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["event"] == "big"
+    assert (tmp_path / "run.jsonl.1").exists()
+
+
+def test_runlog_no_rollover_when_unset(tmp_path):
+    from distributed_inference_demo_tpu.telemetry.runlog import RunLog
+
+    path = tmp_path / "run.jsonl"
+    rl = RunLog(str(path))              # max_bytes 0 = unbounded
+    for i in range(50):
+        rl.event("tick", i=i)
+    rl.close()
+    assert not (tmp_path / "run.jsonl.1").exists()
+    assert len(path.read_text().splitlines()) == 50
+
+
+def test_http_debugz_on_header():
+    """GET /debugz returns flight-ring state, backend in-flight info,
+    and postmortem status without touching the pipeline."""
+    from distributed_inference_demo_tpu.telemetry.flightrecorder import (
+        set_flight_recorder)
+
+    set_flight_recorder(None)
+    header, workers, threads = _build(num_stages=2)
+    backend = HeaderBackend(header, max_seq=64, num_stages=2)
+    srv = InferenceHTTPServer(backend, model_name="llama-test")
+    srv.start()
+    try:
+        url = f"http://{srv.host}:{srv.port}"
+        body = json.dumps({"prompt_ids": PROMPT.tolist(),
+                           "max_new_tokens": 2}).encode()
+        req = urllib.request.Request(url + "/generate", data=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert json.loads(r.read())["tokens"]
+        with urllib.request.urlopen(url + "/debugz", timeout=60) as r:
+            dz = json.loads(r.read())
+        assert dz["flight"]["total"] > 0
+        kinds = {e["kind"] for e in dz["flight"]["tail"]}
+        assert {"hop_send", "tok_recv"} <= kinds
+        assert dz["backend"]["num_stages"] == 2
+        assert dz["backend"]["in_flight"] == []
+        assert dz["postmortem"]["dir"] is None     # capture unconfigured
+    finally:
+        srv.shutdown()
+        header.shutdown_pipeline()
+        for t in threads:
+            t.join(timeout=30)
+        set_flight_recorder(None)

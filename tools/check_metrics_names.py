@@ -27,7 +27,7 @@ import sys
 from typing import List
 
 SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
-              "engine", "control"}
+              "engine", "control", "anomaly", "flight"}
 
 # unit suffixes a metric name may end with (after stripping ``_total``).
 # Plain-count units (requests, tokens, ...) double as the unit for
@@ -35,7 +35,18 @@ SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
 UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "rounds", "hits", "misses", "slots", "spans", "entries",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
-         "info"}
+         "info", "events", "bundles"}
+
+# series the catalog must always register (regressions here would blind
+# the flight-recorder/anomaly layer silently — a scrape with the series
+# simply absent looks exactly like a healthy quiet system)
+REQUIRED_SERIES = {
+    "dwt_flight_events_total",
+    "dwt_flight_buffer_events",
+    "dwt_anomaly_events_total",
+    "dwt_anomaly_last_seconds",
+    "dwt_anomaly_postmortem_bundles_total",
+}
 
 
 def check_registry(registry) -> List[str]:
@@ -71,6 +82,15 @@ def check_registry(registry) -> List[str]:
     return problems
 
 
+def check_required(registry) -> List[str]:
+    """Presence lint for the standard catalog (run against the DEFAULT
+    registry only — synthetic test registries legitimately hold other
+    series sets)."""
+    present = {m.name for m in registry.collect()}
+    return [f"required series {name} is not registered"
+            for name in sorted(REQUIRED_SERIES - present)]
+
+
 def main() -> int:
     # repo root on sys.path when run as a script from anywhere
     import pathlib
@@ -80,7 +100,7 @@ def main() -> int:
     from distributed_inference_demo_tpu.telemetry import catalog  # noqa: F401
     from distributed_inference_demo_tpu.telemetry.metrics import REGISTRY
 
-    problems = check_registry(REGISTRY)
+    problems = check_registry(REGISTRY) + check_required(REGISTRY)
     for p in problems:
         print(f"METRIC LINT: {p}", file=sys.stderr)
     if problems:

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Offline postmortem-bundle analyzer: bundle directory in, diagnosis out.
+
+Reads a bundle written by ``telemetry/postmortem.py`` (manifest, flight
+ring, metrics snapshot, run-log tail) and summarizes it down to the
+offending hop/window:
+
+- for a ``pipeline_stall``, each stalled (rid, step) is walked through
+  its ``hop_send``/``hop_recv``/``tok_recv`` flight events; the LAST
+  event pins the hop where the token step died — a trailing ``hop_send``
+  from stage S to D means the message left S and D never processed it
+  (D dead, or the S→D link down); a trailing ``hop_recv`` at S means S
+  took the message and never forwarded (compute stalled mid-hop);
+- for a ``crash``, the exception chain from the manifest plus the final
+  ring events;
+- always: the recorded anomalies, event counts over the capture window,
+  and the ``dwt_anomaly_*`` counters from the metrics snapshot.
+
+Run standalone (``python tools/postmortem.py <bundle_dir>`` for a human
+summary, ``--json`` for machine output) or import ``summarize_bundle``
+(the tier-1 smoke test runs it against a golden bundle in
+``tests/data/golden_bundle``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _load_jsonl(path: str) -> List[dict]:
+    out: List[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue    # a torn tail line is expected in a crash
+    except OSError:
+        pass
+    return out
+
+
+def _stalled_pairs(manifest: dict, events: List[dict]) -> List[List[int]]:
+    """(rid, step) pairs still awaiting a reply, from the manifest detail
+    or (fallback) the last ``pipeline_stall`` flight event."""
+    detail = manifest.get("detail") or {}
+    pairs = detail.get("in_flight")
+    if not pairs:
+        for e in reversed(events):
+            if e.get("kind") == "pipeline_stall":
+                pairs = e.get("in_flight")
+                break
+    return [[int(r), int(s)] for r, s in (pairs or [])]
+
+
+def _diagnose_pair(rid: int, step: int,
+                   events: List[dict]) -> Dict[str, object]:
+    """Walk one (rid, step)'s hop events; the last one names the hop.
+
+    The named hop is the FIRST UNCONFIRMED one from the capturing
+    process's view: a bundle holds one process's flight ring, so when
+    the trailing ``hop_send``'s destination never appears in this
+    bundle's events at all (separate-process worker), the break is *at
+    or after* that hop and the diagnosis says to continue the walk with
+    the destination's own ring.  When the destination's ring IS in the
+    bundle (in-process loopback, or a merged capture) its silence is
+    conclusive."""
+    chain = [e for e in events
+             if e.get("rid") == rid and e.get("step") == step
+             and e.get("kind") in ("hop_send", "hop_recv", "tok_recv")]
+    chain.sort(key=lambda e: e.get("ts", 0))
+    out: Dict[str, object] = {"rid": rid, "step": step,
+                              "events": len(chain)}
+    if not chain:
+        out["offending_hop"] = "unknown (no hop events captured)"
+        return out
+    stages_seen = {e.get("stage") for e in events if e.get("stage")}
+    last = chain[-1]
+    out["last_event"] = last
+    kind = last.get("kind")
+    stage = last.get("stage", "?")
+    if kind == "tok_recv":
+        out["offending_hop"] = None     # reply made it back after all
+    elif kind == "hop_send":
+        dest = last.get("dest", "?")
+        out["offending_hop"] = f"{stage}->{dest}"
+        if dest in stages_seen:
+            out["diagnosis"] = (f"stage {stage!r} sent (rid={rid}, "
+                                f"step={step}) to {dest!r}, which never "
+                                "processed it — dead stage or dead link")
+        else:
+            out["diagnosis"] = (
+                f"stage {stage!r} sent (rid={rid}, step={step}) to "
+                f"{dest!r} and no reply returned; this bundle holds only "
+                f"{sorted(stages_seen)}'s ring, so the break is at or "
+                f"after this hop — continue the walk with {dest!r}'s own "
+                "flight ring (worker /debugz, or its crash bundle)")
+    else:                               # hop_recv without a send
+        out["offending_hop"] = f"{stage} (compute)"
+        out["diagnosis"] = (f"stage {stage!r} received (rid={rid}, "
+                            f"step={step}) and never forwarded — "
+                            "compute stalled or the process died "
+                            "mid-hop")
+    return out
+
+
+def _metrics_highlights(path: str) -> Dict[str, float]:
+    """The ``dwt_anomaly_*`` samples from the bundle's metrics snapshot
+    (the full file stays available for ad-hoc grepping)."""
+    out: Dict[str, float] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                if not line.startswith("dwt_anomaly_"):
+                    continue
+                name, _, value = line.rstrip("\n").rpartition(" ")
+                try:
+                    out[name] = float(value)
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def summarize_bundle(bundle_dir: str) -> dict:
+    """The analyzer core: bundle directory -> summary dict."""
+    manifest = _load_json(os.path.join(bundle_dir, "manifest.json"))
+    if manifest is None:
+        raise FileNotFoundError(
+            f"{bundle_dir!r} has no readable manifest.json — not a "
+            "postmortem bundle")
+    events = _load_jsonl(os.path.join(bundle_dir, "flight.jsonl"))
+    runlog = _load_jsonl(os.path.join(bundle_dir, "runlog_tail.jsonl"))
+
+    kinds: Dict[str, int] = {}
+    for e in events:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+    ts = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
+
+    out: dict = {
+        "bundle": bundle_dir,
+        "reason": manifest.get("reason"),
+        "ts": manifest.get("ts"),
+        "iso": manifest.get("iso"),
+        "detail": manifest.get("detail") or {},
+        "flight_events": len(events),
+        "event_kinds": kinds,
+        "window_s": round(max(ts) - min(ts), 6) if ts else 0.0,
+        "anomalies": [e for e in events if e.get("kind") == "anomaly"],
+        "metrics": _metrics_highlights(
+            os.path.join(bundle_dir, "metrics.prom")),
+    }
+
+    stalled = [_diagnose_pair(r, s, events)
+               for r, s in _stalled_pairs(manifest, events)]
+    stalled = [d for d in stalled if d.get("offending_hop") is not None]
+    if stalled:
+        out["stalled"] = stalled
+        # the headline answer: the hop most stalled steps died on
+        hops = [d["offending_hop"] for d in stalled]
+        out["offending_hop"] = max(set(hops), key=hops.count)
+
+    if manifest.get("reason") == "crash":
+        d = manifest.get("detail") or {}
+        out["crash"] = {"exc_type": d.get("exc_type"),
+                        "exc": d.get("exc"),
+                        "thread": d.get("thread")}
+
+    if runlog:
+        out["runlog"] = {"lines": len(runlog), "last": runlog[-1]}
+    return out
+
+
+def format_summary(s: dict) -> str:
+    lines = [
+        f"postmortem bundle: {s['bundle']}",
+        f"  reason: {s['reason']}  at {s.get('iso') or s.get('ts')}",
+        f"  flight events: {s['flight_events']} over "
+        f"{s['window_s']}s  kinds: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(s["event_kinds"]
+                                                  .items())),
+    ]
+    if s.get("offending_hop"):
+        lines.append(f"  OFFENDING HOP: {s['offending_hop']}")
+        for d in s.get("stalled", []):
+            lines.append(
+                f"    rid={d['rid']} step={d['step']}: "
+                f"{d.get('diagnosis', d['offending_hop'])}")
+    if s.get("crash"):
+        c = s["crash"]
+        lines.append(f"  CRASH: {c.get('exc_type')}: {c.get('exc')}"
+                     + (f" (thread {c['thread']})" if c.get("thread")
+                        else ""))
+    for a in s.get("anomalies", []):
+        lines.append(f"  anomaly: {a.get('anomaly')} "
+                     f"severity={a.get('severity')}")
+    if s.get("metrics"):
+        lines.append("  metrics: "
+                     + ", ".join(f"{k}={v:g}" for k, v
+                                 in sorted(s["metrics"].items())))
+    if s.get("runlog"):
+        lines.append(f"  runlog tail: {s['runlog']['lines']} lines, "
+                     f"last event {s['runlog']['last'].get('event')!r}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a postmortem bundle down to the "
+                    "offending hop/window")
+    ap.add_argument("bundle", help="bundle directory "
+                                   "(pm-<stamp>-<seq>-<reason>/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary on stdout")
+    args = ap.parse_args(argv)
+    try:
+        s = summarize_bundle(args.bundle)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(json.dumps(s, default=str) if args.json else format_summary(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
