@@ -28,8 +28,11 @@ Extra legs (each reported inside the same JSON object):
 - ``prompt_lookup``: draft-free n-gram speculation at batch 1 on a
   repetitive prompt, vs plain decode;
 - ``batching``: continuous-batching aggregate throughput (24 requests
-  into 8 slots) vs sequential plain batches, plus the automatic prefix
-  cache's hit/reuse counters on a shared-prefix workload;
+  into 8 slots) vs sequential plain batches, plus the block KV cache's
+  hit/reuse counters on a shared-prefix workload;
+- ``prefix_reuse``: the block KV cache (runtime/kvcache) on a
+  repeated-shared-prefix workload — hit rate, reused tokens, and
+  measured prefill-seconds saved (cache-off vs cache-on wall delta);
 - ``long_context``: 32k-token single-chip generation via chunked prefill
   + flash attention (prefill and decode tok/s at full context).
 
@@ -794,12 +797,13 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
 
     with ContinuousBatchingEngine(
             cfg, params, max_seq=max_seq, max_batch=slots,
-            sampling=sampling, prefix_cache_size=8) as eng:
+            sampling=sampling, kv_cache_blocks=64,
+            kv_block_tokens=16) as eng:
         # warmups cover EVERY compile either timed phase can reach:
         # (a) sub-16-token prompt: step + admit + zero_row + bucket 32,
-        #     without polluting the prefix cache (below min_prefix_len);
-        # (b) a 128-token throwaway: bucket 128 (also stores its prefix);
-        # (c) (b)'s prefix + fresh tail: the prefix-HIT path
+        #     without polluting the block cache (below one block);
+        # (b) a 128-token throwaway: bucket 128 (also stores its blocks);
+        # (c) (b)'s prefix + fresh tail: the block-HIT path
         #     (_load_prefix + suffix bucket) — phase B's steady state
         warm = rng.integers(0, 1000, size=(128,)).astype(np.int32)
         eng.submit(warm[:8], 4).wait(timeout=600)
@@ -808,8 +812,9 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
             warm[:96], rng.integers(0, 1000, size=(32,))]).astype(np.int32),
             4).wait(timeout=600)
         # (d) a phase-A-shaped prompt, so ITS bucket is compiled even when
-        #     BENCH_PROMPT lands past 128 (stores one random prefix entry;
-        #     phase A's random prompts can't hit it — LCP < min_prefix_len)
+        #     BENCH_PROMPT lands past 128 (stores one random prompt's
+        #     blocks; phase A's random prompts can't hit them — the
+        #     common prefix stays below one block)
         eng.submit(rng.integers(0, 1000, size=(prompt_len,)).astype(
             np.int32), 4).wait(timeout=600)
         t0 = time.perf_counter()
@@ -821,9 +826,10 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
         out["vs_plain_sequential"] = round(
             (n_req * new_tokens / dt) / plain_tps, 3)
 
-        # Phase B: shared 96-token prefix, distinct 32-token tails (the
-        # bucket layout keeps prompt_len at 128)
-        base = eng.prefix_stats.copy()
+        # Phase B: shared 96-token prefix (6 whole 16-token blocks),
+        # distinct 32-token tails (the bucket layout keeps prompt_len
+        # at 128)
+        base = dict(eng.kv_cache.stats)
         shared = rng.integers(0, 1000, size=(96,))
         pre_prompts = [np.concatenate([
             shared, rng.integers(0, 1000, size=(32,))]).astype(np.int32)
@@ -835,9 +841,9 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
         dt = time.perf_counter() - t0
         out["prefix_phase_tokens_per_sec"] = round(
             slots * new_tokens / dt, 2)
-        out["prefix_stats"] = {
-            k: eng.prefix_stats[k] - base.get(k, 0)
-            for k in eng.prefix_stats}
+        out["kvcache_stats"] = {
+            k: eng.kv_cache.stats[k] - base.get(k, 0)
+            for k in eng.kv_cache.stats}
 
     # Phase B2: the fused decode-block throughput mode (one host sync
     # per 8 steps) on the phase-A workload — on a high-dispatch-latency
@@ -845,7 +851,7 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
     try:
         with ContinuousBatchingEngine(
                 cfg, params, max_seq=max_seq, max_batch=slots,
-                sampling=sampling, prefix_cache_size=0,
+                sampling=sampling, kv_cache_blocks=0,
                 decode_block=8) as eng:
             eng.submit(prompts[0][:8], 4).wait(timeout=600)   # warm 32
             eng.submit(prompts[0], 4).wait(timeout=600)       # warm 128
@@ -868,7 +874,7 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
                                         quantize=True)
         with ContinuousBatchingEngine(
                 cfg, params, max_seq=max_seq, max_batch=slots,
-                sampling=SamplingParams(greedy=True), prefix_cache_size=0,
+                sampling=SamplingParams(greedy=True), kv_cache_blocks=0,
                 draft_cfg=draft_cfg, draft_params=draft_params,
                 num_draft=4) as eng:
             eng.submit(prompts[0][:8], 4).wait(timeout=600)   # warm 32
@@ -890,6 +896,84 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
     except Exception as e:   # phase isolation: A/B numbers survive
         out["spec_batching"] = {"error": f"{type(e).__name__}: {e}"}
     return out
+
+
+def _leg_prefix_reuse(model: str, new_tokens: int, slots: int = 8,
+                      n_req: int = 16, shared_len: int = 96,
+                      tail_len: int = 32, block_tokens: int = 16,
+                      kv_blocks: int = 64) -> dict:
+    """Block-level KV cache (runtime/kvcache) on a repeated-shared-prefix
+    workload: hit rate, reused tokens, and prefill seconds SAVED — the
+    prefill-amortization number shared-prefix serving (chat system
+    prompts, few-shot templates) turns on.
+
+    The same workload runs twice through the batching engine — cache OFF
+    then cache ON — after identical warmup and a priming request, so
+    ``prefill_seconds_saved`` is a measured wall delta on identical
+    decode work, not an estimate from token counts."""
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    sampling = SamplingParams(temperature=0.7, top_k=7)
+    max_seq = shared_len + tail_len + new_tokens
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, 1000, size=(shared_len,))
+
+    def prompt():
+        return np.concatenate(
+            [shared, rng.integers(0, 1000, size=(tail_len,))]
+        ).astype(np.int32)
+
+    prime = prompt()
+    prompts = [prompt() for _ in range(n_req)]
+
+    def run(blocks: int):
+        with ContinuousBatchingEngine(
+                cfg, params, max_seq=max_seq, max_batch=slots,
+                sampling=sampling, kv_cache_blocks=blocks,
+                kv_block_tokens=block_tokens) as eng:
+            # identical warmup both runs: the priming request stores the
+            # shared blocks (cache ON) and compiles the cold admission
+            # path; the second covers the hit path (ON) / re-admission
+            # (OFF) so neither timed wave pays a compile the other
+            # didn't
+            eng.submit(prime, 4).wait(timeout=600)
+            eng.submit(prompts[0], 4).wait(timeout=600)
+            eng.reset_stats()
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, new_tokens) for p in prompts]
+            for r in reqs:
+                r.wait(timeout=900)
+            dt = time.perf_counter() - t0
+            snap = (eng.kv_cache.snapshot()
+                    if eng.kv_cache is not None else None)
+            return dt, snap
+
+    cold_dt, _ = run(0)
+    warm_dt, snap = run(kv_blocks)
+    lookups = snap["hits"] + snap["misses"]
+    return {
+        "model": model, "slots": slots, "requests": n_req,
+        "shared_prefix_tokens": shared_len, "tail_tokens": tail_len,
+        "new_tokens": new_tokens, "block_tokens": block_tokens,
+        "kv_blocks": kv_blocks,
+        "hit_rate": round(snap["hits"] / lookups, 3) if lookups else None,
+        "reused_tokens": snap["partial_hit_tokens"],
+        "cold_seconds": round(cold_dt, 3),
+        "warm_seconds": round(warm_dt, 3),
+        "prefill_seconds_saved": round(cold_dt - warm_dt, 3),
+        "tokens_per_sec_cold": round(n_req * new_tokens / cold_dt, 2),
+        "tokens_per_sec_warm": round(n_req * new_tokens / warm_dt, 2),
+        "blocks_resident": snap["blocks_used"],
+        "evicted_blocks": snap["evicted_blocks"],
+    }
 
 
 def _leg_planner_pipeline(model: str, batch: int, prompt_len: int,
@@ -1136,6 +1220,8 @@ def run_leg(name: str, p: dict) -> dict:
             out = _leg_prompt_lookup(model, new_tokens)
         elif name == "batching":
             out = _leg_batching(model, prompt_len, min(new_tokens, 64))
+        elif name == "prefix_reuse":
+            out = _leg_prefix_reuse(model, min(new_tokens, 64))
         elif name == "pipeline":
             out = _leg_pipeline(model, batch, prompt_len,
                                 min(new_tokens, 32))
@@ -1358,15 +1444,15 @@ def main() -> None:
     # driver's deadline), then the already-proven tails
     legs = ["roofline_probe", "headline", "headline_int8",
             "speculative", "prompt_lookup", "planner_pipeline",
-            "long_context", "flagship_int8", "batching", "sweep",
-            "flagship_bf16", "pipeline", "prefill_long", "moe",
+            "long_context", "flagship_int8", "batching", "prefix_reuse",
+            "sweep", "flagship_bf16", "pipeline", "prefill_long", "moe",
             "multimodal", "int4"]
     for skip_var, leg_names in (
             ("BENCH_SKIP_FLAGSHIP", ["flagship_int8", "flagship_bf16"]),
             ("BENCH_SKIP_PIPELINE", ["pipeline", "planner_pipeline"]),
             ("BENCH_SKIP_SWEEP", ["sweep"]),
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
-                                    "batching"]),
+                                    "batching", "prefix_reuse"]),
             ("BENCH_SKIP_LONGCTX", ["long_context"]),
             ("BENCH_SKIP_PREFILL", ["prefill_long"]),
             ("BENCH_SKIP_MOE_MM", ["moe", "multimodal"]),
@@ -1424,7 +1510,7 @@ def main() -> None:
     # the batching leg builds several engine instances (plain compare +
     # slot/decode-block/speculative phases), each with its own compiles —
     # give it more rope than the single-engine legs
-    leg_timeouts = {"batching": 1500}
+    leg_timeouts = {"batching": 1500, "prefix_reuse": 1200}
     runlog.event("bench_start", params=params, legs=legs)
     results = {}
     for leg in legs:

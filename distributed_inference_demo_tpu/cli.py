@@ -92,11 +92,42 @@ def _load_draft_for_mesh(args, mesh):
     return draft_cfg, draft_params
 
 
+def _kvcache_from_args(args):
+    """``(kv_cache_blocks, kv_block_tokens)`` engine kwargs from the CLI
+    flags (None = not given: the engine falls back to DWT_KVCACHE_* env
+    knobs, then its own default) — one mapping shared by every engine
+    builder so the block-cache flags cannot silently diverge between
+    serve modes."""
+    return {"kv_cache_blocks": getattr(args, "kv_cache_blocks", None),
+            "kv_block_tokens": getattr(args, "kv_block_tokens", None)}
+
+
+def _kvcache_flags_set(args) -> bool:
+    """Did the user EXPLICITLY ask for the block cache?  Unset/0 is not
+    a request (0 is 'off' everywhere) — the one condition every
+    unsupported-mode rejection keys on, so no mode can accept one of
+    the pair and silently drop the other."""
+    return bool(getattr(args, "kv_cache_blocks", None)
+                or getattr(args, "kv_block_tokens", None))
+
+
+def _reject_kvcache_flags(args, mode: str) -> bool:
+    """True (after printing) when the kv-cache flags were explicitly set
+    for a mode with no block-cache plumbing — honor-or-reject, never
+    silently ignore."""
+    if _kvcache_flags_set(args):
+        print("--kv-cache-blocks/--kv-block-tokens are not supported "
+              f"with {mode}", file=sys.stderr)
+        return True
+    return False
+
+
 def _build_spec_engine(args):
     """Construct the draft/verify SpeculativeEngine from CLI flags — the
     one site shared by ``generate --draft-model`` and
     ``serve --draft-model``.  Every engine flag composes here
-    (--kv-cache-dtype, --prefill-chunk, --tp, --eos-id)."""
+    (--kv-cache-dtype, --prefill-chunk, --tp, --eos-id,
+    --kv-cache-blocks)."""
     from .models.registry import get_model_config
     from .runtime import SpeculativeEngine
 
@@ -109,7 +140,8 @@ def _build_spec_engine(args):
         num_draft=args.num_draft, attn_backend=args.attn_backend,
         mesh=mesh, eos_id=getattr(args, "eos_id", None),
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
-        prefill_chunk=getattr(args, "prefill_chunk", 0) or None)
+        prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
+        **_kvcache_from_args(args))
 
 
 def _build_prompt_lookup_engine(args):
@@ -120,6 +152,12 @@ def _build_prompt_lookup_engine(args):
     from .models.registry import get_model_config
     from .runtime.prompt_lookup import PromptLookupEngine
 
+    if _kvcache_flags_set(args):
+        raise ValueError(
+            "--kv-cache-blocks/--kv-block-tokens are not supported with "
+            "standalone --prompt-lookup (no block-cache plumbing in the "
+            "n-gram proposer engine); --batch-slots --prompt-lookup "
+            "composes with the block cache")
     cfg = get_model_config(args.model)
     params, mesh = _load_params_for_mesh(args, cfg)
     return PromptLookupEngine(
@@ -145,7 +183,8 @@ def _build_engine(args):
         attn_backend=args.attn_backend,
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
         prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
-        mesh=mesh, eos_id=getattr(args, "eos_id", None))
+        mesh=mesh, eos_id=getattr(args, "eos_id", None),
+        **_kvcache_from_args(args))
 
 
 # ---------------------------------------------------------------------------
@@ -223,6 +262,9 @@ def cmd_serve(args) -> int:
         if getattr(args, "prefill_chunk", 0):
             print("--prefill-chunk is not supported with --chain",
                   file=sys.stderr)
+            return 1
+        if _reject_kvcache_flags(args, "--chain (pipeline stages see "
+                                 "activations, not tokens)"):
             return 1
         full = _load_full_params(args, cfg)
         sampling = _sampling_from_args(args)
@@ -314,6 +356,7 @@ def cmd_serve(args) -> int:
         unsupported = [flag for flag, on in [
             ("--kv-cache-dtype", bool(getattr(args, "kv_cache_dtype", ""))),
             ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
+            ("--kv-cache-blocks", _kvcache_flags_set(args)),
             ("--tp", getattr(args, "tp", 1) > 1)] if on]
         if unsupported:
             print(f"{'/'.join(unsupported)} not supported with --vision",
@@ -381,15 +424,19 @@ def cmd_serve(args) -> int:
         backend = ContinuousBatchingEngine(
             cfg, params, max_seq=args.max_seq,
             max_batch=args.batch_slots, sampling=sampling, seed=args.seed,
-            prefix_cache_size=args.prefix_cache_size, mesh=mesh,
+            mesh=mesh,
             kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
             eos_id=getattr(args, "eos_id", None),
             draft_cfg=draft_cfg, draft_params=draft_params,
             num_draft=args.num_draft, prompt_lookup=pld,
             decode_block=args.decode_block,
-            prefill_chunk=getattr(args, "prefill_chunk", 0) or None)
+            prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
+            **_kvcache_from_args(args))
+        kvc = backend.kv_cache
+        kv_desc = (f"{kvc.pool.num_blocks}x{kvc.block_tokens}tok"
+                   if kvc is not None else "off")
         print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
-              f"prefix_cache={args.prefix_cache_size} "
+              f"kv_cache={kv_desc} "
               f"tp={getattr(args, 'tp', 1)}"
               + (f" draft={args.draft_model} k={args.num_draft}"
                  if draft_cfg is not None else "")
@@ -977,6 +1024,18 @@ def _add_engine_args(ap):
                          "prompts; with --batch-slots it also bounds the "
                          "decode stall a long admission imposes on "
                          "in-flight rows; 0 = whole-prompt prefill)")
+    ap.add_argument("--kv-cache-blocks", type=int, default=None,
+                    help="block-level KV prefix cache (runtime/kvcache): "
+                         "host block-pool size in blocks; prompts sharing "
+                         "whole leading blocks with earlier prefills skip "
+                         "that prefill (radix-tree partial matches, exact "
+                         "reuse).  Default: DWT_KVCACHE_BLOCKS, else on "
+                         "(64) for --batch-slots and off (0) for the "
+                         "single-request engines; 0 disables")
+    ap.add_argument("--kv-block-tokens", type=int, default=None,
+                    help="tokens per KV cache block (match granularity "
+                         "AND minimum reusable prefix; default "
+                         "DWT_KVCACHE_BLOCK_TOKENS, else 16)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over the first N local "
                          "devices (Megatron-sliced weights, kv-head-"
@@ -1010,6 +1069,7 @@ def _sp_unsupported_flags(args, allow_eos: bool = False) -> list:
         ("--eos-id", not allow_eos
          and getattr(args, "eos_id", None) is not None),
         ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
+        ("--kv-cache-blocks", _kvcache_flags_set(args)),
         ("--attn-backend", args.attn_backend != "auto")] if on]
 
 
@@ -1066,11 +1126,6 @@ def main(argv=None) -> int:
                         "--prompt-lookup) per dispatch when no admission "
                         "could land anyway (one host sync per block; "
                         "admission latency <= N steps)")
-    s.add_argument("--prefix-cache-size", type=int, default=8,
-                   help="with --batch-slots: LRU entries of full-prompt "
-                        "KV kept on device for automatic prefix reuse "
-                        "(0 disables; each entry costs up to a "
-                        "prompt-bucket of KV in HBM)")
     s.add_argument("--vision", action="store_true",
                    help="LLaVA-style multimodal serving: /generate takes "
                         "an optional 'image' field ([H][W][C] floats); "

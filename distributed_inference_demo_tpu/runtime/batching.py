@@ -127,7 +127,8 @@ class ContinuousBatchingEngine:
                  sampling: SamplingParams = SamplingParams(),
                  eos_id: Optional[int] = None, seed: int = 0,
                  prompt_buckets: tuple = (32, 128, 512, 2048),
-                 prefix_cache_size: int = 8, min_prefix_len: int = 16,
+                 kv_cache_blocks: Optional[int] = None,
+                 kv_block_tokens: Optional[int] = None,
                  mesh=None, kv_cache_dtype=None,
                  draft_cfg: Optional[ModelConfig] = None,
                  draft_params: Optional[StageParams] = None,
@@ -135,12 +136,17 @@ class ContinuousBatchingEngine:
                  prompt_lookup: bool = False,
                  decode_block: int = 1,
                  prefill_chunk: Optional[int] = None):
-        """``prefix_cache_size``: LRU entries of full-prompt KV kept on
-        device for automatic prefix reuse (0 disables).  A new prompt
-        sharing >= ``min_prefix_len`` leading tokens with a cached one
-        skips prefill for the shared part: the cached K/V block is copied
-        into the slot row and only the suffix runs (causality makes a
-        prefix's KV independent of what follows, so the reuse is exact).
+        """``kv_cache_blocks`` / ``kv_block_tokens``: the block-level KV
+        cache (``runtime/kvcache``, docs/DESIGN.md §10) — automatic
+        prefix reuse at ``kv_block_tokens`` granularity.  A new prompt
+        sharing at least one whole block of leading tokens with ANY
+        previously prefilled prompt (hits land mid-prompt, not just on
+        full-prompt repeats) skips prefill for the shared run: the
+        cached blocks load into the slot row and only the suffix runs
+        (causality makes a prefix's KV independent of what follows, so
+        the reuse is exact).  ``None`` defers to the ``DWT_KVCACHE_*``
+        env knobs, then to the default (64 blocks x 16 tokens);
+        ``kv_cache_blocks=0`` disables reuse entirely.
 
         ``mesh``: tp mesh — slot forwards run sharded (Megatron weights,
         kv-head-sharded cache); the per-slot scatter attn impl runs
@@ -161,7 +167,7 @@ class ContinuousBatchingEngine:
         unlike SpeculativeEngine's single-offset cache).  Greedy output
         stays bit-identical to the non-draft engine (pinned by tests);
         admission additionally prefills the prompt into a draft-side slot
-        row (full prompt — the prefix cache accelerates only the target
+        row (full prompt — the KV cache accelerates only the target
         side).
 
         ``prompt_lookup``: draft-FREE speculation in the slot loop — the
@@ -574,15 +580,17 @@ class ContinuousBatchingEngine:
         self._rng = jax.random.PRNGKey(seed)
         self._step_count = 0
 
-        # automatic prefix cache: full-prompt tuple -> (k, v, plen); the
-        # K/V blocks are bucket-width device arrays.  Touched only by the
-        # scheduler thread.
-        from collections import OrderedDict
-        self._prefix_cache_size = max(0, prefix_cache_size)
-        self._min_prefix_len = max(1, min_prefix_len)
-        self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
-        self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
-        self._prefix_epoch = 0      # bumped on prefix-cache content change
+        # block-level KV cache (runtime/kvcache): the ONE prefix-reuse
+        # path — radix-tree partial-prefix matches, host block pool,
+        # stores at prefill time.  Matched/stored only on the scheduler
+        # thread; /metrics scrapes read snapshots under the manager lock.
+        from .kvcache import KVCacheManager, resolve_kvcache_config
+        n_blocks, block_tokens = resolve_kvcache_config(
+            kv_cache_blocks, kv_block_tokens, default_blocks=64)
+        self.kv_cache: Optional[KVCacheManager] = (
+            KVCacheManager.for_model(cfg, n_blocks, block_tokens,
+                                     dtype=self.kv_cache_dtype)
+            if n_blocks > 0 else None)
         self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
         # resumable chunked admission: at most ONE prompt streams its
         # chunks at a time (scheduler state, advanced one dispatch per
@@ -771,8 +779,9 @@ class ContinuousBatchingEngine:
                # scheduler-owned state — gauges, not invariants)
                "queue_depth": self._queue.qsize() + len(self._pending),
                "active_slots": sum(1 for s in self._slots
-                                   if s is not None),
-               "prefix_cache": dict(self.prefix_stats)}
+                                   if s is not None)}
+        if self.kv_cache is not None:
+            out["kvcache"] = self.kv_cache.snapshot()
         # completed is the MONOTONIC count; the reservoirs are bounded
         # (the last 512 samples feed the percentiles).  deque.__copy__ is
         # atomic under the GIL — plain iteration would race the
@@ -806,12 +815,17 @@ class ContinuousBatchingEngine:
 
     def debug_state(self) -> dict:
         """Backend fragment of ``GET /debugz``: anomaly-detector state
-        (thresholds, streaks, recent firings, bundles written)."""
-        return {"anomaly": self.anomaly.state()}
+        (thresholds, streaks, recent firings, bundles written) + the KV
+        cache picture (occupancy, LRU leaves, leased nodes)."""
+        out = {"anomaly": self.anomaly.state()}
+        if self.kv_cache is not None:
+            out["kvcache"] = self.kv_cache.debug_state()
+        return out
 
     def reset_stats(self) -> None:
         self._step_count = 0
-        self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
+        if self.kv_cache is not None:
+            self.kv_cache.reset_stats()
         self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
         self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
         self._completed = 0
@@ -838,85 +852,61 @@ class ContinuousBatchingEngine:
                 return b
         return self.max_seq
 
-    def _longest_cached_prefix(self, prompt: np.ndarray):
-        """Best (lcp_len, key) over the prefix cache, or (0, None).
-        The reusable length is capped at plen-1 so the suffix forward is
-        never empty (its last position produces the first sampled token)."""
-        best_m, best_key = 0, None
-        cap = len(prompt) - 1
-        for key in self._prefix_cache:
-            n = min(len(key), cap)
-            if n <= best_m:
-                continue
-            eq = np.asarray(key[:n], np.int32) == prompt[:n]
-            m = int(np.cumprod(eq).sum())
-            if m > best_m:
-                best_m, best_key = m, key
-        return best_m, best_key
-
-    def _prefix_store(self, prompt: np.ndarray, row_k, row_v):
-        # don't thrash the LRU with entries that can never produce a hit
-        # (a match is capped at len(key), which would stay below the
-        # threshold), and don't re-copy on an exact-repeat hit
-        if (not self._prefix_cache_size
-                or len(prompt) < self._min_prefix_len):
-            return
-        key = tuple(int(t) for t in prompt)
-        if key in self._prefix_cache:
-            self._prefix_cache.move_to_end(key)
-            return
-        cols = self._bucket(len(prompt))
-        # slices copy in jax: the stored block does not pin the whole row
-        self._prefix_cache[key] = (row_k[:, :, :, :cols, :],
-                                   row_v[:, :, :, :cols, :])
-        while len(self._prefix_cache) > self._prefix_cache_size:
-            self._prefix_cache.popitem(last=False)
-        # content changed (store and/or eviction): stale _needs_stream
-        # memos must re-classify
-        self._prefix_epoch += 1
-
     def _row_for(self, req: Request):
         """(start, row_k, row_v) for a fresh admission: a zero row, or a
-        prefix-cache hit preloaded with the shared prefix's K/V."""
-        if self._prefix_cache_size:
-            m, key = self._longest_cached_prefix(req.prompt)
-            if m >= self._min_prefix_len:
-                pk, pv = self._prefix_cache[key]
-                self._prefix_cache.move_to_end(key)   # LRU touch
-                row_k, row_v = self._load_prefix(pk, pv)
-                self.prefix_stats["hits"] += 1
-                self.prefix_stats["tokens_reused"] += m
+        KV-cache hit preloaded with the matched block run's K/V.
+
+        The lease pins the matched blocks only for the host gather (the
+        copy-out IS the copy-on-write); the H2D load pads the run out to
+        the prompt bucket so ``_load_prefix`` keeps one compiled shape
+        per bucket — the pad columns sit at positions >= start and are
+        rewritten by the suffix prefill / decode before any query can
+        attend them (stale-slot invariant)."""
+        if self.kv_cache is not None:
+            lease = self.kv_cache.match(req.prompt)
+            if lease is not None:
+                with lease:
+                    m = lease.tokens
+                    pk, pv = lease.gather()       # host [L, H, m, D]
+                cols = self._bucket(m)
+                if cols > m:
+                    pad = ((0, 0), (0, 0), (0, cols - m), (0, 0))
+                    pk = np.pad(pk, pad)
+                    pv = np.pad(pv, pad)
+                row_k, row_v = self._load_prefix(
+                    jnp.asarray(pk[:, None]), jnp.asarray(pv[:, None]))
                 return m, row_k, row_v
         row_k, row_v = self._zero_row()
-        self.prefix_stats["misses"] += 1
         return 0, row_k, row_v
 
     def _needs_stream(self, req: Request) -> bool:
         """Does this prompt need the one-at-a-time chunk stream, or can
         it admit in a single dispatch?  Classified by the EFFECTIVE
-        suffix (a prefix-cache hit may shrink a long prompt to one
+        suffix (a KV-cache hit may shrink a long prompt to one
         dispatch — it must not wait behind an unrelated stream).  Pure
         peek: hit/miss accounting stays with ``_row_for``.
 
         The decision is memoized on the request (``_stream_cls``),
-        validated against the prefix cache's mutation epoch: a blocked
+        validated against the manager's mutation epoch: a blocked
         request is NOT rescanned every scheduler iteration, but a
         store/eviction invalidates the memo — a classification must
-        never outlive the cache entry it relied on (an evicted prefix
+        never outlive the cache content it relied on (an evicted prefix
         would otherwise send a long prompt down the one-dispatch path,
-        voiding the chunked activation-memory bound)."""
+        voiding the chunked activation-memory bound; evictions only
+        happen inside stores, which bump the epoch)."""
         C = self.prefill_chunk
         if C is None:
             return False
+        epoch = self.kv_cache.epoch if self.kv_cache is not None else 0
         cls = getattr(req, "_stream_cls", None)
-        if cls is not None and cls[0] == self._prefix_epoch:
+        if cls is not None and cls[0] == epoch:
             return cls[1]
         needs = len(req.prompt) > C
-        if needs and self._prefix_cache_size:
-            m, _ = self._longest_cached_prefix(req.prompt)
-            if m >= self._min_prefix_len and len(req.prompt) - m <= C:
+        if needs and self.kv_cache is not None:
+            m = self.kv_cache.peek(req.prompt)
+            if m and len(req.prompt) - m <= C:
                 needs = False
-        req._stream_cls = (self._prefix_epoch, needs)
+        req._stream_cls = (epoch, needs)
         return needs
 
     def _admit_request(self, slot: int, req: Request):
@@ -996,7 +986,12 @@ class ContinuousBatchingEngine:
         row_k, row_v, tok, lp0 = self._prefill(
             self.params, jnp.asarray(padded), jnp.int32(start),
             row_k, row_v, jnp.int32(len(suffix)), sub)
-        self._prefix_store(req.prompt, row_k, row_v)
+        if self.kv_cache is not None:
+            # store at PREFILL time: the next shared-prefix request hits
+            # while this one is still decoding.  Columns [0, plen) are
+            # exact (prefix load + suffix prefill); only full blocks
+            # inside them are cached.
+            self.kv_cache.store(req.prompt, row_k, row_v)
         self._ck, self._cv, self._lengths, self._last_tok = self._admit(
             self._ck, self._cv, row_k, row_v, jnp.int32(slot),
             self._lengths, self._last_tok, jnp.int32(plen),

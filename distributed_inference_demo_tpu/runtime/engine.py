@@ -112,7 +112,7 @@ def make_chunk_programs(fwd):
 
 
 def run_chunked_prefill(params, ids, cache, C: int, max_seq: int,
-                        chunk_mid, chunk_last=None):
+                        chunk_mid, chunk_last=None, start: int = 0):
     """The chunked-prefill driver, shared by InferenceEngine and
     SpeculativeEngine (which runs it once per model).
 
@@ -130,29 +130,54 @@ def run_chunked_prefill(params, ids, cache, C: int, max_seq: int,
 
     ``chunk_last=None`` runs the final chunk through ``chunk_mid`` too
     and returns ``(None, cache)`` — the draft-model case, where only the
-    filled cache matters and no logits are needed."""
+    filled cache matters and no logits are needed.
+
+    ``start``: prefill SUFFIX mode — ``ids`` are the tokens from
+    position ``start`` on, and the cache already holds exact K/V for
+    columns ``[0, start)`` (a KV-cache block run, runtime/kvcache).
+    Chunks run at global offsets and the aligned last window never
+    left-shifts below ``start`` (the overlapped-recompute trick needs
+    the overlapped ids, and the caller only has the suffix); when the
+    room past ``start`` is smaller than one chunk, the suffix runs as a
+    single unpadded dispatch instead."""
     b, plen = ids.shape
+    cap = max_seq - start          # columns available at/after start
+    cache = KVCache(cache.keys, cache.values, jnp.int32(start))
+    if cap < C:
+        # near-capacity seeded suffix: no room to pad to a whole chunk
+        # without spilling past max_seq, and no room to left-shift
+        # without the prefix ids — one unpadded dispatch (a per-length
+        # compile, only reachable on prefix hits within C of max_seq)
+        if chunk_last is None:
+            cache = chunk_mid(params, ids, cache, jnp.int32(start))
+            last = None
+        else:
+            last, cache = chunk_last(params, ids, cache, jnp.int32(start),
+                                     jnp.int32(plen - 1))
+        return last, KVCache(cache.keys, cache.values,
+                             jnp.int32(start + plen))
     n_chunks = -(-plen // C)
     padded = jnp.zeros((b, n_chunks * C), jnp.int32)
     padded = jax.lax.dynamic_update_slice(padded, ids, (0, 0))
     for i in range(n_chunks - 1):
         cache = chunk_mid(params, jax.lax.dynamic_slice_in_dim(
-            padded, i * C, C, axis=1), cache, jnp.int32(i * C))
-    start = min((n_chunks - 1) * C, max_seq - C)
+            padded, i * C, C, axis=1), cache, jnp.int32(start + i * C))
+    tail_start = min((n_chunks - 1) * C, cap - C)
     # the left shift must apply to the cache WRITE offset too (the
     # insert position is cache.length inside stage_forward), so the
     # column==position invariant holds; with the buffer padded past
     # max_seq (pad_cache_capacity) the old implicit
     # dynamic_update_slice start-clamp no longer realizes it
-    cache = KVCache(cache.keys, cache.values, jnp.int32(start))
-    tail = jax.lax.dynamic_slice_in_dim(padded, start, C, axis=1)
+    cache = KVCache(cache.keys, cache.values, jnp.int32(start + tail_start))
+    tail = jax.lax.dynamic_slice_in_dim(padded, tail_start, C, axis=1)
     if chunk_last is None:
-        cache = chunk_mid(params, tail, cache, jnp.int32(start))
+        cache = chunk_mid(params, tail, cache, jnp.int32(start + tail_start))
         last = None
     else:
-        last, cache = chunk_last(params, tail, cache, jnp.int32(start),
-                                 jnp.int32(plen - 1 - start))
-    cache = KVCache(cache.keys, cache.values, jnp.int32(plen))
+        last, cache = chunk_last(params, tail, cache,
+                                 jnp.int32(start + tail_start),
+                                 jnp.int32(plen - 1 - tail_start))
+    cache = KVCache(cache.keys, cache.values, jnp.int32(start + plen))
     return last, cache
 
 
@@ -186,7 +211,9 @@ class InferenceEngine:
                  attn_backend: str = "auto",
                  kv_cache_dtype: Optional[str] = None,
                  prefill_chunk: Optional[int] = None,
-                 mesh=None):
+                 mesh=None,
+                 kv_cache_blocks: Optional[int] = None,
+                 kv_block_tokens: Optional[int] = None):
         """``attn_backend``: "auto" (Pallas flash kernel on TPU, jnp
         elsewhere), "flash", "flash-interpret" (testing), or "jnp".
 
@@ -215,7 +242,17 @@ class InferenceEngine:
         Attention math stays f32 (``ops.attention`` upcasts whatever the
         cache holds); inserts round via ``update_kv_cache``'s cast.
         Forces the jnp attention path (the Pallas kernel is not exercised
-        on f8 loads)."""
+        on f8 loads).
+
+        ``kv_cache_blocks`` / ``kv_block_tokens``: block-level KV prefix
+        cache (``runtime/kvcache``, docs/DESIGN.md §10) for the
+        single-request ``generate``/``generate_stream`` paths (batch 1):
+        a prompt sharing whole leading blocks with any previously
+        prefilled prompt seeds its cache from the stored blocks and
+        prefills only the suffix; every prefill stores its full blocks
+        back.  ``None`` defers to ``DWT_KVCACHE_*`` env knobs; default
+        off (0) — the continuous-batching engine is the default-on
+        consumer."""
         self.cfg = cfg
         self.params = params
         self.max_seq = max_seq or cfg.max_seq_len
@@ -252,6 +289,14 @@ class InferenceEngine:
                 "'auto', 'flash', 'flash-interpret', or 'jnp'")
 
         self._attn_impl = attn_impl   # shared with MultimodalEngine
+
+        from .kvcache import KVCacheManager, resolve_kvcache_config
+        n_blocks, block_tokens = resolve_kvcache_config(
+            kv_cache_blocks, kv_block_tokens, default_blocks=0)
+        self.kv_cache = (
+            KVCacheManager.for_model(cfg, n_blocks, block_tokens,
+                                     dtype=self.kv_cache_dtype)
+            if n_blocks > 0 else None)
 
         cfg_ = cfg
         spec_ = self.spec
@@ -365,17 +410,79 @@ class InferenceEngine:
             cache = jax.device_put(cache, self._cache_sharding)
         return cache
 
-    def _run_prefill(self, ids: jnp.ndarray, cache: KVCache):
+    def _run_prefill(self, ids: jnp.ndarray, cache: KVCache,
+                     start: int = 0):
         """Whole-prompt or chunked prefill → (last_logits [b, V], cache).
         Chunked semantics (padding, aligned last window, length rewind)
         live in :func:`run_chunked_prefill`, shared with the
-        speculative engine."""
+        speculative engine.  ``start`` > 0 is the KV-cache-seeded SUFFIX
+        path: ``ids`` still carries the whole prompt, columns
+        ``[0, start)`` of the cache already hold its prefix K/V, and
+        only ``ids[:, start:]`` runs."""
         C = self.prefill_chunk
+        if start:
+            suffix = ids[:, start:]
+            if C is not None:
+                return run_chunked_prefill(
+                    self.params, suffix, cache, C, self.max_seq,
+                    self._prefill_chunk_mid, self._prefill_chunk_last,
+                    start=start)
+            # one dispatch via the shared chunk-last program (positions
+            # offset, logits at the true last position); compiled per
+            # suffix length — no worse than the whole-prompt prefill's
+            # per-length compile it replaces
+            cache = KVCache(cache.keys, cache.values, jnp.int32(start))
+            last, cache = self._prefill_chunk_last(
+                self.params, suffix, cache, jnp.int32(start),
+                jnp.int32(suffix.shape[1] - 1))
+            return last, KVCache(cache.keys, cache.values,
+                                 jnp.int32(ids.shape[1]))
         if C is None:
             return self._prefill(self.params, ids, cache)
         return run_chunked_prefill(self.params, ids, cache, C,
                                    self.max_seq, self._prefill_chunk_mid,
                                    self._prefill_chunk_last)
+
+    # -- block KV cache (runtime/kvcache) seams ------------------------
+
+    def _kv_seed(self, ids: jnp.ndarray, cache: KVCache):
+        """(start, cache): seed a fresh batch-1 cache from the longest
+        cached block-prefix of the prompt, or (0, cache) on a miss.
+        The lease is released the moment the host gather completes —
+        the H2D write reads the caller's own copy."""
+        if self.kv_cache is None or ids.shape[0] != 1:
+            return 0, cache
+        lease = self.kv_cache.match(np.asarray(ids[0]))
+        if lease is None:
+            return 0, cache
+        from .kvcache.device import seed_prefix_cache
+        with lease:
+            m = lease.tokens
+            pk, pv = lease.gather()            # host [L, H, m, D]
+        ck, cv = seed_prefix_cache(cache.keys, cache.values,
+                                   jnp.asarray(pk[:, None]),
+                                   jnp.asarray(pv[:, None]))
+        return m, KVCache(ck, cv, jnp.int32(m))
+
+    def _kv_store(self, ids: jnp.ndarray, cache: KVCache) -> None:
+        """Store the prefilled prompt's full blocks (batch 1 only; one
+        D2H slice for the missing tail).  Must run before the decode
+        scan donates the cache buffers."""
+        if self.kv_cache is not None and ids.shape[0] == 1:
+            self.kv_cache.store(np.asarray(ids[0]), cache.keys,
+                                cache.values)
+
+    def scrape_stats(self) -> dict:
+        """Metrics-scrape fragment (telemetry/catalog.scrape): the KV
+        cache counters, when the cache is on.  Deliberately NOT
+        ``stats()`` — the /stats route keeps its engine-less shape."""
+        return ({"kvcache": self.kv_cache.snapshot()}
+                if self.kv_cache is not None else {})
+
+    def debug_state(self) -> dict:
+        """``GET /debugz`` fragment: KV cache occupancy/LRU picture."""
+        return ({"kvcache": self.kv_cache.debug_state()}
+                if self.kv_cache is not None else {})
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0, logprobs: bool = False) -> GenerationResult:
@@ -395,7 +502,9 @@ class InferenceEngine:
 
         t0 = time.perf_counter()
         cache = self.new_cache(b)
-        last_logits, cache = self._run_prefill(ids, cache)
+        start, cache = self._kv_seed(ids, cache)
+        last_logits, cache = self._run_prefill(ids, cache, start=start)
+        self._kv_store(ids, cache)
         toks, lps, _ = self._decode(self.params, last_logits, cache, rng,
                                     self._eos_scalar(), max_new_tokens,
                                     logprobs)
@@ -458,7 +567,9 @@ class InferenceEngine:
         self._check_capacity(plen, max_new_tokens)
         cache = self.new_cache(b)
         rng = jax.random.PRNGKey(seed)
-        logits, cache = self._run_prefill(ids, cache)
+        start, cache = self._kv_seed(ids, cache)
+        logits, cache = self._run_prefill(ids, cache, start=start)
+        self._kv_store(ids, cache)
         done = jnp.zeros((b,), bool)
         for _ in range(max_new_tokens):
             tok, lp, logits, cache, rng, done = self._decode_one(

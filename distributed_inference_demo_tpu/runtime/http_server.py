@@ -55,10 +55,24 @@ class StopMatcher:
     the stop completes a step later).  When a stop completes,
     ``matched`` is True, ``pos`` is the cut position (start of the
     earliest match across all stop strings), and ``emittable`` carries
-    exactly the remaining pre-stop text."""
+    exactly the remaining pre-stop text.
+
+    The cut is CHUNKING-INDEPENDENT: feeding per-token pieces and
+    feeding the whole text yield the same ``pos`` (the position the
+    whole-string ``min(text.find(s))`` reference produces).  The subtle
+    case is a short stop completing while an EARLIER-starting longer
+    stop is still a live prefix of the buffer tail (stop=["abc", "b"],
+    fed "a" then "b": "b" completes at 1, but "ab" may still become
+    "abc" cutting at 0) — the verdict is DEFERRED, bounded by the
+    longest stop length, until the earlier candidate completes (it wins)
+    or dies (the completed match stands).  ``finish()`` resolves a
+    still-pending verdict at stream end: no more text can arrive, so the
+    completed match stands."""
 
     def __init__(self, stop):
         self.stop = list(stop)
+        # empty stop set = valid pass-through matcher (never matches)
+        self._maxlen = max((len(s) for s in self.stop), default=0)
         # only the UNEMITTED tail is buffered: emitted text was released
         # precisely because the holdback proved no future stop can start
         # inside it, so matching stays O(piece + longest_stop) per feed
@@ -71,31 +85,70 @@ class StopMatcher:
         if self.pos is not None:
             return "", True
         self._buf += piece
-        hits = [self._buf.find(s) for s in self.stop if s in self._buf]
+        return self._scan(final=False)
+
+    def _live_start_before(self, comp: int) -> Optional[int]:
+        """Earliest start j < comp of a LONGER stop still a live prefix
+        running through the buffer end — the position a later-completing
+        match could still cut at, making the verdict at ``comp``
+        undecidable this feed.  Only starts within ``maxlen`` of the
+        buffer end can qualify (a live prefix must outrun the buffer)."""
+        buf = self._buf
+        for j in range(max(0, len(buf) - self._maxlen + 1), comp):
+            tail = buf[j:]
+            if any(len(s) > len(tail) and s.startswith(tail)
+                   for s in self.stop):
+                return j
+        return None
+
+    def _scan(self, final: bool):
+        buf = self._buf
+        hits = [buf.find(s) for s in self.stop if s in buf]
         if hits:
-            m = min(hits)
-            self.pos = self._base + m
-            out = self._buf[:m]
-            self._base += m
-            self._buf = ""
-            return out, True
+            comp = min(hits)
+            live = None if final else self._live_start_before(comp)
+            if live is None:
+                self.pos = self._base + comp
+                out = buf[:comp]
+                self._base += comp
+                self._buf = ""
+                return out, True
+            # verdict deferred: emit only up to the live earlier
+            # candidate's start; the pending completed match stays in
+            # the buffer and is re-found (or beaten) next feed
+            out = buf[:live]
+            self._base += live
+            self._buf = buf[live:]
+            return out, False
         hold = max((k for s in self.stop for k in range(1, len(s))
-                    if self._buf.endswith(s[:k])), default=0)
-        safe_end = len(self._buf) - hold
+                    if buf.endswith(s[:k])), default=0)
+        safe_end = len(buf) - hold
         if safe_end > 0:
-            out = self._buf[:safe_end]
+            out = buf[:safe_end]
             self._base += safe_end
-            self._buf = self._buf[safe_end:]
+            self._buf = buf[safe_end:]
             return out, False
         return "", False
 
-    def flush(self) -> str:
-        """Release any held-back text once the stream ends unmatched."""
+    def finish(self):
+        """End of stream: resolve any deferred verdict (a pending
+        completed match now stands — no more text can complete the
+        earlier candidate) and release held-back text otherwise.
+        Returns ``(emittable, matched)`` like ``feed``."""
         if self.pos is not None:
-            return ""
-        out, self._buf = self._buf, ""
-        self._base += len(out)
-        return out
+            return "", True
+        out, matched = self._scan(final=True)
+        if not matched:
+            out += self._buf
+            self._base += len(self._buf)
+            self._buf = ""
+        return out, matched
+
+    def flush(self) -> str:
+        """Back-compat wrapper: ``finish()``'s text alone.  Callers that
+        can still act on a late match should use ``finish`` and check
+        ``matched`` (a deferred verdict may resolve to a cut here)."""
+        return self.finish()[0]
 
 
 class _StopSession:
@@ -157,10 +210,15 @@ class _StopSession:
                 self._push(r, tail)
                 extra, matched = self.matchers[r].feed(tail)
                 pieces[r] += extra
+                if not matched:
+                    # the row is over: resolve any deferred verdict (a
+                    # pending completed stop now stands) before calling
+                    # it an eos finish
+                    extra2, matched = self.matchers[r].finish()
+                    pieces[r] += extra2
                 if matched:
                     self._cut(r)
                 else:
-                    pieces[r] += self.matchers[r].flush()
                     self.done[r], self.reason[r] = True, "eos"
         return pieces
 
@@ -174,11 +232,12 @@ class _StopSession:
             tail = self.detoks[r].flush()
             self._push(r, tail)
             piece, matched = self.matchers[r].feed(tail)
+            if not matched:
+                extra, matched = self.matchers[r].finish()
+                piece += extra
+            pieces[r] = piece
             if matched:
                 self._cut(r)
-                pieces[r] = piece
-            else:
-                pieces[r] = piece + self.matchers[r].flush()
         return pieces
 
 

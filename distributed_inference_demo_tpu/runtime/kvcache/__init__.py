@@ -1,0 +1,15 @@
+"""Block-level KV cache with radix-tree prefix sharing (docs/DESIGN.md §10).
+
+The single prefix-reuse path for the serving stack: the continuous-
+batching scheduler, the plain ``InferenceEngine`` generate paths, and
+the speculative target engine all match and store through one
+:class:`KVCacheManager`.  See ``manager.py`` for the contract.
+"""
+
+from .manager import (DEFAULT_BLOCK_TOKENS, KVCacheManager, KVLease,
+                      resolve_kvcache_config)
+from .pool import KVBlockPool
+from .radix import RadixTree
+
+__all__ = ["KVBlockPool", "KVCacheManager", "KVLease", "RadixTree",
+           "resolve_kvcache_config", "DEFAULT_BLOCK_TOKENS"]

@@ -1,0 +1,35 @@
+"""Device-side copy programs for the block KV cache.
+
+The manager (``manager.py``) is pure host bookkeeping; these are the two
+ends of the device seam the engines share:
+
+- loads ride :func:`seed_prefix_cache` — one fused dynamic_update_slice
+  pair writing a gathered block run into a fresh cache's columns
+  ``[0, m)`` (the engine-cache twin of batching's ``load_prefix`` row
+  program);
+- stores are plain ``np.asarray`` D2H slices taken by
+  ``KVCacheManager.store`` (no program needed — the copy is the fence).
+
+Kept separate from ``manager.py`` so the manager (and its tests) never
+import jax.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def seed_prefix_cache(ck, cv, pk, pv):
+    """Write a ``[L, b, H, m, D]`` block run into a (fresh, donatable)
+    cache's columns ``[0, m)``.  The caller sets the cache's valid
+    length to ``m`` afterwards; columns past m stay zero and are
+    overwritten by the suffix prefill before any query attends them
+    (stale-slot invariant)."""
+    zero = jnp.zeros((), jnp.int32)
+    idx = (zero, zero, zero, zero, zero)
+    return (jax.lax.dynamic_update_slice(ck, pk.astype(ck.dtype), idx),
+            jax.lax.dynamic_update_slice(cv, pv.astype(cv.dtype), idx))

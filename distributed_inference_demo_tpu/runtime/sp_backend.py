@@ -216,6 +216,10 @@ class SequenceParallelBackend:
                 with self.mesh:
                     out = pf(self.params, ids, jax.random.PRNGKey(seed))
                 device_s += time.perf_counter() - t0
+                # flush prefill time immediately: a generation that ends
+                # at (or right after) prefill — num_new=1, instant eos —
+                # must not report seconds=0 / tokens_per_second NaN
+                device_s_box[0] = device_s
             state, rng = list(out[:-1]), out[-1]
             tok, stop = mask_row_eos(np.asarray(state[-1]))
             yield tok                               # token #1
@@ -243,7 +247,10 @@ class SequenceParallelBackend:
             # an abandoned stream (client disconnect, gen.close()) still
             # spent device time and emitted tokens: count what happened.
             # A stream that failed before its first token counts nothing,
-            # matching generate()'s success-only accounting.
+            # matching generate()'s success-only accounting.  The box is
+            # flushed here too so the caller's timing is complete however
+            # the generator exits (eos mid-block, close, failure).
+            device_s_box[0] = device_s
             if emitted:
                 with self._stats_lock:
                     self._served += 1

@@ -266,7 +266,9 @@ class SpeculativeEngine:
                  mesh=None,
                  eos_id: Optional[int] = None,
                  kv_cache_dtype=None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 kv_cache_blocks: Optional[int] = None,
+                 kv_block_tokens: Optional[int] = None):
         """``kv_cache_dtype``: reduced-precision storage for BOTH the
         target and draft caches (same contract as InferenceEngine /
         ContinuousBatchingEngine: insert rounds via update_kv_cache's
@@ -277,7 +279,15 @@ class SpeculativeEngine:
         ``prefill_chunk``: bound prefill activation memory on long
         prompts by running BOTH models' prefill in fixed C-token chunks
         (engine.run_chunked_prefill, once per model; the draft's final
-        chunk needs no logits).  Same semantics as InferenceEngine's."""
+        chunk needs no logits).  Same semantics as InferenceEngine's.
+
+        ``kv_cache_blocks`` / ``kv_block_tokens``: block-level KV prefix
+        cache (``runtime/kvcache``) on the TARGET side, batch 1: a hit
+        seeds the target cache from stored blocks and prefills only the
+        suffix; the draft always prefills its full prompt (it is cheap
+        by construction, and only the target's logits gate emission, so
+        reuse exactness is a target-side property).  Default off; env
+        ``DWT_KVCACHE_*`` knobs apply as in InferenceEngine."""
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
                 f"draft vocab ({draft_cfg.vocab_size}) != target vocab "
@@ -338,6 +348,14 @@ class SpeculativeEngine:
         from .engine import make_chunk_programs
         self._t_chunk_mid, self._t_chunk_last = make_chunk_programs(fwd_t)
         self._d_chunk_mid, _ = make_chunk_programs(fwd_d)
+
+        from .kvcache import KVCacheManager, resolve_kvcache_config
+        n_blocks, block_tokens = resolve_kvcache_config(
+            kv_cache_blocks, kv_block_tokens, default_blocks=0)
+        self.kv_cache = (
+            KVCacheManager.for_model(cfg, n_blocks, block_tokens,
+                                     dtype=self.kv_cache_dtype)
+            if n_blocks > 0 else None)
 
         def one_round(tparams, dparams, last_tok, tcache, dcache, rng):
             """Draft K, verify K+1 in one target forward, accept/resample.
@@ -443,6 +461,52 @@ class SpeculativeEngine:
             self._d_chunk_mid)
         return last, tcache, dcache
 
+    def _run_prefills(self, ids, tcache, dcache):
+        """The KV-cache-aware prefill front end: on a target-side block
+        hit (batch 1), seed the target cache and prefill only its
+        suffix while the draft prefills the full prompt; otherwise the
+        fused/chunked both-model path.  Stores the target's full blocks
+        afterwards — before the rounds program donates the cache."""
+        from .engine import run_chunked_prefill
+        b, plen = ids.shape
+        start = 0
+        if self.kv_cache is not None and b == 1:
+            lease = self.kv_cache.match(np.asarray(ids[0]))
+            if lease is not None:
+                from .kvcache.device import seed_prefix_cache
+                with lease:
+                    start = lease.tokens
+                    pk, pv = lease.gather()
+                tck, tcv = seed_prefix_cache(tcache.keys, tcache.values,
+                                             jnp.asarray(pk[:, None]),
+                                             jnp.asarray(pv[:, None]))
+                tcache = KVCache(tck, tcv, jnp.int32(start))
+        if start:
+            C = self.prefill_chunk
+            suffix = ids[:, start:]
+            if C is not None:
+                last, tcache = run_chunked_prefill(
+                    self.params, suffix, tcache, C, self.max_seq,
+                    self._t_chunk_mid, self._t_chunk_last, start=start)
+            else:
+                last, tcache = self._t_chunk_last(
+                    self.params, suffix, tcache, jnp.int32(start),
+                    jnp.int32(suffix.shape[1] - 1))
+                tcache = KVCache(tcache.keys, tcache.values,
+                                 jnp.int32(plen))
+            # draft side: always the full prompt (one logits-free
+            # dispatch, or its own chunked drive)
+            _, dcache = run_chunked_prefill(
+                self.draft_params, ids, dcache, C if C else plen,
+                self.max_seq, self._d_chunk_mid)
+        else:
+            last, tcache, dcache = self._run_prefill_both(ids, tcache,
+                                                          dcache)
+        if self.kv_cache is not None and b == 1:
+            self.kv_cache.store(np.asarray(ids[0]), tcache.keys,
+                                tcache.values)
+        return last, tcache, dcache
+
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0,
                  rounds_per_dispatch: Optional[int] = None
@@ -463,7 +527,7 @@ class SpeculativeEngine:
 
         t0 = time.perf_counter()
         tcache, dcache = self.new_caches(b)
-        last_logits, tcache, dcache = self._run_prefill_both(
+        last_logits, tcache, dcache = self._run_prefills(
             ids, tcache, dcache)
         # first token comes from the target's prefill logits (the draft
         # never gets to choose a token unchecked)
@@ -512,7 +576,7 @@ class SpeculativeEngine:
         stats = stats_out if stats_out is not None else SpecStats()
 
         tcache, dcache = self.new_caches(b)
-        last_logits, tcache, dcache = self._run_prefill_both(
+        last_logits, tcache, dcache = self._run_prefills(
             ids, tcache, dcache)
         rng, sub = jax.random.split(rng)
         last_tok = sample_logits(last_logits, sub, self.sampling)

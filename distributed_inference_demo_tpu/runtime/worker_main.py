@@ -94,7 +94,24 @@ def main(argv=None) -> int:
                     help="serve Prometheus GET /metrics on this port "
                          "(0 = ephemeral, -1 = disabled); the header's "
                          "main HTTP server has its own /metrics")
+    ap.add_argument("--kv-cache-blocks", type=int, default=None,
+                    help="block-level KV prefix cache (runtime/kvcache): "
+                         "REJECTED on pipeline stage workers — a stage "
+                         "sees upstream activations, not token ids, so "
+                         "there is no key to match cached blocks by; "
+                         "the flag exists for CLI parity with serve and "
+                         "errors loudly instead of silently ignoring")
+    ap.add_argument("--kv-block-tokens", type=int, default=None,
+                    help="tokens per KV cache block (see "
+                         "--kv-cache-blocks; rejected on stage workers)")
     args = ap.parse_args(argv)
+    if args.kv_cache_blocks or args.kv_block_tokens:
+        print("--kv-cache-blocks/--kv-block-tokens are not supported on "
+              "pipeline stage workers (stages see activations, not "
+              "tokens; block KV reuse lives in the engine-backed serve "
+              "modes — serve --batch-slots or the plain engine)",
+              file=sys.stderr)
+        return 1
 
     # black-box capture: the flight ring is labeled with this stage's
     # identity, and an unhandled crash dumps a postmortem bundle (when

@@ -108,15 +108,19 @@ BATCH_STEPS = counter(
 BATCH_COMPLETED = counter(
     "dwt_batching_completed_requests_total",
     "Requests fully served by the slot scheduler")
+# deprecated aliases for the pre-kvcache prefix series: same values as
+# their dwt_kvcache_* successors for one release, then delete (dashboards
+# migrate by recording rule, not by flag day)
 PREFIX_HITS = counter(
     "dwt_batching_prefix_cache_hits_total",
-    "Prefix-cache lookups that reused a stored KV block")
+    "DEPRECATED alias of dwt_kvcache_hits_total (removal next release)")
 PREFIX_MISSES = counter(
     "dwt_batching_prefix_cache_misses_total",
-    "Prefix-cache lookups that found no usable KV block")
+    "DEPRECATED alias of dwt_kvcache_misses_total (removal next release)")
 PREFIX_REUSED = counter(
     "dwt_batching_prefix_reused_tokens_total",
-    "Prompt tokens whose prefill was skipped via the prefix cache")
+    "DEPRECATED alias of dwt_kvcache_partial_hit_tokens_total "
+    "(removal next release)")
 _BATCH_PCT = {
     (name, q): gauge(
         f"dwt_batching_{name}_p{q}_seconds",
@@ -125,6 +129,59 @@ _BATCH_PCT = {
                        ("e2e", "request end-to-end latency"),
                        ("per_token", "per-output-token latency"))
     for q in (50, 95)}
+
+# -- block KV cache (runtime/kvcache), bridged from manager snapshots ------
+
+KVCACHE_HITS = counter(
+    "dwt_kvcache_hits_total",
+    "Prompt lookups that matched at least one whole cached KV block")
+KVCACHE_MISSES = counter(
+    "dwt_kvcache_misses_total",
+    "Prompt lookups (>= one block long) that matched nothing")
+KVCACHE_PARTIAL_HIT_TOKENS = counter(
+    "dwt_kvcache_partial_hit_tokens_total",
+    "Prompt tokens whose prefill was skipped via matched KV blocks "
+    "(every hit is a partial-prefix hit: reuse is capped below the "
+    "prompt length so the suffix forward is never empty)")
+KVCACHE_STORED_BLOCKS = counter(
+    "dwt_kvcache_stored_blocks_total",
+    "KV blocks admitted into the block pool at prefill time")
+KVCACHE_EVICTED_BLOCKS = counter(
+    "dwt_kvcache_evicted_blocks_total",
+    "KV blocks reclaimed by LRU leaf eviction under pool pressure")
+KVCACHE_RESIDENT_BYTES = gauge(
+    "dwt_kvcache_resident_bytes",
+    "Host bytes held by in-use KV blocks (K + V)")
+KVCACHE_CAPACITY_BYTES = gauge(
+    "dwt_kvcache_capacity_bytes",
+    "Preallocated byte budget of the KV block pool")
+KVCACHE_USED_BLOCKS = gauge(
+    "dwt_kvcache_used_blocks",
+    "KV blocks currently referenced by the radix tree")
+KVCACHE_NODES = gauge(
+    "dwt_kvcache_tree_nodes",
+    "Radix-tree nodes (excluding the root): distinct shared-prefix "
+    "branch points plus leaves")
+
+
+def update_kvcache_series(kv: dict) -> None:
+    """Bridge a ``KVCacheManager.snapshot()`` dict onto the
+    ``dwt_kvcache_*`` series (+ the deprecated ``dwt_batching_prefix_*``
+    aliases, kept one release for dashboard migration)."""
+    KVCACHE_HITS.set_cumulative(kv.get("hits", 0))
+    KVCACHE_MISSES.set_cumulative(kv.get("misses", 0))
+    KVCACHE_PARTIAL_HIT_TOKENS.set_cumulative(
+        kv.get("partial_hit_tokens", 0))
+    KVCACHE_STORED_BLOCKS.set_cumulative(kv.get("stored_blocks", 0))
+    KVCACHE_EVICTED_BLOCKS.set_cumulative(kv.get("evicted_blocks", 0))
+    KVCACHE_RESIDENT_BYTES.set(kv.get("resident_bytes", 0))
+    KVCACHE_CAPACITY_BYTES.set(kv.get("capacity_bytes", 0))
+    KVCACHE_USED_BLOCKS.set(kv.get("blocks_used", 0))
+    KVCACHE_NODES.set(kv.get("nodes", 0))
+    PREFIX_HITS.set_cumulative(kv.get("hits", 0))
+    PREFIX_MISSES.set_cumulative(kv.get("misses", 0))
+    PREFIX_REUSED.set_cumulative(kv.get("partial_hit_tokens", 0))
+
 
 SPEC_ROUNDS = counter(
     "dwt_speculative_rounds_total",
@@ -143,8 +200,10 @@ SPEC_ACCEPT_RATIO = gauge(
 
 def update_batching_series(stats: dict) -> None:
     """Bridge ``ContinuousBatchingEngine.stats()`` (or any dict with the
-    same keys) onto the ``dwt_batching_*`` / ``dwt_speculative_*``
-    series."""
+    same keys) onto the ``dwt_batching_*`` / ``dwt_speculative_*`` /
+    ``dwt_kvcache_*`` series (a bare ``{"kvcache": ...}`` fragment — the
+    plain engines' ``scrape_stats`` — bridges the kvcache section
+    alone)."""
     if "slots" in stats:
         BATCH_CAPACITY.set(stats["slots"])
     if "queue_depth" in stats:
@@ -160,11 +219,9 @@ def update_batching_series(stats: dict) -> None:
         v = lat.get(f"{name}_p{q}_ms")
         # NaN on empty/reset reservoirs, as in update_stage_series
         g.set(v / 1e3 if v is not None else float("nan"))
-    pc = stats.get("prefix_cache") or {}
-    if pc:
-        PREFIX_HITS.set_cumulative(pc.get("hits", 0))
-        PREFIX_MISSES.set_cumulative(pc.get("misses", 0))
-        PREFIX_REUSED.set_cumulative(pc.get("tokens_reused", 0))
+    kv = stats.get("kvcache") or {}
+    if kv:
+        update_kvcache_series(kv)
     sp = stats.get("speculative") or {}
     if sp:
         SPEC_ROUNDS.set_cumulative(sp.get("rounds", 0))

@@ -165,65 +165,134 @@ def test_cancel_frees_slot(params, oracle):
         long.cancel()
 
 
-def test_prefix_cache_exact_repeat(params, oracle):
-    """A repeated prompt reuses all but the last prefix token and still
-    decodes greedy-exact."""
+def test_kvcache_exact_repeat(params, oracle):
+    """A repeated prompt reuses every whole block below plen-1 and still
+    decodes greedy-exact (the old full-prompt-LRU exact-repeat case,
+    ported to the block cache)."""
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                   sampling=GREEDY, prompt_buckets=(16,),
-                                  min_prefix_len=1) as eng:
+                                  kv_cache_blocks=16,
+                                  kv_block_tokens=2) as eng:
         prompt = [3, 14, 15, 92, 65, 35, 89]
         want = expected(oracle, prompt, 10)
         first = eng.submit(prompt, 10).wait(timeout=300)
         second = eng.submit(prompt, 10).wait(timeout=300)
         np.testing.assert_array_equal(first, want)
         np.testing.assert_array_equal(second, want)
-        assert eng.prefix_stats["hits"] == 1
-        assert eng.prefix_stats["tokens_reused"] == len(prompt) - 1
+        st = eng.kv_cache.stats
+        assert st["hits"] == 1
+        # 7 tokens, 2-token blocks, reuse capped below plen: 3 blocks
+        assert st["partial_hit_tokens"] == 6
 
 
-def test_prefix_cache_shared_prefix_divergent_tail(params, oracle):
+def test_kvcache_shared_prefix_divergent_tail(params, oracle):
     """Two prompts sharing a long prefix: the second reuses the shared
-    part only and its full output stays greedy-exact."""
+    whole blocks only and its full output stays greedy-exact."""
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                   sampling=GREEDY, prompt_buckets=(16,),
-                                  min_prefix_len=4) as eng:
+                                  kv_cache_blocks=16,
+                                  kv_block_tokens=2) as eng:
         shared = [7, 3, 9, 1, 4, 6]
         a, b = shared + [11, 12], shared + [20, 21, 22]
         got_a = eng.submit(a, 8).wait(timeout=300)
         got_b = eng.submit(b, 8).wait(timeout=300)
         np.testing.assert_array_equal(got_a, expected(oracle, a, 8))
         np.testing.assert_array_equal(got_b, expected(oracle, b, 8))
-        assert eng.prefix_stats["hits"] == 1
-        assert eng.prefix_stats["tokens_reused"] == len(shared)
+        st = eng.kv_cache.stats
+        assert st["hits"] == 1
+        assert st["partial_hit_tokens"] == len(shared)
 
 
-def test_prefix_cache_below_threshold_and_lru(params, oracle):
-    """Short overlaps don't trigger reuse; the LRU stays bounded."""
+def test_kvcache_mid_prompt_partial_hit_observable(params, oracle):
+    """ISSUE 3 generality: a MID-prompt partial hit — shared prefix
+    strictly shorter than the cached prompt AND the new prompt — reuses
+    >= block_tokens tokens, lands on dwt_kvcache_partial_hit_tokens_total,
+    and records a flight-recorder kvcache_hit event."""
+    from distributed_inference_demo_tpu.telemetry import catalog
+    from distributed_inference_demo_tpu.telemetry.flightrecorder import (
+        get_flight_recorder)
+
+    block_tokens = 4
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                   sampling=GREEDY, prompt_buckets=(16,),
-                                  min_prefix_len=5,
-                                  prefix_cache_size=2) as eng:
+                                  kv_cache_blocks=16,
+                                  kv_block_tokens=block_tokens) as eng:
+        cached = list(range(2, 14))            # 12 tokens -> 3 blocks
+        new = cached[:9] + [51, 52]            # diverges inside block 3
+        np.testing.assert_array_equal(
+            eng.submit(cached, 6).wait(timeout=300),
+            expected(oracle, cached, 6))
+        np.testing.assert_array_equal(
+            eng.submit(new, 6).wait(timeout=300),
+            expected(oracle, new, 6))
+        st = eng.kv_cache.stats
+        assert st["hits"] == 1
+        reused = st["partial_hit_tokens"]
+        assert reused >= block_tokens
+        assert reused == 8                     # 2 whole blocks of the 9
+        assert reused < len(cached) and reused < len(new)  # mid-prompt
+        # the catalog bridge exposes the counter on /metrics
+        text = catalog.scrape(eng)
+        assert f"dwt_kvcache_partial_hit_tokens_total {reused}" in text
+        # and the flight ring holds the hit event
+        hits = [e for e in get_flight_recorder().snapshot()
+                if e.get("kind") == "kvcache_hit"]
+        assert hits and hits[-1]["tokens"] == reused
+
+
+def test_kvcache_primed_vs_cold_scheduler_exactness(params, oracle):
+    """ISSUE 3 exactness (scheduler path): the same suffix-after-shared-
+    prefix prompt decodes token-identically on a COLD engine and on an
+    engine PRIMED with the shared prefix."""
+    shared = list(range(3, 19))                  # 16 tokens = 2 blocks
+    prompt = shared + [42, 43, 44]
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(32,),
+                                  kv_cache_blocks=16,
+                                  kv_block_tokens=8) as cold_eng:
+        cold = cold_eng.submit(prompt, 10).wait(timeout=300)
+        assert cold_eng.kv_cache.stats["hits"] == 0
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(32,),
+                                  kv_cache_blocks=16,
+                                  kv_block_tokens=8) as primed_eng:
+        primed_eng.submit(shared + [99], 4).wait(timeout=300)  # prime
+        primed = primed_eng.submit(prompt, 10).wait(timeout=300)
+        assert primed_eng.kv_cache.stats["hits"] == 1
+    np.testing.assert_array_equal(cold, primed)
+    np.testing.assert_array_equal(cold, expected(oracle, prompt, 10))
+
+
+def test_kvcache_below_block_and_pool_bound(params, oracle):
+    """Sub-block overlaps don't trigger reuse; the pool bound holds
+    under pressure (LRU leaf eviction, never over capacity)."""
+    with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
+                                  sampling=GREEDY, prompt_buckets=(16,),
+                                  kv_cache_blocks=3,
+                                  kv_block_tokens=4) as eng:
         p1 = [1, 2, 3, 4, 9, 9]
-        p2 = [1, 2, 3, 8, 8, 8]     # lcp=3 < threshold 5
+        p2 = [1, 2, 3, 8, 8, 8]     # lcp=3 < block_tokens=4
         eng.submit(p1, 6).wait(timeout=300)
         got = eng.submit(p2, 6).wait(timeout=300)
         np.testing.assert_array_equal(got, expected(oracle, p2, 6))
-        assert eng.prefix_stats["hits"] == 0
-        for extra in ([5, 5, 5, 5, 5, 5], [6, 6, 6, 6, 6, 6]):
+        assert eng.kv_cache.stats["hits"] == 0
+        for extra in ([5] * 8, [6] * 8, [7] * 8):
             eng.submit(extra, 4).wait(timeout=300)
-        assert len(eng._prefix_cache) == 2   # size bound enforced
+        snap = eng.kv_cache.snapshot()
+        assert snap["blocks_used"] <= 3          # pool bound enforced
+        assert snap["evicted_blocks"] > 0        # pressure was real
+        assert snap["resident_bytes"] <= snap["capacity_bytes"]
 
 
-def test_prefix_cache_disabled(params, oracle):
+def test_kvcache_disabled(params, oracle):
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                   sampling=GREEDY, prompt_buckets=(16,),
-                                  prefix_cache_size=0) as eng:
+                                  kv_cache_blocks=0) as eng:
         prompt = [3, 1, 4, 1, 5]
         for _ in range(2):
             got = eng.submit(prompt, 6).wait(timeout=300)
             np.testing.assert_array_equal(got, expected(oracle, prompt, 6))
-        assert eng.prefix_stats["hits"] == 0
-        assert len(eng._prefix_cache) == 0
+        assert eng.kv_cache is None              # 0 = pre-kvcache behavior
 
 
 def test_submit_validation(params):
@@ -292,13 +361,14 @@ def test_tp_mesh_batching_parity(params, oracle):
     sharded = shard_engine_params(params, CFG, mesh)
     with ContinuousBatchingEngine(CFG, sharded, max_seq=96, max_batch=2,
                                   sampling=GREEDY, prompt_buckets=(16,),
-                                  min_prefix_len=4, mesh=mesh) as eng:
+                                  kv_cache_blocks=16, kv_block_tokens=2,
+                                  mesh=mesh) as eng:
         prompts = [[3, 14, 15, 92], [3, 14, 15, 92, 65, 35]]  # shared prefix
         reqs = [eng.submit(p, 10) for p in prompts]
         for p, r in zip(prompts, reqs):
             np.testing.assert_array_equal(r.wait(timeout=300),
                                           expected(oracle, p, 10))
-        assert eng.prefix_stats["hits"] >= 1   # prefix reuse under tp
+        assert eng.kv_cache.stats["hits"] >= 1   # block reuse under tp
 
 
 def test_int8_weights_through_batching():
@@ -767,14 +837,15 @@ def test_chunked_admission_composes_with_prefix_cache(params, oracle):
     tail = base[:24] + [7, 9, 11, 13, 2, 4, 6, 8]  # 24 shared + 8 new
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
                                   sampling=GREEDY, prompt_buckets=(16, 64),
-                                  prefill_chunk=8, min_prefix_len=8) as eng:
+                                  prefill_chunk=8, kv_cache_blocks=16,
+                                  kv_block_tokens=8) as eng:
         np.testing.assert_array_equal(
             eng.submit(base, 8).wait(timeout=300),
             expected(oracle, base, 8))
         np.testing.assert_array_equal(
             eng.submit(tail, 8).wait(timeout=300),
             expected(oracle, tail, 8))
-        assert eng.prefix_stats["hits"] == 1
+        assert eng.kv_cache.stats["hits"] == 1
         # 32/8 = 4 full chunks minus the sampled tail bucket, then the
         # reused-prefix request chunks only its 8-token suffix (0 full
         # chunks — it fits one final dispatch)
@@ -927,7 +998,8 @@ def test_chunked_admission_prefix_hit_passes_streaming_prompt(params,
     seen = []
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
                                   sampling=GREEDY, prompt_buckets=(16, 64),
-                                  prefill_chunk=4, min_prefix_len=8) as eng:
+                                  prefill_chunk=4, kv_cache_blocks=32,
+                                  kv_block_tokens=4) as eng:
         np.testing.assert_array_equal(eng.submit(base, 4).wait(timeout=300),
                                       expected(oracle, base, 4))
         orig = eng._chunk_mid
@@ -948,7 +1020,7 @@ def test_chunked_admission_prefix_hit_passes_streaming_prompt(params,
         # the prefix-hit request finished while the streamer still had
         # chunks left (base's 8 chunks ran before the hook armed)
         assert any(seen)
-        assert eng.prefix_stats["hits"] == 1
+        assert eng.kv_cache.stats["hits"] == 1
 
 
 # ---------------------------------------------------------------------------
@@ -1070,7 +1142,8 @@ def test_everything_on_composition(params, draft_params, oracle):
             prompt_buckets=(16, 64), mesh=mesh,
             kv_cache_dtype="float8_e4m3fn",
             draft_cfg=DRAFT_CFG, draft_params=dsharded, num_draft=3,
-            decode_block=2, prefill_chunk=8, min_prefix_len=4) as eng:
+            decode_block=2, prefill_chunk=8, kv_cache_blocks=16,
+            kv_block_tokens=4) as eng:
         a = eng.submit([5, 4, 3, 2], 12)
         b = eng.submit(long_prompt, 8)
         np.testing.assert_array_equal(

@@ -46,3 +46,24 @@ def test_leg_multimodal_structure_tiny():
     e2e = out["e2e_image_text_generate"]
     assert e2e["decode_tokens_per_sec"] > 0
     assert e2e["image_tokens"] == enc["patches_per_image"]
+
+
+def test_leg_prefix_reuse_structure_tiny():
+    """The prefix_reuse leg's full structure (cache-off run, cache-on
+    run, hit/reuse/saved report) at CPU-viable scale — the dryrun that
+    spends tier-1 minutes so the leg can't burn a TPU session attempt
+    on a structural bug."""
+    out = bench._leg_prefix_reuse("llama-test", 4, slots=2, n_req=4,
+                                  shared_len=12, tail_len=4,
+                                  block_tokens=4, kv_blocks=16)
+    assert "error" not in out
+    # every timed request shares the primed 12-token prefix: all hits
+    assert out["hit_rate"] == 1.0
+    # 3 whole blocks of shared prefix per request
+    assert out["reused_tokens"] == out["requests"] * 12
+    assert out["tokens_per_sec_cold"] > 0
+    assert out["tokens_per_sec_warm"] > 0
+    # wall-delta field is present and finite (sign not asserted: at toy
+    # scale scheduler noise can swamp the saved prefill)
+    assert isinstance(out["prefill_seconds_saved"], float)
+    assert out["blocks_resident"] <= 16

@@ -512,7 +512,10 @@ def test_chat_streaming_detok_holds_back_split_utf8(monkeypatch):
 
 def test_stop_matcher_fuzz():
     """StopMatcher vs a whole-string reference over random texts, stop
-    sets, and chunkings: identical cut positions, and emitted text never
+    sets, and chunkings — INCLUDING per-token (1-char) feeds: identical
+    cut positions regardless of chunking (the chunk-dependent-cut bug:
+    a short stop completing while an earlier-starting longer stop is
+    still a live prefix must defer, ADVICE r5), and emitted text never
     contains anything later retracted (the streaming holdback
     guarantee)."""
     import random
@@ -529,19 +532,113 @@ def test_stop_matcher_fuzz():
         hits = [text.find(s) for s in stops if s in text]
         ref_pos = min(hits) if hits else None
 
-        m = StopMatcher(stops)
-        outs, matched = [], False
-        i = 0
-        while i < len(text) and not matched:
-            j = i + rng.randint(1, 5)
-            out, matched = m.feed(text[i:j])
-            outs.append(out)
-            i = j
-        if matched:
-            assert m.pos == ref_pos
-            assert "".join(outs) == text[:ref_pos]
-        else:
-            assert ref_pos is None or ref_pos >= i  # not reached yet
+        # every chunking — per-char, random, whole-string — must agree
+        # with the whole-string reference on (pos, emitted)
+        chunkings = [1, None, len(text) or 1]
+        for chunk in chunkings:
+            m = StopMatcher(stops)
+            outs, matched = [], False
+            i = 0
+            while i < len(text) and not matched:
+                j = i + (chunk if chunk else rng.randint(1, 5))
+                out, matched = m.feed(text[i:j])
+                outs.append(out)
+                i = j
+            if not matched:
+                # stream over: resolve any deferred verdict
+                out, matched = m.finish()
+                outs.append(out)
             if ref_pos is None:
-                outs.append(m.flush())
+                assert not matched and m.pos is None
                 assert "".join(outs) == text
+            else:
+                assert matched and m.pos == ref_pos, (text, stops, chunk)
+                assert "".join(outs) == text[:ref_pos]
+
+
+def test_stop_matcher_defers_short_stop_inside_longer_candidate():
+    """The ADVICE r5 repro pinned: stop=["abc", "b"] fed "a" then "b"
+    must NOT cut at 1 while "ab" can still become "abc" — the verdict
+    defers (bounded by the longest stop) and resolves identically to
+    whole-string feeding whichever way the tail goes."""
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        StopMatcher)
+
+    # tail completes the longer stop: cut at 0, like feeding "abc" whole
+    m = StopMatcher(["abc", "b"])
+    assert m.feed("a") == ("", False)
+    out, matched = m.feed("b")
+    assert not matched and out == ""      # deferred, nothing emitted
+    out, matched = m.feed("c")
+    assert matched and m.pos == 0 and out == ""
+
+    # tail kills the longer candidate: the short stop's cut stands
+    m = StopMatcher(["abc", "b"])
+    m.feed("a")
+    m.feed("b")
+    out, matched = m.feed("x")
+    assert matched and m.pos == 1 and out == "a"
+
+    # stream ends while deferred: finish() resolves to the short stop
+    m = StopMatcher(["abc", "b"])
+    m.feed("a")
+    m.feed("b")
+    out, matched = m.finish()
+    assert matched and m.pos == 1 and out == "a"
+
+
+def test_cli_kvcache_flags():
+    """--kv-cache-blocks plumbs into generate, defers to DWT_KVCACHE_*
+    env knobs when unset, and is REJECTED (not silently ignored) by
+    modes with no block-cache plumbing."""
+    argv = ["generate", "--model", "llama-test", "--prompt-ids",
+            ",".join(str(i) for i in range(20)), "--max-new-tokens", "4",
+            "--greedy", "--max-seq", "64", "--attn-backend", "jnp"]
+    rc, plain = _run_cli(argv)
+    assert rc == 0
+    rc, cached = _run_cli(argv + ["--kv-cache-blocks", "16",
+                                  "--kv-block-tokens", "4"])
+    assert rc == 0
+    # single cold run: the cache changes nothing about the output
+    assert json.loads(cached)["tokens"] == json.loads(plain)["tokens"]
+    # no plumbing -> loud rejection, never a silent ignore
+    rc, _ = _run_cli(argv + ["--kv-cache-blocks", "16",
+                             "--prompt-lookup"])
+    assert rc == 1
+    rc, _ = _run_cli(["worker", "--model", "llama-test", "--stage-id",
+                      "0", "--num-stages", "1", "--layer-start", "0",
+                      "--layer-end", "1", "--device-id", "w0", "--port",
+                      "1", "--header", "h@127.0.0.1:1",
+                      "--kv-cache-blocks", "8"])
+    assert rc == 1
+
+
+def test_cli_serve_batching_kvcache_env_default(monkeypatch):
+    """DWT_KVCACHE_BLOCKS steers the batching engine when the flag is
+    absent (env knob parity with --kv-cache-blocks)."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    monkeypatch.setenv("DWT_KVCACHE_BLOCKS", "5")
+    monkeypatch.setenv("DWT_KVCACHE_BLOCK_TOKENS", "4")
+    with ContinuousBatchingEngine(cfg, params, max_seq=64, max_batch=2,
+                                  sampling=GREEDY,
+                                  prompt_buckets=(16,)) as eng:
+        assert eng.kv_cache is not None
+        assert eng.kv_cache.pool.num_blocks == 5
+        assert eng.kv_cache.block_tokens == 4
+    monkeypatch.setenv("DWT_KVCACHE_BLOCKS", "0")
+    with ContinuousBatchingEngine(cfg, params, max_seq=64, max_batch=2,
+                                  sampling=GREEDY,
+                                  prompt_buckets=(16,)) as eng:
+        assert eng.kv_cache is None          # 0 restores old behavior
+
+
+def test_stop_matcher_empty_stop_list_passes_through():
+    """An empty stop set is a valid no-op matcher (pure pass-through),
+    not a construction error."""
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        StopMatcher)
+    m = StopMatcher([])
+    assert m.feed("hello") == ("hello", False)
+    out, matched = m.finish()
+    assert out == "" and not matched and m.pos is None

@@ -244,3 +244,26 @@ def test_sp_backend_eos_matches_engine_and_stops_early(strategy):
     steps = list(backend.generate_stream(prompt, 10))
     assert len(steps) == 3 and int(steps[-1][0]) == eos
     np.testing.assert_array_equal(np.stack(steps, axis=1), want[:, :3])
+
+
+@pytest.mark.parametrize("strategy", ["ring"])
+def test_sp_backend_instant_eos_reports_prefill_seconds(strategy):
+    """ADVICE r5: a generation that ends at (or right after) prefill —
+    num_new=1, or eos on the very first token — must report the prefill
+    dispatch's seconds, not 0.0/NaN (the box is flushed right after the
+    prefill dispatch, not only after decode blocks)."""
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.asarray([[5, 17, 42, 7, 9, 2, 30, 11]], np.int32)
+    first = int(InferenceEngine(cfg, params, max_seq=32, sampling=GREEDY)
+                .generate(prompt, 1).tokens[0, 0])
+    backend = SequenceParallelBackend(
+        cfg, params, local_sp_mesh(2), max_seq=32, strategy=strategy,
+        sampling=GREEDY, eos_id=first)       # eos == token #1: instant stop
+    res = backend.generate(prompt, 10)
+    assert res.tokens[0, 0] == first
+    assert res.seconds > 0.0
+    assert res.tokens_per_second == res.tokens_per_second  # not NaN
+    # num_new=1 (prefill-only generation) times the same way
+    res1 = backend.generate(prompt, 1)
+    assert res1.seconds > 0.0

@@ -27,7 +27,7 @@ import sys
 from typing import List
 
 SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
-              "engine", "control", "anomaly", "flight"}
+              "engine", "control", "anomaly", "flight", "kvcache"}
 
 # unit suffixes a metric name may end with (after stripping ``_total``).
 # Plain-count units (requests, tokens, ...) double as the unit for
@@ -35,17 +35,27 @@ SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
 UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "rounds", "hits", "misses", "slots", "spans", "entries",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
-         "info", "events", "bundles"}
+         "info", "events", "bundles", "blocks", "nodes"}
 
 # series the catalog must always register (regressions here would blind
 # the flight-recorder/anomaly layer silently — a scrape with the series
-# simply absent looks exactly like a healthy quiet system)
+# simply absent looks exactly like a healthy quiet system).  The
+# dwt_kvcache_* block is required the same way: a serving stack whose
+# cache section vanished from /metrics reads as "cache disabled", which
+# is indistinguishable from "prefix reuse silently regressed".
 REQUIRED_SERIES = {
     "dwt_flight_events_total",
     "dwt_flight_buffer_events",
     "dwt_anomaly_events_total",
     "dwt_anomaly_last_seconds",
     "dwt_anomaly_postmortem_bundles_total",
+    "dwt_kvcache_hits_total",
+    "dwt_kvcache_misses_total",
+    "dwt_kvcache_partial_hit_tokens_total",
+    "dwt_kvcache_stored_blocks_total",
+    "dwt_kvcache_evicted_blocks_total",
+    "dwt_kvcache_resident_bytes",
+    "dwt_kvcache_tree_nodes",
 }
 
 
