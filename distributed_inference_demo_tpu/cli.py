@@ -122,6 +122,28 @@ def _reject_kvcache_flags(args, mode: str) -> bool:
     return False
 
 
+def _paged_layout_requested(args) -> bool:
+    """Did the CLI explicitly ask for the paged layout?  (The env knob
+    ``DWT_KV_LAYOUT=paged`` is rejected engine-side by
+    ``require_dense_kv_layout`` for every dense-only engine — this check
+    only exists so a typed flag fails at argument validation with a
+    mode-specific message instead of deep in a constructor.)"""
+    return getattr(args, "kv_layout", None) == "paged"
+
+
+def _reject_paged_layout(args, mode: str) -> bool:
+    """True (after printing) when --kv-layout paged was explicitly set
+    for a mode that decodes dense rows — honor-or-reject, never
+    silently ignore."""
+    if _paged_layout_requested(args):
+        print(f"--kv-layout paged is not supported with {mode}; the "
+              "paged block pool serves the continuous-batching decode "
+              "path (--batch-slots without a speculative proposer)",
+              file=sys.stderr)
+        return True
+    return False
+
+
 def _build_spec_engine(args):
     """Construct the draft/verify SpeculativeEngine from CLI flags — the
     one site shared by ``generate --draft-model`` and
@@ -131,6 +153,11 @@ def _build_spec_engine(args):
     from .models.registry import get_model_config
     from .runtime import SpeculativeEngine
 
+    if _paged_layout_requested(args):
+        raise ValueError(
+            "--kv-layout paged is not supported with --draft-model "
+            "(the draft/verify rollback decodes dense cache rows); "
+            "--batch-slots without a proposer is the paged mode")
     cfg = get_model_config(args.model)
     params, mesh = _load_params_for_mesh(args, cfg)
     draft_cfg, draft_params = _load_draft_for_mesh(args, mesh)
@@ -158,6 +185,10 @@ def _build_prompt_lookup_engine(args):
             "standalone --prompt-lookup (no block-cache plumbing in the "
             "n-gram proposer engine); --batch-slots --prompt-lookup "
             "composes with the block cache")
+    if _paged_layout_requested(args):
+        raise ValueError(
+            "--kv-layout paged is not supported with --prompt-lookup "
+            "(the n-gram verify rollback decodes dense cache rows)")
     cfg = get_model_config(args.model)
     params, mesh = _load_params_for_mesh(args, cfg)
     return PromptLookupEngine(
@@ -184,6 +215,7 @@ def _build_engine(args):
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
         prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
         mesh=mesh, eos_id=getattr(args, "eos_id", None),
+        kv_layout=getattr(args, "kv_layout", None),
         **_kvcache_from_args(args))
 
 
@@ -266,6 +298,12 @@ def cmd_serve(args) -> int:
         if _reject_kvcache_flags(args, "--chain (pipeline stages see "
                                  "activations, not tokens)"):
             return 1
+        if _reject_paged_layout(args, "--chain (per-stage dense caches)"):
+            return 1
+        # env knob too: the stage runtimes decode dense rows and must
+        # not run under a knob promising paged HBM accounting
+        from .runtime.kvcache import require_dense_kv_layout
+        require_dense_kv_layout("--chain (per-stage dense caches)")
         full = _load_full_params(args, cfg)
         sampling = _sampling_from_args(args)
 
@@ -357,11 +395,15 @@ def cmd_serve(args) -> int:
             ("--kv-cache-dtype", bool(getattr(args, "kv_cache_dtype", ""))),
             ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
             ("--kv-cache-blocks", _kvcache_flags_set(args)),
+            ("--kv-layout", _paged_layout_requested(args)),
             ("--tp", getattr(args, "tp", 1) > 1)] if on]
         if unsupported:
             print(f"{'/'.join(unsupported)} not supported with --vision",
                   file=sys.stderr)
             return 1
+        from .runtime.kvcache import require_dense_kv_layout
+        require_dense_kv_layout("--vision (the multimodal engine "
+                                "decodes dense rows)")
         cfg = get_model_config(args.model)
         if args.vision_preset == "llava15":
             # the CLIP-ViT-L/14-336 geometry LLaVA-1.5 ships, faithful:
@@ -431,10 +473,12 @@ def cmd_serve(args) -> int:
             num_draft=args.num_draft, prompt_lookup=pld,
             decode_block=args.decode_block,
             prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
+            kv_layout=getattr(args, "kv_layout", None),
             **_kvcache_from_args(args))
         kvc = backend.kv_cache
-        kv_desc = (f"{kvc.pool.num_blocks}x{kvc.block_tokens}tok"
-                   if kvc is not None else "off")
+        kv_desc = "off" if kvc is None else (
+            f"{getattr(kvc, 'num_blocks', None) or kvc.pool.num_blocks}"
+            f"x{kvc.block_tokens}tok {backend.kv_layout}")
         print(f"SERVE_BATCHING {args.model} slots={args.batch_slots} "
               f"kv_cache={kv_desc} "
               f"tp={getattr(args, 'tp', 1)}"
@@ -494,6 +538,9 @@ def cmd_server(args) -> int:
         print("--tp is not supported by the server app (the planner "
               "assigns whole layer ranges per worker)", file=sys.stderr)
         return 1
+    from .runtime.kvcache import require_dense_kv_layout
+    require_dense_kv_layout("the server app (planned pipeline stages "
+                            "decode dense rows)")
 
     app = ServerApp(
         model=args.model, num_workers=args.num_workers,
@@ -523,6 +570,12 @@ def cmd_worker(args) -> int:
     ``--auto`` connects to a ``server`` app and receives its role, layer
     range, and weights from the control plane."""
     from .runtime import worker_main
+    from .runtime.kvcache import require_dense_kv_layout
+
+    # stage workers decode dense cache rows; a DWT_KV_LAYOUT=paged env
+    # must fail loudly here, not be silently ignored per-process
+    require_dense_kv_layout("pipeline stage workers (dense per-stage "
+                            "caches)")
 
     if args.auto:
         ap = argparse.ArgumentParser(prog="worker --auto")
@@ -1036,6 +1089,17 @@ def _add_engine_args(ap):
                     help="tokens per KV cache block (match granularity "
                          "AND minimum reusable prefix; default "
                          "DWT_KVCACHE_BLOCK_TOKENS, else 16)")
+    ap.add_argument("--kv-layout", default=None,
+                    choices=["dense", "paged"],
+                    help="KV cache memory layout (default DWT_KV_LAYOUT, "
+                         "else dense).  paged: device-resident block "
+                         "pool + per-slot block tables (vLLM-style "
+                         "PagedAttention) — HBM reserved per block "
+                         "actually allocated instead of B x max_seq "
+                         "rows, radix prefix hits shared by reference "
+                         "with zero H2D; serve --batch-slots (plain "
+                         "slot decode) only, every other mode rejects "
+                         "it explicitly")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over the first N local "
                          "devices (Megatron-sliced weights, kv-head-"
@@ -1070,6 +1134,7 @@ def _sp_unsupported_flags(args, allow_eos: bool = False) -> list:
          and getattr(args, "eos_id", None) is not None),
         ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
         ("--kv-cache-blocks", _kvcache_flags_set(args)),
+        ("--kv-layout", _paged_layout_requested(args)),
         ("--attn-backend", args.attn_backend != "auto")] if on]
 
 

@@ -84,6 +84,33 @@ def attention(
     return out.reshape(b, chunk, nh, hd).astype(q.dtype)
 
 
+def prepare_kv_chunk(
+    k_new: jnp.ndarray,    # [batch, chunk, nkv, hd] (projection layout)
+    v_new: jnp.ndarray,
+    k_dtype,
+    v_dtype,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Validate + cast a projection-layout K/V chunk for a cache write —
+    the ONE entry every cache-write path goes through (the dense
+    ``update_kv_cache`` below and the paged block write in
+    ``ops.paged_attention.write_paged_kv``), so the write contract is
+    stated and checked in one place:
+
+    **Stale-slot invariant.**  A cache write may land garbage at any
+    position >= the row's valid length (padded prefill tails, freed
+    batching slots, speculative overshoot) PROVIDED every position is
+    rewritten before any query attends it — causal masking
+    (``kv_pos <= q_position``) plus contiguous advance makes that safe.
+    Writers must never touch a position < the row's valid length: stored
+    prefix K/V is immutable (the KV-cache manager's copy-on-write
+    sharing, dense AND paged, relies on it).
+    """
+    assert k_new.ndim == 4 and k_new.shape == v_new.shape, (
+        "KV chunk must be projection-layout [batch, chunk, nkv, hd]; got "
+        f"{k_new.shape} / {v_new.shape}")
+    return k_new.astype(k_dtype), v_new.astype(v_dtype)
+
+
 def update_kv_cache(
     k_cache: jnp.ndarray,  # [batch, nkv, max_seq, hd] (head-major)
     v_cache: jnp.ndarray,
@@ -96,10 +123,13 @@ def update_kv_cache(
     The chunk arrives in projection layout [b, chunk, nkv, hd] (as produced
     by the QKV matmuls) and is transposed to the cache's head-major layout
     here — a [b, chunk, nkv, hd]-sized shuffle, O(chunk), not O(max_seq).
+    Write contract (stale-slot invariant): see :func:`prepare_kv_chunk`.
     """
     zeros = jnp.zeros((), jnp.int32)
-    k_new = k_new.transpose(0, 2, 1, 3).astype(k_cache.dtype)
-    v_new = v_new.transpose(0, 2, 1, 3).astype(v_cache.dtype)
+    k_new, v_new = prepare_kv_chunk(k_new, v_new, k_cache.dtype,
+                                    v_cache.dtype)
+    k_new = k_new.transpose(0, 2, 1, 3)
+    v_new = v_new.transpose(0, 2, 1, 3)
     k_cache = jax.lax.dynamic_update_slice(
         k_cache, k_new, (zeros, zeros, start, zeros))
     v_cache = jax.lax.dynamic_update_slice(

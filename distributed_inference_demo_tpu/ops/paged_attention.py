@@ -1,0 +1,311 @@
+"""Paged decode attention: per-sequence block tables over a device pool.
+
+The PagedAttention memory model (vLLM, SOSP'23): instead of one dense
+``[batch, nkv, max_seq, hd]`` cache row per sequence, K/V live in a
+shared pool of fixed-size pages ``[num_pages, nkv, block_tokens, hd]``
+(one pool per layer — the engines stack a leading layer axis) and each
+sequence addresses its pages through a block table ``[batch, W]`` of
+page ids.  Two consequences the dense layout cannot give:
+
+- HBM is reserved per page actually allocated, not ``batch x max_seq``
+  worst-case rows;
+- two sequences sharing a prefix share the SAME pages (the radix tree in
+  ``runtime/kvcache`` hands out the ids) — a prefix hit is a block-table
+  entry, not a copy of any kind.
+
+Sentinel convention: a table entry ``>= num_pages`` means "no page
+here".  Writes through a sentinel DROP (jax scatter ``mode="drop"`` —
+this is how freed batching slots and fused-block overshoot are routed
+to nowhere); reads CLAMP (the gathered garbage is causally masked, and
+pool pages always hold finite values, so masked garbage contributes
+exact zeros).
+
+Two interchangeable compute paths (same numerics as ``ops.attention``):
+
+- :func:`paged_gather_attention` — pure XLA ``jnp.take`` gather of the
+  table's pages into a linear view + the reference ``attention``.  Runs
+  everywhere (``JAX_PLATFORMS=cpu`` tier-1 and interpret-mode tests
+  exercise the same code path the TPU fallback uses).
+- :func:`paged_flash_attention` — Pallas TPU decode kernel: grid
+  ``(batch, nkv, W)``, the block table rides scalar prefetch so each
+  grid step DMAs exactly one [block_tokens, hd] page HBM->VMEM (pages
+  beyond a row's live count are index-clamped: Mosaic skips the repeat
+  DMA, ``pl.when`` skips the compute), online-softmax accumulators in
+  VMEM scratch — decode reads O(kv_len) HBM, never O(max_seq).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import attention, prepare_kv_chunk
+
+_NEG = -1e30
+
+
+def write_paged_kv(
+    k_pages: jnp.ndarray,   # [num_pages, nkv, block_tokens, hd]
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,     # [batch, chunk, nkv, hd] (projection layout)
+    v_new: jnp.ndarray,
+    tables: jnp.ndarray,    # [batch, W] int32 page ids (>= num_pages = none)
+    positions: jnp.ndarray  # [batch, chunk] absolute token positions
+):
+    """Scatter the chunk's K/V into its pages: token at position ``p`` of
+    row ``b`` lands in page ``tables[b, p // bt]`` at offset ``p % bt``.
+
+    Sentinel table entries route the write out of bounds, where scatter
+    ``mode="drop"`` discards it — the paged twin of the dense layout's
+    "stale writes land on the row's own dead columns".  Write contract
+    (stale-slot invariant, shared with the dense path):
+    :func:`ops.attention.prepare_kv_chunk`.
+    """
+    bt = k_pages.shape[2]
+    k_new, v_new = prepare_kv_chunk(k_new, v_new, k_pages.dtype,
+                                    v_pages.dtype)
+    page = jnp.take_along_axis(tables, positions // bt, axis=1)  # [b, s]
+    off = positions % bt                                         # [b, s]
+    # advanced indices at dims (0, 2) around the head slice: the indexed
+    # result layout [b, s, nkv, hd] is exactly the projection layout the
+    # chunk arrives in — no transpose.
+    k_pages = k_pages.at[page, :, off].set(k_new, mode="drop")
+    v_pages = v_pages.at[page, :, off].set(v_new, mode="drop")
+    return k_pages, v_pages
+
+
+def paged_gather_attention(
+    q: jnp.ndarray,          # [batch, chunk, nh, hd]
+    k_pages: jnp.ndarray,    # [num_pages, nkv, block_tokens, hd]
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,     # [batch, W] int32
+    q_positions: jnp.ndarray,  # [batch, chunk]
+    slopes: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Pure-XLA fallback: gather each row's pages into a linear
+    ``[batch, nkv, W*bt, hd]`` view and run the reference ``attention``.
+
+    Materializes the gathered view (a full cache copy per layer) — fine
+    for CPU tests and small batches, which is exactly where it runs; the
+    TPU path is the Pallas kernel."""
+    num_pages, nkv, bt, hd = k_pages.shape
+    safe = jnp.clip(tables, 0, num_pages - 1)
+    k_lin = jnp.take(k_pages, safe, axis=0)      # [b, W, nkv, bt, hd]
+    v_lin = jnp.take(v_pages, safe, axis=0)
+    b, W = safe.shape
+    k_lin = k_lin.transpose(0, 2, 1, 3, 4).reshape(b, nkv, W * bt, hd)
+    v_lin = v_lin.transpose(0, 2, 1, 3, 4).reshape(b, nkv, W * bt, hd)
+    return attention(q, k_lin, v_lin, q_positions,
+                     jnp.asarray(W * bt, jnp.int32), slopes)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU decode kernel
+
+
+def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, slopes_ref,
+                  o_ref, o_acc, m_acc, l_acc, *, block_tokens: int,
+                  groups: int, use_alibi: bool):
+    """Grid (b, nkv, W), page index innermost: each step folds one
+    streamed [block_tokens, hd] page into the online-softmax accumulators
+    (VMEM scratch persists across the sequential grid).  Rows are the
+    q-head group members of one kv head (decode chunk = 1), all at the
+    same query position ``kv_len - 1``.
+
+    tab_ref (SMEM int32 [b, W]): the block tables; len_ref (SMEM int32
+    [b]): per-row valid lengths AFTER the current token's insert."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    num_j = pl.num_programs(2)
+    rows, hd = q_ref.shape[2], q_ref.shape[3]
+    kv_len = len_ref[b]
+    bt = block_tokens
+
+    @pl.when(j == 0)
+    def _init():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, _NEG)
+        l_acc[:] = jnp.zeros_like(l_acc)
+
+    n_live = (kv_len + bt - 1) // bt
+
+    @pl.when(j < n_live)
+    def _step():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        q = q * (1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32)))
+        k_blk = k_ref[0, 0, :, :]
+        v_blk = v_ref[0, 0, :, :]
+        s = jnp.dot(q, k_blk.astype(jnp.float32).T,
+                    preferred_element_type=jnp.float32)     # [rows, bt]
+        kv_pos = (j * bt
+                  + jax.lax.broadcasted_iota(jnp.int32, (1, bt), 1))
+        # every q row is the same decode position kv_len - 1, so the
+        # causal bound and the validity bound coincide
+        valid = kv_pos < kv_len                             # [1, bt]
+        valid = jnp.broadcast_to(valid, (rows, bt))
+        if use_alibi:
+            slope = slopes_ref[0, 0, :][:, None]            # [rows, 1]
+            dist = ((kv_len - 1) - kv_pos).astype(jnp.float32)
+            s = s - slope * dist
+        s = jnp.where(valid, s, _NEG)
+
+        m = jnp.max(m_acc[:], axis=-1, keepdims=True)       # [rows, 1]
+        l = jnp.max(l_acc[:], axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_acc[:] = o_acc[:] * alpha + jnp.dot(
+            p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m_acc[:] = jnp.broadcast_to(m_new, m_acc.shape)
+        l_acc[:] = jnp.broadcast_to(l_new, l_acc.shape)
+
+    @pl.when(j == num_j - 1)
+    def _finalize():
+        l = jnp.max(l_acc[:], axis=-1, keepdims=True)
+        o_ref[0, 0, :, :] = (o_acc[:]
+                             / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_tokens", "use_alibi",
+                                    "interpret"))
+def _paged_call(q_g, k_pages, v_pages, tables, kv_lens, slopes, *,
+                block_tokens, use_alibi, interpret):
+    b, nkv, rows, hd = q_g.shape
+    num_pages = k_pages.shape[0]
+    W = tables.shape[1]
+    bt = block_tokens
+
+    def page_map(bb, h, j, tab, lens):
+        # clamp to the live frontier: beyond it the index repeats (no
+        # DMA, pl.when skips compute); sentinel entries clamp in-range
+        live = (lens[bb] + bt - 1) // bt
+        jj = jnp.minimum(j, jnp.maximum(live - 1, 0))
+        page = jnp.minimum(tab[bb, jj], num_pages - 1)
+        return (page, h, 0, 0)
+
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, block_tokens=bt, groups=rows,
+                          use_alibi=use_alibi),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, nkv, W),
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, hd),
+                             lambda bb, h, j, tab, lens: (bb, h, 0, 0)),
+                pl.BlockSpec((1, 1, bt, hd), page_map),
+                pl.BlockSpec((1, 1, bt, hd), page_map),
+                pl.BlockSpec((1, 1, rows),
+                             lambda bb, h, j, tab, lens: (h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows, hd),
+                                   lambda bb, h, j, tab, lens:
+                                   (bb, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, hd), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, rows, hd), q_g.dtype),
+        interpret=interpret,
+    )(tables, kv_lens, q_g, k_pages, v_pages, slopes)
+
+
+def paged_flash_attention(
+    q: jnp.ndarray,          # [batch, 1, nh, hd] — decode chunk only
+    k_pages: jnp.ndarray,    # [num_pages, nkv, block_tokens, hd]
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,     # [batch, W] int32
+    kv_lens: jnp.ndarray,    # [batch] int32 valid length incl. this token
+    slopes: Optional[jnp.ndarray] = None,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas paged decode attention; numerics match
+    :func:`paged_gather_attention` (f32 online softmax, same masking).
+
+    Requires ``block_tokens % 8 == 0`` (the page's token axis is the
+    sublane dimension of the streamed tiles) and a 1-token chunk; the
+    caller falls back to the gather path otherwise."""
+    b, chunk, nh, hd = q.shape
+    if chunk != 1:
+        raise ValueError(f"paged_flash_attention is decode-only (chunk=1), "
+                         f"got chunk={chunk}")
+    num_pages, nkv, bt, _ = k_pages.shape
+    if bt % 8:
+        raise ValueError(f"block_tokens must be a multiple of 8 for the "
+                         f"Pallas kernel, got {bt}")
+    g = nh // nkv
+    rows = max(8, -(-g // 8) * 8)    # pad group rows to the sublane granule
+
+    # [b, 1, nh, hd] -> [b, nkv, g, hd] (+ zero-pad rows): row r of head h
+    # is q head h*g + r
+    q_g = q.reshape(b, nkv, g, hd)
+    if rows > g:
+        q_g = jnp.pad(q_g, ((0, 0), (0, 0), (0, rows - g), (0, 0)))
+    if slopes is None:
+        slopes_g = jnp.zeros((nkv, 1, rows), jnp.float32)
+    else:
+        slopes_g = slopes.astype(jnp.float32).reshape(nkv, 1, g)
+        slopes_g = jnp.pad(slopes_g, ((0, 0), (0, 0), (0, rows - g)))
+
+    out = _paged_call(q_g, k_pages, v_pages,
+                      tables.astype(jnp.int32),
+                      kv_lens.astype(jnp.int32), slopes_g,
+                      block_tokens=bt, use_alibi=slopes is not None,
+                      interpret=interpret)
+    return out[:, :, :g, :].reshape(b, 1, nh, hd)
+
+
+# ---------------------------------------------------------------------------
+# the attn_impl seam (models/decoder.py hook)
+
+
+def make_paged_attn_impl(block_tokens: int, backend: str = "auto",
+                         interpret: bool = False):
+    """``(impl, bind)``: an attention hook for paged-layout caches plus
+    the binder that hands it the block tables.
+
+    The decoder's ``attn_impl`` signature has no table slot, so the
+    caller's jitted program binds the traced table array immediately
+    before invoking the forward — ``bind(tables)`` at the top of the
+    traced body, then ``fwd(...)``; the impl reads the binding during
+    tracing (the layer scan closes over it as a loop constant).
+
+    ``backend``: "auto" (Pallas on TPU, XLA gather elsewhere), "xla", or
+    "pallas".  The Pallas path covers 1-token decode chunks with
+    8-aligned pages; anything else takes the gather path.
+    """
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown paged attention backend {backend!r}; "
+                         "expected 'auto', 'xla', or 'pallas'")
+    bound = {}
+
+    def bind(tables):
+        bound["tables"] = tables
+
+    def impl(q, k, v, k_pages, v_pages, positions, cache_start, slopes):
+        tables = bound["tables"]
+        k_pages, v_pages = write_paged_kv(k_pages, v_pages, k, v,
+                                          tables, positions)
+        use_pallas = (backend == "pallas"
+                      or (backend == "auto"
+                          and jax.default_backend() == "tpu"))
+        if (use_pallas and q.shape[1] == 1
+                and k_pages.shape[2] % 8 == 0):
+            kv_lens = positions[:, -1] + 1
+            out = paged_flash_attention(q, k_pages, v_pages, tables,
+                                        kv_lens, slopes,
+                                        interpret=interpret)
+        else:
+            out = paged_gather_attention(q, k_pages, v_pages, tables,
+                                         positions, slopes)
+        return out, k_pages, v_pages
+
+    return impl, bind

@@ -89,6 +89,13 @@ def slot_attention_impl(q, k, v, k_cache, v_cache, positions, cache_start,
     return out, k_cache, v_cache
 
 
+class _BlocksExhausted(Exception):
+    """Paged admission could not allocate its pages (pool pressure with
+    every evictable block pinned by in-flight tables): the request goes
+    back to pending and retries when a completion frees pages — the
+    paged twin of 'no free slot', never a request failure."""
+
+
 @dataclass
 class Request:
     """One in-flight generation request (row-level)."""
@@ -135,7 +142,8 @@ class ContinuousBatchingEngine:
                  num_draft: int = 4,
                  prompt_lookup: bool = False,
                  decode_block: int = 1,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 kv_layout: Optional[str] = None):
         """``kv_cache_blocks`` / ``kv_block_tokens``: the block-level KV
         cache (``runtime/kvcache``, docs/DESIGN.md §10) — automatic
         prefix reuse at ``kv_block_tokens`` granularity.  A new prompt
@@ -205,7 +213,21 @@ class ContinuousBatchingEngine:
         from the same full-context logits (same invariant as
         InferenceEngine's chunked path, runtime/engine.py).  The
         draft-side admission prefill (speculative mode) stays one
-        dispatch — the draft is small by construction."""
+        dispatch — the draft is small by construction.
+
+        ``kv_layout``: "dense" (default; ``DWT_KV_LAYOUT`` env between)
+        keeps the preallocated ``[L, B, H, max_seq, D]`` slot cache.
+        "paged" (docs/DESIGN.md §11) replaces it with a DEVICE-resident
+        page pool ``[L, num_blocks, H, block_tokens, D]`` addressed
+        through per-slot block tables: HBM is reserved per page actually
+        allocated instead of ``B x max_seq`` worst-case rows, radix
+        prefix hits become shared block-table entries (zero H2D, zero
+        copies of any kind), and stores are in-place ownership adoptions
+        (zero D2H).  ``kv_cache_blocks`` then sizes the page pool
+        (default: the dense-equivalent ``B x max_seq/block_tokens``).
+        Paged is plumbed for the plain slot decode path only — the
+        speculative proposers (draft model / prompt-lookup) and tp
+        meshes reject it explicitly."""
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -242,6 +264,28 @@ class ContinuousBatchingEngine:
             b for b in sorted(prompt_buckets) if b <= self.max_seq
         ) or (self.max_seq,)
 
+        from .kvcache import resolve_kvcache_config, resolve_kv_layout
+        self.kv_layout = resolve_kv_layout(kv_layout)
+        n_blocks, block_tokens = resolve_kvcache_config(
+            kv_cache_blocks, kv_block_tokens, default_blocks=64)
+        if self.kv_layout == "paged":
+            # honor-or-reject: a paged request for a mode that would
+            # silently decode dense rows must fail loudly (the caller
+            # believes HBM reservations shrank)
+            if draft_cfg is not None or prompt_lookup:
+                raise ValueError(
+                    "kv_layout='paged' is not plumbed for the "
+                    "speculative slot modes (draft model / prompt-lookup "
+                    "proposers decode dense rows); drop the proposer or "
+                    "use kv_layout='dense'")
+            if mesh is not None and mesh.shape.get("tp", 1) > 1:
+                raise ValueError(
+                    "kv_layout='paged' is not plumbed for a tp mesh "
+                    "(pages are not kv-head-sharded); use "
+                    "kv_layout='dense'")
+            if block_tokens < 1:
+                raise ValueError("kv_block_tokens must be >= 1")
+
         cfg_, spec_, samp_ = cfg, self.spec, sampling
         # S is a BUFFER capacity (row caches, the batch cache, history),
         # sublane-aligned for the flash kernel and held equal across the
@@ -250,6 +294,12 @@ class ContinuousBatchingEngine:
         # buffer anyway — padding S once keeps the row and batch shapes
         # derived from ONE number.)
         B, S = max_batch, pad_cache_capacity(self.max_seq)
+        if self.kv_layout == "paged":
+            # paged rows/tables tile S into whole blocks, so S is padded
+            # to the block granule too (lcm keeps the sublane alignment)
+            import math
+            g = math.lcm(8, block_tokens)
+            S = -(-S // g) * g
 
         from ..parallel.tensor import make_forward_seam
         fwd, self._cache_sharding = make_forward_seam(
@@ -570,27 +620,120 @@ class ContinuousBatchingEngine:
             self._dck, self._dcv = dcache.keys, dcache.values
         self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
 
-        cache = KVCache.create(cfg, cfg.num_layers, B, S + slack,
-                               dtype=self.kv_cache_dtype)
-        if self._cache_sharding is not None:
-            cache = jax.device_put(cache, self._cache_sharding)
-        self._ck, self._cv = cache.keys, cache.values
+        if self.kv_layout == "paged":
+            # DEVICE-resident page pool instead of dense slot rows: HBM
+            # holds num_blocks pages regardless of max_batch x max_seq,
+            # and per-slot block tables (host numpy, the scheduler's
+            # source of truth, shipped as a few hundred metadata bytes
+            # per dispatch) address them.  Entry >= num_blocks = "no
+            # page": writes drop (freed slots, fused-block overshoot),
+            # reads clamp into causally-masked garbage.
+            from ..ops.paged_attention import make_paged_attn_impl
+            from .kvcache import PagedKVCacheManager
+            from .kvcache.device import (seed_row_from_pages,
+                                         write_row_to_pages)
+            bt = block_tokens
+            self._table_width = S // bt
+            n_blocks, _ = resolve_kvcache_config(
+                kv_cache_blocks, kv_block_tokens,
+                default_blocks=B * self._table_width)
+            if n_blocks < 1:
+                raise ValueError(
+                    "kv_layout='paged' needs a block pool "
+                    "(kv_cache_blocks >= 1): the pool IS the decode "
+                    "cache — 0/off only makes sense for the dense "
+                    "layout's optional prefix cache")
+            self.kv_cache = PagedKVCacheManager.for_model(
+                cfg, n_blocks, bt, dtype=self.kv_cache_dtype)
+            N = self.kv_cache.num_blocks
+            self._page_sentinel = N
+            page_dtype = self.kv_cache_dtype or cfg.dtype
+            self._pk = jnp.zeros(
+                (cfg.num_layers, N, cfg.num_kv_heads, bt, cfg.head_dim),
+                page_dtype)
+            self._pv = jnp.zeros_like(self._pk)
+            self._tables = np.full((B, self._table_width), N, np.int32)
+            self._seed_row = seed_row_from_pages
+            self._write_row = write_row_to_pages
+            impl, bind_tables = make_paged_attn_impl(bt, backend="auto")
+            fwd_p, _ = make_forward_seam(cfg, self.spec, None, params,
+                                         attn_impl=impl)
+
+            def paged_one_step(params, cache, lengths, last_tok, active,
+                               rng):
+                """One paged lockstep step — mirrors ``one_step`` above
+                token for token (same rng spends, same masking) so
+                paged-vs-dense greedy parity is structural."""
+                pos = lengths[:, None]
+                logits, cache = fwd_p(params, last_tok[:, None], cache,
+                                      pos, True)
+                tok = sample_logits(logits[:, 0], rng, samp_)
+                tok = jnp.where(active, tok, last_tok)
+                lp = _emitted_logprob(logits[:, 0], tok)
+                lengths = lengths + active.astype(jnp.int32)
+                return cache, lengths, tok, lp
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def paged_step(params, pk, pv, tables, lengths, last_tok,
+                           active, rng):
+                bind_tables(tables)
+                cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
+                cache, lengths, tok, lp = paged_one_step(
+                    params, cache, lengths, last_tok, active, rng)
+                return cache.keys, cache.values, lengths, tok, lp
+
+            @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(8,))
+            def paged_multi_step(params, pk, pv, tables, lengths,
+                                 last_tok, active, rng, num_steps):
+                """decode_block fusion, paged: the tables are frozen for
+                the block (no admission can land mid-block) and rows
+                that finish mid-block keep writing — through their own
+                still-allocated pages, or through sentinel entries that
+                drop the write (the paged stale-slot route)."""
+                bind_tables(tables)
+                cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
+
+                def body(carry, sub):
+                    cache, lengths, tok = carry
+                    cache, lengths, tok, lp = paged_one_step(
+                        params, cache, lengths, tok, active, sub)
+                    return (cache, lengths, tok), (tok, lp)
+
+                (cache, lengths, tok), (toks, lps) = jax.lax.scan(
+                    body, (cache, lengths, last_tok),
+                    jax.random.split(rng, num_steps))
+                return (cache.keys, cache.values, lengths, tok,
+                        jnp.swapaxes(toks, 0, 1), jnp.swapaxes(lps, 0, 1))
+
+            @jax.jit
+            def set_slot_state(lengths, last_tok, slot, new_len, new_tok):
+                return (lengths.at[slot].set(new_len),
+                        last_tok.at[slot].set(new_tok))
+
+            self._paged_step = paged_step
+            self._paged_multi_step = paged_multi_step
+            self._set_slot_state = set_slot_state
+            self._ck = self._cv = None       # no dense batch cache
+        else:
+            cache = KVCache.create(cfg, cfg.num_layers, B, S + slack,
+                                   dtype=self.kv_cache_dtype)
+            if self._cache_sharding is not None:
+                cache = jax.device_put(cache, self._cache_sharding)
+            self._ck, self._cv = cache.keys, cache.values
+            # block-level KV cache (runtime/kvcache): the ONE
+            # prefix-reuse path — radix-tree partial-prefix matches,
+            # host block pool, stores at prefill time.  Matched/stored
+            # only on the scheduler thread; /metrics scrapes read
+            # snapshots under the manager lock.
+            from .kvcache import KVCacheManager
+            self.kv_cache = (
+                KVCacheManager.for_model(cfg, n_blocks, block_tokens,
+                                         dtype=self.kv_cache_dtype)
+                if n_blocks > 0 else None)
         self._lengths = jnp.zeros((B,), jnp.int32)
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._rng = jax.random.PRNGKey(seed)
         self._step_count = 0
-
-        # block-level KV cache (runtime/kvcache): the ONE prefix-reuse
-        # path — radix-tree partial-prefix matches, host block pool,
-        # stores at prefill time.  Matched/stored only on the scheduler
-        # thread; /metrics scrapes read snapshots under the manager lock.
-        from .kvcache import KVCacheManager, resolve_kvcache_config
-        n_blocks, block_tokens = resolve_kvcache_config(
-            kv_cache_blocks, kv_block_tokens, default_blocks=64)
-        self.kv_cache: Optional[KVCacheManager] = (
-            KVCacheManager.for_model(cfg, n_blocks, block_tokens,
-                                     dtype=self.kv_cache_dtype)
-            if n_blocks > 0 else None)
         self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
         # resumable chunked admission: at most ONE prompt streams its
         # chunks at a time (scheduler state, advanced one dispatch per
@@ -632,6 +775,21 @@ class ContinuousBatchingEngine:
                             self._cv, self._dck, self._dcv,
                             self._lengths, self._last_tok, idle,
                             warm_rng, n_r)
+                elif self.kv_layout == "paged":
+                    # all-sentinel tables: writes drop, state holds
+                    tbl = jnp.asarray(self._tables)
+                    if n_r > 1:
+                        (self._pk, self._pv, self._lengths,
+                         self._last_tok, _, _) = self._paged_multi_step(
+                            self.params, self._pk, self._pv, tbl,
+                            self._lengths, self._last_tok, idle,
+                            warm_rng, n_r)
+                    else:
+                        (self._pk, self._pv, self._lengths,
+                         self._last_tok, _) = self._paged_step(
+                            self.params, self._pk, self._pv, tbl,
+                            self._lengths, self._last_tok, idle,
+                            warm_rng)
                 elif n_r > 1:
                     (self._ck, self._cv, self._lengths, self._last_tok,
                      _, _) = self._multi_step(
@@ -674,6 +832,17 @@ class ContinuousBatchingEngine:
             # admission records the first sampled token unconditionally,
             # so a 0-token request would still produce one
             raise ValueError("max_new_tokens must be >= 1")
+        if self.kv_layout == "paged":
+            # the page-pool twin of check_capacity: a request whose full
+            # table can never be allocated would wait in pending forever
+            bt = self.kv_cache.block_tokens
+            need = -(-(len(prompt) + max_new_tokens) // bt)
+            if need > self.kv_cache.num_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks (prompt "
+                    f"{len(prompt)} + new {max_new_tokens} at "
+                    f"{bt} tokens/block) but the paged pool holds only "
+                    f"{self.kv_cache.num_blocks}; raise kv_cache_blocks")
         req = Request(prompt=prompt, max_new=max_new_tokens,
                       t_submit=time.perf_counter())
         with self._submit_lock:
@@ -774,6 +943,7 @@ class ContinuousBatchingEngine:
 
         from .stats import _percentile
         out = {"slots": self.max_batch, "steps": self._step_count,
+               "kv_layout": self.kv_layout,
                # live occupancy for the /metrics gauges: submitted-but-
                # unslotted requests vs slots mid-decode (racy reads of
                # scheduler-owned state — gauges, not invariants)
@@ -862,6 +1032,8 @@ class ContinuousBatchingEngine:
         per bucket — the pad columns sit at positions >= start and are
         rewritten by the suffix prefill / decode before any query can
         attend them (stale-slot invariant)."""
+        if self.kv_layout == "paged":
+            return self._row_for_paged(req)
         if self.kv_cache is not None:
             lease = self.kv_cache.match(req.prompt)
             if lease is not None:
@@ -878,6 +1050,75 @@ class ContinuousBatchingEngine:
                 return m, row_k, row_v
         row_k, row_v = self._zero_row()
         return 0, row_k, row_v
+
+    def _row_for_paged(self, req: Request):
+        """Paged admission, phase 1: reserve the request's pages and
+        build its prefill row — all on device.
+
+        - ``match`` returns page IDS for the matched prefix (pinned by a
+          lease held until the request completes: the slot's table will
+          reference those shared pages for its whole lifetime);
+        - the private remainder — enough pages for prompt + max_new — is
+          allocated up front (LRU tree leaves evict under pressure), so
+          decode can never run out of pages mid-flight; if even eviction
+          cannot free enough, :class:`_BlocksExhausted` sends the
+          request back to pending (a completion will free pages);
+        - the prefill row is gathered straight OUT of the page pool
+          (``seed_row_from_pages``): a prefix hit moves zero bytes
+          through the host — ``dwt_kvcache_h2d_bytes`` stays 0 on this
+          path by construction."""
+        mgr = self.kv_cache
+        bt = mgr.block_tokens
+        plen = len(req.prompt)
+        n_total = -(-(plen + req.max_new) // bt)
+        # retry gate for a previously blocked admission: only re-attempt
+        # once the pool could have changed (a completion frees at least
+        # one private page — n_total strictly exceeds the adoptable
+        # full-prompt blocks — and stores/evictions bump the epoch).
+        # Without this, every scheduler iteration would re-run match(),
+        # inflating hit/miss/reuse counters and flooding the flight ring
+        # with phantom lookups while the request just waits.
+        state = (mgr.epoch, mgr.free_blocks)
+        if getattr(req, "_pkv_blocked", None) == state:
+            raise _BlocksExhausted()
+        lease = mgr.match(req.prompt)
+        m = lease.tokens if lease is not None else 0
+        n_pref = m // bt
+        private = mgr.alloc(n_total - n_pref)
+        if private is None:
+            if lease is not None:
+                lease.release()
+            req._pkv_blocked = (mgr.epoch, mgr.free_blocks)
+            raise _BlocksExhausted()
+        req._pkv_blocked = None
+        table = np.full((self._table_width,), self._page_sentinel,
+                        np.int32)
+        if lease is not None:
+            table[:n_pref] = lease.block_ids
+        table[n_pref:n_total] = private
+        req._pkv = {"lease": lease, "store_lease": None,
+                    "private": private, "adopted": (), "n_pref": n_pref,
+                    "table": table, "released": False}
+        row_k, row_v = self._seed_row(self._pk, self._pv,
+                                      jnp.asarray(table))
+        return m, row_k, row_v
+
+    def _release_request_kv(self, req: Request) -> None:
+        """Return a paged request's KV resources: release its pins
+        (matched prefix + stored path) and free the private pages the
+        tree did not adopt.  Idempotent — completion, cancel, failure,
+        and the shutdown drain all funnel here."""
+        st = getattr(req, "_pkv", None)
+        if st is None or st["released"]:
+            return
+        st["released"] = True
+        if st["lease"] is not None:
+            st["lease"].release()
+        if st["store_lease"] is not None:
+            st["store_lease"].release()
+        adopted = set(st["adopted"])
+        self.kv_cache.free([b for b in st["private"]
+                            if b not in adopted])
 
     def _needs_stream(self, req: Request) -> bool:
         """Does this prompt need the one-at-a-time chunk stream, or can
@@ -914,20 +1155,25 @@ class ContinuousBatchingEngine:
         self._finish_admission(slot, req, start, row_k, row_v,
                                req.prompt[start:])
 
-    def _start_admission(self, req: Request) -> None:
+    def _start_admission(self, req: Request) -> bool:
         """Park a chunk-needing prompt as the in-progress admission the
         scheduler advances one dispatch per iteration (chunked admission
         is resumable state, NOT an inline loop: between dispatches the
         loop keeps decoding in-flight rows AND admitting other queued
         requests into free slots, so a long prompt head-blocks
-        neither)."""
+        neither).  Returns False when the paged pool cannot reserve the
+        request's pages yet — the caller requeues it (everything else,
+        including failure, is handled here)."""
         try:
             start, row_k, row_v = self._row_for(req)
+        except _BlocksExhausted:
+            return False
         except BaseException as e:
             self._fail_request(req, e)
-            return
+            return True
         self._adm = {"req": req, "start": start, "row_k": row_k,
                      "row_v": row_v, "suffix": req.prompt[start:]}
+        return True
 
     def _advance_admission(self, free: list) -> None:
         """One dispatch of the in-progress admission: the next C-token
@@ -986,16 +1232,42 @@ class ContinuousBatchingEngine:
         row_k, row_v, tok, lp0 = self._prefill(
             self.params, jnp.asarray(padded), jnp.int32(start),
             row_k, row_v, jnp.int32(len(suffix)), sub)
-        if self.kv_cache is not None:
-            # store at PREFILL time: the next shared-prefix request hits
-            # while this one is still decoding.  Columns [0, plen) are
-            # exact (prefix load + suffix prefill); only full blocks
-            # inside them are cached.
-            self.kv_cache.store(req.prompt, row_k, row_v)
-        self._ck, self._cv, self._lengths, self._last_tok = self._admit(
-            self._ck, self._cv, row_k, row_v, jnp.int32(slot),
-            self._lengths, self._last_tok, jnp.int32(plen),
-            tok.astype(jnp.int32))
+        if self.kv_layout == "paged":
+            st = req._pkv
+            # scatter the prefilled row into the request's OWN pages
+            # (device-to-device, zero D2H): the matched-prefix entries
+            # are sentineled out — those pages are tree-owned and
+            # immutable (prepare_kv_chunk's write contract)
+            wtable = st["table"].copy()
+            wtable[:st["n_pref"]] = self._page_sentinel
+            self._pk, self._pv = self._write_row(
+                self._pk, self._pv, row_k, row_v, jnp.asarray(wtable))
+            # store at PREFILL time, by ADOPTION: the tree takes
+            # ownership of the full-prompt pages it was missing — the
+            # next shared-prefix request block-table-references the
+            # very same pages this one decodes against
+            if plen // self.kv_cache.block_tokens >= 1:
+                adopted, store_lease = self.kv_cache.store_shared(
+                    req.prompt,
+                    st["table"][:plen // self.kv_cache.block_tokens])
+                st["adopted"] = adopted
+                st["store_lease"] = store_lease
+            self._tables[slot] = st["table"]
+            self._lengths, self._last_tok = self._set_slot_state(
+                self._lengths, self._last_tok, jnp.int32(slot),
+                jnp.int32(plen), tok.astype(jnp.int32))
+        else:
+            if self.kv_cache is not None:
+                # store at PREFILL time: the next shared-prefix request
+                # hits while this one is still decoding.  Columns
+                # [0, plen) are exact (prefix load + suffix prefill);
+                # only full blocks inside them are cached.
+                self.kv_cache.store(req.prompt, row_k, row_v)
+            (self._ck, self._cv, self._lengths,
+             self._last_tok) = self._admit(
+                self._ck, self._cv, row_k, row_v, jnp.int32(slot),
+                self._lengths, self._last_tok, jnp.int32(plen),
+                tok.astype(jnp.int32))
         if self._spec_step is not None:
             # draft-side slot row: always the FULL prompt (prefix reuse
             # applies to the target cache only; the draft is cheap)
@@ -1074,13 +1346,24 @@ class ContinuousBatchingEngine:
             req.stream.put(None)
             req.done.set()
             self._slots[slot] = None
+            if self.kv_layout == "paged":
+                # completion frees the pages: pins released, private
+                # non-adopted pages back to the pool, the slot's table
+                # row sentineled so post-finish stale writes drop
+                self._release_request_kv(req)
+                self._tables[slot] = self._page_sentinel
             self._flight.record("batch_done", slot=slot,
                                 tokens=len(req.tokens),
                                 reason="eos" if hit_eos else "length")
 
-    @staticmethod
-    def _fail_request(req: Request, err: Optional[BaseException]):
-        """Finish a request (with an error, or cleanly for err=None)."""
+    def _fail_request(self, req: Request, err: Optional[BaseException]):
+        """Finish a request (with an error, or cleanly for err=None).
+        Releases any paged pages/pins the request reserved — cancel,
+        failure, and the shutdown drain all reach KV cleanup through
+        here (the slot's table row is reset by the callers that own
+        one)."""
+        if self.kv_layout == "paged":
+            self._release_request_kv(req)
         req.error = err
         req.stream.put(None)
         req.done.set()
@@ -1096,6 +1379,8 @@ class ContinuousBatchingEngine:
             if req is not None:
                 self._fail_request(req, err)
                 self._slots[i] = None
+                if self.kv_layout == "paged":
+                    self._tables[i] = self._page_sentinel
         if self._adm is not None:
             self._fail_request(self._adm["req"], err)
             self._adm = None
@@ -1117,6 +1402,8 @@ class ContinuousBatchingEngine:
             if req is not None and req.cancelled:
                 self._fail_request(req, None)
                 self._slots[i] = None
+                if self.kv_layout == "paged":
+                    self._tables[i] = self._page_sentinel
 
     def _step_active(self, rounds: int) -> None:
         """Run ``rounds`` lockstep decode steps (plain mode) or
@@ -1145,20 +1432,35 @@ class ContinuousBatchingEngine:
             for r in range(rounds):
                 self._drain_spec_blocks(em_np[r], ns_np[r])
         elif rounds > 1:
-            (self._ck, self._cv, self._lengths, tok,
-             blocks, lps) = self._multi_step(
-                self.params, self._ck, self._cv, self._lengths,
-                self._last_tok, jnp.asarray(active_mask), sub,
-                rounds)
+            if self.kv_layout == "paged":
+                (self._pk, self._pv, self._lengths, tok,
+                 blocks, lps) = self._paged_multi_step(
+                    self.params, self._pk, self._pv,
+                    jnp.asarray(self._tables), self._lengths,
+                    self._last_tok, jnp.asarray(active_mask), sub,
+                    rounds)
+            else:
+                (self._ck, self._cv, self._lengths, tok,
+                 blocks, lps) = self._multi_step(
+                    self.params, self._ck, self._cv, self._lengths,
+                    self._last_tok, jnp.asarray(active_mask), sub,
+                    rounds)
             self._last_tok = tok
             self._step_count += rounds
             self._record_row_blocks(
                 np.asarray(blocks), np.full(len(self._slots), rounds),
                 np.asarray(lps))
         else:
-            self._ck, self._cv, self._lengths, tok, lp = self._step(
-                self.params, self._ck, self._cv, self._lengths,
-                self._last_tok, jnp.asarray(active_mask), sub)
+            if self.kv_layout == "paged":
+                (self._pk, self._pv, self._lengths, tok,
+                 lp) = self._paged_step(
+                    self.params, self._pk, self._pv,
+                    jnp.asarray(self._tables), self._lengths,
+                    self._last_tok, jnp.asarray(active_mask), sub)
+            else:
+                self._ck, self._cv, self._lengths, tok, lp = self._step(
+                    self.params, self._ck, self._cv, self._lengths,
+                    self._last_tok, jnp.asarray(active_mask), sub)
             self._last_tok = tok
             tok_np, lp_np = np.asarray(tok), np.asarray(lp)
             self._step_count += 1
@@ -1224,12 +1526,22 @@ class ContinuousBatchingEngine:
                     self._fail_request(req, None)
                 elif self._needs_stream(req):
                     if self._adm is None:
-                        self._start_admission(req)  # consumes no slot
+                        # consumes no slot; False = paged pool full now,
+                        # wait for a completion to free pages
+                        if not self._start_admission(req):
+                            still.append(req)
                     else:
                         still.append(req)  # one stream at a time
                 elif free:
+                    slot = free.pop(0)
                     try:
-                        self._admit_request(free.pop(0), req)
+                        self._admit_request(slot, req)
+                    except _BlocksExhausted:
+                        # give the slot back: a later pending request
+                        # whose pages ARE available must take it this
+                        # pass (serviceable requests pass blocked ones)
+                        free.insert(0, slot)
+                        still.append(req)  # retry when pages free up
                     except BaseException as e:  # surface to the waiter
                         self._fail_request(req, e)
                 else:

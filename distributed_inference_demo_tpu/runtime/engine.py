@@ -213,9 +213,16 @@ class InferenceEngine:
                  prefill_chunk: Optional[int] = None,
                  mesh=None,
                  kv_cache_blocks: Optional[int] = None,
-                 kv_block_tokens: Optional[int] = None):
+                 kv_block_tokens: Optional[int] = None,
+                 kv_layout: Optional[str] = None):
         """``attn_backend``: "auto" (Pallas flash kernel on TPU, jnp
         elsewhere), "flash", "flash-interpret" (testing), or "jnp".
+
+        ``kv_layout``: "dense" only.  The paged block pool
+        (docs/DESIGN.md §11) is plumbed for the continuous-batching
+        decode path; this engine rejects "paged" (flag or
+        ``DWT_KV_LAYOUT`` env) explicitly rather than silently decoding
+        dense rows under a knob that promises paged HBM accounting.
 
         ``mesh``: a ``jax.sharding.Mesh`` with a ``tp`` axis — every
         forward then runs inside a shard_map with Megatron-sliced weights
@@ -253,6 +260,10 @@ class InferenceEngine:
         back.  ``None`` defers to ``DWT_KVCACHE_*`` env knobs; default
         off (0) — the continuous-batching engine is the default-on
         consumer."""
+        from .kvcache import require_dense_kv_layout
+        require_dense_kv_layout(
+            "InferenceEngine (the single-request engines decode dense "
+            "cache rows)", kv_layout)
         self.cfg = cfg
         self.params = params
         self.max_seq = max_seq or cfg.max_seq_len

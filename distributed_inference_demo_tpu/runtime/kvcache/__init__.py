@@ -1,15 +1,62 @@
-"""Block-level KV cache with radix-tree prefix sharing (docs/DESIGN.md §10).
+"""Block-level KV cache with radix-tree prefix sharing (docs/DESIGN.md
+§10 dense layout, §11 paged layout).
 
 The single prefix-reuse path for the serving stack: the continuous-
 batching scheduler, the plain ``InferenceEngine`` generate paths, and
-the speculative target engine all match and store through one
-:class:`KVCacheManager`.  See ``manager.py`` for the contract.
+the speculative target engine all match and store through one manager.
+Two layouts share the radix tree and the block granularity:
+
+- **dense** (:class:`KVCacheManager`): host numpy block pool; hits pay
+  one H2D load into the engine's dense cache rows, stores one D2H
+  slice.  Every engine supports it.
+- **paged** (:class:`~.paged.PagedKVCacheManager`): the blocks live on
+  device in the engine's page pool and the manager keeps ids only —
+  hits are block-table references (zero H2D), stores are ownership
+  adoptions (zero copy).  Plumbed for the continuous-batching decode
+  path; everything else must reject it (``require_dense_kv_layout``),
+  never silently fall back.
+
+Layout selection: the ``kv_layout`` engine kwarg / ``--kv-layout`` flag
+over the ``DWT_KV_LAYOUT`` env knob over the default ``dense``.
 """
+
+import os
 
 from .manager import (DEFAULT_BLOCK_TOKENS, KVCacheManager, KVLease,
                       resolve_kvcache_config)
+from .paged import PagedBlockLease, PagedKVCacheManager
 from .pool import KVBlockPool
 from .radix import RadixTree
 
-__all__ = ["KVBlockPool", "KVCacheManager", "KVLease", "RadixTree",
-           "resolve_kvcache_config", "DEFAULT_BLOCK_TOKENS"]
+KV_LAYOUTS = ("dense", "paged")
+
+
+def resolve_kv_layout(kv_layout=None) -> str:
+    """``kv_layout`` arg over ``DWT_KV_LAYOUT`` env over "dense"."""
+    layout = kv_layout or os.environ.get("DWT_KV_LAYOUT", "") or "dense"
+    if layout not in KV_LAYOUTS:
+        raise ValueError(
+            f"unknown kv layout {layout!r}; expected one of {KV_LAYOUTS}")
+    return layout
+
+
+def require_dense_kv_layout(mode: str, kv_layout=None) -> str:
+    """Resolve the layout for a mode with no paged plumbing: honors
+    "dense", raises on "paged" — an env knob or flag asking for the
+    paged pool must fail loudly, never be silently ignored (the caller
+    would believe HBM reservations shrank when they did not)."""
+    layout = resolve_kv_layout(kv_layout)
+    if layout == "paged":
+        raise ValueError(
+            f"kv layout 'paged' is not supported by {mode}; the paged "
+            "block pool is plumbed for the continuous-batching decode "
+            "path only (--batch-slots without a speculative proposer). "
+            "Use the dense layout here, or serve via --batch-slots.")
+    return layout
+
+
+__all__ = ["KVBlockPool", "KVCacheManager", "KVLease",
+           "PagedBlockLease", "PagedKVCacheManager", "RadixTree",
+           "resolve_kvcache_config", "resolve_kv_layout",
+           "require_dense_kv_layout", "DEFAULT_BLOCK_TOKENS",
+           "KV_LAYOUTS"]

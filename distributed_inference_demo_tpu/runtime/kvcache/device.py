@@ -33,3 +33,50 @@ def seed_prefix_cache(ck, cv, pk, pv):
     idx = (zero, zero, zero, zero, zero)
     return (jax.lax.dynamic_update_slice(ck, pk.astype(ck.dtype), idx),
             jax.lax.dynamic_update_slice(cv, pv.astype(cv.dtype), idx))
+
+
+# ---------------------------------------------------------------------------
+# paged layout (docs/DESIGN.md §11): the row <-> pages seam.  Both
+# programs are device-to-device — the paged cache's whole point is that
+# neither a prefix hit nor a store crosses the host boundary.
+
+
+@jax.jit
+def seed_row_from_pages(pk, pv, table):
+    """Gather one slot's block table out of the page pool into a dense
+    prefill row: pages ``[L, N, H, bt, D]`` + table ``[W]`` ->
+    row ``[L, 1, H, W*bt, D]``.
+
+    The WHOLE table gathers in one compiled shape regardless of how many
+    entries are real: sentinel entries (>= N) clamp to some page and the
+    gathered garbage sits at columns past the matched prefix, which the
+    suffix prefill / decode rewrite before any query attends them
+    (stale-slot invariant) — garbage is finite (pool pages always hold
+    finite values), so the causal mask zeroes it exactly."""
+    L, N, H, bt, D = pk.shape
+    W = table.shape[0]
+    safe = jnp.clip(table, 0, N - 1)
+    rk = jnp.take(pk, safe, axis=1)          # [L, W, H, bt, D]
+    rv = jnp.take(pv, safe, axis=1)
+    rk = rk.transpose(0, 2, 1, 3, 4).reshape(L, 1, H, W * bt, D)
+    rv = rv.transpose(0, 2, 1, 3, 4).reshape(L, 1, H, W * bt, D)
+    return rk, rv
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def write_row_to_pages(pk, pv, row_k, row_v, table):
+    """Scatter a prefilled dense row ``[L, 1, H, W*bt, D]`` into the page
+    pool at ``table``'s ids — the paged store: blocks land in place on
+    device, zero D2H.  Sentinel entries (>= N) DROP their block — the
+    caller sentinels the matched-prefix slots (those pages are tree-owned
+    and immutable) and the unallocated tail; everything written is a page
+    this request owns.  Write contract: ops.attention.prepare_kv_chunk
+    (blocks past the prompt length hold garbage until decode rewrites
+    them — the stale-slot invariant, block-shaped)."""
+    L, N, H, bt, D = pk.shape
+    W = table.shape[0]
+    rk = row_k[:, 0].reshape(L, H, W, bt, D).transpose(0, 2, 1, 3, 4)
+    rv = row_v[:, 0].reshape(L, H, W, bt, D).transpose(0, 2, 1, 3, 4)
+    pk = pk.at[:, table].set(rk.astype(pk.dtype), mode="drop")
+    pv = pv.at[:, table].set(rv.astype(pv.dtype), mode="drop")
+    return pk, pv

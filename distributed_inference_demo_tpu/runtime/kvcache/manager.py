@@ -90,11 +90,17 @@ class KVLease:
         self._released = False
 
     def gather(self):
-        """Host ``[L, H, tokens, D]`` K/V run for the matched blocks."""
+        """Host ``[L, H, tokens, D]`` K/V run for the matched blocks.
+        The copy-out is destined for a device cache row, so the bytes
+        count toward ``h2d_bytes`` (the dense layout's per-hit H2D cost
+        the paged layout exists to delete)."""
         if self._released:
             raise RuntimeError("gather on a released lease")
         k, v = self._mgr.pool.gather(self.block_ids)
-        return k[:, :, :self.tokens], v[:, :, :self.tokens]
+        k, v = k[:, :, :self.tokens], v[:, :, :self.tokens]
+        with self._mgr._lock:
+            self._mgr.stats["h2d_bytes"] += k.nbytes + v.nbytes
+        return k, v
 
     def release(self) -> None:
         if not self._released:
@@ -134,7 +140,7 @@ class KVCacheManager:
         self.epoch = 0
         self.stats = {"hits": 0, "misses": 0, "partial_hit_tokens": 0,
                       "stores": 0, "stored_blocks": 0,
-                      "evicted_blocks": 0}
+                      "evicted_blocks": 0, "h2d_bytes": 0}
         self._flight = get_flight_recorder()
 
     @classmethod
@@ -282,10 +288,12 @@ class KVCacheManager:
         catalog bridge."""
         with self._lock:
             return dict(self.stats,
+                        layout="dense",
                         block_tokens=self.block_tokens,
                         blocks_total=self.pool.num_blocks,
                         blocks_used=self.pool.used_blocks,
                         resident_bytes=self.pool.resident_bytes,
+                        device_resident_bytes=0,   # host pool: see paged.py
                         capacity_bytes=self.pool.capacity_bytes,
                         nodes=self.tree.node_count - 1)   # excl. root
 

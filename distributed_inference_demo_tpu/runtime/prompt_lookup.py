@@ -104,6 +104,10 @@ class PromptLookupEngine:
         buffer is host-seeded from the ids and unaffected)."""
         if num_draft < 1:
             raise ValueError("num_draft must be >= 1")
+        from .kvcache import require_dense_kv_layout
+        require_dense_kv_layout(
+            "PromptLookupEngine (the n-gram verify rollback decodes "
+            "dense cache rows)")
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.sampling = sampling

@@ -52,6 +52,10 @@ class SequenceParallelBackend:
         if strategy not in STRATEGIES:
             raise ValueError(f"unknown sp strategy {strategy!r}; "
                              f"known: {STRATEGIES}")
+        from .kvcache import require_dense_kv_layout
+        require_dense_kv_layout(
+            "the sequence-parallel backend (its cache is sequence-"
+            "sharded across chips, not paged)")
         self.cfg = cfg
         self.params = params
         self.mesh = mesh

@@ -295,6 +295,10 @@ class SpeculativeEngine:
                 "token space")
         if num_draft < 1:
             raise ValueError("num_draft must be >= 1")
+        from .kvcache import require_dense_kv_layout
+        require_dense_kv_layout(
+            "SpeculativeEngine (the draft/verify rollback decodes dense "
+            "cache rows)")
         self.cfg, self.params = cfg, params
         self.draft_cfg, self.draft_params = draft_cfg, draft_params
         self.max_seq = max_seq or cfg.max_seq_len

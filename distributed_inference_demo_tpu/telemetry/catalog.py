@@ -157,11 +157,26 @@ KVCACHE_CAPACITY_BYTES = gauge(
     "Preallocated byte budget of the KV block pool")
 KVCACHE_USED_BLOCKS = gauge(
     "dwt_kvcache_used_blocks",
-    "KV blocks currently referenced by the radix tree")
+    "KV blocks currently referenced by the radix tree (the prefix "
+    "cache's share; compare dwt_kvcache_blocks_in_use for all owners)")
 KVCACHE_NODES = gauge(
     "dwt_kvcache_tree_nodes",
     "Radix-tree nodes (excluding the root): distinct shared-prefix "
     "branch points plus leaves")
+KVCACHE_DEVICE_RESIDENT_BYTES = gauge(
+    "dwt_kvcache_device_resident_bytes",
+    "Device HBM held by in-use KV blocks (paged layout: pages allocated "
+    "to block tables or the radix tree; 0 on the host-pool dense "
+    "layout)")
+KVCACHE_BLOCKS_IN_USE = gauge(
+    "dwt_kvcache_blocks_in_use",
+    "KV blocks currently allocated, all owners: radix-tree cache plus "
+    "(paged layout) in-flight requests' private blocks")
+KVCACHE_H2D_BYTES = counter(
+    "dwt_kvcache_h2d_bytes_total",
+    "Bytes copied host-to-device to seed caches from prefix hits "
+    "(dense layout's per-hit gather; stays 0 on the paged path, where "
+    "hits are device block-table references)")
 
 
 def update_kvcache_series(kv: dict) -> None:
@@ -176,8 +191,18 @@ def update_kvcache_series(kv: dict) -> None:
     KVCACHE_EVICTED_BLOCKS.set_cumulative(kv.get("evicted_blocks", 0))
     KVCACHE_RESIDENT_BYTES.set(kv.get("resident_bytes", 0))
     KVCACHE_CAPACITY_BYTES.set(kv.get("capacity_bytes", 0))
-    KVCACHE_USED_BLOCKS.set(kv.get("blocks_used", 0))
+    # used_blocks = the TREE's share (dense snapshots lack tree_blocks
+    # because there blocks_used IS tree-owned); blocks_in_use = all
+    # owners.  The gap between the two gauges is in-flight requests'
+    # private pages — the §11 runbook's leak alert (blocks_in_use >
+    # used_blocks while idle) depends on them being bridged from
+    # DIFFERENT snapshot keys on the paged layout.
+    KVCACHE_USED_BLOCKS.set(kv.get("tree_blocks",
+                                   kv.get("blocks_used", 0)))
     KVCACHE_NODES.set(kv.get("nodes", 0))
+    KVCACHE_DEVICE_RESIDENT_BYTES.set(kv.get("device_resident_bytes", 0))
+    KVCACHE_BLOCKS_IN_USE.set(kv.get("blocks_used", 0))
+    KVCACHE_H2D_BYTES.set_cumulative(kv.get("h2d_bytes", 0))
     PREFIX_HITS.set_cumulative(kv.get("hits", 0))
     PREFIX_MISSES.set_cumulative(kv.get("misses", 0))
     PREFIX_REUSED.set_cumulative(kv.get("partial_hit_tokens", 0))

@@ -48,6 +48,33 @@ def test_leg_multimodal_structure_tiny():
     assert e2e["image_tokens"] == enc["patches_per_image"]
 
 
+def test_leg_paged_decode_structure_tiny():
+    """The paged_decode leg's full structure (dense run, paged run,
+    primed phase) at CPU-viable scale — proves the leg before it can
+    burn a TPU session attempt, and pins the leg-level acceptance
+    shape: both HBM numbers present, h2d_bytes == 0 on the primed
+    paged path."""
+    out = bench._leg_paged_decode("llama-test", 6, slots=2,
+                                  prompt_len=16, max_seq=64,
+                                  block_tokens=8, n_req=4,
+                                  shared_len=8)
+    assert "error" not in out
+    assert out["dense"]["tokens_per_sec"] > 0
+    assert out["paged"]["tokens_per_sec"] > 0
+    assert out["paged_vs_dense_decode"] > 0
+    # the HBM story: reserved (dense) vs actually allocated (paged)
+    assert out["dense"]["cache_reserved_bytes"] > 0
+    assert 0 < out["paged"]["peak_blocks_in_use"] <= out["paged"][
+        "pool_blocks"]
+    assert (out["paged"]["peak_bytes_in_use"]
+            < out["dense"]["cache_reserved_bytes"])
+    # primed wave: radix hits reference device pages, zero H2D
+    primed = out["paged_primed"]
+    assert primed["hit_rate"] == 1.0
+    assert primed["reused_tokens"] >= 4 * 8
+    assert primed["h2d_bytes"] == 0
+
+
 def test_leg_prefix_reuse_structure_tiny():
     """The prefix_reuse leg's full structure (cache-off run, cache-on
     run, hit/reuse/saved report) at CPU-viable scale — the dryrun that

@@ -37,6 +37,14 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
          "info", "events", "bundles", "blocks", "nodes"}
 
+# exact names exempted from the unit-suffix rule — each entry is a
+# deliberate, documented exception (NOT a new unit: adding a pseudo-unit
+# would let every future misnamed series ending the same way slip
+# through).  dwt_kvcache_blocks_in_use carries its unit (blocks) mid-
+# name; it pairs with dwt_kvcache_used_blocks as the all-owners gauge
+# (docs/DESIGN.md §11 runbook).
+UNIT_SUFFIX_EXEMPT = {"dwt_kvcache_blocks_in_use"}
+
 # series the catalog must always register (regressions here would blind
 # the flight-recorder/anomaly layer silently — a scrape with the series
 # simply absent looks exactly like a healthy quiet system).  The
@@ -56,6 +64,12 @@ REQUIRED_SERIES = {
     "dwt_kvcache_evicted_blocks_total",
     "dwt_kvcache_resident_bytes",
     "dwt_kvcache_tree_nodes",
+    # the paged-layout triple (docs/DESIGN.md §11): device residency and
+    # the h2d counter whose staying-at-zero IS the paged path's claim —
+    # their absence would make "zero-copy prefix hits" unverifiable
+    "dwt_kvcache_device_resident_bytes",
+    "dwt_kvcache_blocks_in_use",
+    "dwt_kvcache_h2d_bytes_total",
 }
 
 
@@ -86,7 +100,8 @@ def check_registry(registry) -> List[str]:
         # unit may be one or two tokens (bytes_per_second)
         unit1 = stripped[-1]
         unit3 = "_".join(stripped[-3:]) if len(stripped) >= 3 else ""
-        if unit1 not in UNITS and unit3 not in UNITS:
+        if (unit1 not in UNITS and unit3 not in UNITS
+                and name not in UNIT_SUFFIX_EXEMPT):
             problems.append(
                 f"{name}: missing unit suffix (allowed: {sorted(UNITS)})")
     return problems

@@ -47,6 +47,8 @@ LEG_BUDGETS = {
     "long_context": 1800,
     "flagship_int8": 2400,
     "batching": 2400,
+    "prefix_reuse": 1800,
+    "paged_decode": 1800,
     "sweep": 1800,
     "flagship_bf16": 2400,
     "pipeline": 1500,
