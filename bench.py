@@ -1331,6 +1331,147 @@ def _leg_multimodal(batch: int, new_tokens: int,
             "e2e_image_text_generate": e2e}
 
 
+def _leg_fault_recovery(model: str, new_tokens: int = 24,
+                        prompt_len: int = 8, max_seq: int = 64,
+                        crash_after_msgs: int = 6,
+                        num_stages: int = 3) -> dict:
+    """Elastic recovery under an injected worker crash (comm/faults):
+    a 3-stage loopback pipeline loses its middle stage mid-generation
+    via a seeded ``crash_after`` fault plan; the leg measures the
+    recovery path end to end — reshard latency, time from crash to the
+    first post-recovery token, and the token streams' bit-identity with
+    a fault-free run (the §12 chaos invariant, timed).
+
+    Loopback on purpose: the number under test is the FRAMEWORK's
+    detect→reshard→drain/resume cost, not socket noise; it is the same
+    path a socket deployment runs (tests/test_chaos.py drives it under
+    messier plans)."""
+    import threading
+
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.comm.faults import (
+        FaultPlan, FaultRule, FaultyTransport, InjectedCrash)
+    from distributed_inference_demo_tpu.comm.transport import (
+        LoopbackNetwork, LoopbackTransport)
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.base import split_layer_ranges
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.elastic import (
+        ElasticHeader, ElasticStageRuntime, ElasticWorker)
+
+    cfg = get_model_config(model)
+    full = init_full_params(jax.random.PRNGKey(0), cfg)
+    specs = split_layer_ranges(cfg.num_layers, num_stages)
+    greedy = SamplingParams(greedy=True)
+    prompt = (np.arange(prompt_len)[None, :] % 97).astype(np.int32)
+    ids = [f"s{i}" for i in range(num_stages)]
+
+    def build(plan):
+        net = LoopbackNetwork()
+        transports = [LoopbackTransport(d, net) for d in ids]
+        if plan is not None:
+            # the crash plan wraps the MIDDLE stage's transport: the
+            # n_msgs-th message through it raises InjectedCrash and the
+            # serve thread dies like a real worker crash
+            transports[1] = FaultyTransport(transports[1], plan)
+        header = ElasticHeader(
+            ElasticStageRuntime(cfg, specs[0], full, max_seq, greedy),
+            transports[0], chain=list(ids), step_timeout=60,
+            poll_interval=0.05)
+        workers = [
+            ElasticWorker(
+                ElasticStageRuntime(cfg, specs[i], full, max_seq, greedy),
+                transports[i],
+                next_id=ids[i + 1] if i + 1 < num_stages else None,
+                header_id=ids[0], step_timeout=60)
+            for i in range(1, num_stages)]
+        threads = []
+        for w in workers:
+            def serve(w=w):
+                try:
+                    w.serve_forever(30)
+                except InjectedCrash:
+                    pass          # the injected death IS the scenario
+            t = threading.Thread(target=serve, daemon=True)
+            t.start()
+            threads.append(t)
+        return header, workers, threads
+
+    # -- fault-free reference run (also the compile warmup) ----------------
+    header, _, threads = build(None)
+    header.generate(prompt, 4)               # compile
+    t0 = time.perf_counter()
+    want = header.generate(prompt, new_tokens)
+    clean_dt = time.perf_counter() - t0
+    header.shutdown_pipeline()
+    for t in threads:
+        t.join(timeout=30)
+
+    # -- chaos run: s1 crashes after crash_after_msgs messages -------------
+    plan = FaultPlan(seed=1234, rules=[
+        FaultRule(kind="crash_after", n_msgs=crash_after_msgs)])
+    header, workers, threads = build(plan)
+    token_times = []
+    t_crash = [None]
+    t_signal = [None]
+    reshard_s = [None]
+    orig_reshard = header.reshard
+
+    def timed_reshard(chain, in_flight=None, dead=()):
+        r0 = time.perf_counter()
+        orig_reshard(chain, in_flight, dead=dead)
+        reshard_s[0] = time.perf_counter() - r0
+    header.reshard = timed_reshard
+
+    def supervise():
+        # stands in for the heartbeat sweeper: the dead serve thread IS
+        # the missed heartbeat (test_elastic wires the real sweeper)
+        threads[0].join()
+        t_crash[0] = time.perf_counter()
+        header.signal_failure(ids[1])
+        t_signal[0] = time.perf_counter()
+    sup = threading.Thread(target=supervise, daemon=True)
+    sup.start()
+
+    t0 = time.perf_counter()
+    got = header.generate_many(
+        [prompt], new_tokens,
+        on_token=lambda i, step, toks: token_times.append(
+            (step, time.perf_counter())))[0]
+    chaos_dt = time.perf_counter() - t0
+    header.shutdown_pipeline()
+    for t in threads[1:]:
+        t.join(timeout=30)
+    sup.join(timeout=30)
+
+    identical = bool(np.array_equal(got, want))
+    post = [ts for _, ts in token_times
+            if t_crash[0] is not None and ts > t_crash[0]]
+    recovery_s = (post[0] - t_crash[0]
+                  if post and t_crash[0] is not None else None)
+    tokens_after = len(post)
+    return {
+        "model": model, "num_stages": num_stages,
+        "new_tokens": new_tokens, "crash_after_msgs": crash_after_msgs,
+        "plan_seed": plan.seed,
+        "injected_events": [e["kind"] for e in plan.events],
+        "tokens_bit_identical_after_recovery": identical,
+        "clean_seconds": round(clean_dt, 3),
+        "chaos_seconds": round(chaos_dt, 3),
+        "reshard_seconds": (round(reshard_s[0], 4)
+                            if reshard_s[0] is not None else None),
+        "crash_to_first_token_seconds": (round(recovery_s, 4)
+                                         if recovery_s is not None
+                                         else None),
+        "tokens_to_recovery": (new_tokens - tokens_after
+                               if t_crash[0] is not None else None),
+        "recovery_overhead_seconds": round(chaos_dt - clean_dt, 3),
+        "surviving_chain": list(header.chain),
+    }
+
+
 # ---------------------------------------------------------------------------
 
 def run_leg(name: str, p: dict) -> dict:
@@ -1365,6 +1506,8 @@ def run_leg(name: str, p: dict) -> dict:
         elif name == "pipeline":
             out = _leg_pipeline(model, batch, prompt_len,
                                 min(new_tokens, 32))
+        elif name == "fault_recovery":
+            out = _leg_fault_recovery(model)
         elif name == "planner_pipeline":
             out = _leg_planner_pipeline(model, batch, prompt_len,
                                         min(new_tokens, 8))
@@ -1591,11 +1734,12 @@ def main() -> None:
             "headline_int8", "speculative", "prompt_lookup",
             "planner_pipeline", "long_context", "flagship_int8",
             "batching", "prefix_reuse", "paged_decode", "sweep",
-            "flagship_bf16", "pipeline", "prefill_long", "moe",
-            "multimodal", "int4"]
+            "flagship_bf16", "pipeline", "fault_recovery", "prefill_long",
+            "moe", "multimodal", "int4"]
     for skip_var, leg_names in (
             ("BENCH_SKIP_FLAGSHIP", ["flagship_int8", "flagship_bf16"]),
-            ("BENCH_SKIP_PIPELINE", ["pipeline", "planner_pipeline"]),
+            ("BENCH_SKIP_PIPELINE", ["pipeline", "planner_pipeline",
+                                     "fault_recovery"]),
             ("BENCH_SKIP_SWEEP", ["sweep"]),
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
                                     "batching", "prefix_reuse",

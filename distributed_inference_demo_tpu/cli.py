@@ -280,6 +280,21 @@ def cmd_serve(args) -> int:
               file=sys.stderr)
         return 1
 
+    # chaos fault plan: resolved EARLY so a leaked DWT_FAULT_PLAN env var
+    # kills the process at startup instead of silently injecting faults
+    from .comm.faults import FaultConfigError, load_fault_plan, maybe_wrap
+    try:
+        fault_plan = load_fault_plan(getattr(args, "fault_plan", ""),
+                                     getattr(args, "chaos", False))
+    except FaultConfigError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    if fault_plan is not None and not args.chain:
+        print("--fault-plan applies to the data-plane transport and "
+              "requires --chain (single-process engine modes have no "
+              "transport to fault)", file=sys.stderr)
+        return 1
+
     tokenizer = _load_tokenizer(args.tokenizer)
 
     if args.chain:
@@ -310,8 +325,9 @@ def cmd_serve(args) -> int:
         peers = [p.split("@", 1) for p in args.chain.split(",")]
         chain = [args.device_id] + [pid for pid, _ in peers]
         specs = split_layer_ranges(cfg.num_layers, len(chain))
-        transport = ZmqTransport(args.device_id, bind_host=args.bind_host,
-                                 port=args.port)
+        transport = maybe_wrap(
+            ZmqTransport(args.device_id, bind_host=args.bind_host,
+                         port=args.port), fault_plan)
         for pid, addr in peers:
             transport.connect(pid, addr)
         # the header's own stage honors --kv-cache-dtype; chain workers
@@ -474,6 +490,7 @@ def cmd_serve(args) -> int:
             decode_block=args.decode_block,
             prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
             kv_layout=getattr(args, "kv_layout", None),
+            max_queue_depth=getattr(args, "admission_queue_depth", 0),
             **_kvcache_from_args(args))
         kvc = backend.kv_cache
         kv_desc = "off" if kvc is None else (
@@ -507,7 +524,9 @@ def cmd_serve(args) -> int:
     server = InferenceHTTPServer(backend, host=args.http_host,
                                  port=args.http_port, tokenizer=tokenizer,
                                  model_name=args.model,
-                                 default_max_new=args.max_new_tokens)
+                                 default_max_new=args.max_new_tokens,
+                                 request_timeout=getattr(
+                                     args, "request_timeout", 0.0) or None)
     print(f"HTTP_READY http://{server.host}:{server.port}", flush=True)
     try:
         server.serve_forever()
@@ -630,7 +649,18 @@ def cmd_worker(args) -> int:
     ap.add_argument("--kv-cache-dtype", default="",
                     help="reduced-precision KV cache storage for this "
                          "stage, e.g. float8_e4m3fn")
+    ap.add_argument("--fault-plan", default="",
+                    help="CHAOS TESTING ONLY: JSON fault-plan spec "
+                         "(path or inline); requires --chaos")
+    ap.add_argument("--chaos", action="store_true")
     a = ap.parse_args(args.rest)
+
+    from .comm.faults import FaultConfigError, load_fault_plan, maybe_wrap
+    try:
+        fault_plan = load_fault_plan(a.fault_plan, a.chaos)
+    except FaultConfigError as e:
+        print(str(e), file=sys.stderr)
+        return 1
 
     cfg = get_model_config(a.model)
     full = init_full_params(jax.random.PRNGKey(a.weights_seed), cfg)
@@ -643,7 +673,9 @@ def cmd_worker(args) -> int:
     rt = ElasticStageRuntime(cfg, spec, full, a.max_seq, sampling,
                              mesh=local_tp_mesh(a.tp),
                              kv_cache_dtype=a.kv_cache_dtype or None)
-    transport = ZmqTransport(a.device_id, bind_host=a.bind_host, port=a.port)
+    transport = maybe_wrap(
+        ZmqTransport(a.device_id, bind_host=a.bind_host, port=a.port),
+        fault_plan)
     next_id = None
     if a.next:
         next_id, next_addr = a.next.split("@", 1)
@@ -1216,6 +1248,26 @@ def main(argv=None) -> int:
                         "+ metrics + trace + run-log tail) here on "
                         "anomaly/stall/crash; equivalent to "
                         "DWT_POSTMORTEM_DIR (docs/DESIGN.md §8)")
+    s.add_argument("--admission-queue-depth", type=int, default=0,
+                   help="with --batch-slots: shed load — when this many "
+                        "requests are already waiting for a slot, "
+                        "/generate answers 503 + Retry-After instead of "
+                        "queueing unboundedly (0 = unbounded; env "
+                        "DWT_MAX_QUEUE_DEPTH)")
+    s.add_argument("--request-timeout", type=float, default=0.0,
+                   help="per-request deadline in seconds for blocking "
+                        "/generate: on expiry the request is CANCELLED "
+                        "(its slot freed) and the client gets 504 "
+                        "instead of a hang (0 = no deadline)")
+    s.add_argument("--fault-plan", default="",
+                   help="CHAOS TESTING ONLY: JSON fault-plan spec (path "
+                        "or inline) injected into the data-plane "
+                        "transport; requires --chaos and --chain "
+                        "(docs/DESIGN.md §12; env DWT_FAULT_PLAN)")
+    s.add_argument("--chaos", action="store_true",
+                   help="explicitly acknowledge fault injection; "
+                        "--fault-plan/DWT_FAULT_PLAN are rejected "
+                        "without it")
     _add_sp_args(s)
     _add_draft_args(s)
     s.set_defaults(fn=cmd_serve)

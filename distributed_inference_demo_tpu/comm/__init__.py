@@ -8,11 +8,13 @@ replacing the reference's ZeroMQ + hand-rolled binary framing
 """
 
 from .wire import (DType, FLAG_TRACE_CONTEXT, TensorMessage,
-                   deserialize_tensors, serialize_tensors,
+                   WireError, WireIntegrityError,
+                   deserialize_tensors, payload_checksum, serialize_tensors,
                    serialize_tensors_traced, split_trace_context,
                    deserialize_token, serialize_token)
 
 __all__ = ["DType", "FLAG_TRACE_CONTEXT", "TensorMessage",
+           "WireError", "WireIntegrityError", "payload_checksum",
            "serialize_tensors", "serialize_tensors_traced",
            "split_trace_context", "deserialize_tensors",
            "serialize_token", "deserialize_token"]

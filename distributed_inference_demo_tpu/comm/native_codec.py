@@ -14,8 +14,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from .wire import (_TO_NP, DType, TensorMessage, WireError,
-                   _np_dtype_to_wire)
+from .wire import (_HEADER, _TO_NP, DType, TensorMessage, WireError,
+                   _np_dtype_to_wire, payload_checksum, verify_checksum)
 
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
@@ -62,7 +62,8 @@ def available() -> bool:
     return _load() is not None
 
 
-def serialize_tensors(arrays: Sequence[np.ndarray], flags: int = 0) -> bytes:
+def serialize_tensors(arrays: Sequence[np.ndarray], flags: int = 0,
+                      checksum: bool = True) -> bytes:
     lib = _load()
     if lib is None:
         raise WireError("native codec not available")
@@ -96,13 +97,27 @@ def serialize_tensors(arrays: Sequence[np.ndarray], flags: int = 0) -> bytes:
         ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)), size)
     if written != size:
         raise WireError(f"native serializer wrote {written}, expected {size}")
-    return out.raw
+    if not checksum:
+        return out.raw
+    # The C codec writes 0 into the header's 16-bit field; the binding
+    # stamps the payload checksum (wire.payload_checksum — the ONE owner
+    # of the math) so native and Python frames stay byte-identical.
+    # zlib.crc32 runs at C speed, so there is no native-side win to chase.
+    import struct as _struct
+    buf = bytearray(out.raw)
+    _struct.pack_into("<H", buf, 6,
+                      payload_checksum(memoryview(buf)[_HEADER.size:]))
+    return bytes(buf)
 
 
 def deserialize_tensors(data: bytes) -> TensorMessage:
     lib = _load()
     if lib is None:
         raise WireError("native codec not available")
+    # Same integrity contract as wire.deserialize_tensors: a nonzero
+    # header checksum is verified BEFORE the C decoder touches any tensor
+    # (WireIntegrityError, never garbage); zero = pre-checksum peer.
+    verify_checksum(data)
     # Zero-copy handoff: c_char_p keeps a reference to `data`; dwt_open makes
     # its own owned copy, so no Python-side staging copy is needed.
     buf = ctypes.cast(ctypes.c_char_p(data),

@@ -14,9 +14,20 @@ SURVEY.md Appendix B (not portable across hosts).  This format fixes that:
 
 Layout (all little-endian):
 
-    header:  magic "DWT1" | version:u8 | flags:u8 | reserved:u16 | ntensors:u32
+    header:  magic "DWT1" | version:u8 | flags:u8 | checksum:u16 | ntensors:u32
     tensor:  dtype:u8 | ndims:u8 | reserved:u16 | nbytes:u64 | dims:u64*ndims
              | raw bytes (C-contiguous)
+
+The message header's 16-bit field (reserved through PR 4) carries an
+integrity checksum over everything after the header: CRC-32 of the
+payload XOR-folded to 16 bits, with 0 remapped to 0xFFFF so the value 0
+unambiguously means "no checksum" — frames from pre-checksum peers (and
+``checksum=False`` senders) decode unchanged, while a corrupt frame
+raises :class:`WireIntegrityError` instead of decoding garbage
+activations into a wrong token.  The fold keeps CRC-32's guarantee for
+single-bit flips and detects random corruption with 1 - 2^-16
+probability; the native codec (``native_codec.py``) reads and writes the
+same field, byte-identically.
 
 Token ids travel as 4-byte little-endian ints (reference
 ``utils.cpp:11-25`` used native-endian).
@@ -30,6 +41,7 @@ from __future__ import annotations
 
 import enum
 import struct
+import zlib
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -98,6 +110,42 @@ class WireError(ValueError):
     """Malformed or incompatible wire payload."""
 
 
+class WireIntegrityError(WireError):
+    """Checksum mismatch: the frame was corrupted in flight.  Receivers
+    treat this as a droppable event (counted + flight-recorded) — the
+    step-timeout/elastic-reshard path recovers, never a wrong token."""
+
+
+def payload_checksum(payload) -> int:  # bytes or memoryview
+    """CRC-32 of ``payload`` XOR-folded to 16 bits, never 0 (0 is the
+    wire's "no checksum" sentinel).  One owner for the math — the native
+    codec binding uses this exact function so both codecs stay
+    byte-identical."""
+    c = zlib.crc32(payload) & 0xFFFFFFFF
+    folded = (c & 0xFFFF) ^ (c >> 16)
+    return folded or 0xFFFF
+
+
+def verify_checksum(data: bytes) -> None:
+    """Raise :class:`WireIntegrityError` when ``data``'s header carries a
+    nonzero checksum that does not match its payload.  Zero-checksum
+    frames (pre-checksum peers) pass — version compat.  Shared by both
+    codecs; structural validation stays the decoder's job."""
+    if len(data) < _HEADER.size:
+        return                     # the decoder's short-message error wins
+    (claimed,) = struct.unpack_from("<H", data, 6)
+    if claimed == 0:
+        return
+    # memoryview: CRC the payload in place — no full-frame copy on the
+    # per-hop receive path
+    actual = payload_checksum(memoryview(data)[_HEADER.size:])
+    if actual != claimed:
+        raise WireIntegrityError(
+            f"wire checksum mismatch: header says 0x{claimed:04x}, "
+            f"payload is 0x{actual:04x} ({len(data)} bytes) — frame "
+            "corrupted in flight")
+
+
 @dataclass
 class TensorMessage:
     """A decoded wire payload: a list of ndarrays plus the header flags."""
@@ -116,15 +164,21 @@ def _np_dtype_to_wire(dt: np.dtype) -> DType:
         raise WireError(f"unsupported dtype for wire: {dt}") from None
 
 
-def serialize_tensors(arrays: Sequence[np.ndarray], flags: int = 0) -> bytes:
+def serialize_tensors(arrays: Sequence[np.ndarray], flags: int = 0,
+                      checksum: bool = True) -> bytes:
     """Encode a sequence of arrays into one wire message.
 
     Counterpart of ``SerializeTensorVectorToBytes`` (``utils.cpp:124-264``),
     including its total-size self-check — here the check is structural
     (we build the buffer piecewise and verify the final length).
+
+    ``checksum=False`` emits the pre-checksum frame (header field 0) —
+    the knob exists for compat tests and for peers that must talk to
+    pre-checksum decoders, not for the hot path (the CRC costs ~1 GB/s-
+    class zlib time, negligible next to serialization itself).
     """
-    parts = [_HEADER.pack(MAGIC, VERSION, flags & 0xFF, 0, len(arrays))]
-    expected = _HEADER.size
+    parts = []
+    expected = 0
     for a in arrays:
         a = np.asarray(a)
         if not a.flags["C_CONTIGUOUS"]:  # 0-d arrays are always contiguous,
@@ -138,10 +192,13 @@ def serialize_tensors(arrays: Sequence[np.ndarray], flags: int = 0) -> bytes:
         parts.append(struct.pack(f"<{a.ndim}Q", *a.shape))
         parts.append(raw)
         expected += _TENSOR_HDR.size + 8 * a.ndim + len(raw)
-    out = b"".join(parts)
-    if len(out) != expected:  # structural self-check (utils.cpp:250-261)
-        raise WireError(f"serializer size mismatch: {len(out)} != {expected}")
-    return out
+    payload = b"".join(parts)
+    if len(payload) != expected:  # structural self-check (utils.cpp:250-261)
+        raise WireError(
+            f"serializer size mismatch: {len(payload)} != {expected}")
+    csum = payload_checksum(payload) if checksum else 0
+    return _HEADER.pack(MAGIC, VERSION, flags & 0xFF, csum,
+                        len(arrays)) + payload
 
 
 def deserialize_tensors(data: bytes) -> TensorMessage:
@@ -149,11 +206,15 @@ def deserialize_tensors(data: bytes) -> TensorMessage:
     ``DeserializeTensorVectorFromBytes`` (``utils.cpp:266-368``)."""
     if len(data) < _HEADER.size:
         raise WireError(f"short message: {len(data)} bytes")
-    magic, version, flags, _rsv, n = _HEADER.unpack_from(data, 0)
+    magic, version, flags, csum, n = _HEADER.unpack_from(data, 0)
     if magic != MAGIC:
         raise WireError(f"bad magic {magic!r}")
     if version != VERSION:
         raise WireError(f"unsupported wire version {version}")
+    if csum:
+        # verified BEFORE any tensor parsing: a corrupt frame must raise
+        # WireIntegrityError, never decode garbage (csum 0 = legacy peer)
+        verify_checksum(data)
     off = _HEADER.size
     out: List[np.ndarray] = []
     for _ in range(n):

@@ -143,7 +143,8 @@ class ContinuousBatchingEngine:
                  prompt_lookup: bool = False,
                  decode_block: int = 1,
                  prefill_chunk: Optional[int] = None,
-                 kv_layout: Optional[str] = None):
+                 kv_layout: Optional[str] = None,
+                 max_queue_depth: Optional[int] = None):
         """``kv_cache_blocks`` / ``kv_block_tokens``: the block-level KV
         cache (``runtime/kvcache``, docs/DESIGN.md §10) — automatic
         prefix reuse at ``kv_block_tokens`` granularity.  A new prompt
@@ -227,7 +228,19 @@ class ContinuousBatchingEngine:
         (default: the dense-equivalent ``B x max_seq/block_tokens``).
         Paged is plumbed for the plain slot decode path only — the
         speculative proposers (draft model / prompt-lookup) and tp
-        meshes reject it explicitly."""
+        meshes reject it explicitly.
+
+        ``max_queue_depth``: overload shedding — when the admission
+        queue (submitted-but-unslotted requests) already holds this
+        many, :meth:`submit` raises
+        :class:`~.overload.SchedulerOverloaded` instead of queueing
+        unboundedly (the HTTP layer maps it to ``503 + Retry-After``).
+        ``None`` defers to ``DWT_MAX_QUEUE_DEPTH``; 0 (the default)
+        keeps the queue unbounded."""
+        if max_queue_depth is None:
+            from ..telemetry._env import env_int
+            max_queue_depth = env_int("DWT_MAX_QUEUE_DEPTH", 0)
+        self.max_queue_depth = max(0, int(max_queue_depth))
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -843,6 +856,17 @@ class ContinuousBatchingEngine:
                     f"{len(prompt)} + new {max_new_tokens} at "
                     f"{bt} tokens/block) but the paged pool holds only "
                     f"{self.kv_cache.num_blocks}; raise kv_cache_blocks")
+        if self.max_queue_depth:
+            depth = self._queue.qsize() + len(self._pending)
+            if depth >= self.max_queue_depth:
+                from .overload import SchedulerOverloaded
+                self._flight.record("admission_shed", depth=depth,
+                                    limit=self.max_queue_depth)
+                raise SchedulerOverloaded(
+                    f"admission queue full ({depth} waiting >= "
+                    f"--admission-queue-depth {self.max_queue_depth}); "
+                    "shedding instead of queueing unboundedly",
+                    retry_after_s=1.0)
         req = Request(prompt=prompt, max_new=max_new_tokens,
                       t_submit=time.perf_counter())
         with self._submit_lock:
@@ -874,7 +898,7 @@ class ContinuousBatchingEngine:
         if ids.ndim == 1:
             ids = ids[None, :]
         t0 = time.perf_counter()
-        reqs = [self.submit(row, max_new_tokens) for row in ids]
+        reqs = self._submit_rows(ids, max_new_tokens)
         try:
             rows = [r.wait(timeout=timeout) for r in reqs]
         except TimeoutError:
@@ -894,18 +918,39 @@ class ContinuousBatchingEngine:
                                 seconds=time.perf_counter() - t0,
                                 logprobs=lps)
 
+    def _submit_rows(self, ids: np.ndarray, max_new_tokens: int) -> list:
+        """Submit every row or none: if a later row is shed by the
+        admission-depth gate, rows already admitted are cancelled before
+        the SchedulerOverloaded propagates — a 503'd multi-row request
+        must not leave orphan rows burning slots while the server sheds
+        load."""
+        reqs = []
+        try:
+            for row in ids:
+                reqs.append(self.submit(row, max_new_tokens))
+        except Exception:
+            for r in reqs:
+                r.cancel()
+            raise
+        return reqs
+
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
-                        seed: int = 0):
+                        seed: int = 0, timeout: Optional[float] = None):
         """Yield [batch] token arrays per step (HTTP streaming surface).
         Single-row streaming only batches trivially; multi-row prompts
         stream in lockstep of the slowest admitted row.  An ABANDONED
         stream (client disconnect, or a stop-sequence early exit closing
         the generator) cancels its in-flight requests, freeing their
-        slots after the current step instead of decoding to max_new."""
+        slots after the current step instead of decoding to max_new.
+        ``timeout``: overall wall-clock deadline — on expiry the
+        requests are cancelled (slots freed) and TimeoutError raised,
+        so a consumer with a deadline never blocks on a wedged
+        scheduler (the --request-timeout contract)."""
         ids = np.asarray(prompt_ids)
         if ids.ndim == 1:
             ids = ids[None, :]
-        reqs = [self.submit(row, max_new_tokens) for row in ids]
+        deadline = None if not timeout else time.monotonic() + timeout
+        reqs = self._submit_rows(ids, max_new_tokens)
         fetched = [[] for _ in reqs]
         finished = [False] * len(reqs)   # row's None sentinel was consumed
         try:
@@ -913,7 +958,14 @@ class ContinuousBatchingEngine:
                 out = []
                 for i, r in enumerate(reqs):
                     while not finished[i] and len(fetched[i]) <= step_i:
-                        item = r.stream.get()
+                        try:
+                            item = r.stream.get(
+                                timeout=None if deadline is None else
+                                max(0.0, deadline - time.monotonic()))
+                        except queue.Empty:
+                            raise TimeoutError(
+                                f"request deadline ({timeout}s) "
+                                "exceeded") from None
                         if item is None:  # end sentinel: EOS, or failure
                             finished[i] = True
                             if r.error is not None:

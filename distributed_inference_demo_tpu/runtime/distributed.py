@@ -46,7 +46,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..comm import wire
-from ..comm.transport import BaseTransport, TransportTimeout
+from ..comm.transport import (BaseTransport, TransportTimeout,
+                              record_corrupt_frame)
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..ops.sampling import SamplingParams, sample_logits
 from ..telemetry import postmortem
@@ -165,6 +166,13 @@ class PipelineWorker:
         self.flight = get_flight_recorder()
         self._last_wait: Optional[float] = None  # serve loop's recv wait
         self._last_wait_start: Optional[float] = None  # its wall start
+        # per-rid expected next step: the KV cache is append-only, so a
+        # DUPLICATED or out-of-order hidden chunk (transport retry, chaos
+        # duplicate/reorder) must be dropped, never run twice into the
+        # cache.  The first frame of a request (or post-reshard relaunch,
+        # where the re-prefill arrives at a mid-stream step) is accepted
+        # at any step; after that, steps must advance by exactly one.
+        self._next_step: Dict[int, int] = {}
 
     def _forward_control(self, tag: str, payload: bytes = b"") -> None:
         if self.next_id is not None:
@@ -207,7 +215,9 @@ class PipelineWorker:
             self._forward_control(tag)
             return False
         if kind == "end":
-            self.rt.free(int(rest.split(":")[0]))
+            rid = int(rest.split(":")[0])
+            self.rt.free(rid)
+            self._next_step.pop(rid, None)
             self._forward_control(tag)
             return True
         if kind == "statsreq":
@@ -287,14 +297,38 @@ class PipelineWorker:
                                rid=rid, step=step, dest=dest)
 
     def _run_and_forward(self, rid: int, step: int, payload: bytes) -> None:
+        expected = self._next_step.get(rid)
+        if expected is not None and step != expected:
+            # duplicate (retry, chaos) or out-of-order frame: running it
+            # would append to the KV cache twice and poison every later
+            # token — drop; a genuinely lost frame surfaces as a stall
+            # and the elastic reshard retransmits
+            self.flight.record("dup_frame_dropped",
+                               stage=self.transport.device_id,
+                               rid=rid, step=step, expected=expected)
+            log.info("worker %s: dropping duplicate/out-of-order frame "
+                     "rid=%d step=%d (expected %d)",
+                     self.transport.device_id, rid, step, expected)
+            return
         self.flight.record("hop_recv", stage=self.transport.device_id,
                            rid=rid, step=step, nbytes=len(payload))
-        t_c = SpanClock()
-        with t_c:
+        try:
             tensors, ctx = wire.split_trace_context(
                 wire.deserialize_tensors(payload))
+        except wire.WireIntegrityError as e:
+            # counted + flight-recorded, then DROPPED: the header's
+            # step-timeout -> reshard path recovers this step; running a
+            # corrupt activation forward would decode a wrong token
+            record_corrupt_frame(self.transport.device_id,
+                                 self._make_h_tag(rid, step),
+                                 len(payload), e)
+            return
+        t_c = SpanClock()
+        with t_c:
             [x] = tensors
             out = self.rt.run_chunk(rid, x)
+            # the cache consumed this chunk: only step+1 may run next
+            self._next_step[rid] = step + 1
             if self.rt.spec.is_last:
                 result = [self.rt.sample_tokens(rid, step, out)]
                 dest, tag = self.header_id, self._make_tok_tag(rid, step)
@@ -318,10 +352,15 @@ class PipelineWorker:
         self.flight.record("hop_recv", stage=self.transport.device_id,
                            rid=rid, step=0, nbytes=len(payload),
                            classify=True)
-        t_c = SpanClock()
-        with t_c:
+        try:
             tensors, ctx = wire.split_trace_context(
                 wire.deserialize_tensors(payload))
+        except wire.WireIntegrityError as e:
+            record_corrupt_frame(self.transport.device_id, f"c:{rid}",
+                                 len(payload), e)
+            return
+        t_c = SpanClock()
+        with t_c:
             x, label_ids = tensors
             out = self.rt.run_chunk(rid, x)
             if self.rt.spec.is_last:
@@ -551,14 +590,24 @@ class PipelineHeader:
             if kind != "tok":
                 log.warning("header: unexpected tag %r", tag)
                 continue
-            rid = int(rest.split(":")[0])
+            fields = rest.split(":")
+            rid, tok_step = int(fields[0]), int(fields[1])
             req = in_flight.get(rid)
-            if req is None:
-                continue
+            if req is None or tok_step != req.step:
+                continue    # finished request, or a duplicate/stale step
+                # (transport retry / chaos duplicate): advancing twice on
+                # one step would append the same token twice
             self.flight.record("tok_recv", stage=self.transport.device_id,
                                rid=rid, step=req.step)
-            tensors, _ = wire.split_trace_context(
-                wire.deserialize_tensors(payload))
+            try:
+                tensors, _ = wire.split_trace_context(
+                    wire.deserialize_tensors(payload))
+            except wire.WireIntegrityError as e:
+                # dropped: this step's token is lost and the step times
+                # out (static pipeline) — never a garbage token appended
+                record_corrupt_frame(self.transport.device_id, tag,
+                                     len(payload), e)
+                continue
             [toks] = tensors
             step = req.step
             self._advance(req, toks)
@@ -662,9 +711,14 @@ class PipelineHeader:
                 continue
             self.flight.record("tok_recv", stage=self.transport.device_id,
                                rid=rid, step=0, classify=True)
+            try:
+                tensors, _ = wire.split_trace_context(
+                    wire.deserialize_tensors(payload))
+            except wire.WireIntegrityError as e:
+                record_corrupt_frame(self.transport.device_id, tag,
+                                     len(payload), e)
+                continue
             self._record_rtt(rid, 0)
-            tensors, _ = wire.split_trace_context(
-                wire.deserialize_tensors(payload))
             [pred] = tensors
             results[rid] = pred.astype(np.int32)
             self.transport.send(self.next_id, f"end:{rid}", b"")

@@ -51,7 +51,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..comm import wire
-from ..comm.transport import BaseTransport, TransportError, TransportTimeout
+from ..comm.transport import (BaseTransport, TransportError,
+                              TransportTimeout, record_corrupt_frame)
 from ..models.base import (ModelConfig, StageParams, StageSpec, slice_stage,
                            split_layer_ranges)
 from ..ops.sampling import SamplingParams
@@ -126,6 +127,7 @@ class ElasticWorker(PipelineWorker):
                 # dropped from the chain but alive: free every cache and
                 # stand by as a spare for a future scale-up.
                 self.rt.caches.clear()
+                self._next_step.clear()
                 self.epoch = plan["epoch"]
                 self.next_id = None
                 self.transport.send(
@@ -135,7 +137,8 @@ class ElasticWorker(PipelineWorker):
                          self.transport.device_id, self.epoch)
                 return True
             self.rt.reassign(_spec_from(plan["spec"]))
-            self.next_id = plan["next_id"]
+            self._next_step.clear()   # fresh caches: relaunched requests
+            self.next_id = plan["next_id"]   # restart at any step
             self.epoch = plan["epoch"]
             self.transport.send(
                 rest, f"rack:{self.transport.device_id}:{self.epoch}", b"")
@@ -175,7 +178,17 @@ class ElasticHeader(PipelineHeader):
                  chain: Sequence[str], eos_id: Optional[int] = None,
                  step_timeout: float = DEFAULT_STEP_TIMEOUT,
                  poll_interval: float = 0.5,
-                 layer_costs: Optional[Sequence[float]] = None):
+                 layer_costs: Optional[Sequence[float]] = None,
+                 stall_reshard_timeout: Optional[float] = None):
+        """``stall_reshard_timeout``: in-place recovery — when no token
+        has arrived for this long but no failure was signaled (a frame
+        lost to corruption/drop, not a dead worker), the header reshards
+        over the SAME chain: epoch bump, caches cleared, every in-flight
+        request re-prefilled from its collected tokens.  The lost frame
+        is effectively retransmitted and greedy output is unchanged
+        (drain/resume exactness).  Default ``step_timeout / 4``; 0/None
+        disables (then a lost frame rides the full step_timeout to the
+        stall postmortem, pre-PR-5 behavior)."""
         if list(chain)[0] != transport.device_id:
             raise ValueError("chain must start with the header's device id")
         if len(chain) < 2:
@@ -185,6 +198,9 @@ class ElasticHeader(PipelineHeader):
         self.chain: List[str] = list(chain)
         self.poll_interval = poll_interval
         self.layer_costs = list(layer_costs) if layer_costs else None
+        self.stall_reshard_timeout = (
+            step_timeout / 4 if stall_reshard_timeout is None
+            else (stall_reshard_timeout or None))
         self.epoch = 0
         self._failed: List[str] = []
         self._failed_lock = threading.Lock()
@@ -255,12 +271,25 @@ class ElasticHeader(PipelineHeader):
                 json.dumps(plan).encode("utf-8"))
         deadline = time.monotonic() + self.step_timeout
         while expected_acks:
+            # a worker that dies MID-RESHARD must not cost the full ack
+            # deadline: a failure signal for a pending acker aborts this
+            # reshard now (the signal stays queued — the run loop's next
+            # poll reshards again without the dead device)
+            with self._failed_lock:
+                dead_waiters = sorted(d for d in self._failed
+                                      if d in expected_acks)
+            if dead_waiters:
+                raise TransportTimeout(
+                    f"reshard (epoch {self.epoch}) aborted: "
+                    f"{dead_waiters} failed mid-reshard")
             left = deadline - time.monotonic()
             if left <= 0:
                 raise TransportTimeout(
                     f"reshard acks missing from {sorted(expected_acks)}")
             try:
-                tag, _ = self.transport.recv_any(timeout=left)
+                # sliced waits so the dead-waiter check above runs even
+                # while nothing arrives
+                tag, _ = self.transport.recv_any(timeout=min(left, 0.5))
             except TransportTimeout:
                 continue  # deadline check above raises the informative error
             kind, _, rest = tag.partition(":")
@@ -306,14 +335,38 @@ class ElasticHeader(PipelineHeader):
         rid_to_index = {req.rid: i for i, req in enumerate(pending)}
         queue = list(pending)
         in_flight: Dict[int, _Request] = {}
-        last_progress = time.monotonic()
+        # last_progress: real token progress only (bounds the final
+        # give-up); last_recovery additionally resets on every recovery
+        # attempt (paces the in-place stall reshards)
+        last_progress = last_recovery = time.monotonic()
+        # cumulative: _take_failures consumes each signal, but a reshard
+        # aborted by a cascading failure leaves the earlier dead device
+        # in self.chain — the retry must still exclude it
+        dead_seen: set = set()
 
         while queue or in_flight:
             failed = self._take_failures()
             if failed:
-                alive = [d for d in self.chain if d not in failed]
-                self.reshard(alive, in_flight, dead=failed)
-                last_progress = time.monotonic()
+                dead_seen.update(failed)
+                alive = [d for d in self.chain if d not in dead_seen]
+                try:
+                    self.reshard(alive, in_flight,
+                                 dead=[d for d in self.chain
+                                       if d in dead_seen])
+                    last_progress = last_recovery = time.monotonic()
+                except TransportTimeout:
+                    # a SECOND device died mid-reshard (the ack-wait
+                    # aborted early on its failure signal, or its acks
+                    # never came): its signal is queued, so the next
+                    # poll reshards again without it — a cascading
+                    # failure must not kill a run that survivors could
+                    # finish.  The no-progress watchdog stays the
+                    # backstop if reshards keep failing.
+                    log.warning("header: reshard after %s failed "
+                                "(another device down mid-reshard?); "
+                                "retrying on the next failure signal",
+                                failed)
+                    last_recovery = time.monotonic()
 
             while queue and len(in_flight) < pool_size:
                 req = queue.pop(0)
@@ -330,13 +383,42 @@ class ElasticHeader(PipelineHeader):
                 tag, payload = self.transport.recv_any(
                     timeout=self.poll_interval)
             except TransportTimeout:
-                if time.monotonic() - last_progress > self.step_timeout:
+                now = time.monotonic()
+                if now - last_progress > self.step_timeout:
                     # reshard couldn't save this run: black-box it like
                     # the static header's step timeout
                     self._stall_postmortem("generate")
                     raise TransportTimeout(
                         f"no progress for {self.step_timeout}s and no "
                         "failure signal; pipeline stalled")
+                if (self.stall_reshard_timeout and in_flight
+                        and now - last_recovery
+                        > self.stall_reshard_timeout):
+                    # a frame was lost (dropped/corrupt) but nobody died:
+                    # reshard IN PLACE — epoch bump + drain/resume acts
+                    # as the retransmit (docs/DESIGN.md §12)
+                    self.flight.record(
+                        "stall_reshard", stage=self.transport.device_id,
+                        idle_s=round(now - last_progress, 3),
+                        epoch=self.epoch)
+                    log.warning(
+                        "header: no progress for %.1fs with no failure "
+                        "signal; resharding in place (epoch %d -> %d)",
+                        now - last_progress, self.epoch, self.epoch + 1)
+                    try:
+                        # over the live chain: a device from an earlier
+                        # ABORTED failure-reshard must stay excluded
+                        self.reshard([d for d in self.chain
+                                      if d not in dead_seen], in_flight,
+                                     dead=[d for d in self.chain
+                                           if d in dead_seen])
+                    except TransportTimeout:
+                        # a worker IS dead (acks missing / aborted by a
+                        # failure signal): the signal-driven reshard at
+                        # the top of the loop finishes the job
+                        log.warning("header: in-place reshard failed; "
+                                    "awaiting failure signal")
+                    last_recovery = time.monotonic()
                 continue
 
             kind, _, rest = tag.partition(":")
@@ -352,8 +434,16 @@ class ElasticHeader(PipelineHeader):
             self.flight.record("tok_recv",
                                stage=self.transport.device_id,
                                rid=rid, step=step)
-            [toks] = wire.split_trace_context(
-                wire.deserialize_tensors(payload))[0]
+            try:
+                [toks] = wire.split_trace_context(
+                    wire.deserialize_tensors(payload))[0]
+            except wire.WireIntegrityError as e:
+                # dropped, counted, flight-recorded; the request's step
+                # stays pending and the no-progress watchdog (or a
+                # failure signal) reshards — never a garbage token
+                record_corrupt_frame(self.transport.device_id, tag,
+                                     len(payload), e)
+                continue
             if on_token is not None:
                 on_token(rid_to_index[rid], step, toks)
             try:
@@ -363,7 +453,7 @@ class ElasticHeader(PipelineHeader):
                 # failure signal will reshard and relaunch from tokens.
                 log.warning("header: advance send for rid=%d failed "
                             "(next hop down?)", rid)
-            last_progress = time.monotonic()
+            last_progress = last_recovery = time.monotonic()
             if req.done:
                 del in_flight[rid]
 

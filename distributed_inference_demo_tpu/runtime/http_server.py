@@ -37,6 +37,7 @@ from typing import Optional
 import numpy as np
 
 from ..telemetry import catalog as _metrics
+from .overload import SchedulerOverloaded
 
 
 def _round_lps(row) -> list:
@@ -372,11 +373,18 @@ class InferenceHTTPServer:
 
     def __init__(self, backend, host: str = "127.0.0.1", port: int = 0,
                  tokenizer=None, model_name: str = "",
-                 default_max_new: int = 128):
+                 default_max_new: int = 128,
+                 request_timeout: Optional[float] = None):
+        """``request_timeout``: per-request deadline for blocking
+        ``/generate`` — passed as ``timeout=`` to backends that accept
+        it (the continuous-batching engine cancels the request through
+        ``Request.cancel()``, freeing its slot) and mapped to a 504
+        instead of a hang.  None/0 = no deadline."""
         self.backend = backend
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.default_max_new = default_max_new
+        self.request_timeout = request_timeout or None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -392,7 +400,8 @@ class InferenceHTTPServer:
                 "/health", "/stats", "/stats/reset", "/metrics", "/trace",
                 "/debugz", "/generate", "/classify"))
 
-            def _json(self, code: int, obj: dict) -> None:
+            def _json(self, code: int, obj: dict,
+                      headers: Optional[dict] = None) -> None:
                 # counted BEFORE the body goes out: a client that reacts
                 # to this response with a /metrics scrape must see its
                 # own request (the scrape itself bypasses _json)
@@ -404,8 +413,19 @@ class InferenceHTTPServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _shed(self, e: SchedulerOverloaded) -> None:
+                """503 + Retry-After: the admission queue is past its
+                configured depth — honest fast rejection, not an
+                unbounded queue (clients with backoff recover; clients
+                without get a clear signal instead of a timeout)."""
+                self._json(503, {"error": str(e)},
+                           headers={"Retry-After":
+                                    str(max(1, int(e.retry_after_s)))})
 
             def _metrics_scrape(self) -> None:
                 """Prometheus text exposition over the shared registry +
@@ -530,6 +550,11 @@ class InferenceHTTPServer:
                         return
                     try:
                         self._generate_stop(ids, max_new, seed, stop)
+                    except SchedulerOverloaded as e:
+                        self._shed(e)
+                    except TimeoutError as e:   # --request-timeout: the
+                        self._json(504, {"error": str(e) or  # stop path
+                                         "request deadline exceeded"})
                     except ValueError as e:
                         self._json(400, {"error": str(e)})
                     except Exception as e:
@@ -558,6 +583,13 @@ class InferenceHTTPServer:
                                              "logprobs"})
                                 return
                             kwargs["logprobs"] = True
+                        if (outer.request_timeout
+                                and _accepts_kwarg(outer.backend.generate,
+                                                   "timeout")):
+                            # per-request deadline: the batching engine
+                            # cancels through Request.cancel() on expiry
+                            # (slot freed), surfacing as TimeoutError
+                            kwargs["timeout"] = outer.request_timeout
                         t_req = time.perf_counter()
                         res = outer.backend.generate(ids, max_new,
                                                      seed=seed, **kwargs)
@@ -573,6 +605,11 @@ class InferenceHTTPServer:
                             out["text"] = [outer.tokenizer.decode(row)
                                            for row in res.tokens.tolist()]
                         self._json(200, out)
+                except SchedulerOverloaded as e:
+                    self._shed(e)
+                except TimeoutError as e:   # --request-timeout expired;
+                    self._json(504, {"error": str(e) or  # request was
+                                     "request deadline exceeded"})  # shed
                 except ValueError as e:     # capacity etc.
                     self._json(400, {"error": str(e)})
                 except Exception as e:      # e.g. a stalled pipeline's
@@ -615,8 +652,15 @@ class InferenceHTTPServer:
                 RAGGED.  ``stop_reason`` per row: "stop", "eos" (the
                 backend's eos ended the row first; the eos token is
                 included, engine convention), or "length"."""
+                kwargs = {}
+                if (outer.request_timeout
+                        and _accepts_kwarg(outer.backend.generate_stream,
+                                           "timeout")):
+                    # the same per-request deadline as the plain branch:
+                    # a wedged scheduler surfaces as 504, never a hang
+                    kwargs["timeout"] = outer.request_timeout
                 gen = outer.backend.generate_stream(ids, max_new,
-                                                    seed=seed)
+                                                    seed=seed, **kwargs)
                 ses = _StopSession(outer.tokenizer, stop, len(ids),
                                    getattr(outer.backend, "eos_id", None))
                 for item in gen:
@@ -679,6 +723,9 @@ class InferenceHTTPServer:
                     first = next(gen)
                 except StopIteration:
                     pass
+                except SchedulerOverloaded as e:
+                    self._shed(e)       # still before headers: clean 503
+                    return
                 except ValueError as e:
                     self._json(400, {"error": str(e)})
                     return

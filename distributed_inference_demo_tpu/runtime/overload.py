@@ -1,0 +1,21 @@
+"""Overload shedding: the exception contract between scheduler and HTTP.
+
+Deliberately a tiny dependency-free module: ``runtime/batching.py``
+(which raises) pulls in jax, and ``runtime/http_server.py`` (which
+catches and maps to ``503 + Retry-After``) must stay importable without
+it.  Graceful degradation is the point — a saturated admission queue
+answers *quickly and honestly* instead of queueing unboundedly until
+every client has timed out anyway (docs/DESIGN.md §12).
+"""
+
+from __future__ import annotations
+
+
+class SchedulerOverloaded(RuntimeError):
+    """The admission queue is past its configured depth: the request was
+    REJECTED, not queued.  ``retry_after_s`` is the server's hint for the
+    HTTP ``Retry-After`` header."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s

@@ -46,8 +46,12 @@ def build_worker(args):
                            kv_cache_dtype=getattr(args, "kv_cache_dtype",
                                                   "") or None)
 
-    transport = ZmqTransport(args.device_id, bind_host=args.bind_host,
-                             port=args.port)
+    from ..comm.faults import load_fault_plan, maybe_wrap
+    transport = maybe_wrap(
+        ZmqTransport(args.device_id, bind_host=args.bind_host,
+                     port=args.port),
+        load_fault_plan(getattr(args, "fault_plan", ""),
+                        getattr(args, "chaos", False)))
     next_id = None
     if args.next:
         next_id, next_addr = args.next.split("@", 1)
@@ -104,7 +108,22 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-block-tokens", type=int, default=None,
                     help="tokens per KV cache block (see "
                          "--kv-cache-blocks; rejected on stage workers)")
+    ap.add_argument("--fault-plan", default="",
+                    help="CHAOS TESTING ONLY: JSON fault-plan spec (path "
+                         "or inline) injected into this stage's "
+                         "transport; requires --chaos (docs/DESIGN.md "
+                         "§12; env DWT_FAULT_PLAN)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="explicitly acknowledge fault injection; "
+                         "--fault-plan/DWT_FAULT_PLAN are rejected "
+                         "without it")
     args = ap.parse_args(argv)
+    from ..comm.faults import FaultConfigError, load_fault_plan
+    try:
+        load_fault_plan(args.fault_plan, args.chaos)  # validate EARLY:
+    except FaultConfigError as e:   # a leaked env plan must not reach
+        print(str(e), file=sys.stderr)     # the serve loop
+        return 1
     if args.kv_cache_blocks or args.kv_block_tokens:
         print("--kv-cache-blocks/--kv-block-tokens are not supported on "
               "pipeline stage workers (stages see activations, not "

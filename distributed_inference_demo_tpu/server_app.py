@@ -112,23 +112,48 @@ class ServerApp:
     def _collect_devices(self, pool) -> List:
         """Reference collection-window semantics (``server.py:709-762``):
         run until ``num_workers`` devices registered, or — once at least one
-        is in — until no new device arrives for ``collect_window`` s."""
+        is in — until no new device arrives for ``collect_window`` s.
+
+        Polling backs off exponentially with jitter (5 ms → 1 s cap)
+        instead of hammering the pool lock at a fixed 50 Hz for the whole
+        window; any arrival resets the backoff so a burst of late
+        registrations is still picked up promptly.  A deadline expiry
+        emits a structured run-log event naming the devices that DID
+        register, so the postmortem question "which workers never showed
+        up" is answerable from the log alone."""
+        import random as _random
+
+        from .telemetry.runlog import get_run_log
+
         deadline = time.monotonic() + self.collect_timeout
         last_count, last_change = 0, time.monotonic()
+        sleep_s, max_sleep = 0.005, 1.0
+        registered: List[str] = []
         while time.monotonic() < deadline:
             devs = pool.get_available_devices()
+            registered = [d.device_id for d in devs]
             if len(devs) >= self.num_workers:
                 return devs[:self.num_workers]
             if len(devs) != last_count:
                 last_count, last_change = len(devs), time.monotonic()
+                sleep_s = 0.005          # arrivals reset the backoff
             if devs and time.monotonic() - last_change > self.collect_window:
                 log.info("collection window closed with %d/%d workers",
                          len(devs), self.num_workers)
                 return devs
-            time.sleep(0.05)
+            time.sleep(min(sleep_s * (1.0 + _random.random()),
+                           max(0.0, deadline - time.monotonic()),
+                           max_sleep))
+            sleep_s = min(sleep_s * 2, max_sleep)
+        get_run_log().event(
+            "device_collect_timeout",
+            want=self.num_workers, got=last_count,
+            registered=sorted(registered),
+            missing=self.num_workers - last_count,
+            collect_timeout_s=self.collect_timeout)
         raise TimeoutError(
             f"no {self.num_workers} workers within {self.collect_timeout}s "
-            f"(got {last_count})")
+            f"(got {last_count}: {sorted(registered)})")
 
     def _self_report(self) -> dict:
         """The server's own probe report (it is the header device)."""

@@ -273,6 +273,30 @@ HTTP_GENERATED_TOKENS = counter(
     "Tokens returned by successful /generate requests")
 
 
+# -- transport reliability / fault injection series ------------------------
+# event-driven from comm/transport.py + comm/faults.py (docs/DESIGN.md §12
+# runbook: which counter spiking means what)
+
+TRANSPORT_SEND_RETRIES = counter(
+    "dwt_transport_send_retries_total",
+    "Transport send attempts beyond the first (bounded retry with "
+    "exponential backoff + jitter; a sustained rate means a slow or "
+    "flapping peer)")
+TRANSPORT_RECONNECTS = counter(
+    "dwt_transport_reconnects_total",
+    "Outbound sockets torn down and re-dialed after a hard send error")
+TRANSPORT_CORRUPT_FRAMES = counter(
+    "dwt_transport_corrupt_frames_total",
+    "Inbound frames dropped on wire-checksum mismatch (each is a frame "
+    "that would otherwise have decoded garbage into the pipeline)")
+FAULT_INJECTED = counter(
+    "dwt_fault_injected_faults_total",
+    "Faults injected by an active chaos fault plan, by kind (drop, "
+    "delay, duplicate, reorder, corrupt, partition, partition_drop, "
+    "crash_after).  Nonzero outside a chaos run is an incident",
+    ("kind",))
+
+
 # -- flight recorder / anomaly series --------------------------------------
 
 FLIGHT_EVENTS = counter(

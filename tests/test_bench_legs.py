@@ -75,6 +75,23 @@ def test_leg_paged_decode_structure_tiny():
     assert primed["h2d_bytes"] == 0
 
 
+def test_leg_fault_recovery_structure_tiny():
+    """The fault_recovery leg's full structure (fault-free reference run,
+    injected crash_after, reshard + drain/resume timing) on CPU — the
+    tier-1 dryrun the ISSUE-5 bench satellite requires."""
+    out = bench._leg_fault_recovery("llama-test", new_tokens=10,
+                                    crash_after_msgs=6)
+    assert "error" not in out
+    assert out["tokens_bit_identical_after_recovery"] is True
+    assert out["injected_events"] == ["crash_after"]
+    assert out["plan_seed"] == 1234
+    assert out["surviving_chain"] == ["s0", "s2"]
+    assert out["reshard_seconds"] is not None and out["reshard_seconds"] > 0
+    assert (out["crash_to_first_token_seconds"] is not None
+            and out["crash_to_first_token_seconds"] > 0)
+    assert out["chaos_seconds"] > 0 and out["clean_seconds"] > 0
+
+
 def test_leg_prefix_reuse_structure_tiny():
     """The prefix_reuse leg's full structure (cache-off run, cache-on
     run, hit/reuse/saved report) at CPU-viable scale — the dryrun that

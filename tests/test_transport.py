@@ -82,6 +82,65 @@ def test_send_unknown_peer_raises():
         t.close()
 
 
+def _counter_value(c, **labels) -> float:
+    want = tuple(sorted(labels.items()))
+    for _name, lab, value in c.samples():
+        if tuple(sorted(lab)) == want:
+            return value
+    return 0.0
+
+
+def test_send_retries_with_backoff_then_timeout():
+    """A blocked peer (full HWM, nobody reading) exhausts the bounded
+    retries — each retry counted — then surfaces as TransportTimeout,
+    never a hang."""
+    from distributed_inference_demo_tpu.telemetry import catalog
+    a = ZmqTransport("ra", hwm=1, send_timeout=0.05, send_retries=2,
+                     retry_backoff=0.01)
+    b = ZmqTransport("rb", hwm=1)
+    b._stop.set()                  # stop rb's pump: nobody drains the queue
+    b._thread.join(timeout=5)
+    a.connect("rb", b.address)
+    before = _counter_value(catalog.TRANSPORT_SEND_RETRIES)
+    try:
+        with pytest.raises(TransportTimeout, match="blocked"):
+            for i in range(64):    # HWM 1 + TCP buffers: fill until Again
+                a.send("rb", "t", b"x" * 65536)
+        # >=: the terminal send burns its full retry budget (2); earlier
+        # sends may each count transient backpressure retries too
+        assert _counter_value(catalog.TRANSPORT_SEND_RETRIES) >= before + 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_reconnect_rebuilds_socket_and_counts():
+    from distributed_inference_demo_tpu.telemetry import catalog
+    a, b = make_zmq_pair()
+    try:
+        a.send("b", "t1", b"before")
+        assert b.recv("t1", timeout=5) == b"before"
+        before = _counter_value(catalog.TRANSPORT_RECONNECTS)
+        a._reconnect("b")
+        assert _counter_value(catalog.TRANSPORT_RECONNECTS) == before + 1
+        a.send("b", "t2", b"after")     # the fresh socket works
+        assert b.recv("t2", timeout=5) == b"after"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_send_retry_duplicates_are_receiver_safe(pair):
+    """The retry contract: re-sending the same (tag, payload) is safe
+    because ring receivers dedup by (rid, step) — at the transport level
+    both copies arrive; the dedup lives above (test_chaos pins it)."""
+    a, b = pair
+    a.send("b", "h:0:0", b"p")
+    a.send("b", "h:0:0", b"p")        # what a retry after a lost ack does
+    assert b.recv("h:0:0", timeout=5) == b"p"
+    assert b.recv("h:0:0", timeout=5) == b"p"
+
+
 def test_concurrent_senders(pair):
     a, b = pair
     n = 50

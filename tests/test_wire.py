@@ -158,22 +158,71 @@ def test_trace_context_u64_extremes():
 
 def test_untraced_frames_byte_identical():
     """Frames without the trace bit are EXACTLY today's format — pinned
-    against a hand-computed golden blob, and serialize_tensors_traced
-    with trace_id=None is a byte-level no-op."""
+    against a hand-computed golden blob (checksum field computed here
+    independently: CRC-32 of the payload XOR-folded to 16 bits), and
+    serialize_tensors_traced with trace_id=None is a byte-level no-op."""
+    import zlib
     a = np.arange(3, dtype=np.int32)
     blob = wire.serialize_tensors([a])
-    golden = (b"DWT1" + bytes([1, 0]) + b"\x00\x00"      # ver, flags, rsv
+    payload = (bytes([int(wire.DType.I32), 1]) + b"\x00\x00"
+               + (12).to_bytes(8, "little")              # nbytes
+               + (3).to_bytes(8, "little")               # dims
+               + a.tobytes())
+    crc = zlib.crc32(payload)
+    fold = ((crc & 0xFFFF) ^ (crc >> 16)) or 0xFFFF
+    golden = (b"DWT1" + bytes([1, 0])                    # ver, flags
+              + fold.to_bytes(2, "little")               # checksum
               + (1).to_bytes(4, "little")                # ntensors
-              + bytes([int(wire.DType.I32), 1]) + b"\x00\x00"
-              + (12).to_bytes(8, "little")               # nbytes
-              + (3).to_bytes(8, "little")                # dims
-              + a.tobytes())
+              + payload)
     assert blob == golden
     assert wire.serialize_tensors_traced([a], None) == blob
     msg = wire.deserialize_tensors(blob)
     assert not (msg.flags & wire.FLAG_TRACE_CONTEXT)
     tensors, ctx = wire.split_trace_context(msg)
     assert ctx is None and len(tensors) == 1
+
+
+# -- wire integrity checksum (PR 5) -----------------------------------------
+
+@pytest.mark.parametrize("pos", [6, 12, 13, 25, -1])
+def test_checksum_detects_any_flipped_byte(pos):
+    blob = wire.serialize_tensors([np.arange(6, dtype=np.float32)])
+    bad = bytearray(blob)
+    bad[pos] ^= 0x01
+    with pytest.raises(wire.WireIntegrityError):
+        wire.deserialize_tensors(bytes(bad))
+    if native_codec.available():
+        with pytest.raises(wire.WireIntegrityError):
+            native_codec.deserialize_tensors(bytes(bad))
+
+
+def test_zero_checksum_legacy_frames_accepted_both_impls():
+    """Version compat: a pre-checksum peer's frame (field = 0) decodes
+    unchanged — including one whose payload was built by a current
+    serializer with checksum=False."""
+    a = [np.arange(5, dtype=np.int16)]
+    for blob in (wire.serialize_tensors(a, checksum=False),
+                 native_codec.serialize_tensors(a, checksum=False)
+                 if native_codec.available() else None):
+        if blob is None:
+            continue
+        assert blob[6:8] == b"\x00\x00"
+        for decode in (wire.deserialize_tensors,
+                       native_codec.deserialize_tensors
+                       if native_codec.available() else None):
+            if decode is None:
+                continue
+            np.testing.assert_array_equal(decode(blob).tensors[0], a[0])
+
+
+def test_checksum_zero_fold_remapped():
+    """The empty payload's CRC folds to 0 — the sentinel — so it must be
+    remapped (0xFFFF): an empty checksummed message stays verifiable and
+    distinguishable from a legacy frame."""
+    blob = wire.serialize_tensors([])
+    assert blob[6:8] == b"\xff\xff"
+    assert wire.deserialize_tensors(blob).tensors == []
+    assert wire.payload_checksum(b"") == 0xFFFF
 
 
 def test_trace_context_native_codec_ignores_flag_gracefully():

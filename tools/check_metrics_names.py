@@ -27,7 +27,8 @@ import sys
 from typing import List
 
 SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
-              "engine", "control", "anomaly", "flight", "kvcache"}
+              "engine", "control", "anomaly", "flight", "kvcache",
+              "transport", "fault"}
 
 # unit suffixes a metric name may end with (after stripping ``_total``).
 # Plain-count units (requests, tokens, ...) double as the unit for
@@ -35,7 +36,8 @@ SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
 UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "rounds", "hits", "misses", "slots", "spans", "entries",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
-         "info", "events", "bundles", "blocks", "nodes"}
+         "info", "events", "bundles", "blocks", "nodes",
+         "retries", "reconnects", "frames", "faults"}
 
 # exact names exempted from the unit-suffix rule — each entry is a
 # deliberate, documented exception (NOT a new unit: adding a pseudo-unit
@@ -70,6 +72,15 @@ REQUIRED_SERIES = {
     "dwt_kvcache_device_resident_bytes",
     "dwt_kvcache_blocks_in_use",
     "dwt_kvcache_h2d_bytes_total",
+    # the transport-reliability / chaos quartet (docs/DESIGN.md §12): a
+    # corrupt frame that is silently absent from /metrics is exactly the
+    # "decoded garbage into a wrong token" failure this layer exists to
+    # rule out, and dwt_fault_* staying registered-and-zero is how a
+    # production scrape PROVES no fault plan leaked into the process
+    "dwt_transport_send_retries_total",
+    "dwt_transport_reconnects_total",
+    "dwt_transport_corrupt_frames_total",
+    "dwt_fault_injected_faults_total",
 }
 
 

@@ -168,6 +168,20 @@ def summarize_bundle(bundle_dir: str) -> dict:
             os.path.join(bundle_dir, "metrics.prom")),
     }
 
+    # chaos runs: injected-fault flight events + the fault named in the
+    # manifest (injected_fault_crash bundles) — the bundle states its own
+    # cause so a failing soak is replayable from seed + plan alone
+    injected = [e for e in events if e.get("kind") in
+                ("fault_injected", "corrupt_frame")]
+    detail = manifest.get("detail") or {}
+    if injected or "fault" in detail:
+        out["injected_faults"] = injected
+        cause = detail.get("fault") or (injected[-1] if injected else None)
+        if cause is not None:
+            out["injected_cause"] = cause
+        if "plan_seed" in detail:
+            out["fault_plan_seed"] = detail["plan_seed"]
+
     stalled = [_diagnose_pair(r, s, events)
                for r, s in _stalled_pairs(manifest, events)]
     stalled = [d for d in stalled if d.get("offending_hop") is not None]
@@ -208,6 +222,19 @@ def format_summary(s: dict) -> str:
         lines.append(f"  CRASH: {c.get('exc_type')}: {c.get('exc')}"
                      + (f" (thread {c['thread']})" if c.get("thread")
                         else ""))
+    if s.get("injected_cause") is not None:
+        c = s["injected_cause"]
+        lines.append(
+            # plan events carry the rule kind as "kind"; flight events as
+            # "fault_kind" (their kind is the event type itself)
+            f"  INJECTED FAULT: {c.get('fault_kind') or c.get('kind')} "
+            f"device={c.get('device', c.get('stage', '?'))} "
+            f"peer={c.get('peer')} tag={c.get('tag')}"
+            + (f" (fault plan seed {s['fault_plan_seed']} — replay with "
+               "the same seed)" if "fault_plan_seed" in s else ""))
+    elif s.get("injected_faults"):
+        lines.append(f"  injected faults in window: "
+                     f"{len(s['injected_faults'])} (chaos run)")
     for a in s.get("anomalies", []):
         lines.append(f"  anomaly: {a.get('anomaly')} "
                      f"severity={a.get('severity')}")
