@@ -66,7 +66,8 @@ PRIOR_ARTIFACT_FALLBACKS = ["BENCH_SELF_r04.json", "BENCH_SELF_r03.json"]
 # extras keys that are session bookkeeping, not measured legs
 _NON_LEG_EXTRAS = {"baseline", "device", "prior_legs", "prior_note",
                    "probe_history", "measured_ceiling_gbs",
-                   "probe_spread_gbs", "headline_live_error", "error"}
+                   "probe_spread_gbs", "headline_live_error", "error",
+                   "micro", "roofline_ledger"}
 
 # Approximate HBM bandwidth by device kind, for roofline fractions in the
 # report (sources: public TPU specs; v5e ~819 GB/s, v4 ~1228 GB/s).
@@ -133,26 +134,127 @@ def measured_ceiling(roofline: dict, probe_history=None):
     return round(max(cands), 1) if cands else None
 
 
-def apply_measured_frac(leg, ceiling) -> None:
-    """Annotate a decode leg with achieved/measured-ceiling.  A leg that
-    BEATS the ceiling gets a ``probe_inconsistent`` stamp and NO
-    measured fraction: a "ceiling" the workload exceeds describes
-    degraded probes, not the chip (the r05 artifact shipped a 1.691
-    "roofline fraction" this way), and a >1.0 fraction in the artifact
-    reads as a measurement when it is actually an apology."""
+# -- persistent best-ever roofline ledger (docs/DESIGN.md §9/§13) ----------
+# Committed JSON keyed by device kind.  Session probes measure the
+# TUNNEL's mood as much as the chip (r05: probes 168-312 GB/s while the
+# headline workload sustained 526.9); the ledger persists the best
+# evidence EVER seen for the chip, so one degraded session can no longer
+# manufacture a "ceiling" every real workload beats.
+
+ROOFLINE_LEDGER_PATH = REPO / "ROOFLINE_LEDGER.json"
+
+
+def load_roofline_ledger(device=None):
+    """The committed ledger dict, or one device's entry (None if
+    absent/unreadable — a missing ledger degrades to session-only
+    ceilings, never an error)."""
+    try:
+        data = json.loads(ROOFLINE_LEDGER_PATH.read_text())
+    except (OSError, json.JSONDecodeError, ValueError):
+        data = {}
+    if not isinstance(data, dict):
+        data = {}
+    if device is None:
+        return data
+    entry = data.get(device)
+    return entry if isinstance(entry, dict) else None
+
+
+def update_roofline_ledger(device, gbs, source: str) -> bool:
+    """Raise ``device``'s best-ever HBM number (monotone max — the
+    ledger only ever improves, so a degraded-tunnel session can never
+    LOWER the declared ceiling).  Returns True when the file changed;
+    callers that commit artifacts commit the ledger alongside."""
+    if not device or not gbs:
+        return False
+    data = load_roofline_ledger()
+    cur = data.get(device)
+    best = cur.get("hbm_gbs", 0) if isinstance(cur, dict) else 0
+    if best >= gbs:
+        return False
+    data[device] = {
+        "hbm_gbs": round(float(gbs), 1), "source": source,
+        "updated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    ROOFLINE_LEDGER_PATH.write_text(
+        json.dumps(data, indent=1, sort_keys=True) + "\n")
+    return True
+
+
+def declared_ceiling(device, session_gbs):
+    """THE ceiling decode legs are judged against:
+    ``max(session probes, committed ledger)``.  Returns
+    ``(ceiling_or_None, ledger_gbs_or_None)``."""
+    entry = load_roofline_ledger(device)
+    ledger = entry.get("hbm_gbs") if entry else None
+    cands = [c for c in (session_gbs, ledger) if c]
+    return (round(max(cands), 1) if cands else None), ledger
+
+
+def apply_measured_frac(leg, ceiling, device=None) -> None:
+    """Annotate a decode leg with achieved/declared-ceiling.  A
+    ``frac_measured > 1`` is STRUCTURALLY IMPOSSIBLE: a leg that beats
+    the declared ceiling has itself measured a higher sustainable HBM
+    rate (achieved_gbs is real weight-stream traffic, a lower bound),
+    so the ledger is RAISED to the achieved number, the leg reports
+    frac 1.0, and the raise is stamped — no more r05-class 1.691
+    "fractions" that are actually apologies for degraded probes."""
     if isinstance(leg, dict) and leg.get("achieved_gbs") and ceiling:
         frac = round(leg["achieved_gbs"] / ceiling, 3)
         leg.pop("ceiling_suspect", None)       # pre-r06 name
+        leg.pop("probe_inconsistent", None)    # r06 pre-ledger name
         if frac > 1.0:
-            leg.pop("hbm_roofline_frac_measured", None)
-            leg["probe_inconsistent"] = (
-                f"achieved {leg['achieved_gbs']} GB/s exceeds every "
-                f"session probe (best {ceiling} GB/s): the probes ran "
-                "through a degraded tunnel, so no measured roofline "
-                "fraction is emitted")
+            dev = device or leg.get("device")
+            update_roofline_ledger(
+                dev, leg["achieved_gbs"],
+                source=f"achieved_gbs of a decode leg "
+                       f"({leg.get('model', '?')} b{leg.get('batch', '?')}"
+                       f" {leg.get('dtype', '?')}): weight-stream lower "
+                       "bound sustained by a real workload")
+            leg["hbm_roofline_frac_measured"] = 1.0
+            leg["ledger_raised"] = (
+                f"achieved {leg['achieved_gbs']} GB/s exceeded the "
+                f"declared ceiling ({ceiling} GB/s): the workload IS the "
+                "better bandwidth measurement, so the roofline ledger "
+                "was raised to it (frac > 1 is impossible by "
+                "construction)")
         else:
             leg["hbm_roofline_frac_measured"] = frac
-            leg.pop("probe_inconsistent", None)
+            leg.pop("ledger_raised", None)
+
+
+def apply_declared_ceiling(headline, extras, device, session, source,
+                           skip_headline: bool = False):
+    """One owner for the declared-ceiling judgement, shared by bench
+    ``main()`` and ``tools/measure_session.merge``: raise the committed
+    ledger to the session probe max, declare ``max(session, ledger)``,
+    stamp the provenance into ``extras['roofline_ledger']``, and apply
+    the measured fraction to every leg that reports ``achieved_gbs``
+    (headline, int8/flagship legs, sweep points, int4 sub-legs).
+
+    ``skip_headline``: the headline dict belongs to a DIFFERENT session
+    (bench's prior-headline substitution) — its fraction must keep that
+    session's ceiling, not this run's.  Returns the declared ceiling, or
+    None when neither the session nor the ledger has evidence."""
+    if session:
+        update_roofline_ledger(device, session, source=source)
+    measured, ledger = declared_ceiling(device, session)
+    if not measured:
+        return None
+    extras["measured_ceiling_gbs"] = measured
+    # provenance stamp: which side of max() declared this ceiling
+    extras["roofline_ledger"] = {
+        "device": device, "session_probe_gbs": session,
+        "ledger_gbs": ledger, "declared_ceiling_gbs": measured,
+        "path": ROOFLINE_LEDGER_PATH.name}
+    if not skip_headline:
+        apply_measured_frac(headline, measured, device)
+    for key in ("headline_int8", "flagship_int8", "flagship_bf16"):
+        apply_measured_frac(extras.get(key, {}) or {}, measured, device)
+    for pt in (extras.get("sweep", {}) or {}).get("points", []):
+        apply_measured_frac(pt, measured, device)
+    for sub in (extras.get("int4", {}) or {}).values():
+        apply_measured_frac(sub, measured, device)
+    return measured
 
 
 def _bench_engine(model: str, batch: int, prompt_len: int, new_tokens: int,
@@ -322,14 +424,15 @@ def _leg_flagship(model: str, batch: int, prompt_len: int, new_tokens: int,
     return _bench_engine(model, batch, prompt_len, new_tokens, quant=quant)
 
 
-def _leg_sweep(model: str, prompt_len: int, new_tokens: int) -> dict:
+def _leg_sweep(model: str, prompt_len: int, new_tokens: int,
+               quants=(False, True), batches=(32, 64)) -> dict:
     """Batch sweep at bf16 and int8 with achieved GB/s per point.
     Points are isolated: one OOMing batch size must not discard the rest.
     (b=8 is omitted — the headline/headline_int8 legs already cover it —
     to keep total bench wall-clock inside the driver's window.)"""
     points = []
-    for quant in (False, True):
-        for batch in (32, 64):
+    for quant in quants:
+        for batch in batches:
             try:
                 points.append(_bench_engine(model, batch, prompt_len,
                                             new_tokens, quant=quant))
@@ -340,7 +443,7 @@ def _leg_sweep(model: str, prompt_len: int, new_tokens: int) -> dict:
     return {"points": points}
 
 
-def _leg_roofline_probe() -> dict:
+def _leg_roofline_probe(reps: int = 32, rounds_n: int = 3) -> dict:
     """Measure THIS chip's achievable ceilings (one dispatch each; the
     axon tunnel adds ~9 ms per dispatch, so loops run on device):
 
@@ -365,7 +468,7 @@ def _leg_roofline_probe() -> dict:
         # reported bandwidth 32x)
         def rep(acc, j):
             return acc + jnp.sum((x + j).astype(jnp.float32)), None
-        acc, _ = jax.lax.scan(rep, 0.0, jnp.arange(32, dtype=x.dtype))
+        acc, _ = jax.lax.scan(rep, 0.0, jnp.arange(reps, dtype=x.dtype))
         return acc
 
     float(red_many(big))                        # compile
@@ -373,11 +476,11 @@ def _leg_roofline_probe() -> dict:
     # (132 vs 505 GB/s observed) — the MAX is the ceiling, the spread is
     # reported so roofline fractions can be read with due suspicion
     rounds = []
-    for _ in range(3):
+    for _ in range(rounds_n):
         t0 = time.perf_counter()
         s = red_many(big)
         float(s)
-        rounds.append(big.nbytes * 32 / (time.perf_counter() - t0) / 1e9)
+        rounds.append(big.nbytes * reps / (time.perf_counter() - t0) / 1e9)
     hbm = max(rounds)
     ordered = sorted(rounds)
     median = ordered[len(ordered) // 2]
@@ -400,7 +503,7 @@ def _leg_roofline_probe() -> dict:
             "dispatch_floor_ms": round(floor_ms, 2)}
 
 
-def _leg_prefill_long(model: str) -> dict:
+def _leg_prefill_long(model: str, seqs=(2048, 8192)) -> dict:
     """Long-prompt prefill: Pallas flash kernel vs jnp attention.
 
     >= 100k tokens of work per measurement; this is where the L1 kernel
@@ -417,7 +520,7 @@ def _leg_prefill_long(model: str) -> dict:
     out = {"model": model, "points": []}
     # 4096 omitted: two more multi-minute tunnel compiles for a point
     # between the two endpoints (r3 measured flash 1.17x there)
-    for seq in (2048, 8192):
+    for seq in seqs:
         # small batch x long prompt: the long-context serving shape (and
         # where flash's causal block-skipping matters); reps make up the
         # >=128k tokens of measured work
@@ -505,6 +608,68 @@ def _leg_long_context(model: str) -> dict:
         "prefill_tokens_per_sec": round(plen / prefill_s, 1),
         "decode_tokens_per_sec": round(new / decode_s, 2),
     }
+
+
+def _leg_decode_fused(model: str, prompt_len: int, new_tokens: int,
+                      batches=(1, 8), blocks=(1, 4, 16)) -> dict:
+    """The device-resident decode loop (docs/DESIGN.md §13): streamed
+    decode tok/s + MEASURED host dispatches/token at batch x
+    stream_block K.  K=1 is the per-token path — its dispatches/token
+    is exactly 1 and its tok/s exposes the host dispatch floor
+    (BENCH_SELF_r05: 15.31 ms/dispatch vs a ~4.2 ms decode step); the
+    K>1 points show the floor amortizing as dispatches/token ≈ 1/K.
+    Greedy-bit-identity across K is pinned by tier-1 tests; this leg
+    measures only speed."""
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime import InferenceEngine
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    out = {"model": model, "prompt_len": prompt_len,
+           "new_tokens": new_tokens, "points": []}
+    for batch in batches:
+        prompt = (np.arange(batch * prompt_len).reshape(batch, prompt_len)
+                  % 1000).astype(np.int32)
+        for K in blocks:
+            try:
+                engine = InferenceEngine(
+                    cfg, params, max_seq=prompt_len + new_tokens,
+                    sampling=SamplingParams(temperature=0.7, top_k=7),
+                    stream_block=K)
+                for _ in engine.generate_stream(prompt, new_tokens,
+                                                seed=0):
+                    pass                        # compile warmup
+                engine.loop_stats = {"host_dispatches": 0,
+                                     "device_loop_steps": 0}
+                t_first = t_last = None
+                n = 0
+                for _ in engine.generate_stream(prompt, new_tokens,
+                                                seed=0):
+                    t_last = time.perf_counter()
+                    if t_first is None:
+                        t_first = t_last
+                    n += 1
+                point = {"batch": batch, "stream_block": K, "tokens": n,
+                         **engine.loop_stats}
+                point["dispatches_per_token"] = round(
+                    engine.loop_stats["host_dispatches"] / max(n, 1), 4)
+                if n > 1:
+                    point["decode_tokens_per_sec"] = round(
+                        batch * (n - 1) / (t_last - t_first), 2)
+                out["points"].append(point)
+            except Exception as e:   # per-point isolation
+                out["points"].append({"batch": batch, "stream_block": K,
+                                      "error": f"{type(e).__name__}: "
+                                               f"{e}"[:300]})
+    best = [p.get("decode_tokens_per_sec") for p in out["points"]
+            if p.get("decode_tokens_per_sec")]
+    if best:
+        out["best_decode_tokens_per_sec"] = max(best)
+    return out
 
 
 def _leg_pipeline(model: str, batch: int, prompt_len: int,
@@ -1474,19 +1639,34 @@ def _leg_fault_recovery(model: str, new_tokens: int = 24,
 
 # ---------------------------------------------------------------------------
 
-def run_leg(name: str, p: dict) -> dict:
+def micro_shape(p: dict) -> dict:
+    """The micro-prepass shape (tools/measure_session.py): the SAME
+    model and leg structure at the smallest meaningful scale — 1 round,
+    tiny token budgets — so a short healthy tunnel window can bank a
+    coarse number for EVERY leg before the full-budget passes start
+    (r03-r05 each lost most legs to mid-session tunnel wedges)."""
+    return dict(p, batch=min(p["batch"], 2),
+                prompt_len=min(p["prompt_len"], 32),
+                new_tokens=min(p["new_tokens"], 8))
+
+
+def run_leg(name: str, p: dict, micro: bool = False) -> dict:
+    if micro:
+        p = micro_shape(p)
     model, batch = p["model"], p["batch"]
     prompt_len, new_tokens = p["prompt_len"], p["new_tokens"]
     flagship = p["flagship"]
     try:
         if name == "headline":
             out = _bench_engine(model, batch, prompt_len, new_tokens,
-                                latency=True)
+                                latency=not micro)
         elif name == "headline_int8":
             out = _bench_engine(model, batch, prompt_len, new_tokens,
-                                quant=True, latency=True)
+                                quant=True, latency=not micro)
         elif name == "sweep":
-            out = _leg_sweep(model, prompt_len, new_tokens)
+            out = (_leg_sweep(model, prompt_len, new_tokens,
+                              quants=(False,), batches=(32,)) if micro
+                   else _leg_sweep(model, prompt_len, new_tokens))
         elif name == "flagship_int8":
             out = _leg_flagship(flagship, batch, prompt_len,
                                 min(new_tokens, 64), quant=True)
@@ -1503,17 +1683,28 @@ def run_leg(name: str, p: dict) -> dict:
             out = _leg_prefix_reuse(model, min(new_tokens, 64))
         elif name == "paged_decode":
             out = _leg_paged_decode(model, new_tokens)
+        elif name == "decode_fused":
+            out = (_leg_decode_fused(model, prompt_len, new_tokens,
+                                     batches=(1,), blocks=(1, 4))
+                   if micro else
+                   _leg_decode_fused(model, prompt_len, new_tokens))
         elif name == "pipeline":
             out = _leg_pipeline(model, batch, prompt_len,
                                 min(new_tokens, 32))
         elif name == "fault_recovery":
-            out = _leg_fault_recovery(model)
+            out = (_leg_fault_recovery(model, new_tokens=8) if micro
+                   else _leg_fault_recovery(model))
         elif name == "planner_pipeline":
             out = _leg_planner_pipeline(model, batch, prompt_len,
                                         min(new_tokens, 8))
         elif name == "prefill_long":
-            out = _leg_prefill_long(model)
+            out = (_leg_prefill_long(model, seqs=(512,)) if micro
+                   else _leg_prefill_long(model))
         elif name == "long_context":
+            if micro:
+                # one chunk-multiple context that still exercises the
+                # chunked-prefill + full-context-decode structure
+                os.environ.setdefault("BENCH_LONG_CTX", "4096")
             out = _leg_long_context(model)
         elif name in ("roofline_probe", "roofline_probe_rerun"):
             # the rerun executes the SAME probe immediately after the
@@ -1521,7 +1712,8 @@ def run_leg(name: str, p: dict) -> dict:
             # against was measured adjacent to it, not minutes earlier
             # through a different tunnel mood (the r05 artifact's 1.691
             # "fraction" came from exactly that gap)
-            out = _leg_roofline_probe()
+            out = (_leg_roofline_probe(reps=8, rounds_n=1) if micro
+                   else _leg_roofline_probe())
         elif name == "moe":
             out = _leg_moe(batch, prompt_len, min(new_tokens, 64))
         elif name == "multimodal":
@@ -1533,6 +1725,12 @@ def run_leg(name: str, p: dict) -> dict:
             raise SystemExit(f"unknown leg {name!r}")
     except Exception as e:         # structured error, not a dead process
         out = {"error": f"{type(e).__name__}: {e}"}
+    if micro:
+        # stamped so a micro number can never masquerade as a
+        # full-budget measurement in the artifact
+        out["micro"] = True
+        out["micro_shape"] = {k: p[k] for k in ("batch", "prompt_len",
+                                                "new_tokens")}
     if "device" not in out:
         # guarded + lazy: the planner leg sets its own device string (its
         # subprocess owns the exclusive TPU), and an error path must not
@@ -1670,11 +1868,13 @@ def _run_group_killable(cmd, timeout: int):
         return None, "", ""
 
 
-def _spawn_leg(name: str, params: dict, timeout: int = 900) -> dict:
+def _spawn_leg(name: str, params: dict, timeout: int = 900,
+               micro: bool = False) -> dict:
     """Run one leg in a fresh process; parse the last stdout line as JSON."""
     rc, stdout, stderr = _run_group_killable(
         [sys.executable, str(REPO / "bench.py"), "--leg", name,
-         "--params", json.dumps(params)], timeout)
+         "--params", json.dumps(params)]
+        + (["--micro"] if micro else []), timeout)
     if rc is None:
         return {"error": f"leg timed out after {timeout}s"}
     lines = [l for l in stdout.strip().splitlines() if l.strip()]
@@ -1691,6 +1891,10 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--leg")
     ap.add_argument("--params")
+    ap.add_argument("--micro", action="store_true",
+                    help="run the leg's micro variant (1 round, smallest "
+                         "meaningful shape — the measurement session's "
+                         "prepass)")
     ap.add_argument("--run-log", default=os.environ.get("BENCH_RUN_LOG",
                                                         ""),
                     help="append structured JSONL run-log events "
@@ -1722,7 +1926,7 @@ def main() -> None:
     if args.leg:  # subprocess mode: one leg, one JSON line
         if args.params:
             params.update(json.loads(args.params))
-        print(json.dumps(run_leg(args.leg, params)))
+        print(json.dumps(run_leg(args.leg, params, micro=args.micro)))
         return
 
     # priority order: never-measured evidence first (speculative /
@@ -1731,11 +1935,11 @@ def main() -> None:
     # leg (its 1500s budget must not starve the flagship under the
     # driver's deadline), then the already-proven tails
     legs = ["roofline_probe", "headline", "roofline_probe_rerun",
-            "headline_int8", "speculative", "prompt_lookup",
-            "planner_pipeline", "long_context", "flagship_int8",
-            "batching", "prefix_reuse", "paged_decode", "sweep",
-            "flagship_bf16", "pipeline", "fault_recovery", "prefill_long",
-            "moe", "multimodal", "int4"]
+            "headline_int8", "decode_fused", "speculative",
+            "prompt_lookup", "planner_pipeline", "long_context",
+            "flagship_int8", "batching", "prefix_reuse", "paged_decode",
+            "sweep", "flagship_bf16", "pipeline", "fault_recovery",
+            "prefill_long", "moe", "multimodal", "int4"]
     for skip_var, leg_names in (
             ("BENCH_SKIP_FLAGSHIP", ["flagship_int8", "flagship_bf16"]),
             ("BENCH_SKIP_PIPELINE", ["pipeline", "planner_pipeline",
@@ -1876,7 +2080,7 @@ def main() -> None:
     # legs that still beat every probe get probe_inconsistent instead
     # of a >1.0 "fraction" (apply_measured_frac)
     rerun = results.get("roofline_probe_rerun", {}) or {}
-    measured = measured_ceiling(
+    session = measured_ceiling(
         results.get("roofline_probe", {}),
         [{"hbm_gbs": r} for r in rerun.get("hbm_read_gbs_rounds", [])])
     all_rounds = sorted(
@@ -1889,18 +2093,15 @@ def main() -> None:
             "min": round(all_rounds[0], 1),
             "median": round(all_rounds[len(all_rounds) // 2], 1),
             "max": round(all_rounds[-1], 1)}
-    if measured:
-        extras["measured_ceiling_gbs"] = measured
-        if not headline_is_prior:
-            # a prior headline keeps ITS session's measured-ceiling
-            # fraction; this run's probe doesn't describe that session
-            apply_measured_frac(headline, measured)
-        for key in ("headline_int8", "flagship_int8", "flagship_bf16"):
-            apply_measured_frac(extras.get(key, {}), measured)
-        for pt in extras.get("sweep", {}).get("points", []):
-            apply_measured_frac(pt, measured)
-        for sub in (extras.get("int4", {}) or {}).values():
-            apply_measured_frac(sub, measured)
+    # the DECLARED ceiling is max(session probes, committed best-ever
+    # ledger) — a degraded-tunnel session inherits the chip's real
+    # ceiling instead of minting a lower one; session probes that beat
+    # the ledger raise it for every future session
+    # a prior headline keeps ITS session's measured-ceiling fraction;
+    # this run's probe doesn't describe that session
+    apply_declared_ceiling(headline, extras, device, session,
+                           source="session roofline probe max",
+                           skip_headline=headline_is_prior)
 
     runlog.event("bench_done", value=summary["value"],
                  vs_baseline=summary["vs_baseline"],

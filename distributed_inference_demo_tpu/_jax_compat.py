@@ -1,0 +1,30 @@
+"""jax API compat shims — ONE owner for every call site in the repo.
+
+jax >= 0.6 promotes ``shard_map`` to the top level (replication check
+spelled ``check_vma``) and adds ``jax.lax.axis_size``; earlier releases
+keep ``shard_map`` in ``jax.experimental.shard_map`` under ``check_rep``
+and spell axis size as the classic ``psum(1, axis)`` idiom (which
+constant-folds to a static int).  Call sites use the new spellings; this
+module translates downward so the repo runs on both.
+
+Deliberately free of intra-package imports: ``models`` and ``parallel``
+both consume it, so it must sit below both in the import graph.
+"""
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma,
+                                 **kw)
+
+try:
+    axis_size = jax.lax.axis_size
+except AttributeError:
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)

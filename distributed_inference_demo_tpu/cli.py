@@ -158,6 +158,11 @@ def _build_spec_engine(args):
             "--kv-layout paged is not supported with --draft-model "
             "(the draft/verify rollback decodes dense cache rows); "
             "--batch-slots without a proposer is the paged mode")
+    if getattr(args, "stream_block", None) is not None:
+        raise ValueError(
+            "--stream-block is not supported with --draft-model "
+            "(the draft/verify round is already the fused dispatch "
+            "unit)")
     cfg = get_model_config(args.model)
     params, mesh = _load_params_for_mesh(args, cfg)
     draft_cfg, draft_params = _load_draft_for_mesh(args, mesh)
@@ -189,6 +194,11 @@ def _build_prompt_lookup_engine(args):
         raise ValueError(
             "--kv-layout paged is not supported with --prompt-lookup "
             "(the n-gram verify rollback decodes dense cache rows)")
+    if getattr(args, "stream_block", None) is not None:
+        raise ValueError(
+            "--stream-block is not supported with --prompt-lookup "
+            "(the n-gram draft/verify round is already the fused "
+            "dispatch unit)")
     cfg = get_model_config(args.model)
     params, mesh = _load_params_for_mesh(args, cfg)
     return PromptLookupEngine(
@@ -214,6 +224,7 @@ def _build_engine(args):
         attn_backend=args.attn_backend,
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
         prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
+        stream_block=getattr(args, "stream_block", None),
         mesh=mesh, eos_id=getattr(args, "eos_id", None),
         kv_layout=getattr(args, "kv_layout", None),
         **_kvcache_from_args(args))
@@ -310,6 +321,13 @@ def cmd_serve(args) -> int:
             print("--prefill-chunk is not supported with --chain",
                   file=sys.stderr)
             return 1
+        if getattr(args, "stream_block", None) is not None:
+            # the ring's topology caps a circuit at one token (DESIGN
+            # §13: the tail fuses forward+sample instead); honor-or-
+            # reject, never silently ignore
+            print("--stream-block is not supported with --chain",
+                  file=sys.stderr)
+            return 1
         if _reject_kvcache_flags(args, "--chain (pipeline stages see "
                                  "activations, not tokens)"):
             return 1
@@ -393,7 +411,8 @@ def cmd_serve(args) -> int:
             cfg, params, mesh, max_seq=args.max_seq,
             strategy=args.sp_strategy, sampling=_sampling_from_args(args),
             kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
-            eos_id=getattr(args, "eos_id", None))
+            eos_id=getattr(args, "eos_id", None),
+            max_queue_depth=getattr(args, "sp_queue_depth", None))
         print(f"SERVE_SP {args.model} sp={args.sp} "
               f"strategy={args.sp_strategy} max_seq={args.max_seq}",
               flush=True)
@@ -410,6 +429,8 @@ def cmd_serve(args) -> int:
         unsupported = [flag for flag, on in [
             ("--kv-cache-dtype", bool(getattr(args, "kv_cache_dtype", ""))),
             ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
+            ("--stream-block",
+             getattr(args, "stream_block", None) is not None),
             ("--kv-cache-blocks", _kvcache_flags_set(args)),
             ("--kv-layout", _paged_layout_requested(args)),
             ("--tp", getattr(args, "tp", 1) > 1)] if on]
@@ -471,6 +492,12 @@ def cmd_serve(args) -> int:
         from .models.registry import get_model_config
         from .runtime.batching import ContinuousBatchingEngine
 
+        if getattr(args, "stream_block", None) is not None:
+            # the scheduler's fused block is --decode-block; a second K
+            # knob must be rejected, never silently ignored
+            print("--stream-block is not supported with --batch-slots "
+                  "(use --decode-block)", file=sys.stderr)
+            return 1
         cfg = get_model_config(args.model)
         sampling = _sampling_from_args(args)
         params, mesh = _load_params_for_mesh(args, cfg)
@@ -1109,6 +1136,13 @@ def _add_engine_args(ap):
                          "prompts; with --batch-slots it also bounds the "
                          "decode stall a long admission imposes on "
                          "in-flight rows; 0 = whole-prompt prefill)")
+    ap.add_argument("--stream-block", type=int, default=None,
+                    help="fuse N decode steps per streaming dispatch "
+                         "(docs/DESIGN.md §13): one host dispatch "
+                         "per N tokens with on-device eos/stop matching "
+                         "and early exit; output is bit-identical to "
+                         "the per-token path; default DWT_STREAM_BLOCK "
+                         "or 1")
     ap.add_argument("--kv-cache-blocks", type=int, default=None,
                     help="block-level KV prefix cache (runtime/kvcache): "
                          "host block-pool size in blocks; prompts sharing "
@@ -1152,6 +1186,11 @@ def _add_sp_args(p) -> None:
                    help="ring = sequence-sharded cache + ring-attention "
                         "prefill; ulysses = all_to_all to head-sharded "
                         "attention (needs heads divisible by N)")
+    p.add_argument("--sp-queue-depth", type=int, default=None,
+                   help="serve --sp: max requests allowed to WAIT behind "
+                        "the one running before arrivals get 429 + "
+                        "Retry-After (the sp mesh serializes requests); "
+                        "default DWT_SP_QUEUE_DEPTH or 8, 0 = unbounded")
 
 
 def _sp_unsupported_flags(args, allow_eos: bool = False) -> list:
@@ -1165,6 +1204,8 @@ def _sp_unsupported_flags(args, allow_eos: bool = False) -> list:
         ("--eos-id", not allow_eos
          and getattr(args, "eos_id", None) is not None),
         ("--prefill-chunk", bool(getattr(args, "prefill_chunk", 0))),
+        ("--stream-block",
+         getattr(args, "stream_block", None) is not None),
         ("--kv-cache-blocks", _kvcache_flags_set(args)),
         ("--kv-layout", _paged_layout_requested(args)),
         ("--attn-backend", args.attn_backend != "auto")] if on]

@@ -36,6 +36,27 @@ class SamplingParams:
             raise ValueError(f"min_p must be in [0, 1], got {self.min_p}")
 
 
+def pad_stop_ids(stop_token_ids) -> jnp.ndarray:
+    """Stop-token ids as the traced ``[S]`` int32 vector the device
+    decode loops consume (``-1`` = empty slot, matching the eos
+    sentinel convention).  ``None``/empty becomes a single ``-1`` slot
+    so every engine compiles ONE loop shape whether or not stops are
+    configured."""
+    ids = sorted(set(int(t) for t in (stop_token_ids or ())))
+    if any(t < 0 for t in ids):
+        raise ValueError(f"stop_token_ids must be >= 0, got {ids}")
+    return jnp.asarray(ids or [-1], jnp.int32)
+
+
+def match_stop_ids(tok: jnp.ndarray, stop_ids: jnp.ndarray) -> jnp.ndarray:
+    """[b] sampled tokens vs the padded ``[S]`` stop-id vector -> [b]
+    bool (True where the token IS a stop id).  Pure compare-and-any, so
+    it fuses into the decode loops' step body; ``-1`` slots can never
+    match (token ids are non-negative)."""
+    return jnp.any((tok[:, None] == stop_ids[None, :])
+                   & (stop_ids[None, :] >= 0), axis=-1)
+
+
 def kth_largest(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     """The k-th largest value of [..., vocab] logits (counting
     duplicates, exactly ``lax.top_k(x, k)[0][..., -1]``), as k

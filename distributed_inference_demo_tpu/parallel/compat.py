@@ -1,0 +1,7 @@
+"""Re-export of the repo's jax compat shims under the historical
+``parallel.compat`` name (the shims live in ``.._jax_compat`` so
+``models`` can consume them without importing this package)."""
+
+from .._jax_compat import axis_size, shard_map
+
+__all__ = ["axis_size", "shard_map"]

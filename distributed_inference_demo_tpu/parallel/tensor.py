@@ -13,6 +13,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..models.decoder import stage_forward
+from .compat import shard_map
 from .sharding import stage_param_spec_tree
 
 
@@ -66,7 +67,7 @@ def make_tp_forward(cfg: ModelConfig, spec: StageSpec, mesh: Mesh,
             return stage_forward(p, cfg, spec, i, c, po, tp_axis="tp",
                                  attn_impl=attn_impl,
                                  last_logits_only=last_logits_only)
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(p_specs, P(), _CACHE_SPEC, P()),
             out_specs=(P(), _CACHE_SPEC),

@@ -345,30 +345,67 @@ class ContinuousBatchingEngine:
                                                last_tok, active, rng)
             return cache.keys, cache.values, lengths, tok, lp
 
-        @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(7,))
+        def _fused_loop(step_fn, params, cache, lengths, last_tok,
+                        active, rng, eos, budget, num_steps):
+            """The device-resident fused-block loop shared by the dense
+            and paged multi-step jits (docs/DESIGN.md §13): up to
+            ``num_steps`` lockstep steps in one dispatch (one host sync
+            per BLOCK, not per token — on a device with ~15 ms dispatch
+            latency this is the difference between ~100 tok/s and the
+            HBM roofline), with EARLY EXIT the moment every active row
+            is done — eos'd on device, or out of its remaining token
+            ``budget`` — so a block whose rows all finish at step
+            j < num_steps stops after j steps instead of decoding into
+            stale positions for the rest.  The active mask stays frozen
+            (admission still waits out the block); rows that finish
+            while OTHERS run keep decoding into their own stale
+            positions exactly as before, so the recorded tokens are
+            bit-identical to the fixed-trip scan's.  Returns
+            ``(cache, lengths, tok, toks [B, num_steps], lps,
+            steps_ran)``; the host drain reads ``steps_ran`` columns —
+            the on-device active count that tells it how many steps
+            actually ran.  rng is pre-split per step (the fixed-trip
+            scan's consumption order), so sampled fused blocks keep
+            their exact historical streams."""
+            B = last_tok.shape[0]
+            keys = jax.random.split(rng, num_steps)
+            toks0 = jnp.zeros((B, num_steps), jnp.int32)
+            lps0 = jnp.zeros((B, num_steps), jnp.float32)
+            done0 = jnp.zeros((B,), bool)
+
+            def cond(carry):
+                j, cache, lengths, tok, row_done, toks, lps = carry
+                return (j < num_steps) & jnp.any(active & ~row_done)
+
+            def body(carry):
+                j, cache, lengths, tok, row_done, toks, lps = carry
+                cache, lengths, tok, lp = step_fn(
+                    params, cache, lengths, tok, active, keys[j])
+                row_done = (row_done
+                            | ((eos >= 0) & (tok == eos) & active)
+                            | (j + 1 >= budget))
+                toks = jax.lax.dynamic_update_slice(
+                    toks, tok[:, None], (jnp.int32(0), j))
+                lps = jax.lax.dynamic_update_slice(
+                    lps, lp[:, None], (jnp.int32(0), j))
+                return (j + 1, cache, lengths, tok, row_done, toks, lps)
+
+            (steps, cache, lengths, tok, _, toks, lps) = \
+                jax.lax.while_loop(
+                    cond, body, (jnp.int32(0), cache, lengths, last_tok,
+                                 done0, toks0, lps0))
+            return cache, lengths, tok, toks, lps, steps
+
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(9,))
         def multi_step(params, ck, cv, lengths, last_tok, active, rng,
-                       num_steps):
-            """``num_steps`` lockstep steps fused in one dispatch (one
-            host sync per BLOCK, not per token — on a device with ~10 ms
-            dispatch latency this is the difference between ~100 tok/s
-            and the HBM roofline).  The active mask is frozen for the
-            block; rows that hit max_new/eos mid-block keep decoding
-            into their own stale positions and the host drain simply
-            stops recording them (the speculative drain's guard)."""
+                       eos, budget, num_steps):
+            """Dense fused block: ``_fused_loop`` over ``one_step``."""
             cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
-
-            def body(carry, sub):
-                cache, lengths, tok = carry
-                cache, lengths, tok, lp = one_step(params, cache, lengths,
-                                                   tok, active, sub)
-                return (cache, lengths, tok), (tok, lp)
-
-            (cache, lengths, tok), (toks, lps) = jax.lax.scan(
-                body, (cache, lengths, last_tok),
-                jax.random.split(rng, num_steps))
-            return (cache.keys, cache.values, lengths, tok,
-                    jnp.swapaxes(toks, 0, 1),          # [B, num_steps]
-                    jnp.swapaxes(lps, 0, 1))
+            cache, lengths, tok, toks, lps, steps = _fused_loop(
+                one_step, params, cache, lengths, last_tok, active, rng,
+                eos, budget, num_steps)
+            return (cache.keys, cache.values, lengths, tok, toks, lps,
+                    steps)
 
         @partial(jax.jit, donate_argnums=(3, 4))
         def prefill(params, ids, start, row_k, row_v, real_len, rng):
@@ -695,28 +732,25 @@ class ContinuousBatchingEngine:
                     params, cache, lengths, last_tok, active, rng)
                 return cache.keys, cache.values, lengths, tok, lp
 
-            @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(8,))
+            @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(10,))
             def paged_multi_step(params, pk, pv, tables, lengths,
-                                 last_tok, active, rng, num_steps):
-                """decode_block fusion, paged: the tables are frozen for
-                the block (no admission can land mid-block) and rows
-                that finish mid-block keep writing — through their own
+                                 last_tok, active, rng, eos, budget,
+                                 num_steps):
+                """decode_block fusion, paged: ``_fused_loop`` over
+                ``paged_one_step`` — the same early-exit/active-count
+                semantics as the dense block (so paged-vs-dense greedy
+                parity stays structural).  The tables are frozen for the
+                block (no admission can land mid-block) and rows that
+                finish while others run keep writing — through their own
                 still-allocated pages, or through sentinel entries that
                 drop the write (the paged stale-slot route)."""
                 bind_tables(tables)
                 cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
-
-                def body(carry, sub):
-                    cache, lengths, tok = carry
-                    cache, lengths, tok, lp = paged_one_step(
-                        params, cache, lengths, tok, active, sub)
-                    return (cache, lengths, tok), (tok, lp)
-
-                (cache, lengths, tok), (toks, lps) = jax.lax.scan(
-                    body, (cache, lengths, last_tok),
-                    jax.random.split(rng, num_steps))
-                return (cache.keys, cache.values, lengths, tok,
-                        jnp.swapaxes(toks, 0, 1), jnp.swapaxes(lps, 0, 1))
+                cache, lengths, tok, toks, lps, steps = _fused_loop(
+                    paged_one_step, params, cache, lengths, last_tok,
+                    active, rng, eos, budget, num_steps)
+                return (cache.keys, cache.values, lengths, tok, toks,
+                        lps, steps)
 
             @jax.jit
             def set_slot_state(lengths, last_tok, slot, new_len, new_tok):
@@ -747,6 +781,11 @@ class ContinuousBatchingEngine:
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._rng = jax.random.PRNGKey(seed)
         self._step_count = 0
+        # device-loop dispatch accounting (docs/DESIGN.md §13): one
+        # host dispatch per fused block, device_loop_steps counts the
+        # steps (or speculative rounds) that actually ran inside it —
+        # early exit makes steps < decode_block visible here
+        self.loop_stats = {"host_dispatches": 0, "device_loop_steps": 0}
         self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
         # resumable chunked admission: at most ONE prompt streams its
         # chunks at a time (scheduler state, advanced one dispatch per
@@ -793,10 +832,11 @@ class ContinuousBatchingEngine:
                     tbl = jnp.asarray(self._tables)
                     if n_r > 1:
                         (self._pk, self._pv, self._lengths,
-                         self._last_tok, _, _) = self._paged_multi_step(
+                         self._last_tok, _, _, _) = self._paged_multi_step(
                             self.params, self._pk, self._pv, tbl,
                             self._lengths, self._last_tok, idle,
-                            warm_rng, n_r)
+                            warm_rng, self._eos_scalar(),
+                            jnp.zeros((B,), jnp.int32), n_r)
                     else:
                         (self._pk, self._pv, self._lengths,
                          self._last_tok, _) = self._paged_step(
@@ -805,9 +845,11 @@ class ContinuousBatchingEngine:
                             warm_rng)
                 elif n_r > 1:
                     (self._ck, self._cv, self._lengths, self._last_tok,
-                     _, _) = self._multi_step(
+                     _, _, _) = self._multi_step(
                         self.params, self._ck, self._cv, self._lengths,
-                        self._last_tok, idle, warm_rng, n_r)
+                        self._last_tok, idle, warm_rng,
+                        self._eos_scalar(), jnp.zeros((B,), jnp.int32),
+                        n_r)
                 else:
                     (self._ck, self._cv, self._lengths,
                      self._last_tok, _) = self._step(
@@ -1004,6 +1046,10 @@ class ContinuousBatchingEngine:
                                    if s is not None)}
         if self.kv_cache is not None:
             out["kvcache"] = self.kv_cache.snapshot()
+        # dispatch-floor picture (§13): dispatches vs device steps —
+        # steps/dispatches ≈ decode_block when fusion is engaging
+        out["device_loop"] = dict(self.loop_stats,
+                                  decode_block=self.decode_block)
         # completed is the MONOTONIC count; the reservoirs are bounded
         # (the last 512 samples feed the percentiles).  deque.__copy__ is
         # atomic under the GIL — plain iteration would race the
@@ -1046,6 +1092,7 @@ class ContinuousBatchingEngine:
 
     def reset_stats(self) -> None:
         self._step_count = 0
+        self.loop_stats = {"host_dispatches": 0, "device_loop_steps": 0}
         if self.kv_cache is not None:
             self.kv_cache.reset_stats()
         self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
@@ -1457,12 +1504,34 @@ class ContinuousBatchingEngine:
                 if self.kv_layout == "paged":
                     self._tables[i] = self._page_sentinel
 
+    def _eos_scalar(self):
+        """eos_id as the traced sentinel scalar (-1 = disabled) — the
+        fused loop's on-device eos check (engine.py convention)."""
+        return jnp.int32(self.eos_id if self.eos_id is not None else -1)
+
+    def _budget_vec(self) -> jnp.ndarray:
+        """[B] remaining-token budget per slot (0 for empty slots): the
+        fused loop's on-device row-done bound, so a block whose rows
+        all reach max_new at step j < decode_block exits at j."""
+        return jnp.asarray(
+            [(r.max_new - len(r.tokens)) if r is not None else 0
+             for r in self._slots], jnp.int32)
+
+    def _count_loop(self, steps: int) -> None:
+        from .engine import count_device_loop
+        self.loop_stats["host_dispatches"] += 1
+        self.loop_stats["device_loop_steps"] += steps
+        count_device_loop(type(self).__name__, steps)
+
     def _step_active(self, rounds: int) -> None:
-        """Run ``rounds`` lockstep decode steps (plain mode) or
+        """Run up to ``rounds`` lockstep decode steps (plain mode) or
         draft/verify rounds (speculative / prompt-lookup modes) over the
         currently occupied slots and record the emitted tokens.  Shared
         by the scheduler loop and chunked admission's between-chunk
-        interleaving (``prefill_chunk``)."""
+        interleaving (``prefill_chunk``).  The plain fused block may run
+        FEWER than ``rounds`` steps (on-device early exit when every
+        row eos'd or exhausted its budget); the device-reported step
+        count drives the drain."""
         active_mask = np.array([s is not None for s in self._slots])
         self._rng, sub = jax.random.split(self._rng)
         if self._pld_step is not None or self._spec_step is not None:
@@ -1480,27 +1549,30 @@ class ContinuousBatchingEngine:
                     self._last_tok, jnp.asarray(active_mask), sub,
                     rounds)
             self._last_tok = tok
+            self._count_loop(rounds)
             em_np, ns_np = np.asarray(em), np.asarray(ns)
             for r in range(rounds):
                 self._drain_spec_blocks(em_np[r], ns_np[r])
         elif rounds > 1:
             if self.kv_layout == "paged":
                 (self._pk, self._pv, self._lengths, tok,
-                 blocks, lps) = self._paged_multi_step(
+                 blocks, lps, steps) = self._paged_multi_step(
                     self.params, self._pk, self._pv,
                     jnp.asarray(self._tables), self._lengths,
                     self._last_tok, jnp.asarray(active_mask), sub,
-                    rounds)
+                    self._eos_scalar(), self._budget_vec(), rounds)
             else:
                 (self._ck, self._cv, self._lengths, tok,
-                 blocks, lps) = self._multi_step(
+                 blocks, lps, steps) = self._multi_step(
                     self.params, self._ck, self._cv, self._lengths,
                     self._last_tok, jnp.asarray(active_mask), sub,
-                    rounds)
+                    self._eos_scalar(), self._budget_vec(), rounds)
             self._last_tok = tok
-            self._step_count += rounds
+            steps = int(steps)       # the on-device active count
+            self._count_loop(steps)
+            self._step_count += steps
             self._record_row_blocks(
-                np.asarray(blocks), np.full(len(self._slots), rounds),
+                np.asarray(blocks), np.full(len(self._slots), steps),
                 np.asarray(lps))
         else:
             if self.kv_layout == "paged":
@@ -1514,6 +1586,7 @@ class ContinuousBatchingEngine:
                     self.params, self._ck, self._cv, self._lengths,
                     self._last_tok, jnp.asarray(active_mask), sub)
             self._last_tok = tok
+            self._count_loop(1)
             tok_np, lp_np = np.asarray(tok), np.asarray(lp)
             self._step_count += 1
             for i, req in enumerate(self._slots):

@@ -107,8 +107,29 @@ class StageRuntime:
         def sample(last_logits, rng):
             return sample_logits(last_logits, rng, sampling)
 
+        @jax.jit
+        def forward_sample(params, inputs, cache, rng):
+            """Tail hot path: layer range + LM head + in-jit sampling
+            fused into ONE program (docs/DESIGN.md §13) — halves the
+            tail's per-token host dispatches vs forward-then-sample.
+            Same rng, same sample_logits: bit-identical tokens to the
+            split pair by construction."""
+            b, s = inputs.shape[0], inputs.shape[1]
+            pos = cache.length + jnp.broadcast_to(jnp.arange(s), (b, s))
+            out, cache = fwd(params, inputs, cache, pos, False)
+            return sample_logits(out[:, -1], rng, sampling), cache
+
         self._forward = forward
         self._sample = sample
+        self._forward_sample = forward_sample
+        # the socket ring's topology caps the circuit at ONE token (the
+        # stage cut severs the token -> embed dependency; §13), so the
+        # tail's device-side win is dispatch FUSION, not K-fusion —
+        # DWT_RING_FUSED_TAIL=0 restores the split pair (the parity
+        # reference the fused program is pinned against)
+        from ..telemetry._env import env_int
+        self.fused_tail = (spec.is_last
+                           and env_int("DWT_RING_FUSED_TAIL", 1) != 0)
 
     def _cache_for(self, rid: int, batch: int) -> KVCache:
         cache = self.caches.get(rid)
@@ -134,6 +155,20 @@ class StageRuntime:
         rng = jax.random.fold_in(jax.random.fold_in(self._rng_base, rid),
                                  step)
         return np.asarray(self._sample(last_logits, rng))
+
+    def run_chunk_sample(self, rid: int, step: int,
+                         inputs: np.ndarray) -> np.ndarray:
+        """Tail-only fused step: run this stage AND sample in one
+        dispatch.  The rng is the same ``fold_in(rid, step)`` stream
+        :meth:`sample_tokens` draws, so the fused and split tails emit
+        bit-identical tokens."""
+        x = jnp.asarray(inputs)
+        cache = self._cache_for(rid, x.shape[0])
+        rng = jax.random.fold_in(jax.random.fold_in(self._rng_base, rid),
+                                 step)
+        tok, self.caches[rid] = self._forward_sample(self.params, x,
+                                                     cache, rng)
+        return np.asarray(tok)
 
     def free(self, rid: int) -> None:
         self.caches.pop(rid, None)
@@ -164,6 +199,7 @@ class PipelineWorker:
         self.stats = StageStats(role=role)
         self.tracer = TraceRecorder(f"{role}:{transport.device_id}")
         self.flight = get_flight_recorder()
+        self.tail_dispatches = 0   # host dispatches spent sampling (§13)
         self._last_wait: Optional[float] = None  # serve loop's recv wait
         self._last_wait_start: Optional[float] = None  # its wall start
         # per-rid expected next step: the KV cache is append-only, so a
@@ -177,6 +213,14 @@ class PipelineWorker:
     def _forward_control(self, tag: str, payload: bytes = b"") -> None:
         if self.next_id is not None:
             self.transport.send(self.next_id, tag, payload)
+
+    def _count_tail_dispatches(self, dispatches: int) -> None:
+        """Per-token host-dispatch accounting on the tail (the ring's
+        share of the dwt_engine_* dispatch-floor series): 1 on the
+        fused forward+sample path, 2 on the split reference pair."""
+        from .engine import count_device_loop
+        self.tail_dispatches += dispatches
+        count_device_loop("PipelineWorkerTail", 1, dispatches)
 
     # tag factories — overridable (the elastic runtime appends a reshard
     # epoch so stale pre-reshard traffic is identifiable and droppable)
@@ -326,15 +370,27 @@ class PipelineWorker:
         t_c = SpanClock()
         with t_c:
             [x] = tensors
-            out = self.rt.run_chunk(rid, x)
-            # the cache consumed this chunk: only step+1 may run next
-            self._next_step[rid] = step + 1
-            if self.rt.spec.is_last:
-                result = [self.rt.sample_tokens(rid, step, out)]
+            if self.rt.fused_tail:
+                # ONE dispatch: layers + head + sample (dispatch-floor
+                # fusion, §13); the split pair below is its pinned
+                # parity reference
+                toks = self.rt.run_chunk_sample(rid, step, x)
+                self._next_step[rid] = step + 1
+                self._count_tail_dispatches(1)
+                result = [toks]
                 dest, tag = self.header_id, self._make_tok_tag(rid, step)
             else:
-                result = [np.asarray(out)]
-                dest, tag = self.next_id, self._make_h_tag(rid, step)
+                out = self.rt.run_chunk(rid, x)
+                # the cache consumed this chunk: only step+1 may run next
+                self._next_step[rid] = step + 1
+                if self.rt.spec.is_last:
+                    result = [self.rt.sample_tokens(rid, step, out)]
+                    self._count_tail_dispatches(2)
+                    dest, tag = self.header_id, self._make_tok_tag(rid,
+                                                                   step)
+                else:
+                    result = [np.asarray(out)]
+                    dest, tag = self.next_id, self._make_h_tag(rid, step)
             compute_span = self.tracer.next_span_id() if ctx else 0
             body = (wire.serialize_tensors_traced(result, ctx[0],
                                                   compute_span)

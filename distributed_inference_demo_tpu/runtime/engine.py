@@ -7,12 +7,17 @@ send -> host-side sampling in C++ (``Communication.java:682-928``,
 collapses all of it into two compiled programs:
 
 - ``prefill``: one jit over the whole prompt chunk.
-- ``decode``: ONE ``lax.scan`` over all new tokens — sampling fused in, KV
-  cache donated, zero host round-trips until the final token block comes
-  back.  Per-token host work is literally nothing.
+- ``decode_loop``: ONE ``lax.while_loop`` over the new tokens — sampling
+  fused in, KV cache donated, on-device eos/stop-token matching with
+  ALL-ROWS-DONE EARLY EXIT, zero host round-trips until the token block
+  comes back.  Per-token host work is literally nothing, and an early
+  eos no longer burns the remainder of a fixed block.
 
-A ``generate_stream`` variant trades the fused scan for a per-token jitted
-step so callers can stream partial decodes (the reference streams partial
+``generate_stream`` runs the same loop in K-token chunks
+(``stream_block``): one host dispatch per K tokens instead of per token
+(the BENCH_SELF_r05 15.31 ms dispatch floor amortizes K-fold), flushing
+early when the device reports all rows done; K=1 keeps the per-token
+jitted step the loop is bit-identical to (the reference streams partial
 strings to the UI via DataRepository, ``Communication.java:629-638``).
 
 Also enforces the KV capacity bound host-side (prompt + new tokens <=
@@ -30,9 +35,35 @@ import numpy as np
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..models.decoder import stage_forward
 from ..ops.flash_attention import make_flash_attn_impl
-from ..ops.sampling import SamplingParams, sample_logits
+from ..ops.sampling import (SamplingParams, match_stop_ids, pad_stop_ids,
+                            sample_logits)
 from ..telemetry.flightrecorder import get_flight_recorder
 from ..telemetry.runlog import get_run_log
+
+
+def resolve_stream_block(stream_block) -> int:
+    """The streaming decode-block size K, ONE owner for every engine
+    that fuses K device-loop steps per host dispatch: ``None`` defers to
+    the ``DWT_STREAM_BLOCK`` env knob, default 1 (the per-token path —
+    the parity reference the device loop is pinned against)."""
+    if stream_block is None:
+        from ..telemetry._env import env_int
+        stream_block = env_int("DWT_STREAM_BLOCK", 1)
+    stream_block = int(stream_block)
+    if stream_block < 1:
+        raise ValueError(f"stream_block must be >= 1, got {stream_block}")
+    return stream_block
+
+
+def count_device_loop(engine_name: str, steps: int,
+                      dispatches: int = 1) -> None:
+    """Feed the device-loop telemetry pair: one host DISPATCH issued,
+    ``steps`` decode steps executed inside it.  dispatches/token ≈ 1/K
+    is the headline invariant the decode_fused bench leg measures."""
+    from ..telemetry.catalog import (ENGINE_DEVICE_LOOP_STEPS,
+                                     ENGINE_HOST_DISPATCHES)
+    ENGINE_HOST_DISPATCHES.inc(dispatches, engine=engine_name)
+    ENGINE_DEVICE_LOOP_STEPS.inc(steps, engine=engine_name)
 
 
 def shard_engine_params(params: "StageParams", cfg: "ModelConfig", mesh):
@@ -67,10 +98,20 @@ class GenerationResult:
     # the temperature/top-k-filtered sampling distribution — the
     # OpenAI-style convention), [batch, max_new_tokens] f32, or None
     logprobs: Optional[np.ndarray] = None
+    # decode steps the device loop actually RAN (docs/DESIGN.md §13):
+    # early exit on eos/stop can make this < num_new, in which case
+    # token columns >= steps_computed are deterministic padding the
+    # device never computed.  None = engines without the loop (every
+    # step ran).
+    steps_computed: Optional[int] = None
 
     @property
     def tokens_per_second(self) -> float:
-        total = self.tokens.shape[0] * self.num_new
+        """Throughput over steps the device actually ran — an
+        early-exited run must not claim rate for padding it skipped."""
+        steps = (self.steps_computed if self.steps_computed is not None
+                 else self.num_new)
+        total = self.tokens.shape[0] * steps
         return total / self.seconds if self.seconds > 0 else float("nan")
 
 
@@ -214,7 +255,9 @@ class InferenceEngine:
                  mesh=None,
                  kv_cache_blocks: Optional[int] = None,
                  kv_block_tokens: Optional[int] = None,
-                 kv_layout: Optional[str] = None):
+                 kv_layout: Optional[str] = None,
+                 stop_token_ids=None,
+                 stream_block: Optional[int] = None):
         """``attn_backend``: "auto" (Pallas flash kernel on TPU, jnp
         elsewhere), "flash", "flash-interpret" (testing), or "jnp".
 
@@ -259,7 +302,28 @@ class InferenceEngine:
         prefills only the suffix; every prefill stores its full blocks
         back.  ``None`` defers to ``DWT_KVCACHE_*`` env knobs; default
         off (0) — the continuous-batching engine is the default-on
-        consumer."""
+        consumer.
+
+        ``stop_token_ids``: token ids that end a row ON DEVICE, inside
+        the fused decode loop (docs/DESIGN.md §13) — single-token stop
+        matching at zero host round-trips (text-level stop STRINGS stay
+        a server-side concern, runtime/http_server.StopMatcher).  The
+        stop token itself is emitted (the eos-include convention); the
+        row then pads with eos like an eos finish.  With ``eos_id``
+        UNSET there is no pad token: ``generate``'s fixed-width output
+        pads with token 0 past the cut — read
+        ``GenerationResult.steps_computed`` for where real output ends,
+        or use ``generate_stream``, which simply stops.
+
+        ``stream_block``: fuse this many decode steps per
+        ``generate_stream`` host dispatch (K).  The device loop checks
+        eos/stop and all-rows-done ON DEVICE, so an early finish exits
+        after j <= K steps instead of burning the block; the host sees
+        tokens in K-sized chunks (dispatches/token ≈ 1/K — the
+        BENCH_SELF_r05 15.31 ms host dispatch floor amortizes K-fold).
+        1 (default; ``DWT_STREAM_BLOCK`` env between) keeps the
+        per-token path, which the fused loop is bit-identical to
+        (greedy) by construction."""
         from .kvcache import require_dense_kv_layout
         require_dense_kv_layout(
             "InferenceEngine (the single-request engines decode dense "
@@ -272,6 +336,13 @@ class InferenceEngine:
         self.spec = StageSpec(0, 1, 0, cfg.num_layers)
         self.prefill_chunk = validate_prefill_chunk(prefill_chunk,
                                                     self.max_seq)
+        self.stream_block = resolve_stream_block(stream_block)
+        self._stop_ids = pad_stop_ids(stop_token_ids)
+        self._has_stop_ids = bool(stop_token_ids)
+        # host-dispatch / device-step counters for THIS engine instance
+        # (the dwt_engine_* series aggregate across instances); the
+        # decode_fused bench leg and the 1/K invariant test read these
+        self.loop_stats = {"host_dispatches": 0, "device_loop_steps": 0}
         self.mesh = mesh
         tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         from ..parallel.tensor import resolve_tp_attn_backend
@@ -343,61 +414,88 @@ class InferenceEngine:
             done = done | (live & (tok == eos))
             return tok, done
 
-        @partial(jax.jit, donate_argnums=(2,), static_argnums=(5, 6))
-        def decode(params, last_logits, cache, rng, eos, num_steps,
-                   with_logprobs=False):
-            """Fused sample+forward scan for ``num_steps`` tokens.
+        def _emitted_lp(logits, tok):
+            return jnp.take_along_axis(
+                jax.nn.log_softmax(logits.astype(jnp.float32), -1),
+                tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
 
-            With an ``eos_id``, rows that emitted it keep emitting it
-            (static shapes can't shorten the scan, but a finished row's
-            suffix is deterministic eos padding, matching the streaming
-            path's early stop semantics row-wise).  ``with_logprobs``
-            additionally emits each token's raw log-softmax probability
-            (one extra [b, V] reduction per step, only when asked)."""
+        @partial(jax.jit, donate_argnums=(2,), static_argnums=(8, 9))
+        def decode_loop(params, last_logits, cache, rng, eos, stop_ids,
+                        done, limit, num_steps, with_logprobs=False):
+            """The device-resident decode loop (docs/DESIGN.md §13): up
+            to ``limit`` fused sample+forward steps in ONE dispatch,
+            with on-device eos masking, stop-token-ID matching, and
+            ALL-ROWS-DONE EARLY EXIT — an eos at step j < limit ends
+            the loop after j+1 steps instead of burning the remainder
+            of a fixed block; the host is touched once per block.
+
+            ``num_steps`` (static) sizes the token/logprob buffers;
+            ``limit`` (traced) bounds the trip count, so one compiled
+            program serves both full blocks and the stream's tail
+            block.  Rows that finished keep emitting deterministic eos
+            padding while others run (``_mask_eos`` row-wise — the
+            per-token path's semantics, which this loop is greedy
+            bit-identical to: same rng split order, same mask-then-
+            score step order).  Returns ``(toks [b, num_steps],
+            lps [b, num_steps], next_logits, cache, rng, done,
+            steps_ran)``; buffer columns >= steps_ran are eos padding
+            the host must not read past."""
             b = last_logits.shape[0]
+            pad = jnp.where(eos >= 0, eos, 0).astype(jnp.int32)
+            toks0 = jnp.broadcast_to(pad, (b, num_steps)).astype(jnp.int32)
+            lps0 = jnp.zeros((b, num_steps), jnp.float32)
 
-            def step(carry, _):
-                logits, cache, rng, done = carry
+            def cond(carry):
+                j, logits, cache, rng, done, toks, lps = carry
+                return (j < limit) & ~jnp.all(done)
+
+            def body(carry):
+                j, logits, cache, rng, done, toks, lps = carry
                 rng, sub = jax.random.split(rng)
                 tok = sample_logits(logits, sub, samp_)
                 tok, done = _mask_eos(tok, done, eos)
+                done = done | match_stop_ids(tok, stop_ids)
                 if with_logprobs:
-                    lp = jnp.take_along_axis(
-                        jax.nn.log_softmax(logits.astype(jnp.float32), -1),
-                        tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+                    lp = _emitted_lp(logits, tok)
                 else:
                     lp = jnp.zeros((b,), jnp.float32)
+                toks = jax.lax.dynamic_update_slice(
+                    toks, tok[:, None], (jnp.int32(0), j))
+                lps = jax.lax.dynamic_update_slice(
+                    lps, lp[:, None], (jnp.int32(0), j))
                 pos = jnp.broadcast_to(cache.length, (b, 1))
                 out, cache = fwd(params, tok[:, None], cache, pos, False)
-                return (out[:, 0], cache, rng, done), (tok, lp)
+                return (j + 1, out[:, 0], cache, rng, done, toks, lps)
 
-            (_, cache, _, _), (toks, lps) = jax.lax.scan(
-                step, (last_logits, cache, rng, jnp.zeros((b,), bool)),
-                None, length=num_steps)
-            return (jnp.swapaxes(toks, 0, 1),
-                    jnp.swapaxes(lps, 0, 1), cache)  # [batch, steps]
+            (steps, logits, cache, rng, done, toks, lps) = \
+                jax.lax.while_loop(
+                    cond, body,
+                    (jnp.int32(0), last_logits, cache, rng, done,
+                     toks0, lps0))
+            return toks, lps, logits, cache, rng, done, steps
 
         @partial(jax.jit, donate_argnums=(2,))
-        def decode_one(params, last_logits, cache, rng, eos, done):
-            """One streamed step; eos masking and the logprob both happen
-            HERE, in the same order as the fused scan's step (mask first,
-            then score the emitted token), so the two paths agree on
-            (token, logprob) pairs row-wise."""
+        def decode_one(params, last_logits, cache, rng, eos, stop_ids,
+                       done):
+            """One streamed step — the PER-TOKEN path the device loop is
+            pinned against; eos masking, stop-id matching, and the
+            logprob all happen HERE in the same order as the loop's body
+            (mask first, then score the emitted token), so the two paths
+            agree on (token, logprob, done) triples row-wise."""
             rng, sub = jax.random.split(rng)
             tok = sample_logits(last_logits, sub, samp_)
             tok, done = _mask_eos(tok, done, eos)
+            done = done | match_stop_ids(tok, stop_ids)
             b = tok.shape[0]
             # per-token logprob rides along (one [b, V] reduction; the
             # streaming path is dispatch-bound, so it's in the noise)
-            lp = jnp.take_along_axis(
-                jax.nn.log_softmax(last_logits.astype(jnp.float32), -1),
-                tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+            lp = _emitted_lp(last_logits, tok)
             pos = jnp.broadcast_to(cache.length, (b, 1))
             out, cache = fwd(params, tok[:, None], cache, pos, False)
             return tok, lp, out[:, 0], cache, rng, done
 
         self._prefill = prefill
-        self._decode = decode
+        self._decode_loop = decode_loop
         self._decode_one = decode_one
 
     # ------------------------------------------------------------------
@@ -409,6 +507,13 @@ class InferenceEngine:
         """eos_id as the traced sentinel scalar (-1 = disabled), read at
         call time so eos_id assignment between calls takes effect."""
         return jnp.int32(self.eos_id if self.eos_id is not None else -1)
+
+    def _count_loop(self, steps: int, dispatches: int = 1) -> None:
+        """One decode dispatch left the host and ran ``steps`` device
+        steps: feed the instance counters + the dwt_engine_* series."""
+        self.loop_stats["host_dispatches"] += dispatches
+        self.loop_stats["device_loop_steps"] += steps
+        count_device_loop(type(self).__name__, steps, dispatches)
 
     def new_cache(self, batch: int) -> KVCache:
         # KVCache.create pads the buffer to the sublane granule; max_seq
@@ -483,6 +588,20 @@ class InferenceEngine:
             self.kv_cache.store(np.asarray(ids[0]), cache.keys,
                                 cache.values)
 
+    def _decode(self, params, last_logits, cache, rng, eos, num_steps,
+                with_logprobs=False):
+        """Back-compat fused-decode surface (multimodal engine, bench
+        long_context leg): the device loop with ``limit == num_steps``
+        — same output contract as the old fixed-trip scan, now with
+        all-rows-done early exit.  Returns ``(toks, lps, cache)``."""
+        b = last_logits.shape[0]
+        toks, lps, _, cache, _, _, steps = self._decode_loop(
+            params, last_logits, cache, rng, eos, self._stop_ids,
+            jnp.zeros((b,), bool), jnp.int32(num_steps), num_steps,
+            with_logprobs)
+        self._count_loop(int(steps))
+        return toks, lps, cache
+
     def scrape_stats(self) -> dict:
         """Metrics-scrape fragment (telemetry/catalog.scrape): the KV
         cache counters, when the cache is on.  Deliberately NOT
@@ -491,9 +610,13 @@ class InferenceEngine:
                 if self.kv_cache is not None else {})
 
     def debug_state(self) -> dict:
-        """``GET /debugz`` fragment: KV cache occupancy/LRU picture."""
-        return ({"kvcache": self.kv_cache.debug_state()}
-                if self.kv_cache is not None else {})
+        """``GET /debugz`` fragment: KV cache occupancy/LRU picture +
+        the device-loop dispatch accounting (§13 runbook)."""
+        out = {"device_loop": dict(self.loop_stats,
+                                   stream_block=self.stream_block)}
+        if self.kv_cache is not None:
+            out["kvcache"] = self.kv_cache.debug_state()
+        return out
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0, logprobs: bool = False) -> GenerationResult:
@@ -516,15 +639,18 @@ class InferenceEngine:
         start, cache = self._kv_seed(ids, cache)
         last_logits, cache = self._run_prefill(ids, cache, start=start)
         self._kv_store(ids, cache)
-        toks, lps, _ = self._decode(self.params, last_logits, cache, rng,
-                                    self._eos_scalar(), max_new_tokens,
-                                    logprobs)
+        toks, lps, _, _, _, _, steps = self._decode_loop(
+            self.params, last_logits, cache, rng, self._eos_scalar(),
+            self._stop_ids, jnp.zeros((b,), bool),
+            jnp.int32(max_new_tokens), max_new_tokens, logprobs)
         toks = np.asarray(toks)
+        steps = int(steps)
+        self._count_loop(steps)
         lps_np = np.asarray(lps) if logprobs else None
         dt = time.perf_counter() - t0
         result = GenerationResult(tokens=toks, prompt_len=plen,
                                   num_new=max_new_tokens, seconds=dt,
-                                  logprobs=lps_np)
+                                  logprobs=lps_np, steps_computed=steps)
         rl = get_run_log()
         if rl.enabled:   # per-request summary in the structured run log
             rl.event("generate", engine=type(self).__name__,
@@ -572,7 +698,15 @@ class InferenceEngine:
                         logprobs: bool = False) -> Iterator[np.ndarray]:
         """Yield one [batch] token array per step (UI streaming path);
         with ``logprobs=True`` yields ([batch] tokens, [batch] logprobs)
-        pairs instead."""
+        pairs instead.
+
+        With ``stream_block`` K > 1 the per-token dispatch is replaced
+        by the device loop: ONE dispatch produces up to K tokens
+        (buffered host-side and yielded one step at a time, so the
+        consumer surface is unchanged), the stream flushes early the
+        moment the device reports all rows done, and the host never
+        pays a dispatch for steps the loop skipped.  Greedy output is
+        bit-identical to K=1 (pinned by tests)."""
         ids = jnp.asarray(prompt_ids, jnp.int32)
         b, plen = ids.shape
         self._check_capacity(plen, max_new_tokens)
@@ -582,10 +716,35 @@ class InferenceEngine:
         logits, cache = self._run_prefill(ids, cache, start=start)
         self._kv_store(ids, cache)
         done = jnp.zeros((b,), bool)
+        K = self.stream_block
+        if K > 1:
+            remaining = max_new_tokens
+            while remaining > 0:
+                toks, lps, logits, cache, rng, done, steps = \
+                    self._decode_loop(
+                        self.params, logits, cache, rng,
+                        self._eos_scalar(), self._stop_ids, done,
+                        jnp.int32(min(K, remaining)), K, logprobs)
+                steps = int(steps)
+                self._count_loop(steps)
+                if steps == 0:      # all rows were already done on entry
+                    return
+                tok_np = np.asarray(toks)
+                lp_np = np.asarray(lps) if logprobs else None
+                for j in range(steps):
+                    yield ((tok_np[:, j], lp_np[:, j]) if logprobs
+                           else tok_np[:, j])
+                remaining -= steps
+                if bool(np.asarray(done).all()):
+                    return
+            return
         for _ in range(max_new_tokens):
             tok, lp, logits, cache, rng, done = self._decode_one(
-                self.params, logits, cache, rng, self._eos_scalar(), done)
+                self.params, logits, cache, rng, self._eos_scalar(),
+                self._stop_ids, done)
+            self._count_loop(1)
             tok_np = np.asarray(tok)
             yield (tok_np, np.asarray(lp)) if logprobs else tok_np
-            if self.eos_id is not None and np.asarray(done).all():
+            if ((self.eos_id is not None or self._has_stop_ids)
+                    and np.asarray(done).all()):
                 return

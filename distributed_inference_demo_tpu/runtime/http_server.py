@@ -158,9 +158,16 @@ class _StopSession:
     rules must not fork).  ``consume(item)`` processes one step's [b]
     tokens and returns per-row emittable text; ``finish()`` flushes rows
     that ran to length.  Results: ``toks`` (truncated, ragged),
-    ``texts``, ``reason`` ("stop" | "eos" | "length"), ``done``."""
+    ``texts``, ``reason`` ("stop" | "eos" | "length"), ``done``.
 
-    def __init__(self, tokenizer, stop, b: int, eos):
+    With ``logprobs=True``, ``consume`` takes the backend's
+    ``(tokens, logprobs)`` pairs and ``lps`` carries per-row logprob
+    rows truncated EXACTLY where ``toks`` truncates — one cut position,
+    two parallel lists, so a stop can never leave a logprob for a token
+    the client never saw (or vice versa)."""
+
+    def __init__(self, tokenizer, stop, b: int, eos,
+                 logprobs: bool = False):
         from ..tokenizer import StreamDetokenizer
         self.eos = eos
         self.detoks = [StreamDetokenizer(tokenizer) for _ in range(b)]
@@ -168,6 +175,7 @@ class _StopSession:
         self.texts = [""] * b
         self.toks = [[] for _ in range(b)]
         self.lens = [[] for _ in range(b)]   # cum text len per token
+        self.lps = [[] for _ in range(b)] if logprobs else None
         self.done = [False] * b
         self.reason = ["length"] * b
         self.b = b
@@ -175,11 +183,14 @@ class _StopSession:
     def _cut(self, r: int) -> None:
         """Apply a completed match: truncate text at the cut and keep
         every token needed to produce it (up to the first whose
-        cumulative visible text reaches the cut)."""
+        cumulative visible text reaches the cut) — logprob rows cut at
+        the same token index."""
         import bisect
         m = self.matchers[r].pos
         keep = bisect.bisect_left(self.lens[r], m) + 1
         self.toks[r] = self.toks[r][:min(keep, len(self.toks[r]))]
+        if self.lps is not None:
+            self.lps[r] = self.lps[r][:len(self.toks[r])]
         self.texts[r] = self.texts[r][:m]
         self.done[r], self.reason[r] = True, "stop"
 
@@ -189,12 +200,19 @@ class _StopSession:
             self.lens[r][-1] = len(self.texts[r])
 
     def consume(self, item) -> list:
-        arr = np.asarray(item).reshape(-1).tolist()
+        if self.lps is not None:
+            toks_item, lps_item = item
+            lp_arr = np.asarray(lps_item).reshape(-1).tolist()
+        else:
+            toks_item, lp_arr = item, None
+        arr = np.asarray(toks_item).reshape(-1).tolist()
         pieces = [""] * self.b
         for r in range(self.b):
             if self.done[r]:
                 continue
             self.toks[r].append(int(arr[r]))
+            if lp_arr is not None:
+                self.lps[r].append(float(lp_arr[r]))
             raw = self.detoks[r].push(arr[r])
             self.texts[r] += raw
             self.lens[r].append(len(self.texts[r]))
@@ -419,11 +437,14 @@ class InferenceHTTPServer:
                 self.wfile.write(body)
 
             def _shed(self, e: SchedulerOverloaded) -> None:
-                """503 + Retry-After: the admission queue is past its
-                configured depth — honest fast rejection, not an
+                """503/429 + Retry-After: the admission queue is past
+                its configured depth — honest fast rejection, not an
                 unbounded queue (clients with backoff recover; clients
-                without get a clear signal instead of a timeout)."""
-                self._json(503, {"error": str(e)},
+                without get a clear signal instead of a timeout).  The
+                exception carries the code: 503 = service saturated
+                (batching scheduler), 429 = back off, the sp queue is
+                full behind a long-context request."""
+                self._json(getattr(e, "http_code", 503), {"error": str(e)},
                            headers={"Retry-After":
                                     str(max(1, int(e.retry_after_s)))})
 
@@ -538,18 +559,29 @@ class InferenceHTTPServer:
                     unsupported = [w for w, on in [
                         ("a server-side tokenizer (none attached)",
                          outer.tokenizer is None),
-                        ("logprobs", bool(req.get("logprobs"))),
                         ("image", image is not None)] if on]
                     if unsupported:
                         self._json(501, {
                             "error": "stop does not support "
                                      + ", ".join(unsupported)})
                         return
+                    want_lp = bool(req.get("logprobs"))
+                    if want_lp and not _accepts_kwarg(
+                            outer.backend.generate_stream, "logprobs"):
+                        # both stop paths consume the STREAM surface, so
+                        # streaming logprob support is the one capability
+                        # they need (honor-or-reject, never drop)
+                        self._json(501, {
+                            "error": "backend does not support "
+                                     "logprobs with stop"})
+                        return
                     if req.get("stream"):
-                        self._stream_stop(ids, max_new, seed, stop)
+                        self._stream_stop(ids, max_new, seed, stop,
+                                          logprobs=want_lp)
                         return
                     try:
-                        self._generate_stop(ids, max_new, seed, stop)
+                        self._generate_stop(ids, max_new, seed, stop,
+                                            logprobs=want_lp)
                     except SchedulerOverloaded as e:
                         self._shed(e)
                     except TimeoutError as e:   # --request-timeout: the
@@ -639,20 +671,25 @@ class InferenceHTTPServer:
                 except Exception as e:      # stalled pipeline etc. -> 500
                     self._json(500, {"error": str(e)})
 
-            def _generate_stop(self, ids, max_new, seed, stop):
+            def _generate_stop(self, ids, max_new, seed, stop,
+                               logprobs=False):
                 """Blocking generation with STOP SEQUENCES: rows end at
                 the earliest occurrence of any stop string (which is
                 excluded from the output — the OpenAI convention), and
                 the batch stops consuming once every row finished
                 (stream backends with resumable dispatches skip the
                 remaining decode; fused/pipeline backends finish their
-                in-flight program in the background).  Matching, token
+                in-flight program in the background).  With
+                ``logprobs=True`` each row additionally carries its
+                per-token logprobs, truncated at EXACTLY the same token
+                index as the tokens (_StopSession owns the one cut).
+                Matching, token
                 truncation, and eos handling live in ONE owner shared
                 with the streaming path (_StopSession); rows are
                 RAGGED.  ``stop_reason`` per row: "stop", "eos" (the
                 backend's eos ended the row first; the eos token is
                 included, engine convention), or "length"."""
-                kwargs = {}
+                kwargs = {"logprobs": True} if logprobs else {}
                 if (outer.request_timeout
                         and _accepts_kwarg(outer.backend.generate_stream,
                                            "timeout")):
@@ -662,31 +699,41 @@ class InferenceHTTPServer:
                 gen = outer.backend.generate_stream(ids, max_new,
                                                     seed=seed, **kwargs)
                 ses = _StopSession(outer.tokenizer, stop, len(ids),
-                                   getattr(outer.backend, "eos_id", None))
+                                   getattr(outer.backend, "eos_id", None),
+                                   logprobs=logprobs)
                 for item in gen:
                     ses.consume(item)
                     if all(ses.done):
                         gen.close()
                         break
                 ses.finish()
-                self._json(200, {"tokens": ses.toks, "text": ses.texts,
-                                 "stop_reason": ses.reason})
+                out = {"tokens": ses.toks, "text": ses.texts,
+                       "stop_reason": ses.reason}
+                if logprobs:
+                    out["logprobs"] = [_round_lps(row) for row in ses.lps]
+                self._json(200, out)
 
-            def _stream_stop(self, ids, max_new, seed, stop):
+            def _stream_stop(self, ids, max_new, seed, stop,
+                             logprobs=False):
                 """STREAMING generation with stop sequences: chunked
                 JSONL where each line carries per-row TEXT deltas only
                 (tokens would mislead — text is authoritative under
                 stop, and characters that might begin a stop string are
                 held back until they provably aren't part of one, so
                 nothing ever has to be retracted).  A final line carries
-                the truncated token rows + per-row ``stop_reason``."""
+                the truncated token rows + per-row ``stop_reason`` (+
+                per-row logprob rows cut at the same token index, with
+                ``logprobs=True`` — deltas can't carry them: a logprob
+                belongs to a token, and tokens aren't streamed here)."""
+                kwargs = {"logprobs": True} if logprobs else {}
                 gen = outer.backend.generate_stream(ids, max_new,
-                                                    seed=seed)
+                                                    seed=seed, **kwargs)
 
                 def lines(items, gen):
                     ses = _StopSession(
                         outer.tokenizer, stop, len(ids),
-                        getattr(outer.backend, "eos_id", None))
+                        getattr(outer.backend, "eos_id", None),
+                        logprobs=logprobs)
                     step = 0
                     for item in items:
                         pieces = ses.consume(item)
@@ -699,8 +746,12 @@ class InferenceHTTPServer:
                     tail = ses.finish()
                     if any(tail):
                         yield {"step": step, "text": tail}
-                    yield {"done": True, "tokens": ses.toks,
-                           "stop_reason": ses.reason}
+                    final = {"done": True, "tokens": ses.toks,
+                             "stop_reason": ses.reason}
+                    if logprobs:
+                        final["logprobs"] = [_round_lps(row)
+                                             for row in ses.lps]
+                    yield final
 
                 self._stream_lines(gen, lines)
 

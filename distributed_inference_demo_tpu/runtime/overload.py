@@ -14,8 +14,13 @@ from __future__ import annotations
 class SchedulerOverloaded(RuntimeError):
     """The admission queue is past its configured depth: the request was
     REJECTED, not queued.  ``retry_after_s`` is the server's hint for the
-    HTTP ``Retry-After`` header."""
+    HTTP ``Retry-After`` header; ``http_code`` picks the status the HTTP
+    layer renders — 503 (service saturated; the batching scheduler's
+    convention) or 429 (this client should back off; the sp backend's
+    one-request-at-a-time queue)."""
 
-    def __init__(self, msg: str, retry_after_s: float = 1.0):
+    def __init__(self, msg: str, retry_after_s: float = 1.0,
+                 http_code: int = 503):
         super().__init__(msg)
         self.retry_after_s = retry_after_s
+        self.http_code = http_code

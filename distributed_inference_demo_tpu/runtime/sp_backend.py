@@ -21,6 +21,12 @@ Design notes:
 - One request runs at a time (lock): the sp mesh owns every device in
   the group, so concurrent requests would interleave collectives from
   two programs on the same chips.
+- The line behind that lock is BOUNDED and VISIBLE: ``/stats`` reports
+  ``queue_depth``/``busy``, and a request arriving past
+  ``max_queue_depth`` waiting requests is rejected with 429 +
+  Retry-After (``SchedulerOverloaded``) instead of blocking silently
+  for potentially minutes at 32k context (``DWT_SP_QUEUE_DEPTH`` /
+  ``serve --sp-queue-depth``; 0 = unbounded, the old behavior).
 """
 
 from __future__ import annotations
@@ -37,6 +43,7 @@ from ..ops.sampling import SamplingParams
 from ..parallel.sequence import make_sp_generate_fn, validate_sp_prompt
 from ..parallel.ulysses import make_ulysses_generate_fn
 from .engine import GenerationResult
+from .overload import SchedulerOverloaded
 
 STRATEGIES = ("ring", "ulysses")
 
@@ -48,7 +55,13 @@ class SequenceParallelBackend:
                  strategy: str = "ring",
                  sampling: Optional[SamplingParams] = None,
                  kv_cache_dtype: Optional[str] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None):
+        """``max_queue_depth``: how many requests may WAIT behind the
+        one running (the sp mesh serializes requests); one more and the
+        arrival is rejected with 429 + Retry-After instead of blocking
+        on the device lock unboundedly.  ``None`` defers to
+        ``DWT_SP_QUEUE_DEPTH`` (default 8); 0 = unbounded."""
         if strategy not in STRATEGIES:
             raise ValueError(f"unknown sp strategy {strategy!r}; "
                              f"known: {STRATEGIES}")
@@ -76,6 +89,13 @@ class SequenceParallelBackend:
         self._served = 0
         self._decode_seconds = 0.0
         self._tokens_out = 0
+        if max_queue_depth is None:
+            from ..telemetry._env import env_int
+            max_queue_depth = env_int("DWT_SP_QUEUE_DEPTH", 8)
+        self.max_queue_depth = max(0, int(max_queue_depth))
+        # requests admitted and not yet finished (running + waiting on
+        # the device lock) — the /stats queue picture and the 429 bound
+        self._active = 0
         # fail at CONSTRUCTION, not at the first request: the generate
         # fns' build-time checks (max_seq % sp, Ulysses head
         # divisibility) run here, so a misconfigured server errors
@@ -112,14 +132,46 @@ class SequenceParallelBackend:
                 self._fns.popitem(last=False)
         return fn
 
+    def _admit(self):
+        """Bounded admission to the one-request-at-a-time queue: past
+        ``max_queue_depth`` WAITING requests, reject NOW with 429 +
+        Retry-After (estimated from this backend's own measured
+        seconds/request) — a client must never discover saturation by
+        silently blocking on the device lock for minutes.  Callers pair
+        this with ``_leave`` in a finally."""
+        with self._stats_lock:
+            if (self.max_queue_depth
+                    and self._active >= 1 + self.max_queue_depth):
+                per_req = (self._decode_seconds / self._served
+                           if self._served else 30.0)
+                retry = min(600.0, max(1.0, per_req * self._active))
+                raise SchedulerOverloaded(
+                    f"sp queue full: {self._active - 1} request(s) "
+                    f"already waiting behind the running one (bound "
+                    f"{self.max_queue_depth}); retry later",
+                    retry_after_s=retry, http_code=429)
+            self._active += 1
+
+    def _leave(self):
+        with self._stats_lock:
+            self._active -= 1
+
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0) -> GenerationResult:
-        import jax
-
         ids = np.asarray(prompt_ids, dtype=np.int32)
         num_new = int(max_new_tokens)
         # ValueError renders as HTTP 400 with the rule spelled out
         validate_sp_prompt(ids.shape[1], self.sp, self.max_seq, num_new)
+        self._admit()
+        try:
+            return self._generate_admitted(ids, num_new, seed)
+        finally:
+            self._leave()
+
+    def _generate_admitted(self, ids: np.ndarray, num_new: int,
+                           seed: int) -> GenerationResult:
+        import jax
+
         if self.eos_id is not None:
             # eos early stop rides the step-split stream programs (the
             # fused fn has a baked trip count and no eos plumbing):
@@ -181,12 +233,14 @@ class SequenceParallelBackend:
         Greedy streams are bit-identical to ``generate``; sampled streams
         are equally distributed but draw per-block sub-rngs (the engines'
         streaming contract).  Validation errors surface on the first
-        ``next()`` (a clean 400), like every other backend."""
+        ``next()`` (a clean 400), like every other backend — and so does
+        the bounded-queue rejection (a clean 429, still pre-headers)."""
         yield from self._stream(np.asarray(prompt_ids, np.int32),
-                                int(max_new_tokens), seed, [0.0])
+                                int(max_new_tokens), seed, [0.0],
+                                admit=True)
 
     def _stream(self, ids: np.ndarray, num_new: int, seed: int,
-                device_s_box: list):
+                device_s_box: list, admit: bool = False):
         """generate_stream's body; ``device_s_box[0]`` accumulates pure
         device-dispatch seconds so the eos ``generate()`` path can report
         the same device-only timing the fused path does (wall-clock would
@@ -194,6 +248,10 @@ class SequenceParallelBackend:
         import jax
 
         validate_sp_prompt(ids.shape[1], self.sp, self.max_seq, num_new)
+        if admit:
+            # generate_stream entry: the generate() path admitted before
+            # calling in (one admission per REQUEST, not per surface)
+            self._admit()
         emitted, device_s = 0, 0.0
         try:
             # the device lock is held per DISPATCH, never across a yield:
@@ -255,6 +313,8 @@ class SequenceParallelBackend:
             # flushed here too so the caller's timing is complete however
             # the generator exits (eos mid-block, close, failure).
             device_s_box[0] = device_s
+            if admit:
+                self._leave()
             if emitted:
                 with self._stats_lock:
                     self._served += 1
@@ -263,7 +323,8 @@ class SequenceParallelBackend:
 
     def stats(self) -> dict:
         # _stats_lock only: /stats must answer WHILE a long-context
-        # request holds the generation lock
+        # request holds the generation lock — that is exactly when a
+        # client needs the queue picture
         with self._stats_lock:
             return {
                 "mode": "sequence_parallel",
@@ -274,6 +335,12 @@ class SequenceParallelBackend:
                 "tokens_out": self._tokens_out,
                 "seconds_generating": round(self._decode_seconds, 3),
                 "compiled_max_new_variants": sorted(self._fns),
+                # the line behind the one-request-at-a-time device lock:
+                # how deep it is, whether a request is running, and the
+                # bound past which arrivals get 429 (0 = unbounded)
+                "queue_depth": max(0, self._active - 1),
+                "busy": self._lock.locked(),
+                "queue_bound": self.max_queue_depth,
             }
 
     def reset_stats(self) -> None:

@@ -258,6 +258,26 @@ def update_batching_series(stats: dict) -> None:
             SPEC_ACCEPT_RATIO.set(sp["acceptance_rate"])
 
 
+# -- engine device-loop series (event-driven, docs/DESIGN.md §13) ----------
+# dispatches/token ≈ 1/K is the headline invariant: the device-resident
+# decode loop touches the host once per K-token block (or earlier on an
+# all-rows-done exit), so a ratio drifting toward 1 means the fused loop
+# stopped engaging (stream_block/decode_block misconfigured, or a code
+# path fell back to per-token dispatch)
+
+ENGINE_HOST_DISPATCHES = counter(
+    "dwt_engine_host_dispatches_total",
+    "Decode-loop programs dispatched from the host, by engine "
+    "(one per K-token device-loop block on the fused paths; one per "
+    "token on the per-token reference path)", ("engine",))
+ENGINE_DEVICE_LOOP_STEPS = counter(
+    "dwt_engine_device_loop_steps_total",
+    "Decode steps actually executed inside device-resident loops, by "
+    "engine (early exit means steps < K for a block whose rows all "
+    "finished; divide dwt_engine_host_dispatches_total by this for "
+    "dispatches per token)", ("engine",))
+
+
 # -- HTTP serving series (event-driven, not snapshot-bridged) --------------
 
 HTTP_REQUESTS = counter(

@@ -28,6 +28,10 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running multi-process integration test")
+    config.addinivalue_line(
+        "markers", "quick: fast-lane smoke set (~2 min): one cheap, "
+        "representative test per subsystem, for the edit-verify loop "
+        "(`pytest -m quick`); the full suite stays the merge gate")
 
 
 @pytest.fixture(scope="session")

@@ -39,6 +39,7 @@ def expected(oracle, prompt, n):
     return oracle.generate(np.asarray(prompt)[None, :], n).tokens[0]
 
 
+@pytest.mark.quick
 def test_single_request_matches_engine(params, oracle):
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
                                   sampling=GREEDY,
@@ -681,8 +682,13 @@ def test_pld_exclusive_with_draft(params):
 # randomized soak: scheduler races under a mixed workload
 
 
-@pytest.mark.parametrize("mode", ["plain", "draft", "pld", "chunked",
-                                  "chunked-draft"])
+@pytest.mark.parametrize("mode", [
+    "plain",
+    pytest.param("draft", marks=pytest.mark.slow),
+    pytest.param("pld", marks=pytest.mark.slow),
+    pytest.param("chunked", marks=pytest.mark.slow),
+    pytest.param("chunked-draft", marks=pytest.mark.slow),
+])
 def test_soak_random_workload(params, draft_params, oracle, mode):
     """30 requests with random lengths, ~20% random cancellations, and
     staggered submission against 3 slots: every surviving request must
@@ -1121,6 +1127,7 @@ def test_stats_latency_percentiles(params):
         assert eng.stats()["latency"]["completed"] == 0
 
 
+@pytest.mark.slow
 def test_everything_on_composition(params, draft_params, oracle):
     """The maximal serving stack in ONE engine: tensor parallelism x
     fp8 KV cache x speculative decoding x chunked (resumable) admission

@@ -111,3 +111,43 @@ def test_leg_prefix_reuse_structure_tiny():
     # scale scheduler noise can swamp the saved prefill)
     assert isinstance(out["prefill_seconds_saved"], float)
     assert out["blocks_resident"] <= 16
+
+
+def test_leg_decode_fused_structure_tiny():
+    """The decode_fused leg's full structure (per-point engines across
+    batch x stream_block K, measured dispatches/token) at CPU-viable
+    scale — and the leg-level acceptance shape: K=1 pays exactly one
+    dispatch per token, K=4 pays 1/K (no eos in the synthetic prompt
+    stream, so the ratio is exact)."""
+    out = bench._leg_decode_fused("llama-test", 8, 8,
+                                  batches=(1, 2), blocks=(1, 4))
+    assert "error" not in out
+    assert len(out["points"]) == 4
+    for pt in out["points"]:
+        assert "error" not in pt, pt
+        assert pt["tokens"] == 8
+        assert pt["decode_tokens_per_sec"] > 0
+        K = pt["stream_block"]
+        assert pt["host_dispatches"] == (8 if K == 1 else 2)
+        assert pt["dispatches_per_token"] == (1.0 if K == 1 else 0.25)
+        assert pt["device_loop_steps"] == 8
+    assert out["best_decode_tokens_per_sec"] > 0
+
+
+def test_run_leg_micro_variants_stamp_and_shrink():
+    """--micro runs the same leg structure at the smallest meaningful
+    shape and stamps the result so a micro number can never masquerade
+    as a full-budget measurement."""
+    p = {"model": "llama-test", "batch": 8, "prompt_len": 64,
+         "new_tokens": 128, "flagship": "llama-test"}
+    shrunk = bench.micro_shape(p)
+    assert (shrunk["batch"], shrunk["prompt_len"],
+            shrunk["new_tokens"]) == (2, 32, 8)
+    out = bench.run_leg("decode_fused", p, micro=True)
+    assert out["micro"] is True
+    assert out["micro_shape"] == {"batch": 2, "prompt_len": 32,
+                                  "new_tokens": 8}
+    assert "error" not in out
+    # the micro decode_fused variant runs the reduced point grid
+    assert {(pt["batch"], pt["stream_block"])
+            for pt in out["points"]} == {(1, 1), (1, 4)}

@@ -108,6 +108,7 @@ def test_pipeline_interleaved_requests_match():
     np.testing.assert_array_equal(got[1], want1)
 
 
+@pytest.mark.quick
 def test_pipeline_eos_early_stop():
     """EOS: the header must stop a request early and release the stages."""
     cfg = get_model_config("llama-test")

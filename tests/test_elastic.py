@@ -109,6 +109,7 @@ def _stop_all(header, extra_ids=()):
         header.transport.send(dev, "stop", b"")
 
 
+@pytest.mark.slow
 def test_live_migration_scale_down_park_and_rejoin():
     """Planned migration: 3 stages -> 2 (the dropped live worker is parked:
     caches freed, standing by) -> back to 3 (the spare rejoins).  Every
@@ -132,6 +133,7 @@ def test_live_migration_scale_down_park_and_rejoin():
         t.join(timeout=30)
 
 
+@pytest.mark.quick
 def test_live_migration_scale_up():
     """Scale-up: a spare worker joins the chain via reshard."""
     want = reference_tokens(PROMPT, 10)

@@ -26,6 +26,7 @@ def test_generate_shapes_and_throughput(engine):
     assert np.isfinite(res.tokens_per_second)
 
 
+@pytest.mark.quick
 def test_stream_matches_fused_scan(engine):
     """The streaming path must produce the same tokens as the fused scan
     (both greedy, same seed)."""

@@ -91,7 +91,10 @@ def test_flash_matches_reference(b, chunk, nh, nkv, hd, max_seq, q_start,
                                rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("model", ["llama-test", "bloom-test"])
+@pytest.mark.parametrize("model", [
+    "llama-test",
+    pytest.param("bloom-test", marks=pytest.mark.slow),
+])
 def test_flash_attn_impl_generation_parity(model):
     """Whole-model greedy generation: flash attn_impl == default path."""
     cfg = get_model_config(model)

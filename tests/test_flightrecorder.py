@@ -16,6 +16,7 @@ def _isolate_process_recorder():
     set_flight_recorder(None)
 
 
+@pytest.mark.quick
 def test_ring_bounded_keeps_newest():
     fr = FlightRecorder(max_events=4)
     for i in range(10):

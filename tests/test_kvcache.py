@@ -45,6 +45,7 @@ class BruteForce:
         return best
 
 
+@pytest.mark.quick
 def test_radix_match_equals_bruteforce_on_random_workload():
     rng = np.random.default_rng(0)
     bt = 4

@@ -105,6 +105,7 @@ def _histo(samples, name, labels=frozenset()):
     return sorted(buckets), count, total
 
 
+@pytest.mark.quick
 def test_metrics_scrape_counters_and_histogram(served_engine):
     url = served_engine
     _post(url + "/generate", {"prompt_ids": PROMPT, "max_new_tokens": 3})

@@ -7,6 +7,8 @@ review."""
 import importlib.util
 import pathlib
 
+import pytest
+
 from distributed_inference_demo_tpu.telemetry import catalog  # noqa: F401
 from distributed_inference_demo_tpu.telemetry.metrics import (
     Counter, Gauge, REGISTRY, Registry)
@@ -22,6 +24,7 @@ def _load_lint():
     return mod
 
 
+@pytest.mark.quick
 def test_standard_catalog_is_clean():
     lint = _load_lint()
     problems = lint.check_registry(REGISTRY)

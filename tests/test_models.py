@@ -24,6 +24,7 @@ def _full_spec(cfg):
 
 
 @pytest.mark.parametrize("name", FAMILIES)
+@pytest.mark.quick
 def test_forward_shapes(name):
     cfg = get_model_config(name)
     params = init_full_params(jax.random.PRNGKey(0), cfg)
@@ -198,6 +199,7 @@ def test_topk_boundary_ties_exactly_k():
         assert tok in (0, 1)
 
 
+@pytest.mark.slow
 def test_topk_fused_draw_matches_filtered_distribution():
     """The [b, k] candidate draw must follow the SAME distribution as a
     categorical over softmax(filtered_logits) — the contract speculative

@@ -68,6 +68,7 @@ def assert_no_leak(eng):
     assert mgr.debug_state()["leased_nodes"] == 0
 
 
+@pytest.mark.quick
 def test_cold_parity_concurrent_requests(params, oracle):
     prompts = [[3, 14, 15], [9, 2, 6, 5, 3, 5], [1], [7, 7, 7, 7]]
     ns = [10, 14, 8, 12]

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
+from distributed_inference_demo_tpu.parallel.compat import shard_map
 
 from distributed_inference_demo_tpu.models import KVCache, StageSpec, get_model_config
 from distributed_inference_demo_tpu.models.decoder import (
@@ -190,7 +191,7 @@ def test_pipeline_quantized_params(devices):
     targets_mb = targets.reshape(2, 2, 8)
 
     in_specs = _pp_in_specs(qparams, cfg, use_tp=True)
-    fwd = jax.shard_map(
+    fwd = shard_map(
         lambda p, i, t: pipeline_apply(cfg, p, i, t, "tp"),
         mesh=mesh, in_specs=(in_specs, P(), P()), out_specs=P(),
         check_vma=False)

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
+from distributed_inference_demo_tpu.parallel.compat import shard_map
 
 from distributed_inference_demo_tpu.models import (
     KVCache, StageSpec, get_model_config)
@@ -54,7 +55,7 @@ def test_ring_self_attention_matches_dense(sp_mesh, alibi):
 
     expected = _dense_causal(q, k, v, slopes)
 
-    ring = jax.shard_map(
+    ring = shard_map(
         lambda q, k, v: ring_self_attention(q, k, v, "sp", slopes=slopes),
         mesh=sp_mesh, in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"), check_vma=False)
@@ -90,7 +91,7 @@ def test_sp_decode_attention_matches_dense(sp_mesh):
             v_shard[:, :, slot] = np.asarray(v_dense[:, pos])
             kv_pos[slot] = pos
 
-    dec = jax.shard_map(
+    dec = shard_map(
         lambda q, k, v, kp: sp_decode_attention(q, k, v, kp, q_pos, "sp"),
         mesh=sp_mesh,
         in_specs=(P(), P(None, None, "sp"), P(None, None, "sp"), P("sp")),
@@ -118,7 +119,10 @@ def _single_device_greedy(cfg, params, prompt, num_new, max_seq):
     return np.stack([np.asarray(t) for t in toks], axis=1)
 
 
-@pytest.mark.parametrize("model", ["llama-test", "bloom-test"])
+@pytest.mark.parametrize("model", [
+    "llama-test",
+    pytest.param("bloom-test", marks=pytest.mark.slow),
+])
 def test_sp_generate_matches_single_device(sp_mesh, model):
     cfg = get_model_config(model)
     params = init_full_params(jax.random.PRNGKey(0), cfg)

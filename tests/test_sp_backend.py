@@ -54,6 +54,7 @@ def sp_server(request):
     server.shutdown()
 
 
+@pytest.mark.quick
 def test_sp_serve_matches_plain_engine(sp_server):
     server, plain, _ = sp_server
     prompt = [[5, 17, 42, 7, 9, 2, 30, 11]]       # len 8, divides sp=2
@@ -90,6 +91,86 @@ def test_sp_serve_stats(sp_server):
     assert body["mode"] == "sequence_parallel"
     assert body["sp"] == 2
     assert body["strategy"] == backend.strategy
+    # queue picture: idle server -> empty line, bound surfaced
+    assert body["queue_depth"] == 0
+    assert body["busy"] is False
+    assert body["queue_bound"] == backend.max_queue_depth
+
+
+def _req_h(server, method, path, body=None):
+    """_req + response headers (Retry-After assertions)."""
+    conn = http.client.HTTPConnection(server.host, server.port,
+                                      timeout=60)
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, headers, data
+
+
+def test_sp_queue_two_clients_visibility_and_429():
+    """The VERDICT r5 item-5 scenario: while one long-context request
+    holds the sp device lock, a second client sees the line on /stats
+    (queue_depth/busy) and — past the configured bound — gets an
+    immediate 429 + Retry-After instead of silently blocking on
+    ``_lock`` for potentially minutes."""
+    import threading
+    import time
+
+    cfg = get_model_config("llama-test")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    backend = SequenceParallelBackend(
+        cfg, params, local_sp_mesh(2), max_seq=32, strategy="ring",
+        sampling=GREEDY, max_queue_depth=1)
+    server = InferenceHTTPServer(backend, port=0, model_name="llama-test")
+    server.start()
+    prompt = {"prompt_ids": [[5, 17, 42, 7, 9, 2, 30, 11]],
+              "max_new_tokens": 2}
+    try:
+        # a "long-context request" occupies the device: admitted AND
+        # holding the lock (deterministic stand-in for minutes of sp
+        # compute — the admission API is exactly what a request uses)
+        backend._admit()
+        backend._lock.acquire()
+        try:
+            results = {}
+            t = threading.Thread(
+                target=lambda: results.update(
+                    a=_req(server, "POST", "/generate", prompt)),
+                daemon=True)
+            t.start()             # client A: admitted, waits in line
+            deadline = time.monotonic() + 30
+            while True:
+                body = json.loads(_req(server, "GET", "/stats")[1])
+                if body["queue_depth"] >= 1:
+                    break
+                assert time.monotonic() < deadline, "A never queued"
+                time.sleep(0.02)
+            assert body["busy"] is True
+            assert body["queue_bound"] == 1
+            # client B: the line is full -> 429 NOW, with Retry-After
+            status, headers, data = _req_h(server, "POST", "/generate",
+                                           prompt)
+            assert status == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert "queue full" in json.loads(data)["error"]
+            # streaming client: same rejection, clean pre-header 429
+            status, headers, _ = _req_h(
+                server, "POST", "/generate", dict(prompt, stream=True))
+            assert status == 429
+            assert "Retry-After" in headers
+        finally:
+            backend._lock.release()
+        t.join(timeout=60)
+        assert results["a"][0] == 200     # the queued client completed
+        backend._leave()                  # the stand-in request's exit
+        body = json.loads(_req(server, "GET", "/stats")[1])
+        assert body["queue_depth"] == 0 and body["busy"] is False
+    finally:
+        server.shutdown()
 
 
 def test_sp_serve_streaming(sp_server):
@@ -137,8 +218,10 @@ def test_sp_serve_mode_pairing_rules(capsys):
     assert cli.main(base + ["--chain", "w@127.0.0.1:1"]) == 1
     assert cli.main(base + ["--tp", "2"]) == 1
     assert cli.main(base + ["--prefill-chunk", "4"]) == 1
+    assert cli.main(base + ["--stream-block", "4"]) == 1
     err = capsys.readouterr().err
     assert "--prefill-chunk" in err
+    assert "--stream-block" in err
 
 
 @pytest.mark.parametrize("strategy", ["ring", "ulysses"])

@@ -32,6 +32,7 @@ def draft_params():
     return init_full_params(jax.random.PRNGKey(1), DRAFT_CFG)
 
 
+@pytest.mark.quick
 def test_greedy_matches_target_only(params, draft_params):
     """Spec decode at greedy must equal plain greedy decode exactly."""
     sampling = SamplingParams(greedy=True)
@@ -72,7 +73,11 @@ def test_fp8_kv_greedy_matches_fp8_engine(params, draft_params):
                           kv_cache_dtype="float8_e4m3fn")
 
 
-@pytest.mark.parametrize("plen", [5, 8, 9, 17])
+@pytest.mark.parametrize("plen", [
+    5, 8,
+    pytest.param(9, marks=pytest.mark.slow),
+    pytest.param(17, marks=pytest.mark.slow),
+])
 def test_chunked_prefill_matches_whole(params, draft_params, plen):
     """Spec decode with prefill_chunk (C=8, both models chunked) must be
     bit-identical to whole-prompt spec prefill for every remainder

@@ -8,6 +8,7 @@ import urllib.request
 
 import jax
 import numpy as np
+import pytest
 
 from distributed_inference_demo_tpu.comm.transport import (
     LoopbackNetwork, LoopbackTransport)
@@ -52,6 +53,7 @@ def _build(num_stages=2, max_seq=64):
     return header, workers, threads
 
 
+@pytest.mark.quick
 def test_percentile_helper():
     assert _percentile([], 50) != _percentile([], 50)  # nan
     xs = list(range(1, 101))

@@ -76,6 +76,7 @@ def _events_by_step(trace, name, proc_prefix=None):
     return out
 
 
+@pytest.mark.slow
 def test_e2e_trace_has_complete_span_chain_per_token():
     header, workers, threads = _build(num_stages=2)
     new = 5

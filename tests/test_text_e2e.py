@@ -243,6 +243,98 @@ def test_stop_sequences():
         server.shutdown()
 
 
+def test_stop_with_logprobs_truncates_rows_identically():
+    """stop × logprobs, both paths (the 501 wall this combination used
+    to hit is lifted): logprob rows truncate at EXACTLY the token index
+    the stop truncates tokens — one cut, two parallel lists — and the
+    values match the engine's full-row logprobs prefix-for-prefix."""
+    pieces = [("<unk>", 0.0, UNKNOWN), ("<s>", 0.0, CONTROL),
+              ("</s>", 0.0, CONTROL)]
+    pieces += [(f"▁w{i}", -float(i % 7 + 1), NORMAL)
+               for i in range(253)]
+    tok = Tokenizer.from_sentencepiece(build_model_proto(pieces))
+    cfg = get_model_config(MODEL)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(cfg, params, max_seq=64, sampling=GREEDY)
+    server = InferenceHTTPServer(engine, port=0, tokenizer=tok,
+                                 model_name=MODEL)
+    server.start()
+    try:
+        prompt = [5, 17, 42, 7]
+        ref = engine.generate(np.asarray([prompt], np.int32), 8,
+                              logprobs=True)
+        want_toks = ref.tokens[0].tolist()
+        want_lps = [round(float(x), 6) for x in ref.logprobs[0]]
+        want_text = tok.decode(want_toks)
+        mid = len(want_text) // 2
+        stop_str = want_text[mid:mid + 3]
+
+        # BLOCKING: rows truncate together
+        status, data = _post(server, "/generate",
+                             {"prompt_ids": [prompt],
+                              "max_new_tokens": 8, "stop": [stop_str],
+                              "logprobs": True})
+        assert status == 200
+        body = json.loads(data)
+        assert body["stop_reason"] == ["stop"]
+        kept = len(body["tokens"][0])
+        assert 0 < kept < 8
+        assert len(body["logprobs"][0]) == kept
+        assert body["tokens"][0] == want_toks[:kept]
+        assert body["logprobs"][0] == want_lps[:kept]
+
+        # no match -> full rows, still aligned
+        status, data = _post(server, "/generate",
+                             {"prompt_ids": [prompt],
+                              "max_new_tokens": 8,
+                              "stop": ["\x00never\x00"],
+                              "logprobs": True})
+        body2 = json.loads(data)
+        assert status == 200 and body2["stop_reason"] == ["length"]
+        assert body2["logprobs"][0] == want_lps
+
+        # STREAMING: the final line carries the SAME truncated pairs
+        status, data = _post(server, "/generate",
+                             {"prompt_ids": [prompt],
+                              "max_new_tokens": 8, "stop": [stop_str],
+                              "stream": True, "logprobs": True})
+        assert status == 200
+        lines = [json.loads(l) for l in data.decode().splitlines()
+                 if l.strip()]
+        final = lines[-1]
+        assert final.get("done") is True
+        assert final["tokens"] == body["tokens"]
+        assert final["logprobs"] == body["logprobs"]
+        assert final["stop_reason"] == ["stop"]
+    finally:
+        server.shutdown()
+
+
+def test_stop_with_logprobs_needs_stream_logprob_backend():
+    """A backend without streaming logprob support still gets a clean
+    501 for the stop × logprobs combination (honor-or-reject)."""
+    class NoLpBackend:
+        eos_id = None
+
+        def generate(self, ids, max_new, seed=0):
+            raise AssertionError("unused")
+
+        def generate_stream(self, ids, max_new, seed=0):
+            raise AssertionError("unused")
+
+    pieces = [("<unk>", 0.0, UNKNOWN), ("a", -1.0, NORMAL)]
+    tok = Tokenizer.from_sentencepiece(build_model_proto(pieces))
+    server = InferenceHTTPServer(NoLpBackend(), port=0, tokenizer=tok)
+    server.start()
+    try:
+        status, data = _post(server, "/generate",
+                             {"prompt_ids": [[1]], "max_new_tokens": 2,
+                              "stop": ["x"], "logprobs": True})
+        assert status == 501 and b"logprobs" in data
+    finally:
+        server.shutdown()
+
+
 def test_stop_needs_tokenizer():
     """A tokenizer-less server rejects stop strings with a clean 501."""
     cfg = get_model_config(MODEL)

@@ -114,6 +114,7 @@ def test_metaspace_matches_hf(metaspace_json, text):
     assert ours_py.decode(ref) == ours_native.decode(ref) == hf.decode(ref)
 
 
+@pytest.mark.quick
 def test_special_token_split(metaspace_json):
     tok = Tokenizer.from_json(metaspace_json, backend="python")
     ids = tok.encode("<s>hello</s>")

@@ -28,6 +28,7 @@ CASES = [
 
 
 @pytest.mark.parametrize("arrays", CASES, ids=range(len(CASES)))
+@pytest.mark.quick
 def test_python_roundtrip(arrays):
     blob = wire.serialize_tensors(arrays, flags=7)
     msg = wire.deserialize_tensors(blob)

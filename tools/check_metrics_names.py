@@ -37,7 +37,7 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "rounds", "hits", "misses", "slots", "spans", "entries",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
          "info", "events", "bundles", "blocks", "nodes",
-         "retries", "reconnects", "frames", "faults"}
+         "retries", "reconnects", "frames", "faults", "dispatches"}
 
 # exact names exempted from the unit-suffix rule — each entry is a
 # deliberate, documented exception (NOT a new unit: adding a pseudo-unit
@@ -81,6 +81,12 @@ REQUIRED_SERIES = {
     "dwt_transport_reconnects_total",
     "dwt_transport_corrupt_frames_total",
     "dwt_fault_injected_faults_total",
+    # the device-loop pair (docs/DESIGN.md §13): dispatches/token ≈ 1/K
+    # is the dispatch-floor claim — with either series absent, a fused
+    # loop that silently fell back to per-token dispatch would scrape
+    # exactly like a healthy one
+    "dwt_engine_host_dispatches_total",
+    "dwt_engine_device_loop_steps_total",
 }
 
 

@@ -17,8 +17,15 @@ harness makes every completed leg durable immediately:
       4. a failed health probe ends the session; the next invocation
          (tools/tpu_watch.sh loops on this) resumes at the first missing leg
 
+Before any full-budget leg runs, a MICRO PREPASS sweeps every leg at its
+smallest meaningful shape (``bench.py --leg X --micro``, 1 round, ~15 s
+of measurement each) and commits the results under ``extras.micro`` — a
+short healthy tunnel window banks a coarse number for ALL legs
+(including ones whose full budgets would never fit the window) before
+the session gambles on full-budget passes.  ``--no-micro`` skips it.
+
 Usage: ``python tools/measure_session.py [--artifact BENCH_SELF_r04.json]
-[--legs a,b,c] [--force a,b]``
+[--legs a,b,c] [--force a,b] [--no-micro]``
 """
 
 import argparse
@@ -41,6 +48,7 @@ LEG_BUDGETS = {
     "roofline_probe": 600,
     "headline": 1200,
     "headline_int8": 1200,
+    "decode_fused": 1200,
     "speculative": 1500,
     "prompt_lookup": 1500,
     "planner_pipeline": 1800,
@@ -58,6 +66,11 @@ LEG_BUDGETS = {
     "int4": 2400,
 }
 DEFAULT_LEGS = list(LEG_BUDGETS)
+
+# micro-prepass subprocess budget: the SHAPE measures in ~15 s; the
+# budget leaves room for compile through a slow tunnel.  One bad micro
+# leg must not eat the window the prepass exists to exploit.
+MICRO_BUDGET = int(os.environ.get("DWT_MICRO_BUDGET_S", "300"))
 
 
 _PROBE_SRC = """
@@ -179,24 +192,40 @@ def merge(artifact: dict, leg: str, result: dict, params: dict) -> dict:
             result["attempts"] = prev.get("attempts", 1) + 1
         artifact.setdefault("extras", {})[leg] = result
 
-    # measured-ceiling fractions: the MAX over the roofline leg and every
-    # per-leg health probe this session (the probes bracket each leg, so
-    # a ceiling measured during tunnel degradation can't stay the
-    # ceiling).  If a decode leg still beats the max probe, that is
-    # labeled rather than silently reported as frac > 1.
-    measured = session_ceiling(artifact)
-    if measured:
-        artifact.setdefault("extras", {})["measured_ceiling_gbs"] = measured
-        bench.apply_measured_frac(artifact.get("headline", {}), measured)
-        for key in ("headline_int8", "flagship_int8", "flagship_bf16"):
-            bench.apply_measured_frac(artifact["extras"].get(key, {}),
-                                      measured)
-        for pt in (artifact["extras"].get("sweep", {}) or {}).get(
-                "points", []):
-            bench.apply_measured_frac(pt, measured)
-        for sub in (artifact["extras"].get("int4", {}) or {}).values():
-            bench.apply_measured_frac(sub, measured)
+    # measured-ceiling fractions against the DECLARED ceiling:
+    # max(session probes, committed best-ever roofline ledger).  The
+    # session side is the MAX over the roofline leg and every per-leg
+    # health probe (the probes bracket each leg, so a ceiling measured
+    # during tunnel degradation can't stay the ceiling); the ledger side
+    # persists the best evidence ever seen for the chip, so one degraded
+    # session can no longer mint a "ceiling" real workloads beat —
+    # frac > 1 is impossible by construction (bench.apply_measured_frac
+    # raises the ledger to any achieved rate that exceeds it).
+    session = session_ceiling(artifact)
+    device = artifact_device(artifact, result)
+    bench.apply_declared_ceiling(artifact.get("headline", {}) or {},
+                                 artifact.setdefault("extras", {}),
+                                 device, session,
+                                 source="measure_session probe max")
     return artifact
+
+
+def artifact_device(artifact: dict, result=None):
+    """The device string this artifact's numbers describe — headline
+    first (the ledger key must be stable across legs), then any leg's
+    stamp, then the just-measured result."""
+    cands = [artifact.get("headline") or {}]
+    for v in (artifact.get("extras") or {}).values():
+        if isinstance(v, dict):
+            cands.append(v)
+            cands += [p for p in v.get("points", [])
+                      if isinstance(p, dict)]
+    cands += [result or {}]
+    for c in cands:
+        d = c.get("device")
+        if d and d != "?":
+            return d
+    return None
 
 
 def session_ceiling(artifact: dict):
@@ -208,13 +237,90 @@ def session_ceiling(artifact: dict):
                                   extras.get("probe_history"))
 
 
+def micro_done(artifact: dict, leg: str) -> bool:
+    r = ((artifact.get("extras") or {}).get("micro") or {}).get(leg)
+    return isinstance(r, dict) and "error" not in r
+
+
+def micro_exhausted(artifact: dict, leg: str) -> bool:
+    """Same MAX_ATTEMPTS bound as ``leg_exhausted``: a deterministically
+    failing micro leg (e.g. a compile that never fits MICRO_BUDGET) must
+    not re-enter ``todo`` on every watcher tick forever — after the cap
+    it keeps its recorded error and the prepass moves on."""
+    r = ((artifact.get("extras") or {}).get("micro") or {}).get(leg)
+    return (isinstance(r, dict) and "error" in r
+            and r.get("attempts", 1) >= MAX_ATTEMPTS)
+
+
+def micro_prepass(artifact: dict, path: Path, legs, params) -> int:
+    """Bank a coarse number for EVERY leg before any full budget runs:
+    one ``bench.py --leg X --micro`` subprocess per leg (1 round,
+    smallest meaningful shape, ~15 s of measurement each), back-to-back
+    inside one health window, merged under ``extras.micro`` and
+    COMMITTED before the full-budget passes start — a short healthy
+    tunnel window leaves a number for all legs instead of one or two
+    full ones (r03–r05 each lost most legs to mid-session wedges).
+
+    Returns 0 (prepass complete / nothing to do) or 3 (tunnel wedged —
+    whatever was banked is already committed; the watcher retries)."""
+    todo = [l for l in legs if not micro_done(artifact, l)
+            and not leg_done(artifact, l)
+            and not micro_exhausted(artifact, l)]
+    if not todo:
+        return 0
+    healthy, probe_gbs = tunnel_healthy()
+    if not healthy:
+        print("measure_session: tunnel unhealthy before micro prepass; "
+              "stopping (watcher will retry)", flush=True)
+        return 3
+    if probe_gbs:
+        artifact.setdefault("extras", {}).setdefault(
+            "probe_history", []).append(
+            {"hbm_gbs": probe_gbs, "before_leg": "micro_prepass",
+             "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())})
+    print(f"measure_session: micro prepass, todo = {todo}", flush=True)
+    wedged = False
+    for leg in todo:
+        t0 = time.perf_counter()
+        result = bench._spawn_leg(leg, params, timeout=MICRO_BUDGET,
+                                  micro=True)
+        result["leg_seconds"] = round(time.perf_counter() - t0, 1)
+        micros = artifact.setdefault("extras", {}).setdefault("micro", {})
+        if "error" in result:
+            prev = micros.get(leg)
+            if isinstance(prev, dict) and "error" in prev:
+                result["attempts"] = prev.get("attempts", 1) + 1
+        micros[leg] = result
+        path.write_text(json.dumps(artifact, indent=1) + "\n")
+        ok = "error" not in result
+        print(f"measure_session: micro {leg} "
+              f"{'OK' if ok else 'ERROR'} ({result['leg_seconds']}s): "
+              f"{json.dumps(result)[:160]}", flush=True)
+        if not ok and "timed out" in str(result.get("error", "")):
+            wedged = True
+            break
+    n = sum(micro_done(artifact, l) for l in legs)
+    commit(path, f"Bench artifact: micro prepass "
+                 f"({n}/{len(legs)} legs banked)")
+    if wedged:
+        print("measure_session: micro leg timeout -> assuming wedge; "
+              "stopping", flush=True)
+        return 3
+    return 0
+
+
 def commit(path: Path, msg: str) -> bool:
-    """Path-scoped add+commit; a FAILED commit is loudly visible in the
-    watcher log (a silent failure would quietly drop the
-    'artifact durable after every leg' guarantee this harness exists
-    for — e.g. index.lock contention with a concurrent watcher)."""
-    for cmd in (["git", "add", str(path)],
-                ["git", "commit", "-m", msg, "--", str(path)]):
+    """Path-scoped add+commit of the artifact AND the roofline ledger
+    (the declared ceiling must travel with the numbers judged against
+    it); a FAILED commit is loudly visible in the watcher log (a silent
+    failure would quietly drop the 'artifact durable after every leg'
+    guarantee this harness exists for — e.g. index.lock contention with
+    a concurrent watcher)."""
+    paths = [str(path)]
+    if bench.ROOFLINE_LEDGER_PATH.exists():
+        paths.append(str(bench.ROOFLINE_LEDGER_PATH))
+    for cmd in (["git", "add"] + paths,
+                ["git", "commit", "-m", msg, "--"] + paths):
         p = subprocess.run(cmd, cwd=str(REPO), stdout=subprocess.DEVNULL,
                            stderr=subprocess.PIPE, text=True)
         if p.returncode != 0:
@@ -231,6 +337,8 @@ def main():
     ap.add_argument("--legs", default=",".join(DEFAULT_LEGS))
     ap.add_argument("--force", default="",
                     help="comma list of legs to re-run even if done")
+    ap.add_argument("--no-micro", action="store_true",
+                    help="skip the micro prepass (full-budget legs only)")
     args = ap.parse_args()
 
     path = REPO / args.artifact
@@ -245,6 +353,10 @@ def main():
     }
 
     artifact = load_artifact(path)
+    if not args.no_micro:
+        rc = micro_prepass(artifact, path, legs, params)
+        if rc:
+            return rc           # banked micros are already committed
     todo = [l for l in legs if l in force
             or (not leg_done(artifact, l)
                 and not leg_exhausted(artifact, l))]
