@@ -976,7 +976,11 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
 
     with ContinuousBatchingEngine(
             cfg, params, max_seq=max_seq, max_batch=slots,
-            sampling=sampling, kv_cache_blocks=64,
+            sampling=sampling,
+            # default pool (B x table_width): the dense-era explicit 64
+            # blocks sized a PREFIX cache; on the paged-native scheduler
+            # the pool IS the decode cache and 64 blocks would make page
+            # pressure, not batching, the measured bottleneck
             kv_block_tokens=16) as eng:
         # warmups cover EVERY compile either timed phase can reach:
         # (a) sub-16-token prompt: step + admit + zero_row + bucket 32,
@@ -1080,7 +1084,7 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
 def _leg_prefix_reuse(model: str, new_tokens: int, slots: int = 8,
                       n_req: int = 16, shared_len: int = 96,
                       tail_len: int = 32, block_tokens: int = 16,
-                      kv_blocks: int = 64) -> dict:
+                      kv_blocks: int = 0) -> dict:
     """Block-level KV cache (runtime/kvcache) on a repeated-shared-prefix
     workload: hit rate, reused tokens, and prefill seconds SAVED — the
     prefill-amortization number shared-prefix serving (chat system
@@ -1112,31 +1116,37 @@ def _leg_prefix_reuse(model: str, new_tokens: int, slots: int = 8,
 
     prime = prompt()
     prompts = [prompt() for _ in range(n_req)]
+    # the no-reuse baseline: same shape, all-distinct prefixes — random
+    # prompts share no whole block, so every admission prefills in full.
+    # (The paged-native scheduler has no cache-off mode to compare
+    # against: the pool IS the decode cache, so "off" is modeled by a
+    # workload that cannot hit, not by a disabled subsystem.)
+    cold_prompts = [rng.integers(0, 1000,
+                                 size=(shared_len + tail_len,)).astype(
+                                     np.int32) for _ in range(n_req)]
 
-    def run(blocks: int):
+    def run(wave):
         with ContinuousBatchingEngine(
                 cfg, params, max_seq=max_seq, max_batch=slots,
-                sampling=sampling, kv_cache_blocks=blocks,
+                sampling=sampling, kv_cache_blocks=kv_blocks,
                 kv_block_tokens=block_tokens) as eng:
             # identical warmup both runs: the priming request stores the
-            # shared blocks (cache ON) and compiles the cold admission
-            # path; the second covers the hit path (ON) / re-admission
-            # (OFF) so neither timed wave pays a compile the other
-            # didn't
+            # shared blocks and compiles the cold admission path; the
+            # second covers the hit path (warm wave) / re-admission
+            # (cold wave) so neither timed wave pays a compile the
+            # other didn't
             eng.submit(prime, 4).wait(timeout=600)
             eng.submit(prompts[0], 4).wait(timeout=600)
             eng.reset_stats()
             t0 = time.perf_counter()
-            reqs = [eng.submit(p, new_tokens) for p in prompts]
+            reqs = [eng.submit(p, new_tokens) for p in wave]
             for r in reqs:
                 r.wait(timeout=900)
             dt = time.perf_counter() - t0
-            snap = (eng.kv_cache.snapshot()
-                    if eng.kv_cache is not None else None)
-            return dt, snap
+            return dt, eng.kv_cache.snapshot()
 
-    cold_dt, _ = run(0)
-    warm_dt, snap = run(kv_blocks)
+    cold_dt, _ = run(cold_prompts)
+    warm_dt, snap = run(prompts)
     lookups = snap["hits"] + snap["misses"]
     return {
         "model": model, "slots": slots, "requests": n_req,
@@ -1159,28 +1169,40 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
                       prompt_len: int = 64, max_seq: int = 1024,
                       block_tokens: int = 16, n_req: int = 0,
                       shared_len: int = 48) -> dict:
-    """Paged vs dense KV layout on the batching engine (docs/DESIGN.md
-    §11): decode tok/s parity AND the HBM story the paged layout exists
-    for — at a serving-realistic ``max_seq`` the dense engine reserves
-    ``B x max_seq`` cache rows up front while the paged engine allocates
-    blocks per request actually in flight.
+    """Paged KV on the (paged-native) batching engine vs dense-layout
+    reservation (docs/DESIGN.md §11/§14): decode tok/s parity AND the
+    HBM story the paged layout exists for — at a serving-realistic
+    ``max_seq`` a dense cache reserves ``B x max_seq`` rows up front
+    while the paged engine allocates blocks per request actually in
+    flight.
 
-    Three phases, one workload shape (distinct prompts, then a
-    shared-prefix wave on the paged engine):
+    Phases, one workload shape (distinct prompts, then a shared-prefix
+    wave on the paged engine):
 
-    - dense: tok/s + reserved cache bytes (measured off the real
-      buffers, not estimated);
+    - dense reference: the plain InferenceEngine with the explicit
+      ``kv_layout='dense'`` escape hatch at batch = slots — the dense
+      batch cache was deleted from the scheduler, so the surviving
+      dense decode path IS the reference; its cache bytes are measured
+      off the real buffers, not estimated;
     - paged: tok/s + pool capacity + PEAK blocks/bytes in use (polled
       while the wave decodes) + the analytic max-concurrent-sequences
-      at the dense run's HBM budget;
+      at the dense reference's HBM budget;
+    - admissible: at the dense reservation byte budget, the max
+      admissible batch at 4k/8k/32k sequence budgets — dense reserves
+      the full row per request, paged reserves the blocks the workload
+      shape actually touches (strictly larger batches, the §14
+      acceptance gate);
     - paged primed: radix hits on the paged path — ``h2d_bytes`` must
       stay 0 (hits are block-table references, nothing crosses the
       host boundary)."""
     import jax
     import numpy as np
     from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.base import (
+        pad_cache_capacity)
     from distributed_inference_demo_tpu.models.decoder import init_full_params
     from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime import InferenceEngine
     from distributed_inference_demo_tpu.runtime.batching import (
         ContinuousBatchingEngine)
 
@@ -1219,20 +1241,28 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
            "prompt_len": prompt_len, "new_tokens": new_tokens,
            "max_seq": max_seq, "block_tokens": block_tokens}
 
-    # phase 1: dense (prefix cache off — pure dense-layout baseline)
-    with ContinuousBatchingEngine(
-            cfg, params, max_seq=max_seq, max_batch=slots,
-            sampling=sampling, kv_cache_blocks=0,
-            kv_layout="dense") as eng:
-        eng.submit(prompts[0], 4).wait(timeout=600)      # compile warmup
-        eng.submit(prompts[1], 4).wait(timeout=600)
-        dt, _ = run_wave(eng, prompts)
-        dense_bytes = eng._ck.nbytes + eng._cv.nbytes
-        out["dense"] = {
-            "tokens_per_sec": round(n_req * new_tokens / dt, 2),
-            "cache_reserved_bytes": dense_bytes,
-            "reserved_tokens": slots * max_seq,
-        }
+    # phase 1: the dense reference — the surviving dense decode path
+    # (plain engine escape hatch) at batch = slots, dense cache bytes
+    # measured off its real buffers at the serving max_seq
+    dense_eng = InferenceEngine(cfg, params, max_seq=max_seq,
+                                sampling=sampling, kv_layout="dense")
+    batch_prompts = np.stack(prompts[:slots])
+    dense_eng.generate(batch_prompts, new_tokens, seed=0)     # compile
+    dense_cache = dense_eng.new_cache(slots)
+    dense_bytes = int(dense_cache.keys.nbytes + dense_cache.values.nbytes)
+    del dense_cache
+    t0 = time.perf_counter()
+    for i in range(0, n_req, slots):
+        dense_eng.generate(np.stack(prompts[i:i + slots]), new_tokens,
+                           seed=0)
+    dense_dt = time.perf_counter() - t0
+    del dense_eng
+    out["dense"] = {
+        "engine": "InferenceEngine kv_layout=dense (escape hatch)",
+        "tokens_per_sec": round(n_req * new_tokens / dense_dt, 2),
+        "cache_reserved_bytes": dense_bytes,
+        "reserved_tokens": slots * max_seq,
+    }
 
     # phase 2 + 3: paged (pool sized to the dense-equivalent budget)
     with ContinuousBatchingEngine(
@@ -1263,6 +1293,27 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
         out["cache_bytes_ratio"] = round(
             out["paged"]["peak_bytes_in_use"] / dense_bytes, 3)
 
+        # the §14 acceptance table: at the dense reservation's byte
+        # budget, the max admissible batch per sequence budget — dense
+        # pins a padded max_seq row per request; paged pins only the
+        # blocks this workload shape (prompt + new) actually touches
+        itemsize = np.dtype(cfg.dtype).itemsize
+        kv_row_unit = 2 * cfg.num_layers * cfg.num_kv_heads \
+            * cfg.head_dim * itemsize
+        used_tokens = prompt_len + new_tokens
+        admissible = {}
+        for seq in (4096, 8192, 32768):
+            dense_row = kv_row_unit * pad_cache_capacity(seq)
+            paged_req = (-(-used_tokens // block_tokens)
+                         * mgr.block_bytes)
+            admissible[str(seq)] = {
+                "budget_bytes": dense_bytes,
+                "dense_max_batch": int(dense_bytes // dense_row),
+                "paged_max_batch": int(dense_bytes // paged_req),
+                "workload_tokens_per_request": used_tokens,
+            }
+        out["admissible"] = admissible
+
         # phase 3: primed — shared-prefix wave; hits must move 0 bytes
         # through the host (the acceptance gate for the paged path)
         eng.submit(shared_prompt(), 4).wait(timeout=600)   # prime+compile
@@ -1277,6 +1328,157 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
             "h2d_bytes": snap["h2d_bytes"],
         }
     return out
+
+
+def _leg_serving_relative(model: str, batch: int, prompt_len: int,
+                          new_tokens: int, slots: int = 4,
+                          n_req: int = 8) -> dict:
+    """CPU-relative serving evidence (VERDICT r5 "Next round" #4): the
+    serving-stack RATIOS that survive a hardware change — speculative
+    speedup vs plain, prompt-lookup acceptance rate, batching aggregate
+    throughput-per-slot vs the plain engine — measured wherever the leg
+    runs and stamped with the platform.  Absolute tok/s here are NOT
+    comparable to the TPU legs and the stamp says so
+    (``relative_only``); what transfers is the mechanics: acceptance is
+    an argmax-agreement property, per-slot scaling a scheduler
+    property."""
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime import (InferenceEngine,
+                                                        SpeculativeEngine)
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+    from distributed_inference_demo_tpu.runtime.prompt_lookup import (
+        PromptLookupEngine)
+    from distributed_inference_demo_tpu.runtime.speculative import stats_json
+
+    cfg = get_model_config(model)
+    draft_cfg = get_model_config(model + "-int8")
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    draft_params = init_full_params(jax.random.PRNGKey(0), draft_cfg,
+                                    quantize=True)
+    greedy = SamplingParams(greedy=True)
+    max_seq = max(prompt_len, 64) + new_tokens
+    prompt = (np.arange(batch * prompt_len).reshape(batch, prompt_len)
+              % 1000).astype(np.int32)
+    out = {"platform": jax.default_backend(), "relative_only": True,
+           "model": model, "batch": batch, "prompt_len": prompt_len,
+           "new_tokens": new_tokens}
+
+    plain = InferenceEngine(cfg, params, max_seq=max_seq, sampling=greedy)
+    plain.generate(prompt, new_tokens, seed=0)             # compile
+    base = plain.generate(prompt, new_tokens, seed=0)
+    out["plain_tokens_per_sec"] = round(base.tokens_per_second, 2)
+
+    num_draft = 4
+    spec = SpeculativeEngine(cfg, params, draft_cfg, draft_params,
+                             max_seq=max_seq, sampling=greedy,
+                             num_draft=num_draft)
+    spec.generate(prompt, new_tokens, seed=0)              # compile
+    sres, sstats = spec.generate(prompt, new_tokens, seed=0)
+    out["speculative"] = dict(
+        stats_json(sstats, num_draft),
+        tokens_per_sec=round(sres.tokens_per_second, 2),
+        speedup_vs_plain=round(sres.tokens_per_second
+                               / base.tokens_per_second, 3))
+
+    # prompt lookup on its natural shape: a repeated motif (acceptance
+    # is what transfers; seed weights are adversarial for it)
+    motif = (np.arange(16) * 37 % 1000).astype(np.int32)
+    pl_len = max(32, min(prompt_len, max_seq - new_tokens) // 16 * 16)
+    pl_prompt = np.tile(motif, pl_len // 16)[None, :]
+    pld = PromptLookupEngine(cfg, params, max_seq=max_seq,
+                             sampling=greedy, num_draft=num_draft)
+    pld.generate(pl_prompt, new_tokens, seed=0)            # compile
+    pres, pstats = pld.generate(pl_prompt, new_tokens, seed=0)
+    out["prompt_lookup"] = dict(
+        stats_json(pstats, num_draft),
+        tokens_per_sec=round(pres.tokens_per_second, 2))
+
+    # batching: aggregate throughput per slot vs one plain stream
+    rng = np.random.default_rng(0)
+    reqs_p = rng.integers(0, 1000, size=(n_req, prompt_len)).astype(
+        np.int32)
+    plain.generate(prompt[:1], new_tokens, seed=0)   # compile [1, plen]
+    single = plain.generate(prompt[:1], new_tokens, seed=0)
+    with ContinuousBatchingEngine(cfg, params, max_seq=max_seq,
+                                  max_batch=slots,
+                                  sampling=greedy) as eng:
+        eng.submit(reqs_p[0], 2).wait(timeout=600)         # compile
+        t0 = time.perf_counter()
+        rs = [eng.submit(p, new_tokens) for p in reqs_p]
+        for r in rs:
+            r.wait(timeout=900)
+        agg_tps = n_req * new_tokens / (time.perf_counter() - t0)
+    out["batching"] = {
+        "slots": slots, "requests": n_req,
+        "aggregate_tokens_per_sec": round(agg_tps, 2),
+        "throughput_per_slot": round(agg_tps / slots, 2),
+        "per_slot_vs_plain_single": round(
+            (agg_tps / slots) / single.tokens_per_second, 3),
+    }
+    return out
+
+
+def _long_context_sp_points(model: str, new: int = 8) -> list:
+    """>= 32k-context points for BOTH sp strategies (ring / Ulysses) at
+    micro budget — the carried sweep satellite: the sequence-parallel
+    long-context shape banks at least a micro number per strategy in
+    the first healthy window.  Needs >= 2 local devices; stamps a skip
+    otherwise.  Per-strategy isolation: one failing build (e.g. a head
+    count Ulysses can't divide) must not lose the other point."""
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.parallel.mesh import local_sp_mesh
+
+    ctx = int(os.environ.get("BENCH_LONG_CTX_SP", "32768"))
+    if len(jax.devices()) < 2:
+        return [{"skipped": "sequence parallelism needs >= 2 devices",
+                 "context": ctx}]
+    sp = 2
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    plen = (ctx - new) // sp * sp
+    prompt = (np.arange(plen) % 1000).astype(np.int32)[None, :]
+    points = []
+    for strategy in ("ring", "ulysses"):
+        point = {"strategy": strategy, "sp": sp, "context": ctx,
+                 "prompt_len": plen, "new_tokens": new}
+        try:
+            if strategy == "ring":
+                from distributed_inference_demo_tpu.parallel.sequence import (
+                    make_sp_generate_fn)
+                gen = make_sp_generate_fn(
+                    cfg, local_sp_mesh(sp), max_seq=ctx,
+                    num_new_tokens=new,
+                    sampling=SamplingParams(greedy=True))
+            else:
+                from distributed_inference_demo_tpu.parallel.ulysses import (
+                    make_ulysses_generate_fn)
+                gen = make_ulysses_generate_fn(
+                    cfg, local_sp_mesh(sp), max_seq=ctx,
+                    num_new_tokens=new,
+                    sampling=SamplingParams(greedy=True))
+            mesh = local_sp_mesh(sp)
+            with mesh:
+                toks = np.asarray(gen(params, prompt,
+                                      jax.random.PRNGKey(0)))  # compile
+            t0 = time.perf_counter()
+            with mesh:
+                toks = np.asarray(gen(params, prompt,
+                                      jax.random.PRNGKey(0)))
+            dt = time.perf_counter() - t0
+            point["tokens_per_sec"] = round(toks.size / dt, 2)
+        except Exception as e:
+            point["error"] = f"{type(e).__name__}: {e}"[:300]
+        points.append(point)
+    return points
 
 
 def _leg_planner_pipeline(model: str, batch: int, prompt_len: int,
@@ -1664,8 +1866,14 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
             out = _bench_engine(model, batch, prompt_len, new_tokens,
                                 quant=True, latency=not micro)
         elif name == "sweep":
+            # micro runs the FULL b8/32/64 x {bf16,int8,int4} grid at
+            # the micro token budget (carried satellite: the sweep
+            # SHAPES bank coarse numbers in the first healthy window;
+            # the full-budget pass keeps its narrower grid — b8 is the
+            # headline legs' point, int4 has its own leg there)
             out = (_leg_sweep(model, prompt_len, new_tokens,
-                              quants=(False,), batches=(32,)) if micro
+                              quants=(False, True, "int4"),
+                              batches=(8, 32, 64)) if micro
                    else _leg_sweep(model, prompt_len, new_tokens))
         elif name == "flagship_int8":
             out = _leg_flagship(flagship, batch, prompt_len,
@@ -1683,6 +1891,12 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
             out = _leg_prefix_reuse(model, min(new_tokens, 64))
         elif name == "paged_decode":
             out = _leg_paged_decode(model, new_tokens)
+        elif name == "serving_relative":
+            out = (_leg_serving_relative(model, batch, prompt_len,
+                                         new_tokens, slots=2, n_req=4)
+                   if micro else
+                   _leg_serving_relative(model, batch, prompt_len,
+                                         new_tokens))
         elif name == "decode_fused":
             out = (_leg_decode_fused(model, prompt_len, new_tokens,
                                      batches=(1,), blocks=(1, 4))
@@ -1703,9 +1917,14 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
         elif name == "long_context":
             if micro:
                 # one chunk-multiple context that still exercises the
-                # chunked-prefill + full-context-decode structure
+                # chunked-prefill + full-context-decode structure; the
+                # >= 32k sp strategy points ride the micro prepass too
+                # (carried satellite) so both strategies bank a number
+                # in the first healthy window
                 os.environ.setdefault("BENCH_LONG_CTX", "4096")
             out = _leg_long_context(model)
+            if micro:
+                out["sp_points"] = _long_context_sp_points(model)
         elif name in ("roofline_probe", "roofline_probe_rerun"):
             # the rerun executes the SAME probe immediately after the
             # headline leg, so the ceiling the headline is judged
@@ -1938,8 +2157,9 @@ def main() -> None:
             "headline_int8", "decode_fused", "speculative",
             "prompt_lookup", "planner_pipeline", "long_context",
             "flagship_int8", "batching", "prefix_reuse", "paged_decode",
-            "sweep", "flagship_bf16", "pipeline", "fault_recovery",
-            "prefill_long", "moe", "multimodal", "int4"]
+            "serving_relative", "sweep", "flagship_bf16", "pipeline",
+            "fault_recovery", "prefill_long", "moe", "multimodal",
+            "int4"]
     for skip_var, leg_names in (
             ("BENCH_SKIP_FLAGSHIP", ["flagship_int8", "flagship_bf16"]),
             ("BENCH_SKIP_PIPELINE", ["pipeline", "planner_pipeline",
@@ -1947,7 +2167,8 @@ def main() -> None:
             ("BENCH_SKIP_SWEEP", ["sweep"]),
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
                                     "batching", "prefix_reuse",
-                                    "paged_decode"]),
+                                    "paged_decode",
+                                    "serving_relative"]),
             ("BENCH_SKIP_LONGCTX", ["long_context"]),
             ("BENCH_SKIP_PREFILL", ["prefill_long"]),
             ("BENCH_SKIP_MOE_MM", ["moe", "multimodal"]),
@@ -2008,7 +2229,7 @@ def main() -> None:
     # paged_decode keeps the acceptance shape (new=128, unclamped) and
     # builds two engines + three waves — budget it like batching
     leg_timeouts = {"batching": 1500, "prefix_reuse": 1200,
-                    "paged_decode": 1500}
+                    "paged_decode": 1500, "serving_relative": 1500}
     runlog.event("bench_start", params=params, legs=legs)
     results = {}
     for leg in legs:

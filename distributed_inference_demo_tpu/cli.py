@@ -122,28 +122,6 @@ def _reject_kvcache_flags(args, mode: str) -> bool:
     return False
 
 
-def _paged_layout_requested(args) -> bool:
-    """Did the CLI explicitly ask for the paged layout?  (The env knob
-    ``DWT_KV_LAYOUT=paged`` is rejected engine-side by
-    ``require_dense_kv_layout`` for every dense-only engine — this check
-    only exists so a typed flag fails at argument validation with a
-    mode-specific message instead of deep in a constructor.)"""
-    return getattr(args, "kv_layout", None) == "paged"
-
-
-def _reject_paged_layout(args, mode: str) -> bool:
-    """True (after printing) when --kv-layout paged was explicitly set
-    for a mode that decodes dense rows — honor-or-reject, never
-    silently ignore."""
-    if _paged_layout_requested(args):
-        print(f"--kv-layout paged is not supported with {mode}; the "
-              "paged block pool serves the continuous-batching decode "
-              "path (--batch-slots without a speculative proposer)",
-              file=sys.stderr)
-        return True
-    return False
-
-
 def _build_spec_engine(args):
     """Construct the draft/verify SpeculativeEngine from CLI flags — the
     one site shared by ``generate --draft-model`` and
@@ -153,11 +131,6 @@ def _build_spec_engine(args):
     from .models.registry import get_model_config
     from .runtime import SpeculativeEngine
 
-    if _paged_layout_requested(args):
-        raise ValueError(
-            "--kv-layout paged is not supported with --draft-model "
-            "(the draft/verify rollback decodes dense cache rows); "
-            "--batch-slots without a proposer is the paged mode")
     if getattr(args, "stream_block", None) is not None:
         raise ValueError(
             "--stream-block is not supported with --draft-model "
@@ -173,6 +146,7 @@ def _build_spec_engine(args):
         mesh=mesh, eos_id=getattr(args, "eos_id", None),
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
         prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
+        kv_layout=getattr(args, "kv_layout", None),
         **_kvcache_from_args(args))
 
 
@@ -184,16 +158,6 @@ def _build_prompt_lookup_engine(args):
     from .models.registry import get_model_config
     from .runtime.prompt_lookup import PromptLookupEngine
 
-    if _kvcache_flags_set(args):
-        raise ValueError(
-            "--kv-cache-blocks/--kv-block-tokens are not supported with "
-            "standalone --prompt-lookup (no block-cache plumbing in the "
-            "n-gram proposer engine); --batch-slots --prompt-lookup "
-            "composes with the block cache")
-    if _paged_layout_requested(args):
-        raise ValueError(
-            "--kv-layout paged is not supported with --prompt-lookup "
-            "(the n-gram verify rollback decodes dense cache rows)")
     if getattr(args, "stream_block", None) is not None:
         raise ValueError(
             "--stream-block is not supported with --prompt-lookup "
@@ -207,7 +171,9 @@ def _build_prompt_lookup_engine(args):
         attn_backend=args.attn_backend, mesh=mesh,
         eos_id=getattr(args, "eos_id", None),
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
-        prefill_chunk=getattr(args, "prefill_chunk", 0) or None)
+        prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
+        kv_layout=getattr(args, "kv_layout", None),
+        **_kvcache_from_args(args))
 
 
 def _build_engine(args):
@@ -329,14 +295,9 @@ def cmd_serve(args) -> int:
                   file=sys.stderr)
             return 1
         if _reject_kvcache_flags(args, "--chain (pipeline stages see "
-                                 "activations, not tokens)"):
+                                 "activations, not tokens — there is "
+                                 "no prompt key to match blocks by)"):
             return 1
-        if _reject_paged_layout(args, "--chain (per-stage dense caches)"):
-            return 1
-        # env knob too: the stage runtimes decode dense rows and must
-        # not run under a knob promising paged HBM accounting
-        from .runtime.kvcache import require_dense_kv_layout
-        require_dense_kv_layout("--chain (per-stage dense caches)")
         full = _load_full_params(args, cfg)
         sampling = _sampling_from_args(args)
 
@@ -353,7 +314,8 @@ def cmd_serve(args) -> int:
         # own business — the wire carries activations, not cache state)
         rt = ElasticStageRuntime(
             cfg, specs[0], full, args.max_seq, sampling,
-            kv_cache_dtype=getattr(args, "kv_cache_dtype", "") or None)
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", "") or None,
+            kv_layout=getattr(args, "kv_layout", None))
         header = ElasticHeader(rt, transport, chain,
                                eos_id=getattr(args, "eos_id", None),
                                step_timeout=args.step_timeout)
@@ -412,7 +374,8 @@ def cmd_serve(args) -> int:
             strategy=args.sp_strategy, sampling=_sampling_from_args(args),
             kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
             eos_id=getattr(args, "eos_id", None),
-            max_queue_depth=getattr(args, "sp_queue_depth", None))
+            max_queue_depth=getattr(args, "sp_queue_depth", None),
+            kv_layout=getattr(args, "kv_layout", None))
         print(f"SERVE_SP {args.model} sp={args.sp} "
               f"strategy={args.sp_strategy} max_seq={args.max_seq}",
               flush=True)
@@ -432,15 +395,11 @@ def cmd_serve(args) -> int:
             ("--stream-block",
              getattr(args, "stream_block", None) is not None),
             ("--kv-cache-blocks", _kvcache_flags_set(args)),
-            ("--kv-layout", _paged_layout_requested(args)),
             ("--tp", getattr(args, "tp", 1) > 1)] if on]
         if unsupported:
             print(f"{'/'.join(unsupported)} not supported with --vision",
                   file=sys.stderr)
             return 1
-        from .runtime.kvcache import require_dense_kv_layout
-        require_dense_kv_layout("--vision (the multimodal engine "
-                                "decodes dense rows)")
         cfg = get_model_config(args.model)
         if args.vision_preset == "llava15":
             # the CLIP-ViT-L/14-336 geometry LLaVA-1.5 ships, faithful:
@@ -484,7 +443,8 @@ def cmd_serve(args) -> int:
             cfg, params, vcfg, vparams, max_seq=args.max_seq,
             sampling=_sampling_from_args(args),
             eos_id=getattr(args, "eos_id", None),
-            attn_backend=args.attn_backend))
+            attn_backend=args.attn_backend,
+            kv_layout=getattr(args, "kv_layout", None)))
         print(f"SERVE_VISION {args.model} tower={args.vision_preset} "
               f"image={vcfg.image_size} patches={vcfg.num_patches}",
               flush=True)
@@ -584,9 +544,6 @@ def cmd_server(args) -> int:
         print("--tp is not supported by the server app (the planner "
               "assigns whole layer ranges per worker)", file=sys.stderr)
         return 1
-    from .runtime.kvcache import require_dense_kv_layout
-    require_dense_kv_layout("the server app (planned pipeline stages "
-                            "decode dense rows)")
 
     app = ServerApp(
         model=args.model, num_workers=args.num_workers,
@@ -616,12 +573,6 @@ def cmd_worker(args) -> int:
     ``--auto`` connects to a ``server`` app and receives its role, layer
     range, and weights from the control plane."""
     from .runtime import worker_main
-    from .runtime.kvcache import require_dense_kv_layout
-
-    # stage workers decode dense cache rows; a DWT_KV_LAYOUT=paged env
-    # must fail loudly here, not be silently ignored per-process
-    require_dense_kv_layout("pipeline stage workers (dense per-stage "
-                            "caches)")
 
     if args.auto:
         ap = argparse.ArgumentParser(prog="worker --auto")
@@ -676,6 +627,12 @@ def cmd_worker(args) -> int:
     ap.add_argument("--kv-cache-dtype", default="",
                     help="reduced-precision KV cache storage for this "
                          "stage, e.g. float8_e4m3fn")
+    ap.add_argument("--kv-layout", default=None,
+                    choices=["dense", "paged"],
+                    help="this stage's request-cache layout (default "
+                         "DWT_KV_LAYOUT, else paged: per-stage page "
+                         "pool, blocks reserved per chunk actually "
+                         "run)")
     ap.add_argument("--fault-plan", default="",
                     help="CHAOS TESTING ONLY: JSON fault-plan spec "
                          "(path or inline); requires --chaos")
@@ -699,7 +656,8 @@ def cmd_worker(args) -> int:
     from .parallel.mesh import local_tp_mesh
     rt = ElasticStageRuntime(cfg, spec, full, a.max_seq, sampling,
                              mesh=local_tp_mesh(a.tp),
-                             kv_cache_dtype=a.kv_cache_dtype or None)
+                             kv_cache_dtype=a.kv_cache_dtype or None,
+                             kv_layout=a.kv_layout)
     transport = maybe_wrap(
         ZmqTransport(a.device_id, bind_host=a.bind_host, port=a.port),
         fault_plan)
@@ -1158,14 +1116,16 @@ def _add_engine_args(ap):
     ap.add_argument("--kv-layout", default=None,
                     choices=["dense", "paged"],
                     help="KV cache memory layout (default DWT_KV_LAYOUT, "
-                         "else dense).  paged: device-resident block "
-                         "pool + per-slot block tables (vLLM-style "
-                         "PagedAttention) — HBM reserved per block "
-                         "actually allocated instead of B x max_seq "
-                         "rows, radix prefix hits shared by reference "
-                         "with zero H2D; serve --batch-slots (plain "
-                         "slot decode) only, every other mode rejects "
-                         "it explicitly")
+                         "else paged — docs/DESIGN.md §14).  paged: "
+                         "device-resident block pool + block tables "
+                         "(vLLM-style PagedAttention) — HBM reserved "
+                         "per block actually allocated instead of "
+                         "B x max_seq rows, radix prefix hits shared "
+                         "by reference with zero H2D; every serve/"
+                         "generate mode accepts it.  dense: the "
+                         "host-pool escape hatch on the single-request "
+                         "engines and pipeline stages (one release); "
+                         "--batch-slots is paged-native and rejects it")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over the first N local "
                          "devices (Megatron-sliced weights, kv-head-"
@@ -1207,7 +1167,6 @@ def _sp_unsupported_flags(args, allow_eos: bool = False) -> list:
         ("--stream-block",
          getattr(args, "stream_block", None) is not None),
         ("--kv-cache-blocks", _kvcache_flags_set(args)),
-        ("--kv-layout", _paged_layout_requested(args)),
         ("--attn-backend", args.attn_backend != "auto")] if on]
 
 

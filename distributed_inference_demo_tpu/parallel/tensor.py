@@ -112,6 +112,61 @@ def make_forward_seam(cfg: ModelConfig, spec: StageSpec, mesh,
     return fwd, None
 
 
+def make_paged_forward_seam(cfg: ModelConfig, spec: StageSpec, mesh,
+                            params_template: StageParams,
+                            block_tokens: int, backend: str = "auto"):
+    """``(fwd, bind, pool_sharding)`` for a PAGED-cache engine: the
+    forward runs ``ops.paged_attention``'s block-table hook over a page
+    pool ``[L, N, H, bt, D]`` standing in for the dense cache buffers.
+
+    ``bind(tables)`` hands the current dispatch's block tables to the
+    hook — call it at the top of the caller's jitted body, before the
+    first ``fwd``.  Off-mesh, the hook reads the binding by closure (a
+    loop constant of the trace).  Under a tp mesh the tables are
+    threaded through ``shard_map`` as an explicit replicated argument
+    instead — shard_map bodies must not close over traced values — and
+    the pool shards by kv head exactly like the dense cache
+    (``_CACHE_SPEC``: axis 2 either way), so each chip pages only its
+    own head planes.  The one paged-dispatch rule shared by the
+    batching scheduler and the ring stage runtimes."""
+    from ..ops.paged_attention import make_paged_attn_impl
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if tp <= 1:
+        impl, bind = make_paged_attn_impl(block_tokens, backend)
+
+        def fwd(p, inputs, cache, positions, last_logits_only):
+            return stage_forward(p, cfg, spec, inputs, cache, positions,
+                                 attn_impl=impl,
+                                 last_logits_only=last_logits_only)
+
+        return fwd, bind, None
+    validate_tp(cfg, mesh)
+    p_specs = _tp_param_specs(params_template, cfg)
+    bound = {}
+
+    def bind(tables):
+        bound["tables"] = tables
+
+    def fwd(p, inputs, cache, positions, last_logits_only):
+        def body(p_, i_, c_, po_, tab_):
+            # the Pallas kernel is not exercised per-shard (the dense
+            # tp rule, resolve_tp_attn_backend) — force the XLA gather
+            impl, bind_local = make_paged_attn_impl(block_tokens, "xla")
+            bind_local(tab_)
+            return stage_forward(p_, cfg, spec, i_, c_, po_,
+                                 tp_axis="tp", attn_impl=impl,
+                                 last_logits_only=last_logits_only)
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(p_specs, P(), _CACHE_SPEC, P(), P()),
+            out_specs=(P(), _CACHE_SPEC),
+            check_vma=False)(p, inputs, cache, positions,
+                             bound["tables"])
+
+    return fwd, bind, tp_cache_sharding(mesh)
+
+
 def make_tp_stage_fn(cfg: ModelConfig, spec: StageSpec, mesh: Mesh,
                      params_template: StageParams):
     """Jitted fn(params, inputs, cache, positions) -> (out, cache) with the

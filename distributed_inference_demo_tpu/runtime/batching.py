@@ -13,27 +13,35 @@ accelerator where batching, not sockets, is the concurrency mechanism.
 TPU-first design:
 
 - **One compiled step, static shapes.**  Every decode step runs the full
-  ``[max_batch]`` slot array through one donated-cache jit; an ``active``
+  ``[max_batch]`` slot array through one donated-pool jit; an ``active``
   mask keeps finished/empty slots harmless (their writes land on their own
-  stale positions, which the causal mask hides — see below).  Admission
-  never recompiles the step.
-- **Per-slot cache positions, no per-slot programs.**  Each slot fills its
-  cache row from position 0 independently.  The attention mask is already
+  stale positions or drop through sentinel tables — see below).
+  Admission never recompiles the step.
+- **PAGED-NATIVE slot cache** (docs/DESIGN.md §11/§14): K/V live in one
+  device-resident page pool ``[L, num_blocks, H, block_tokens, D]``
+  addressed through per-slot block tables — HBM is reserved per page a
+  request actually holds, never ``B x max_seq`` worst-case rows, and
+  radix prefix hits are shared block-table entries (zero copies of any
+  kind).  Every slot mode rides the pool: plain decode, the speculative
+  proposers (the draft model pages its own scratch pool), tp meshes
+  (the pool shards by kv head).  The dense batch cache is deleted.
+- **Per-slot cache positions, no per-slot programs.**  Each slot fills
+  its pages from position 0 independently.  The attention mask is
   per-row (``kv_pos <= q_position`` — ops/attention.py), so ragged slot
-  lengths need no extra masking; a custom ``attn_impl`` scatters the
-  chunk's K/V at per-row positions (``cache.at[rows, :, positions]``)
-  instead of the engines' scalar-offset ``dynamic_update_slice``.
-- **Admission = batch-1 prefill + row copy.**  The prompt is padded to a
-  small set of bucket lengths (one compile per bucket, reused), prefilled
-  into a temp row (zeroed, or preloaded with a cached prefix block), and
-  copied into the slot's row of the shared cache — a handful of
-  dispatches (3 cold, plus the prefix load / store copies when the prefix
-  cache engages), between steps, while the other slots' state stays on
-  device.
+  lengths need no extra masking; writes scatter at
+  ``(table[p // bt], p % bt)`` (ops/paged_attention.py).
+- **Admission = batch-1 prefill into a temp row + page scatter.**  The
+  prompt is padded to a small set of bucket lengths (one compile per
+  bucket, reused), prefilled into a dense temp row seeded straight out
+  of the pool (matched prefix pages gather device-to-device), and the
+  finished row scatters into the request's own reserved pages — a
+  handful of dispatches, between steps, while the other slots' state
+  stays on device.
 - **Stale-slot safety** is the same invariant speculative decoding relies
   on: garbage KV only ever sits at positions >= a row's valid length, a
   query at position p attends only kv_pos <= p, and position p is always
-  rewritten before any query reaches it.
+  rewritten before any query reaches it.  Freed slots additionally route
+  writes through sentinel table entries, which drop them.
 
 Per-request ``seed`` is not honored (slots share one RNG stream — the
 batch's sampling order depends on who else is in flight); the engine-level
@@ -216,19 +224,22 @@ class ContinuousBatchingEngine:
         draft-side admission prefill (speculative mode) stays one
         dispatch — the draft is small by construction.
 
-        ``kv_layout``: "dense" (default; ``DWT_KV_LAYOUT`` env between)
-        keeps the preallocated ``[L, B, H, max_seq, D]`` slot cache.
-        "paged" (docs/DESIGN.md §11) replaces it with a DEVICE-resident
-        page pool ``[L, num_blocks, H, block_tokens, D]`` addressed
-        through per-slot block tables: HBM is reserved per page actually
+        ``kv_layout``: "paged" only — the scheduler is PAGED-NATIVE
+        (docs/DESIGN.md §14): its slot cache IS a device-resident page
+        pool ``[L, num_blocks, H, block_tokens, D]`` addressed through
+        per-slot block tables.  HBM is reserved per page actually
         allocated instead of ``B x max_seq`` worst-case rows, radix
-        prefix hits become shared block-table entries (zero H2D, zero
-        copies of any kind), and stores are in-place ownership adoptions
-        (zero D2H).  ``kv_cache_blocks`` then sizes the page pool
-        (default: the dense-equivalent ``B x max_seq/block_tokens``).
-        Paged is plumbed for the plain slot decode path only — the
-        speculative proposers (draft model / prompt-lookup) and tp
-        meshes reject it explicitly.
+        prefix hits are shared block-table entries (zero H2D, zero
+        copies of any kind), stores are in-place ownership adoptions
+        (zero D2H), and EVERY slot mode rides the pool: plain decode,
+        the draft-model and prompt-lookup speculative proposers (the
+        draft gets its own scratch page pool, reserved and freed with
+        the request), and tp meshes (the pool shards by kv head exactly
+        like the dense cache did).  ``kv_cache_blocks`` sizes the pool
+        (0/None = the dense-equivalent ``B x table_width`` — there is
+        no cache-off mode: the pool is the decode cache).  The dense
+        batch cache is deleted; "dense" survives one release as the
+        single-request engines' escape hatch and is rejected here.
 
         ``max_queue_depth``: overload shedding — when the admission
         queue (submitted-but-unslotted requests) already holds this
@@ -279,44 +290,78 @@ class ContinuousBatchingEngine:
 
         from .kvcache import resolve_kvcache_config, resolve_kv_layout
         self.kv_layout = resolve_kv_layout(kv_layout)
-        n_blocks, block_tokens = resolve_kvcache_config(
-            kv_cache_blocks, kv_block_tokens, default_blocks=64)
-        if self.kv_layout == "paged":
-            # honor-or-reject: a paged request for a mode that would
-            # silently decode dense rows must fail loudly (the caller
-            # believes HBM reservations shrank)
-            if draft_cfg is not None or prompt_lookup:
-                raise ValueError(
-                    "kv_layout='paged' is not plumbed for the "
-                    "speculative slot modes (draft model / prompt-lookup "
-                    "proposers decode dense rows); drop the proposer or "
-                    "use kv_layout='dense'")
-            if mesh is not None and mesh.shape.get("tp", 1) > 1:
-                raise ValueError(
-                    "kv_layout='paged' is not plumbed for a tp mesh "
-                    "(pages are not kv-head-sharded); use "
-                    "kv_layout='dense'")
-            if block_tokens < 1:
-                raise ValueError("kv_block_tokens must be >= 1")
+        if self.kv_layout != "paged":
+            raise ValueError(
+                "kv_layout='dense' is not supported by the paged-native "
+                "continuous-batching scheduler: its slot cache IS the "
+                "device page pool (docs/DESIGN.md §14) — the dense "
+                "batch cache was deleted when paged became the "
+                "universal default.  The dense escape hatch survives "
+                "on the single-request engines (serve/generate without "
+                "--batch-slots).")
+        n_blocks_arg, block_tokens = resolve_kvcache_config(
+            kv_cache_blocks, kv_block_tokens, default_blocks=0)
+        if block_tokens < 1:
+            raise ValueError("kv_block_tokens must be >= 1")
 
         cfg_, spec_, samp_ = cfg, self.spec, sampling
-        # S is a BUFFER capacity (row caches, the batch cache, history),
-        # sublane-aligned for the flash kernel and held equal across the
-        # row/batch dynamic_update_slice pairs; admission limits still
-        # check the caller's max_seq.  (KVCache.create would pad each
-        # buffer anyway — padding S once keeps the row and batch shapes
-        # derived from ONE number.)
-        B, S = max_batch, pad_cache_capacity(self.max_seq)
-        if self.kv_layout == "paged":
-            # paged rows/tables tile S into whole blocks, so S is padded
-            # to the block granule too (lcm keeps the sublane alignment)
-            import math
-            g = math.lcm(8, block_tokens)
-            S = -(-S // g) * g
+        # S is a BUFFER capacity (temp prefill rows, block tables,
+        # history), sublane-aligned for the flash kernel AND padded to
+        # the page granule (lcm keeps both alignments); admission limits
+        # still check the caller's max_seq.  The speculative slot modes
+        # additionally fold in SLACK columns: a fused dispatch may write
+        # up to decode_block*(K+1) positions past a row's last drained
+        # length before the host learns the accepted counts, and every
+        # such write must land in a page the request actually reserved
+        # (an unreserved write would sentinel-drop K/V a later round
+        # attends).
+        import math
+        B = max_batch
+        spec_mode = prompt_lookup or draft_cfg is not None
+        self._slack_tokens = (decode_block * (num_draft + 1)
+                              if spec_mode else 0)
+        g = math.lcm(8, block_tokens)
+        S = -(-(pad_cache_capacity(self.max_seq)
+                + self._slack_tokens) // g) * g
 
-        from ..parallel.tensor import make_forward_seam
+        from ..parallel.tensor import (make_forward_seam,
+                                       make_paged_forward_seam)
         fwd, self._cache_sharding = make_forward_seam(
             cfg, self.spec, mesh, params, attn_impl=slot_attention_impl)
+
+        # ------------------------------------------------------------------
+        # the DEVICE-resident page pool (docs/DESIGN.md §11/§14): HBM
+        # holds num_blocks pages regardless of max_batch x max_seq, and
+        # per-slot block tables (host numpy, the scheduler's source of
+        # truth, shipped as a few hundred metadata bytes per dispatch)
+        # address them.  Entry >= num_blocks = "no page": writes drop
+        # (freed slots, fused-block overshoot), reads clamp into
+        # causally-masked garbage.  Under a tp mesh the pool shards by
+        # kv head (axis 2), exactly like the dense cache did.
+        from .kvcache import PagedKVCacheManager
+        from .kvcache.device import (seed_row_from_pages,
+                                     write_row_to_pages)
+        bt = block_tokens
+        self._table_width = S // bt
+        n_blocks = (n_blocks_arg if n_blocks_arg >= 1
+                    else B * self._table_width)
+        self.kv_cache = PagedKVCacheManager.for_model(
+            cfg, n_blocks, bt, dtype=self.kv_cache_dtype)
+        N = self.kv_cache.num_blocks
+        self._page_sentinel = N
+        page_dtype = self.kv_cache_dtype or cfg.dtype
+        fwd_p, bind_tables, pool_sharding = make_paged_forward_seam(
+            cfg, self.spec, mesh, params, bt)
+        self._pk = jnp.zeros(
+            (cfg.num_layers, N, cfg.num_kv_heads, bt, cfg.head_dim),
+            page_dtype)
+        self._pv = jnp.zeros_like(self._pk)
+        if pool_sharding is not None:
+            self._pk = jax.device_put(self._pk, pool_sharding.keys)
+            self._pv = jax.device_put(self._pv, pool_sharding.values)
+        self._tables = np.full((B, self._table_width), N, np.int32)
+        self._seed_row = seed_row_from_pages
+        self._write_row = write_row_to_pages
 
         def _emitted_logprob(logits, tok):
             """Raw log-softmax of the emitted token (the engines'
@@ -326,12 +371,16 @@ class ContinuousBatchingEngine:
                 jax.nn.log_softmax(logits.astype(jnp.float32), -1),
                 tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
 
-        def one_step(params, cache, lengths, last_tok, active, rng):
-            """One lockstep decode step over all slots — the shared core
-            of the per-step jit and the fused multi-step scan."""
+        def paged_one_step(params, cache, lengths, last_tok, active,
+                           rng):
+            """One paged lockstep decode step over all slots — the
+            shared core of the per-step jit and the fused multi-step
+            loop; mirrors the deleted dense ``one_step`` token for token
+            (same rng spends, same masking) so paged-vs-plain-engine
+            greedy parity is structural."""
             pos = lengths[:, None]
-            logits, cache = fwd(params, last_tok[:, None], cache, pos,
-                                True)
+            logits, cache = fwd_p(params, last_tok[:, None], cache, pos,
+                                  True)
             tok = sample_logits(logits[:, 0], rng, samp_)
             tok = jnp.where(active, tok, last_tok)
             lp = _emitted_logprob(logits[:, 0], tok)
@@ -339,10 +388,12 @@ class ContinuousBatchingEngine:
             return cache, lengths, tok, lp
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def step(params, ck, cv, lengths, last_tok, active, rng):
-            cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
-            cache, lengths, tok, lp = one_step(params, cache, lengths,
-                                               last_tok, active, rng)
+        def paged_step(params, pk, pv, tables, lengths, last_tok,
+                       active, rng):
+            bind_tables(tables)
+            cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
+            cache, lengths, tok, lp = paged_one_step(
+                params, cache, lengths, last_tok, active, rng)
             return cache.keys, cache.values, lengths, tok, lp
 
         def _fused_loop(step_fn, params, cache, lengths, last_tok,
@@ -396,16 +447,28 @@ class ContinuousBatchingEngine:
                                  done0, toks0, lps0))
             return cache, lengths, tok, toks, lps, steps
 
-        @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(9,))
-        def multi_step(params, ck, cv, lengths, last_tok, active, rng,
-                       eos, budget, num_steps):
-            """Dense fused block: ``_fused_loop`` over ``one_step``."""
-            cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(10,))
+        def paged_multi_step(params, pk, pv, tables, lengths,
+                             last_tok, active, rng, eos, budget,
+                             num_steps):
+            """decode_block fusion: ``_fused_loop`` over
+            ``paged_one_step``.  The tables are frozen for the block (no
+            admission can land mid-block) and rows that finish while
+            others run keep writing — through their own still-reserved
+            pages, or through sentinel entries that drop the write (the
+            paged stale-slot route)."""
+            bind_tables(tables)
+            cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
             cache, lengths, tok, toks, lps, steps = _fused_loop(
-                one_step, params, cache, lengths, last_tok, active, rng,
-                eos, budget, num_steps)
+                paged_one_step, params, cache, lengths, last_tok,
+                active, rng, eos, budget, num_steps)
             return (cache.keys, cache.values, lengths, tok, toks, lps,
                     steps)
+
+        @jax.jit
+        def set_slot_state(lengths, last_tok, slot, new_len, new_tok):
+            return (lengths.at[slot].set(new_len),
+                    last_tok.at[slot].set(new_tok))
 
         @partial(jax.jit, donate_argnums=(3, 4))
         def prefill(params, ids, start, row_k, row_v, real_len, rng):
@@ -443,48 +506,32 @@ class ContinuousBatchingEngine:
             row = KVCache.create(cfg_, cfg_.num_layers, 1, S, dtype=kv_dtype)
             return row.keys, row.values
 
-        @partial(jax.jit, out_shardings=row_shardings)
-        def load_prefix(prefix_k, prefix_v):
-            """Zero row with a cached prefix K/V block at columns [0, m)."""
-            row = KVCache.create(cfg_, cfg_.num_layers, 1, S, dtype=kv_dtype)
-            zero = jnp.zeros((), jnp.int32)
-            idx = (zero, zero, zero, zero, zero)
-            return (jax.lax.dynamic_update_slice(row.keys, prefix_k, idx),
-                    jax.lax.dynamic_update_slice(row.values, prefix_v, idx))
-
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def admit(ck, cv, row_k, row_v, slot, lengths, last_tok,
-                  new_len, new_tok):
-            zero = jnp.zeros((), jnp.int32)
-            ck = jax.lax.dynamic_update_slice(
-                ck, row_k, (zero, slot, zero, zero, zero))
-            cv = jax.lax.dynamic_update_slice(
-                cv, row_v, (zero, slot, zero, zero, zero))
-            lengths = lengths.at[slot].set(new_len)
-            last_tok = last_tok.at[slot].set(new_tok)
-            return ck, cv, lengths, last_tok
-
         # mid-chunk program for chunked admission: the SHARED factory
         # (engine.make_chunk_programs — one owner of chunk semantics), so
         # non-final chunks extend the row cache without materializing
         # logits or sampling (XLA drops the LM head entirely)
         self._chunk_mid, _ = make_chunk_programs(fwd)
 
-        self._step, self._prefill, self._admit = step, prefill, admit
-        self._multi_step = multi_step
-        self._load_prefix, self._zero_row = load_prefix, zero_row
+        self._prefill, self._zero_row = prefill, zero_row
+        self._paged_step = paged_step
+        self._paged_multi_step = paged_multi_step
+        self._set_slot_state = set_slot_state
 
         def verify_slots(params, cache, drafts, q_logits, lengths,
                          last_tok, active, rng):
             """Target-verify all slots' proposals in ONE [B, K+1]
-            forward + per-row accept + inactive-row masking — the verify
-            half shared by the draft-model and prompt-lookup step jits
-            (their host-side twin is _drain_spec_blocks)."""
+            forward over the PAGE POOL (the [B, K+1] chunk rides the
+            paged impl's XLA-gather path; writes scatter through the
+            frozen tables) + per-row accept + inactive-row masking — the
+            verify half shared by the draft-model and prompt-lookup step
+            jits (their host-side twin is _drain_spec_blocks).  Inactive
+            rows' chunk writes route through their slots' sentineled
+            tables and drop."""
             K = drafts.shape[1]
             verify_in = jnp.concatenate([last_tok[:, None], drafts],
                                         axis=1)
             pos = lengths[:, None] + jnp.arange(K + 1)[None, :]
-            t_logits, cache = fwd(params, verify_in, cache, pos, False)
+            t_logits, cache = fwd_p(params, verify_in, cache, pos, False)
             rng, sub_u, sub_x = jax.random.split(rng, 3)
             emitted, n, new_last = verify_emit_per_row(
                 t_logits, drafts, q_logits, samp_, sub_u, sub_x)
@@ -499,20 +546,24 @@ class ContinuousBatchingEngine:
             from .prompt_lookup import ngram_propose
             K = num_draft
             # emitted blocks write up to decode_block*(K+1) past a row's
-            # history length before the host drains (same contiguous-
-            # coverage invariant as the cache slack below)
-            hcap = S + decode_block * (K + 1) + 1
+            # history length before the host drains — S already folds
+            # that slack in; +1 is the OOB routing column for inactive
+            # rows
+            hcap = S + 1
 
             @partial(jax.jit, donate_argnums=(1, 2, 3),
-                     static_argnums=(8,))
-            def pld_step(params, ck, cv, history, lengths, last_tok,
-                         active, rng, num_rounds):
+                     static_argnums=(9,))
+            def pld_step(params, pk, pv, history, tables, lengths,
+                         last_tok, active, rng, num_rounds):
                 """``num_rounds`` prompt-lookup rounds over all slots,
                 fused in one dispatch: n-gram propose per row, verify
-                [B, K+1] in one forward, per-row accept, append the
-                emitted block to each active row's history."""
+                [B, K+1] in one paged forward, per-row accept, append
+                the emitted block to each active row's history.  The
+                K/V lands in each row's own reserved pages (the slack
+                columns folded into S cover the fused overshoot)."""
                 b = last_tok.shape[0]
-                cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
+                bind_tables(tables)
+                cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
 
                 def one_round(carry, sub):
                     cache, history, lengths, last_tok = carry
@@ -558,34 +609,65 @@ class ContinuousBatchingEngine:
         # ------------------------------------------------------------------
         # speculative slot decoding (draft model inside the slot loop)
         self._spec_step = None
-        slack = decode_block * (num_draft + 1) if prompt_lookup else 0
+        self._dmgr = None
         if draft_cfg is not None:
             # each fused round writes K+1 positions past a row's length
             # before the host learns how many were kept; rows advance
             # contiguously (n <= K+1 per round), so a query only ever
-            # reaches a column in the round that writes it — slack columns
-            # are never attended stale, even across slot reuse.  With
-            # decode_block rounds fused the overshoot compounds.
-            slack = decode_block * (num_draft + 1)
+            # reaches a column in the round that writes it — the slack
+            # columns folded into S (and into every request's page
+            # reservation) are never attended stale, even across slot
+            # reuse.  With decode_block rounds fused the overshoot
+            # compounds — hence slack = decode_block*(K+1).
             K = num_draft
             dcfg_ = draft_cfg
+            dspec = StageSpec(0, 1, 0, draft_cfg.num_layers)
+            # dense temp-row prefill (slot impl) + paged decode seam —
+            # the draft twins of the target's fwd / fwd_p pair
             fwd_d, _ = make_forward_seam(
-                draft_cfg, StageSpec(0, 1, 0, draft_cfg.num_layers), mesh,
-                draft_params, attn_impl=slot_attention_impl)
+                draft_cfg, dspec, mesh, draft_params,
+                attn_impl=slot_attention_impl)
+            fwd_dp, bind_dtables, dpool_sharding = \
+                make_paged_forward_seam(draft_cfg, dspec, mesh,
+                                        draft_params, bt)
+            # the draft page pool: pure per-request SCRATCH — no radix
+            # tree ever adopts draft pages (only the target's logits
+            # gate emission, so reuse is a target-side property); the
+            # manager is used for its free-list/accounting only, and
+            # used_blocks == 0 whenever no request is in flight (the
+            # draft half of the leak invariant)
+            self._dmgr = PagedKVCacheManager.for_model(
+                draft_cfg, n_blocks, bt, dtype=self.kv_cache_dtype)
+            ND = self._dmgr.num_blocks
+            self._dpage_sentinel = ND
+            self._dpk = jnp.zeros(
+                (draft_cfg.num_layers, ND, draft_cfg.num_kv_heads, bt,
+                 draft_cfg.head_dim), page_dtype)
+            self._dpv = jnp.zeros_like(self._dpk)
+            if dpool_sharding is not None:
+                self._dpk = jax.device_put(self._dpk,
+                                           dpool_sharding.keys)
+                self._dpv = jax.device_put(self._dpv,
+                                           dpool_sharding.values)
+            self._dtables = np.full((B, self._table_width), ND, np.int32)
 
             @partial(jax.jit, donate_argnums=(2, 3, 4, 5),
-                     static_argnums=(10,))
-            def spec_step(params, dparams, ck, cv, dck, dcv, lengths,
-                          last_tok, active, rng, num_rounds):
+                     static_argnums=(12,))
+            def spec_step(params, dparams, pk, pv, dpk, dpv, tables,
+                          dtables, lengths, last_tok, active, rng,
+                          num_rounds):
                 """``num_rounds`` speculative rounds over all slots,
-                fused in one dispatch: draft K per row, verify [B, K+1]
-                in one target forward, per-row accept
-                (verify_emit_per_row).  Returns [R, B, K+1] emitted
-                blocks + [R, B] counts for the host to drain; inactive
-                rows advance by 0 and keep last_tok."""
+                fused in one dispatch: draft K per row (through the
+                draft page pool), verify [B, K+1] in one paged target
+                forward, per-row accept (verify_emit_per_row).  Returns
+                [R, B, K+1] emitted blocks + [R, B] counts for the host
+                to drain; inactive rows advance by 0 and keep
+                last_tok."""
                 b = last_tok.shape[0]
-                cache = KVCache(ck, cv, jnp.zeros((), jnp.int32))
-                dcache = KVCache(dck, dcv, jnp.zeros((), jnp.int32))
+                bind_tables(tables)
+                bind_dtables(dtables)
+                cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
+                dcache = KVCache(dpk, dpv, jnp.zeros((), jnp.int32))
 
                 def one_round(carry, sub):
                     cache, dcache, lengths, last_tok = carry
@@ -597,8 +679,8 @@ class ContinuousBatchingEngine:
                     def dstep(c, j):
                         tok, dc, r = c
                         pos = (lengths + j)[:, None]
-                        logits, dc = fwd_d(dparams, tok[:, None], dc,
-                                           pos, True)
+                        logits, dc = fwd_dp(dparams, tok[:, None], dc,
+                                            pos, True)
                         logits = logits[:, 0]
                         r, s = jax.random.split(r)
                         if samp_.greedy:
@@ -651,132 +733,10 @@ class ContinuousBatchingEngine:
                                      dtype=kv_dtype)
                 return row.keys, row.values
 
-            @partial(jax.jit, donate_argnums=(0, 1))
-            def admit_d(dck, dcv, row_k, row_v, slot):
-                zero = jnp.zeros((), jnp.int32)
-                dck = jax.lax.dynamic_update_slice(
-                    dck, row_k, (zero, slot, zero, zero, zero))
-                dcv = jax.lax.dynamic_update_slice(
-                    dcv, row_v, (zero, slot, zero, zero, zero))
-                return dck, dcv
-
             self._spec_step = spec_step
             self._dprefill, self._zero_row_d = dprefill, zero_row_d
-            self._admit_d = admit_d
-            dcache = KVCache.create(draft_cfg, draft_cfg.num_layers, B,
-                                    S + slack, dtype=self.kv_cache_dtype)
-            if self._cache_sharding is not None:
-                dcache = jax.device_put(dcache, self._cache_sharding)
-            self._dck, self._dcv = dcache.keys, dcache.values
         self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
 
-        if self.kv_layout == "paged":
-            # DEVICE-resident page pool instead of dense slot rows: HBM
-            # holds num_blocks pages regardless of max_batch x max_seq,
-            # and per-slot block tables (host numpy, the scheduler's
-            # source of truth, shipped as a few hundred metadata bytes
-            # per dispatch) address them.  Entry >= num_blocks = "no
-            # page": writes drop (freed slots, fused-block overshoot),
-            # reads clamp into causally-masked garbage.
-            from ..ops.paged_attention import make_paged_attn_impl
-            from .kvcache import PagedKVCacheManager
-            from .kvcache.device import (seed_row_from_pages,
-                                         write_row_to_pages)
-            bt = block_tokens
-            self._table_width = S // bt
-            n_blocks, _ = resolve_kvcache_config(
-                kv_cache_blocks, kv_block_tokens,
-                default_blocks=B * self._table_width)
-            if n_blocks < 1:
-                raise ValueError(
-                    "kv_layout='paged' needs a block pool "
-                    "(kv_cache_blocks >= 1): the pool IS the decode "
-                    "cache — 0/off only makes sense for the dense "
-                    "layout's optional prefix cache")
-            self.kv_cache = PagedKVCacheManager.for_model(
-                cfg, n_blocks, bt, dtype=self.kv_cache_dtype)
-            N = self.kv_cache.num_blocks
-            self._page_sentinel = N
-            page_dtype = self.kv_cache_dtype or cfg.dtype
-            self._pk = jnp.zeros(
-                (cfg.num_layers, N, cfg.num_kv_heads, bt, cfg.head_dim),
-                page_dtype)
-            self._pv = jnp.zeros_like(self._pk)
-            self._tables = np.full((B, self._table_width), N, np.int32)
-            self._seed_row = seed_row_from_pages
-            self._write_row = write_row_to_pages
-            impl, bind_tables = make_paged_attn_impl(bt, backend="auto")
-            fwd_p, _ = make_forward_seam(cfg, self.spec, None, params,
-                                         attn_impl=impl)
-
-            def paged_one_step(params, cache, lengths, last_tok, active,
-                               rng):
-                """One paged lockstep step — mirrors ``one_step`` above
-                token for token (same rng spends, same masking) so
-                paged-vs-dense greedy parity is structural."""
-                pos = lengths[:, None]
-                logits, cache = fwd_p(params, last_tok[:, None], cache,
-                                      pos, True)
-                tok = sample_logits(logits[:, 0], rng, samp_)
-                tok = jnp.where(active, tok, last_tok)
-                lp = _emitted_logprob(logits[:, 0], tok)
-                lengths = lengths + active.astype(jnp.int32)
-                return cache, lengths, tok, lp
-
-            @partial(jax.jit, donate_argnums=(1, 2))
-            def paged_step(params, pk, pv, tables, lengths, last_tok,
-                           active, rng):
-                bind_tables(tables)
-                cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
-                cache, lengths, tok, lp = paged_one_step(
-                    params, cache, lengths, last_tok, active, rng)
-                return cache.keys, cache.values, lengths, tok, lp
-
-            @partial(jax.jit, donate_argnums=(1, 2), static_argnums=(10,))
-            def paged_multi_step(params, pk, pv, tables, lengths,
-                                 last_tok, active, rng, eos, budget,
-                                 num_steps):
-                """decode_block fusion, paged: ``_fused_loop`` over
-                ``paged_one_step`` — the same early-exit/active-count
-                semantics as the dense block (so paged-vs-dense greedy
-                parity stays structural).  The tables are frozen for the
-                block (no admission can land mid-block) and rows that
-                finish while others run keep writing — through their own
-                still-allocated pages, or through sentinel entries that
-                drop the write (the paged stale-slot route)."""
-                bind_tables(tables)
-                cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
-                cache, lengths, tok, toks, lps, steps = _fused_loop(
-                    paged_one_step, params, cache, lengths, last_tok,
-                    active, rng, eos, budget, num_steps)
-                return (cache.keys, cache.values, lengths, tok, toks,
-                        lps, steps)
-
-            @jax.jit
-            def set_slot_state(lengths, last_tok, slot, new_len, new_tok):
-                return (lengths.at[slot].set(new_len),
-                        last_tok.at[slot].set(new_tok))
-
-            self._paged_step = paged_step
-            self._paged_multi_step = paged_multi_step
-            self._set_slot_state = set_slot_state
-            self._ck = self._cv = None       # no dense batch cache
-        else:
-            cache = KVCache.create(cfg, cfg.num_layers, B, S + slack,
-                                   dtype=self.kv_cache_dtype)
-            if self._cache_sharding is not None:
-                cache = jax.device_put(cache, self._cache_sharding)
-            self._ck, self._cv = cache.keys, cache.values
-            # block-level KV cache (runtime/kvcache): the ONE
-            # prefix-reuse path — radix-tree partial-prefix matches,
-            # host block pool, stores at prefill time.  Matched/stored
-            # only on the scheduler thread; /metrics scrapes read
-            # snapshots under the manager lock.
-            from .kvcache import KVCacheManager
-            self.kv_cache = (
-                KVCacheManager.for_model(cfg, n_blocks, block_tokens,
-                                         dtype=self.kv_cache_dtype)
-                if n_blocks > 0 else None)
         self._lengths = jnp.zeros((B,), jnp.int32)
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._rng = jax.random.PRNGKey(seed)
@@ -810,51 +770,38 @@ class ContinuousBatchingEngine:
             # Real executions on purpose — jit's AOT path
             # (.lower().compile()) returns a separate executable and
             # does NOT seed the call cache the serving loop hits.
+            # all-sentinel tables: writes drop, state holds
             idle = jnp.zeros((B,), bool)
             warm_rng = jax.random.PRNGKey(0)
+            tbl = jnp.asarray(self._tables)
             for n_r in (1, self.decode_block):
                 if self._pld_step is not None:
-                    (self._ck, self._cv, self._history, self._lengths,
+                    (self._pk, self._pv, self._history, self._lengths,
                      self._last_tok, _, _) = self._pld_step(
-                        self.params, self._ck, self._cv, self._history,
-                        self._lengths, self._last_tok, idle, warm_rng,
-                        n_r)
+                        self.params, self._pk, self._pv, self._history,
+                        tbl, self._lengths, self._last_tok, idle,
+                        warm_rng, n_r)
                 elif self._spec_step is not None:
-                    (self._ck, self._cv, self._dck, self._dcv,
+                    (self._pk, self._pv, self._dpk, self._dpv,
                      self._lengths, self._last_tok, _, _) = \
                         self._spec_step(
-                            self.params, self.draft_params, self._ck,
-                            self._cv, self._dck, self._dcv,
-                            self._lengths, self._last_tok, idle,
-                            warm_rng, n_r)
-                elif self.kv_layout == "paged":
-                    # all-sentinel tables: writes drop, state holds
-                    tbl = jnp.asarray(self._tables)
-                    if n_r > 1:
-                        (self._pk, self._pv, self._lengths,
-                         self._last_tok, _, _, _) = self._paged_multi_step(
-                            self.params, self._pk, self._pv, tbl,
-                            self._lengths, self._last_tok, idle,
-                            warm_rng, self._eos_scalar(),
-                            jnp.zeros((B,), jnp.int32), n_r)
-                    else:
-                        (self._pk, self._pv, self._lengths,
-                         self._last_tok, _) = self._paged_step(
-                            self.params, self._pk, self._pv, tbl,
-                            self._lengths, self._last_tok, idle,
-                            warm_rng)
+                            self.params, self.draft_params, self._pk,
+                            self._pv, self._dpk, self._dpv, tbl,
+                            jnp.asarray(self._dtables), self._lengths,
+                            self._last_tok, idle, warm_rng, n_r)
                 elif n_r > 1:
-                    (self._ck, self._cv, self._lengths, self._last_tok,
-                     _, _, _) = self._multi_step(
-                        self.params, self._ck, self._cv, self._lengths,
-                        self._last_tok, idle, warm_rng,
-                        self._eos_scalar(), jnp.zeros((B,), jnp.int32),
-                        n_r)
+                    (self._pk, self._pv, self._lengths,
+                     self._last_tok, _, _, _) = self._paged_multi_step(
+                        self.params, self._pk, self._pv, tbl,
+                        self._lengths, self._last_tok, idle,
+                        warm_rng, self._eos_scalar(),
+                        jnp.zeros((B,), jnp.int32), n_r)
                 else:
-                    (self._ck, self._cv, self._lengths,
-                     self._last_tok, _) = self._step(
-                        self.params, self._ck, self._cv, self._lengths,
-                        self._last_tok, idle, warm_rng)
+                    (self._pk, self._pv, self._lengths,
+                     self._last_tok, _) = self._paged_step(
+                        self.params, self._pk, self._pv, tbl,
+                        self._lengths, self._last_tok, idle,
+                        warm_rng)
 
         self._slots: List[Optional[Request]] = [None] * B
         self._queue: "queue.Queue" = queue.Queue()
@@ -887,17 +834,23 @@ class ContinuousBatchingEngine:
             # admission records the first sampled token unconditionally,
             # so a 0-token request would still produce one
             raise ValueError("max_new_tokens must be >= 1")
-        if self.kv_layout == "paged":
-            # the page-pool twin of check_capacity: a request whose full
-            # table can never be allocated would wait in pending forever
-            bt = self.kv_cache.block_tokens
-            need = -(-(len(prompt) + max_new_tokens) // bt)
-            if need > self.kv_cache.num_blocks:
-                raise ValueError(
-                    f"request needs {need} KV blocks (prompt "
-                    f"{len(prompt)} + new {max_new_tokens} at "
-                    f"{bt} tokens/block) but the paged pool holds only "
-                    f"{self.kv_cache.num_blocks}; raise kv_cache_blocks")
+        # the page-pool twin of check_capacity: a request whose full
+        # table (incl. the speculative modes' fused-overshoot slack)
+        # can never be allocated would wait in pending forever
+        bt = self.kv_cache.block_tokens
+        need = -(-(len(prompt) + max_new_tokens
+                   + self._slack_tokens) // bt)
+        pool_bound = self.kv_cache.num_blocks
+        if self._dmgr is not None:
+            # the draft pool cannot evict (no tree), so it binds too
+            pool_bound = min(pool_bound, self._dmgr.num_blocks)
+        if need > pool_bound:
+            raise ValueError(
+                f"request needs {need} KV blocks (prompt "
+                f"{len(prompt)} + new {max_new_tokens} + slack "
+                f"{self._slack_tokens} at {bt} tokens/block) but the "
+                f"paged pool holds only {pool_bound}; raise "
+                "kv_cache_blocks")
         if self.max_queue_depth:
             depth = self._queue.qsize() + len(self._pending)
             if depth >= self.max_queue_depth:
@@ -1122,46 +1075,20 @@ class ContinuousBatchingEngine:
         return self.max_seq
 
     def _row_for(self, req: Request):
-        """(start, row_k, row_v) for a fresh admission: a zero row, or a
-        KV-cache hit preloaded with the matched block run's K/V.
-
-        The lease pins the matched blocks only for the host gather (the
-        copy-out IS the copy-on-write); the H2D load pads the run out to
-        the prompt bucket so ``_load_prefix`` keeps one compiled shape
-        per bucket — the pad columns sit at positions >= start and are
-        rewritten by the suffix prefill / decode before any query can
-        attend them (stale-slot invariant)."""
-        if self.kv_layout == "paged":
-            return self._row_for_paged(req)
-        if self.kv_cache is not None:
-            lease = self.kv_cache.match(req.prompt)
-            if lease is not None:
-                with lease:
-                    m = lease.tokens
-                    pk, pv = lease.gather()       # host [L, H, m, D]
-                cols = self._bucket(m)
-                if cols > m:
-                    pad = ((0, 0), (0, 0), (0, cols - m), (0, 0))
-                    pk = np.pad(pk, pad)
-                    pv = np.pad(pv, pad)
-                row_k, row_v = self._load_prefix(
-                    jnp.asarray(pk[:, None]), jnp.asarray(pv[:, None]))
-                return m, row_k, row_v
-        row_k, row_v = self._zero_row()
-        return 0, row_k, row_v
-
-    def _row_for_paged(self, req: Request):
         """Paged admission, phase 1: reserve the request's pages and
         build its prefill row — all on device.
 
         - ``match`` returns page IDS for the matched prefix (pinned by a
           lease held until the request completes: the slot's table will
           reference those shared pages for its whole lifetime);
-        - the private remainder — enough pages for prompt + max_new — is
-          allocated up front (LRU tree leaves evict under pressure), so
-          decode can never run out of pages mid-flight; if even eviction
-          cannot free enough, :class:`_BlocksExhausted` sends the
-          request back to pending (a completion will free pages);
+        - the private remainder — enough pages for prompt + max_new (+
+          the speculative modes' fused-overshoot slack) — is allocated
+          up front (LRU tree leaves evict under pressure), so decode can
+          never run out of pages mid-flight; the draft pool (speculative
+          mode) reserves the same span of scratch pages atomically with
+          the target's; if even eviction cannot free enough,
+          :class:`_BlocksExhausted` sends the request back to pending
+          (a completion will free pages);
         - the prefill row is gathered straight OUT of the page pool
           (``seed_row_from_pages``): a prefix hit moves zero bytes
           through the host — ``dwt_kvcache_h2d_bytes`` stays 0 on this
@@ -1169,7 +1096,7 @@ class ContinuousBatchingEngine:
         mgr = self.kv_cache
         bt = mgr.block_tokens
         plen = len(req.prompt)
-        n_total = -(-(plen + req.max_new) // bt)
+        n_total = -(-(plen + req.max_new + self._slack_tokens) // bt)
         # retry gate for a previously blocked admission: only re-attempt
         # once the pool could have changed (a completion frees at least
         # one private page — n_total strictly exceeds the adoptable
@@ -1189,24 +1116,43 @@ class ContinuousBatchingEngine:
                 lease.release()
             req._pkv_blocked = (mgr.epoch, mgr.free_blocks)
             raise _BlocksExhausted()
+        dprivate = None
+        if self._dmgr is not None:
+            # draft scratch pages, reserved atomically with the
+            # target's: a half-reserved admission must not wedge pages
+            # while it waits for the other pool
+            dprivate = self._dmgr.alloc(n_total)
+            if dprivate is None:
+                mgr.free(private)
+                if lease is not None:
+                    lease.release()
+                req._pkv_blocked = (mgr.epoch, mgr.free_blocks)
+                raise _BlocksExhausted()
         req._pkv_blocked = None
         table = np.full((self._table_width,), self._page_sentinel,
                         np.int32)
         if lease is not None:
             table[:n_pref] = lease.block_ids
         table[n_pref:n_total] = private
+        dtable = None
+        if dprivate is not None:
+            dtable = np.full((self._table_width,), self._dpage_sentinel,
+                             np.int32)
+            dtable[:n_total] = dprivate
         req._pkv = {"lease": lease, "store_lease": None,
                     "private": private, "adopted": (), "n_pref": n_pref,
-                    "table": table, "released": False}
+                    "table": table, "dprivate": dprivate,
+                    "dtable": dtable, "released": False}
         row_k, row_v = self._seed_row(self._pk, self._pv,
                                       jnp.asarray(table))
         return m, row_k, row_v
 
     def _release_request_kv(self, req: Request) -> None:
         """Return a paged request's KV resources: release its pins
-        (matched prefix + stored path) and free the private pages the
-        tree did not adopt.  Idempotent — completion, cancel, failure,
-        and the shutdown drain all funnel here."""
+        (matched prefix + stored path), free the private pages the
+        tree did not adopt, and free the draft pool's scratch pages
+        (never adopted by anything).  Idempotent — completion, cancel,
+        failure, and the shutdown drain all funnel here."""
         st = getattr(req, "_pkv", None)
         if st is None or st["released"]:
             return
@@ -1218,6 +1164,8 @@ class ContinuousBatchingEngine:
         adopted = set(st["adopted"])
         self.kv_cache.free([b for b in st["private"]
                             if b not in adopted])
+        if st["dprivate"] is not None:
+            self._dmgr.free(st["dprivate"])
 
     def _needs_stream(self, req: Request) -> bool:
         """Does this prompt need the one-at-a-time chunk stream, or can
@@ -1331,52 +1279,43 @@ class ContinuousBatchingEngine:
         row_k, row_v, tok, lp0 = self._prefill(
             self.params, jnp.asarray(padded), jnp.int32(start),
             row_k, row_v, jnp.int32(len(suffix)), sub)
-        if self.kv_layout == "paged":
-            st = req._pkv
-            # scatter the prefilled row into the request's OWN pages
-            # (device-to-device, zero D2H): the matched-prefix entries
-            # are sentineled out — those pages are tree-owned and
-            # immutable (prepare_kv_chunk's write contract)
-            wtable = st["table"].copy()
-            wtable[:st["n_pref"]] = self._page_sentinel
-            self._pk, self._pv = self._write_row(
-                self._pk, self._pv, row_k, row_v, jnp.asarray(wtable))
-            # store at PREFILL time, by ADOPTION: the tree takes
-            # ownership of the full-prompt pages it was missing — the
-            # next shared-prefix request block-table-references the
-            # very same pages this one decodes against
-            if plen // self.kv_cache.block_tokens >= 1:
-                adopted, store_lease = self.kv_cache.store_shared(
-                    req.prompt,
-                    st["table"][:plen // self.kv_cache.block_tokens])
-                st["adopted"] = adopted
-                st["store_lease"] = store_lease
-            self._tables[slot] = st["table"]
-            self._lengths, self._last_tok = self._set_slot_state(
-                self._lengths, self._last_tok, jnp.int32(slot),
-                jnp.int32(plen), tok.astype(jnp.int32))
-        else:
-            if self.kv_cache is not None:
-                # store at PREFILL time: the next shared-prefix request
-                # hits while this one is still decoding.  Columns
-                # [0, plen) are exact (prefix load + suffix prefill);
-                # only full blocks inside them are cached.
-                self.kv_cache.store(req.prompt, row_k, row_v)
-            (self._ck, self._cv, self._lengths,
-             self._last_tok) = self._admit(
-                self._ck, self._cv, row_k, row_v, jnp.int32(slot),
-                self._lengths, self._last_tok, jnp.int32(plen),
-                tok.astype(jnp.int32))
+        st = req._pkv
+        # scatter the prefilled row into the request's OWN pages
+        # (device-to-device, zero D2H): the matched-prefix entries
+        # are sentineled out — those pages are tree-owned and
+        # immutable (prepare_kv_chunk's write contract)
+        wtable = st["table"].copy()
+        wtable[:st["n_pref"]] = self._page_sentinel
+        self._pk, self._pv = self._write_row(
+            self._pk, self._pv, row_k, row_v, jnp.asarray(wtable))
+        # store at PREFILL time, by ADOPTION: the tree takes
+        # ownership of the full-prompt pages it was missing — the
+        # next shared-prefix request block-table-references the
+        # very same pages this one decodes against
+        if plen // self.kv_cache.block_tokens >= 1:
+            adopted, store_lease = self.kv_cache.store_shared(
+                req.prompt,
+                st["table"][:plen // self.kv_cache.block_tokens])
+            st["adopted"] = adopted
+            st["store_lease"] = store_lease
+        self._tables[slot] = st["table"]
+        self._lengths, self._last_tok = self._set_slot_state(
+            self._lengths, self._last_tok, jnp.int32(slot),
+            jnp.int32(plen), tok.astype(jnp.int32))
         if self._spec_step is not None:
-            # draft-side slot row: always the FULL prompt (prefix reuse
-            # applies to the target cache only; the draft is cheap)
+            # draft-side pages: always the FULL prompt (prefix reuse
+            # applies to the target cache only; the draft is cheap) —
+            # prefilled into a dense temp row, scattered into the
+            # request's reserved draft scratch pages, zero D2H
             dbucket = self._bucket(plen)
             dpad = np.zeros((1, dbucket), np.int32)
             dpad[0, :plen] = req.prompt
             drow_k, drow_v = self._dprefill(
                 self.draft_params, jnp.asarray(dpad), *self._zero_row_d())
-            self._dck, self._dcv = self._admit_d(
-                self._dck, self._dcv, drow_k, drow_v, jnp.int32(slot))
+            self._dpk, self._dpv = self._write_row(
+                self._dpk, self._dpv, drow_k, drow_v,
+                jnp.asarray(st["dtable"]))
+            self._dtables[slot] = st["dtable"]
         if self._pld_step is not None:
             # seed the slot's n-gram history: full prompt + first token
             hpad = np.zeros((1, self._bucket(plen)), np.int32)
@@ -1445,12 +1384,12 @@ class ContinuousBatchingEngine:
             req.stream.put(None)
             req.done.set()
             self._slots[slot] = None
-            if self.kv_layout == "paged":
-                # completion frees the pages: pins released, private
-                # non-adopted pages back to the pool, the slot's table
-                # row sentineled so post-finish stale writes drop
-                self._release_request_kv(req)
-                self._tables[slot] = self._page_sentinel
+            # completion frees the pages: pins released, private
+            # non-adopted pages back to the pool (target AND draft),
+            # the slot's table rows sentineled so post-finish stale
+            # writes drop
+            self._release_request_kv(req)
+            self._sentinel_slot(slot)
             self._flight.record("batch_done", slot=slot,
                                 tokens=len(req.tokens),
                                 reason="eos" if hit_eos else "length")
@@ -1461,8 +1400,7 @@ class ContinuousBatchingEngine:
         failure, and the shutdown drain all reach KV cleanup through
         here (the slot's table row is reset by the callers that own
         one)."""
-        if self.kv_layout == "paged":
-            self._release_request_kv(req)
+        self._release_request_kv(req)
         req.error = err
         req.stream.put(None)
         req.done.set()
@@ -1478,8 +1416,7 @@ class ContinuousBatchingEngine:
             if req is not None:
                 self._fail_request(req, err)
                 self._slots[i] = None
-                if self.kv_layout == "paged":
-                    self._tables[i] = self._page_sentinel
+                self._sentinel_slot(i)
         if self._adm is not None:
             self._fail_request(self._adm["req"], err)
             self._adm = None
@@ -1501,8 +1438,14 @@ class ContinuousBatchingEngine:
             if req is not None and req.cancelled:
                 self._fail_request(req, None)
                 self._slots[i] = None
-                if self.kv_layout == "paged":
-                    self._tables[i] = self._page_sentinel
+                self._sentinel_slot(i)
+
+    def _sentinel_slot(self, slot: int) -> None:
+        """Route a freed slot's future writes to nowhere: sentinel its
+        block-table row(s) so post-finish stale writes drop."""
+        self._tables[slot] = self._page_sentinel
+        if self._dmgr is not None:
+            self._dtables[slot] = self._dpage_sentinel
 
     def _eos_scalar(self):
         """eos_id as the traced sentinel scalar (-1 = disabled) — the
@@ -1536,16 +1479,19 @@ class ContinuousBatchingEngine:
         self._rng, sub = jax.random.split(self._rng)
         if self._pld_step is not None or self._spec_step is not None:
             if self._pld_step is not None:
-                (self._ck, self._cv, self._history, self._lengths,
+                (self._pk, self._pv, self._history, self._lengths,
                  tok, em, ns) = self._pld_step(
-                    self.params, self._ck, self._cv, self._history,
-                    self._lengths, self._last_tok,
-                    jnp.asarray(active_mask), sub, rounds)
+                    self.params, self._pk, self._pv, self._history,
+                    jnp.asarray(self._tables), self._lengths,
+                    self._last_tok, jnp.asarray(active_mask), sub,
+                    rounds)
             else:
-                (self._ck, self._cv, self._dck, self._dcv,
+                (self._pk, self._pv, self._dpk, self._dpv,
                  self._lengths, tok, em, ns) = self._spec_step(
-                    self.params, self.draft_params, self._ck,
-                    self._cv, self._dck, self._dcv, self._lengths,
+                    self.params, self.draft_params, self._pk,
+                    self._pv, self._dpk, self._dpv,
+                    jnp.asarray(self._tables),
+                    jnp.asarray(self._dtables), self._lengths,
                     self._last_tok, jnp.asarray(active_mask), sub,
                     rounds)
             self._last_tok = tok
@@ -1554,19 +1500,12 @@ class ContinuousBatchingEngine:
             for r in range(rounds):
                 self._drain_spec_blocks(em_np[r], ns_np[r])
         elif rounds > 1:
-            if self.kv_layout == "paged":
-                (self._pk, self._pv, self._lengths, tok,
-                 blocks, lps, steps) = self._paged_multi_step(
-                    self.params, self._pk, self._pv,
-                    jnp.asarray(self._tables), self._lengths,
-                    self._last_tok, jnp.asarray(active_mask), sub,
-                    self._eos_scalar(), self._budget_vec(), rounds)
-            else:
-                (self._ck, self._cv, self._lengths, tok,
-                 blocks, lps, steps) = self._multi_step(
-                    self.params, self._ck, self._cv, self._lengths,
-                    self._last_tok, jnp.asarray(active_mask), sub,
-                    self._eos_scalar(), self._budget_vec(), rounds)
+            (self._pk, self._pv, self._lengths, tok,
+             blocks, lps, steps) = self._paged_multi_step(
+                self.params, self._pk, self._pv,
+                jnp.asarray(self._tables), self._lengths,
+                self._last_tok, jnp.asarray(active_mask), sub,
+                self._eos_scalar(), self._budget_vec(), rounds)
             self._last_tok = tok
             steps = int(steps)       # the on-device active count
             self._count_loop(steps)
@@ -1575,16 +1514,11 @@ class ContinuousBatchingEngine:
                 np.asarray(blocks), np.full(len(self._slots), steps),
                 np.asarray(lps))
         else:
-            if self.kv_layout == "paged":
-                (self._pk, self._pv, self._lengths, tok,
-                 lp) = self._paged_step(
-                    self.params, self._pk, self._pv,
-                    jnp.asarray(self._tables), self._lengths,
-                    self._last_tok, jnp.asarray(active_mask), sub)
-            else:
-                self._ck, self._cv, self._lengths, tok, lp = self._step(
-                    self.params, self._ck, self._cv, self._lengths,
-                    self._last_tok, jnp.asarray(active_mask), sub)
+            (self._pk, self._pv, self._lengths, tok,
+             lp) = self._paged_step(
+                self.params, self._pk, self._pv,
+                jnp.asarray(self._tables), self._lengths,
+                self._last_tok, jnp.asarray(active_mask), sub)
             self._last_tok = tok
             self._count_loop(1)
             tok_np, lp_np = np.asarray(tok), np.asarray(lp)

@@ -65,7 +65,8 @@ class StageRuntime:
 
     def __init__(self, cfg: ModelConfig, spec: StageSpec, params: StageParams,
                  max_seq: int, sampling: SamplingParams = SamplingParams(),
-                 seed: int = 0, mesh=None, kv_cache_dtype=None):
+                 seed: int = 0, mesh=None, kv_cache_dtype=None,
+                 kv_layout=None):
         """``mesh``: a local tp mesh — this stage's layer range then runs
         with Megatron-sliced weights and a kv-head-sharded cache on this
         host's chips (pipeline across hosts x tensor parallelism within
@@ -75,7 +76,18 @@ class StageRuntime:
         ``kv_cache_dtype``: reduced-precision storage for this stage's
         request cache slots (e.g. "float8_e4m3fn"), same insert-cast /
         read-upcast contract as InferenceEngine's — each pipeline stage
-        halves its own cache bytes independently."""
+        halves its own cache bytes independently.
+
+        ``kv_layout``: "paged" (the default, docs/DESIGN.md §14) backs
+        every request's cache with ONE per-stage page pool: blocks are
+        allocated per chunk actually run (a request holding 40 tokens
+        holds ceil(40/bt) pages, not a max_seq row) and returned on
+        ``end:{rid}``, so concurrent rids (``pool_size`` dynamic
+        batching) share the pool instead of each reserving worst-case
+        rows.  Pool size: ``DWT_STAGE_KV_BLOCKS`` (default
+        ``DWT_STAGE_KV_ROWS`` = 16 rows' worth); exhaustion raises
+        loudly rather than silently evicting live KV.  "dense" keeps
+        the per-rid ``[b, max_seq]`` rows."""
         self.cfg = cfg
         self.spec = spec
         self.max_seq = max_seq
@@ -83,45 +95,111 @@ class StageRuntime:
         self.mesh = mesh
         self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
                                if kv_cache_dtype else None)
+        from .kvcache import resolve_kv_layout
+        self.kv_layout = resolve_kv_layout(kv_layout)
         self._rng_base = jax.random.PRNGKey(seed)
-        self.caches: Dict[int, KVCache] = {}
+        self.caches: Dict[int, KVCache] = {}      # dense layout only
 
-        from ..parallel.tensor import make_forward_seam
-        fwd, self._cache_sharding = make_forward_seam(cfg, spec, mesh,
-                                                      params)
-        if self._cache_sharding is not None:
-            from .engine import shard_engine_params
-            params = shard_engine_params(params, cfg, mesh)
-        self.params = params
-
+        from ..parallel.tensor import (make_forward_seam,
+                                       make_paged_forward_seam)
         take_last = spec.is_last
+        if self.kv_layout == "paged":
+            import math
 
-        @jax.jit
-        def forward(params, inputs, cache):
-            b, s = inputs.shape[0], inputs.shape[1]
-            pos = cache.length + jnp.broadcast_to(jnp.arange(s), (b, s))
-            out, cache = fwd(params, inputs, cache, pos, False)
-            return (out[:, -1] if take_last else out), cache
+            from ..telemetry._env import env_int
+            from .kvcache import resolve_kvcache_config
+            _, bt = resolve_kvcache_config(None, None)
+            g = math.lcm(8, bt)
+            S = -(-max_seq // g) * g
+            self._bt, self._table_width = bt, S // bt
+            rows = env_int("DWT_STAGE_KV_ROWS", 16)
+            n_blocks = env_int("DWT_STAGE_KV_BLOCKS",
+                               rows * self._table_width)
+            fwd, bind, pool_sharding = make_paged_forward_seam(
+                cfg, spec, mesh, params, bt)
+            self._cache_sharding = pool_sharding
+            if pool_sharding is not None:
+                from .engine import shard_engine_params
+                params = shard_engine_params(params, cfg, mesh)
+            self.params = params
+            page_dtype = self.kv_cache_dtype or cfg.dtype
+            self._pk = jnp.zeros(
+                (spec.num_layers, n_blocks, cfg.num_kv_heads, bt,
+                 cfg.head_dim), page_dtype)
+            self._pv = jnp.zeros_like(self._pk)
+            if pool_sharding is not None:
+                self._pk = jax.device_put(self._pk, pool_sharding.keys)
+                self._pv = jax.device_put(self._pv, pool_sharding.values)
+            self._sentinel = n_blocks
+            self._pool_free = list(range(n_blocks - 1, -1, -1))
+            self._tables: Dict[int, np.ndarray] = {}
+            self._rid_len: Dict[int, int] = {}
+            self._rid_blocks: Dict[int, int] = {}
+
+            @jax.jit
+            def forward_p(params, inputs, pk, pv, table, length):
+                bind(table)
+                cache = KVCache(pk, pv, length)
+                b, s = inputs.shape[0], inputs.shape[1]
+                pos = length + jnp.broadcast_to(jnp.arange(s), (b, s))
+                out, cache = fwd(params, inputs, cache, pos, False)
+                return ((out[:, -1] if take_last else out),
+                        cache.keys, cache.values)
+
+            @jax.jit
+            def forward_sample_p(params, inputs, pk, pv, table, length,
+                                 rng):
+                """Paged tail hot path: layer range + LM head + in-jit
+                sampling in ONE dispatch over the page pool — same rng,
+                same sample_logits as the split pair (§13)."""
+                bind(table)
+                cache = KVCache(pk, pv, length)
+                b, s = inputs.shape[0], inputs.shape[1]
+                pos = length + jnp.broadcast_to(jnp.arange(s), (b, s))
+                out, cache = fwd(params, inputs, cache, pos, False)
+                return (sample_logits(out[:, -1], rng, sampling),
+                        cache.keys, cache.values)
+
+            self._forward_p = forward_p
+            self._forward_sample_p = forward_sample_p
+        else:
+            fwd, self._cache_sharding = make_forward_seam(cfg, spec,
+                                                          mesh, params)
+            if self._cache_sharding is not None:
+                from .engine import shard_engine_params
+                params = shard_engine_params(params, cfg, mesh)
+            self.params = params
+
+            @jax.jit
+            def forward(params, inputs, cache):
+                b, s = inputs.shape[0], inputs.shape[1]
+                pos = cache.length + jnp.broadcast_to(jnp.arange(s),
+                                                      (b, s))
+                out, cache = fwd(params, inputs, cache, pos, False)
+                return (out[:, -1] if take_last else out), cache
+
+            @jax.jit
+            def forward_sample(params, inputs, cache, rng):
+                """Tail hot path: layer range + LM head + in-jit
+                sampling fused into ONE program (docs/DESIGN.md §13) —
+                halves the tail's per-token host dispatches vs
+                forward-then-sample.  Same rng, same sample_logits:
+                bit-identical tokens to the split pair by
+                construction."""
+                b, s = inputs.shape[0], inputs.shape[1]
+                pos = cache.length + jnp.broadcast_to(jnp.arange(s),
+                                                      (b, s))
+                out, cache = fwd(params, inputs, cache, pos, False)
+                return sample_logits(out[:, -1], rng, sampling), cache
+
+            self._forward = forward
+            self._forward_sample = forward_sample
 
         @jax.jit
         def sample(last_logits, rng):
             return sample_logits(last_logits, rng, sampling)
 
-        @jax.jit
-        def forward_sample(params, inputs, cache, rng):
-            """Tail hot path: layer range + LM head + in-jit sampling
-            fused into ONE program (docs/DESIGN.md §13) — halves the
-            tail's per-token host dispatches vs forward-then-sample.
-            Same rng, same sample_logits: bit-identical tokens to the
-            split pair by construction."""
-            b, s = inputs.shape[0], inputs.shape[1]
-            pos = cache.length + jnp.broadcast_to(jnp.arange(s), (b, s))
-            out, cache = fwd(params, inputs, cache, pos, False)
-            return sample_logits(out[:, -1], rng, sampling), cache
-
-        self._forward = forward
         self._sample = sample
-        self._forward_sample = forward_sample
         # the socket ring's topology caps the circuit at ONE token (the
         # stage cut severs the token -> embed dependency; §13), so the
         # tail's device-side win is dispatch FUSION, not K-fusion —
@@ -142,10 +220,51 @@ class StageRuntime:
             self.caches[rid] = cache
         return cache
 
+    def _paged_chunk_state(self, rid: int, batch: int, s: int):
+        """(table, length) for this rid's next ``s``-token chunk,
+        growing its block table from the stage pool first — pages are
+        reserved per chunk actually run, never per max_seq row.  Pool
+        exhaustion raises loudly (evicting live KV would decode wrong
+        tokens); the header's capacity check bounds per-rid growth."""
+        tbl = self._tables.get(rid)
+        if tbl is None:
+            tbl = np.full((batch, self._table_width), self._sentinel,
+                          np.int32)
+            self._tables[rid] = tbl
+        cur = self._rid_len.get(rid, 0)
+        need = -(-(cur + s) // self._bt)
+        have = self._rid_blocks.get(rid, 0)
+        if need > self._table_width:
+            raise RuntimeError(
+                f"rid {rid} needs {need} KV blocks but the stage table "
+                f"is {self._table_width} wide (max_seq {self.max_seq})")
+        grow = (need - have) * batch
+        if grow > len(self._pool_free):
+            # all-or-nothing grow: popping a partial set into the table
+            # before raising would leak pages if the chunk is retried
+            # (the table entries would be overwritten by fresh pops)
+            raise RuntimeError(
+                "stage page pool exhausted: raise "
+                "DWT_STAGE_KV_BLOCKS (or DWT_STAGE_KV_ROWS) — "
+                "refusing to evict live request KV")
+        for j in range(have, need):
+            for row in range(batch):
+                tbl[row, j] = self._pool_free.pop()
+        self._rid_blocks[rid] = max(have, need)
+        return tbl, cur
+
     def run_chunk(self, rid: int, inputs: np.ndarray) -> jax.Array:
         """Run this stage on a chunk; updates the request's cache in place.
         Returns hidden [b,s,H] (or last-position logits on the tail)."""
         x = jnp.asarray(inputs)
+        if self.kv_layout == "paged":
+            tbl, cur = self._paged_chunk_state(rid, x.shape[0],
+                                               x.shape[1])
+            out, self._pk, self._pv = self._forward_p(
+                self.params, x, self._pk, self._pv, jnp.asarray(tbl),
+                jnp.int32(cur))
+            self._rid_len[rid] = cur + x.shape[1]
+            return out
         cache = self._cache_for(rid, x.shape[0])
         out, self.caches[rid] = self._forward(self.params, x, cache)
         return out
@@ -163,15 +282,39 @@ class StageRuntime:
         :meth:`sample_tokens` draws, so the fused and split tails emit
         bit-identical tokens."""
         x = jnp.asarray(inputs)
-        cache = self._cache_for(rid, x.shape[0])
         rng = jax.random.fold_in(jax.random.fold_in(self._rng_base, rid),
                                  step)
+        if self.kv_layout == "paged":
+            tbl, cur = self._paged_chunk_state(rid, x.shape[0],
+                                               x.shape[1])
+            tok, self._pk, self._pv = self._forward_sample_p(
+                self.params, x, self._pk, self._pv, jnp.asarray(tbl),
+                jnp.int32(cur), rng)
+            self._rid_len[rid] = cur + x.shape[1]
+            return np.asarray(tok)
+        cache = self._cache_for(rid, x.shape[0])
         tok, self.caches[rid] = self._forward_sample(self.params, x,
                                                      cache, rng)
         return np.asarray(tok)
 
     def free(self, rid: int) -> None:
         self.caches.pop(rid, None)
+        if self.kv_layout == "paged":
+            tbl = self._tables.pop(rid, None)
+            self._rid_len.pop(rid, None)
+            self._rid_blocks.pop(rid, None)
+            if tbl is not None:
+                self._pool_free.extend(
+                    int(v) for v in tbl.flat if v != self._sentinel)
+
+    def reset_caches(self) -> None:
+        """Drop every request's cache state (reshard/restart): dense
+        rows garbage-collect; paged tables hand their pages back to the
+        stage pool (clearing the dict alone would leak them)."""
+        self.caches.clear()
+        if self.kv_layout == "paged":
+            for rid in list(self._tables):
+                self.free(rid)
 
 
 def _h_tag(rid: int, step: int) -> str:

@@ -74,11 +74,13 @@ class ElasticStageRuntime(StageRuntime):
     def __init__(self, cfg: ModelConfig, spec: StageSpec,
                  full_params: StageParams, max_seq: int,
                  sampling: SamplingParams = SamplingParams(),
-                 seed: int = 0, mesh=None, kv_cache_dtype=None):
+                 seed: int = 0, mesh=None, kv_cache_dtype=None,
+                 kv_layout=None):
         self.full_params = full_params
         super().__init__(cfg, spec, slice_stage(full_params, cfg, spec),
                          max_seq, sampling, seed, mesh=mesh,
-                         kv_cache_dtype=kv_cache_dtype)
+                         kv_cache_dtype=kv_cache_dtype,
+                         kv_layout=kv_layout)
         self._seed = seed
 
     def reassign(self, spec: StageSpec) -> None:
@@ -86,7 +88,9 @@ class ElasticStageRuntime(StageRuntime):
                 spec.num_stages) == (self.spec.layer_start,
                                      self.spec.layer_end, self.spec.stage_id,
                                      self.spec.num_stages):
-            self.caches.clear()   # topology unchanged but run restarts
+            # topology unchanged but run restarts: paged tables hand
+            # their pages back; dense rows garbage-collect
+            self.reset_caches()
             return
         # Re-init via StageRuntime.__init__ to rebuild the jitted closures
         # for the new spec (old executables are dropped with the old refs).
@@ -94,7 +98,8 @@ class ElasticStageRuntime(StageRuntime):
                               slice_stage(self.full_params, self.cfg, spec),
                               self.max_seq, self.sampling, self._seed,
                               mesh=self.mesh,
-                              kv_cache_dtype=self.kv_cache_dtype)
+                              kv_cache_dtype=self.kv_cache_dtype,
+                              kv_layout=self.kv_layout)
 
 
 def _spec_payload(spec: StageSpec) -> dict:
@@ -126,7 +131,7 @@ class ElasticWorker(PipelineWorker):
             if plan.get("park"):
                 # dropped from the chain but alive: free every cache and
                 # stand by as a spare for a future scale-up.
-                self.rt.caches.clear()
+                self.rt.reset_caches()
                 self._next_step.clear()
                 self.epoch = plan["epoch"]
                 self.next_id = None

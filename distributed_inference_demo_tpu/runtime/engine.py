@@ -222,6 +222,32 @@ def run_chunked_prefill(params, ids, cache, C: int, max_seq: int,
     return last, cache
 
 
+def run_seeded_prefill(params, ids, cache, C, max_seq, prefill,
+                       chunk_mid, chunk_last, start: int = 0):
+    """Whole-prompt or chunked prefill with an optional KV-cache-seeded
+    prefix: the ONE dispatch rule shared by InferenceEngine and
+    PromptLookupEngine (SpeculativeEngine drives the same pieces per
+    model).  ``start`` > 0: the cache already holds exact K/V for
+    columns ``[0, start)`` and only ``ids[:, start:]`` runs — one
+    chunk-last dispatch (compiled per suffix length, no worse than the
+    whole-prompt prefill's per-length compile), or the chunked driver's
+    suffix mode."""
+    if start:
+        suffix = ids[:, start:]
+        if C is not None:
+            return run_chunked_prefill(params, suffix, cache, C, max_seq,
+                                       chunk_mid, chunk_last, start=start)
+        cache = KVCache(cache.keys, cache.values, jnp.int32(start))
+        last, cache = chunk_last(params, suffix, cache, jnp.int32(start),
+                                 jnp.int32(suffix.shape[1] - 1))
+        return last, KVCache(cache.keys, cache.values,
+                             jnp.int32(ids.shape[1]))
+    if C is None:
+        return prefill(params, ids, cache)
+    return run_chunked_prefill(params, ids, cache, C, max_seq,
+                               chunk_mid, chunk_last)
+
+
 def resolve_cache_dtype_backend(kv_cache_dtype, attn_backend: str):
     """The reduced-precision-cache rule, ONE owner for every engine
     (plain / speculative / prompt-lookup / batching): a reduced-dtype KV
@@ -261,11 +287,15 @@ class InferenceEngine:
         """``attn_backend``: "auto" (Pallas flash kernel on TPU, jnp
         elsewhere), "flash", "flash-interpret" (testing), or "jnp".
 
-        ``kv_layout``: "dense" only.  The paged block pool
-        (docs/DESIGN.md §11) is plumbed for the continuous-batching
-        decode path; this engine rejects "paged" (flag or
-        ``DWT_KV_LAYOUT`` env) explicitly rather than silently decoding
-        dense rows under a knob that promises paged HBM accounting.
+        ``kv_layout``: layout of the prefix-reuse pool behind the
+        ``runtime/kvcache`` backend seam (docs/DESIGN.md §14).  "paged"
+        (the default) keeps the pool device-resident: hits gather pages
+        into the fresh cache on device and stores scatter blocks back —
+        zero bytes cross the host boundary either way.  "dense" is the
+        one-release escape hatch: the §10 host pool (H2D on hit, D2H on
+        store).  Either way the ONE request in flight decodes against a
+        dense working cache its decode loop donates — the layout
+        governs the standing pool, which is where reserved HBM lives.
 
         ``mesh``: a ``jax.sharding.Mesh`` with a ``tp`` axis — every
         forward then runs inside a shard_map with Megatron-sliced weights
@@ -324,10 +354,8 @@ class InferenceEngine:
         1 (default; ``DWT_STREAM_BLOCK`` env between) keeps the
         per-token path, which the fused loop is bit-identical to
         (greedy) by construction."""
-        from .kvcache import require_dense_kv_layout
-        require_dense_kv_layout(
-            "InferenceEngine (the single-request engines decode dense "
-            "cache rows)", kv_layout)
+        from .kvcache import resolve_kv_layout
+        self.kv_layout = resolve_kv_layout(kv_layout)
         self.cfg = cfg
         self.params = params
         self.max_seq = max_seq or cfg.max_seq_len
@@ -372,13 +400,10 @@ class InferenceEngine:
 
         self._attn_impl = attn_impl   # shared with MultimodalEngine
 
-        from .kvcache import KVCacheManager, resolve_kvcache_config
-        n_blocks, block_tokens = resolve_kvcache_config(
-            kv_cache_blocks, kv_block_tokens, default_blocks=0)
-        self.kv_cache = (
-            KVCacheManager.for_model(cfg, n_blocks, block_tokens,
-                                     dtype=self.kv_cache_dtype)
-            if n_blocks > 0 else None)
+        from .kvcache import make_kv_backend
+        self.kv_cache = make_kv_backend(
+            cfg, kv_cache_blocks, kv_block_tokens, layout=self.kv_layout,
+            dtype=self.kv_cache_dtype, default_blocks=0)
 
         cfg_ = cfg
         spec_ = self.spec
@@ -530,63 +555,34 @@ class InferenceEngine:
                      start: int = 0):
         """Whole-prompt or chunked prefill → (last_logits [b, V], cache).
         Chunked semantics (padding, aligned last window, length rewind)
-        live in :func:`run_chunked_prefill`, shared with the
-        speculative engine.  ``start`` > 0 is the KV-cache-seeded SUFFIX
-        path: ``ids`` still carries the whole prompt, columns
-        ``[0, start)`` of the cache already hold its prefix K/V, and
-        only ``ids[:, start:]`` runs."""
-        C = self.prefill_chunk
-        if start:
-            suffix = ids[:, start:]
-            if C is not None:
-                return run_chunked_prefill(
-                    self.params, suffix, cache, C, self.max_seq,
-                    self._prefill_chunk_mid, self._prefill_chunk_last,
-                    start=start)
-            # one dispatch via the shared chunk-last program (positions
-            # offset, logits at the true last position); compiled per
-            # suffix length — no worse than the whole-prompt prefill's
-            # per-length compile it replaces
-            cache = KVCache(cache.keys, cache.values, jnp.int32(start))
-            last, cache = self._prefill_chunk_last(
-                self.params, suffix, cache, jnp.int32(start),
-                jnp.int32(suffix.shape[1] - 1))
-            return last, KVCache(cache.keys, cache.values,
-                                 jnp.int32(ids.shape[1]))
-        if C is None:
-            return self._prefill(self.params, ids, cache)
-        return run_chunked_prefill(self.params, ids, cache, C,
-                                   self.max_seq, self._prefill_chunk_mid,
-                                   self._prefill_chunk_last)
+        live in :func:`run_chunked_prefill`; the seeded-suffix dispatch
+        rule in :func:`run_seeded_prefill` — both shared with the
+        speculative and prompt-lookup engines.  ``start`` > 0 is the
+        KV-cache-seeded SUFFIX path: ``ids`` still carries the whole
+        prompt, columns ``[0, start)`` of the cache already hold its
+        prefix K/V, and only ``ids[:, start:]`` runs."""
+        return run_seeded_prefill(
+            self.params, ids, cache, self.prefill_chunk, self.max_seq,
+            self._prefill, self._prefill_chunk_mid,
+            self._prefill_chunk_last, start=start)
 
     # -- block KV cache (runtime/kvcache) seams ------------------------
 
     def _kv_seed(self, ids: jnp.ndarray, cache: KVCache):
         """(start, cache): seed a fresh batch-1 cache from the longest
-        cached block-prefix of the prompt, or (0, cache) on a miss.
-        The lease is released the moment the host gather completes —
-        the H2D write reads the caller's own copy."""
-        if self.kv_cache is None or ids.shape[0] != 1:
+        cached block-prefix of the prompt, or (0, cache) on a miss —
+        the backend seam (kvcache/backend.py) owns the layout-specific
+        copy path (dense: host gather + H2D; paged: device gather,
+        zero H2D)."""
+        if self.kv_cache is None:
             return 0, cache
-        lease = self.kv_cache.match(np.asarray(ids[0]))
-        if lease is None:
-            return 0, cache
-        from .kvcache.device import seed_prefix_cache
-        with lease:
-            m = lease.tokens
-            pk, pv = lease.gather()            # host [L, H, m, D]
-        ck, cv = seed_prefix_cache(cache.keys, cache.values,
-                                   jnp.asarray(pk[:, None]),
-                                   jnp.asarray(pv[:, None]))
-        return m, KVCache(ck, cv, jnp.int32(m))
+        return self.kv_cache.seed(ids, cache)
 
     def _kv_store(self, ids: jnp.ndarray, cache: KVCache) -> None:
-        """Store the prefilled prompt's full blocks (batch 1 only; one
-        D2H slice for the missing tail).  Must run before the decode
-        scan donates the cache buffers."""
-        if self.kv_cache is not None and ids.shape[0] == 1:
-            self.kv_cache.store(np.asarray(ids[0]), cache.keys,
-                                cache.values)
+        """Store the prefilled prompt's full blocks (batch 1 only).
+        Must run before the decode scan donates the cache buffers."""
+        if self.kv_cache is not None:
+            self.kv_cache.store(ids, cache)
 
     def _decode(self, params, last_logits, cache, rng, eos, num_steps,
                 with_logprobs=False):

@@ -1,27 +1,31 @@
 """Block-level KV cache with radix-tree prefix sharing (docs/DESIGN.md
-§10 dense layout, §11 paged layout).
+§10 dense layout, §11 paged layout, §14 universal-paged contract).
 
-The single prefix-reuse path for the serving stack: the continuous-
-batching scheduler, the plain ``InferenceEngine`` generate paths, and
-the speculative target engine all match and store through one manager.
-Two layouts share the radix tree and the block granularity:
+The single prefix-reuse path for the serving stack.  Two layouts share
+the radix tree and the block granularity, both behind the
+:mod:`~.backend` seam every engine consumes:
 
-- **dense** (:class:`KVCacheManager`): host numpy block pool; hits pay
-  one H2D load into the engine's dense cache rows, stores one D2H
-  slice.  Every engine supports it.
-- **paged** (:class:`~.paged.PagedKVCacheManager`): the blocks live on
-  device in the engine's page pool and the manager keeps ids only —
-  hits are block-table references (zero H2D), stores are ownership
-  adoptions (zero copy).  Plumbed for the continuous-batching decode
-  path; everything else must reject it (``require_dense_kv_layout``),
-  never silently fall back.
+- **paged** (the DEFAULT): the blocks live on device — the batching
+  scheduler's slot cache IS a page pool addressed through block tables,
+  the ring stage workers hold per-stage page pools, and the
+  single-request engines keep a device-resident prefix pool
+  (:class:`~.backend.PagedKVBackend`).  Hits are device gathers /
+  block-table references, stores are device scatters / ownership
+  adoptions — zero bytes cross the host boundary
+  (``dwt_kvcache_h2d_bytes_total == 0`` structurally).
+- **dense** (:class:`KVCacheManager` behind
+  :class:`~.backend.DenseKVBackend`): host numpy block pool; hits pay
+  one H2D load, stores one D2H slice.  Survives one release as the
+  explicit ``--kv-layout dense`` escape hatch on the single-request
+  engines; the batching scheduler and the ring stages are paged-native.
 
 Layout selection: the ``kv_layout`` engine kwarg / ``--kv-layout`` flag
-over the ``DWT_KV_LAYOUT`` env knob over the default ``dense``.
+over the ``DWT_KV_LAYOUT`` env knob over the default ``paged``.
 """
 
 import os
 
+from .backend import (DenseKVBackend, PagedKVBackend, make_kv_backend)
 from .manager import (DEFAULT_BLOCK_TOKENS, KVCacheManager, KVLease,
                       resolve_kvcache_config)
 from .paged import PagedBlockLease, PagedKVCacheManager
@@ -32,8 +36,8 @@ KV_LAYOUTS = ("dense", "paged")
 
 
 def resolve_kv_layout(kv_layout=None) -> str:
-    """``kv_layout`` arg over ``DWT_KV_LAYOUT`` env over "dense"."""
-    layout = kv_layout or os.environ.get("DWT_KV_LAYOUT", "") or "dense"
+    """``kv_layout`` arg over ``DWT_KV_LAYOUT`` env over "paged"."""
+    layout = kv_layout or os.environ.get("DWT_KV_LAYOUT", "") or "paged"
     if layout not in KV_LAYOUTS:
         raise ValueError(
             f"unknown kv layout {layout!r}; expected one of {KV_LAYOUTS}")
@@ -41,21 +45,23 @@ def resolve_kv_layout(kv_layout=None) -> str:
 
 
 def require_dense_kv_layout(mode: str, kv_layout=None) -> str:
-    """Resolve the layout for a mode with no paged plumbing: honors
-    "dense", raises on "paged" — an env knob or flag asking for the
-    paged pool must fail loudly, never be silently ignored (the caller
-    would believe HBM reservations shrank when they did not)."""
+    """LEGACY guard from the §11 rejection-matrix era: honors "dense",
+    raises on "paged".  Every production call site is gone — the matrix
+    is dissolved; every engine and CLI mode accepts the paged layout
+    (docs/DESIGN.md §14) — and ``tools/check_kv_layout.py`` lints that
+    none regrows outside this package.  Kept only so an out-of-tree
+    caller that still imports it fails the same loud way it always did
+    rather than with an ImportError mid-request."""
     layout = resolve_kv_layout(kv_layout)
     if layout == "paged":
         raise ValueError(
-            f"kv layout 'paged' is not supported by {mode}; the paged "
-            "block pool is plumbed for the continuous-batching decode "
-            "path only (--batch-slots without a speculative proposer). "
-            "Use the dense layout here, or serve via --batch-slots.")
+            f"kv layout 'paged' is not supported by {mode}; use the "
+            "dense layout here")
     return layout
 
 
 __all__ = ["KVBlockPool", "KVCacheManager", "KVLease",
+           "DenseKVBackend", "PagedKVBackend", "make_kv_backend",
            "PagedBlockLease", "PagedKVCacheManager", "RadixTree",
            "resolve_kvcache_config", "resolve_kv_layout",
            "require_dense_kv_layout", "DEFAULT_BLOCK_TOKENS",

@@ -1,0 +1,219 @@
+"""The KV backend seam: ONE prefix-reuse surface over both layouts.
+
+Before this seam, every engine special-cased the dense manager inline
+(match → host gather → H2D seed; D2H slice → store) and *rejected*
+``--kv-layout paged`` outright — the DESIGN.md §11 rejection matrix.
+The seam is the two calls an engine actually needs around its prefill,
+implemented by both layouts so the engines stop caring which one runs:
+
+- ``seed(ids, cache) -> (start, cache)`` — write the longest cached
+  prefix of the (batch-1) prompt into a fresh engine cache's leading
+  columns; ``start`` is how many positions are now exact, so the engine
+  prefills only the suffix.
+- ``store(ids, cache) -> None`` — cache the prefilled prompt's full
+  blocks for the next shared-prefix request.  Runs before the decode
+  program donates the cache buffers.
+
+Layouts:
+
+- :class:`DenseKVBackend` wraps the §10 host-pool
+  :class:`~.manager.KVCacheManager`: a hit pays one H2D gather, a store
+  one D2H slice (counted in ``dwt_kvcache_h2d_bytes_total``).
+- :class:`PagedKVBackend` owns a DEVICE-resident page pool
+  ``[L, N, H, bt, D]`` plus the §11 page-id
+  :class:`~.paged.PagedKVCacheManager`: seeds gather pages into the
+  cache on device and stores scatter cache blocks into freshly
+  allocated pages on device — zero bytes cross the host boundary in
+  either direction, and two prompts sharing a prefix share the very
+  same pages in HBM (radix-tree dedup; the speculative engine's target
+  prefills ride this, so a draft/verify request never duplicates an
+  accepted prefix already paged in).
+
+The single-request engines keep a dense *working* cache for the one
+request in flight (its decode loop donates it); the layout choice
+governs the standing *pool* — which is where the reserved-HBM story
+lives once the batching scheduler and the ring stages page their own
+decode caches (docs/DESIGN.md §14).
+
+Ownership (paged): pages are tree-owned or free — a seed copies out of
+tree pages under a short-lived pin, a store hands freshly written pages
+to the tree (redundant ones are freed immediately), so after every
+``seed``/``store`` the leak invariant ``used == tree.block_count``
+holds with zero live leases.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .manager import (KVCacheManager, apply_byte_budget,
+                      resolve_kvcache_config)
+from .paged import PagedKVCacheManager
+
+
+class DenseKVBackend:
+    """Host block pool behind the seam (docs/DESIGN.md §10)."""
+
+    layout = "dense"
+
+    def __init__(self, mgr: KVCacheManager):
+        self.mgr = mgr
+        self.block_tokens = mgr.block_tokens
+
+    def seed(self, ids, cache):
+        """Match + host gather + one fused H2D write into the fresh
+        cache's columns ``[0, m)``.  Batch-1 only (multi-row prompts
+        have no shared single prefix key)."""
+        import jax.numpy as jnp
+
+        from ...models.base import KVCache
+        from .device import seed_prefix_cache
+        if ids.shape[0] != 1:
+            return 0, cache
+        lease = self.mgr.match(np.asarray(ids[0]))
+        if lease is None:
+            return 0, cache
+        with lease:
+            m = lease.tokens
+            pk, pv = lease.gather()            # host [L, H, m, D]
+        ck, cv = seed_prefix_cache(cache.keys, cache.values,
+                                   jnp.asarray(pk[:, None]),
+                                   jnp.asarray(pv[:, None]))
+        return m, KVCache(ck, cv, jnp.int32(m))
+
+    def store(self, ids, cache) -> None:
+        if ids.shape[0] == 1:
+            self.mgr.store(np.asarray(ids[0]), cache.keys, cache.values)
+
+    @property
+    def stats(self) -> dict:
+        return self.mgr.stats
+
+    def snapshot(self) -> dict:
+        return self.mgr.snapshot()
+
+    def debug_state(self) -> dict:
+        return self.mgr.debug_state()
+
+    def reset_stats(self) -> None:
+        self.mgr.reset_stats()
+
+
+class PagedKVBackend:
+    """Device page pool behind the seam (docs/DESIGN.md §11/§14)."""
+
+    layout = "paged"
+
+    def __init__(self, cfg, num_blocks: int, block_tokens: int,
+                 dtype=None):
+        import jax.numpy as jnp
+        self.mgr = PagedKVCacheManager.for_model(cfg, num_blocks,
+                                                 block_tokens, dtype=dtype)
+        self.block_tokens = self.mgr.block_tokens
+        page_dtype = dtype if dtype is not None else cfg.dtype
+        self._pk = jnp.zeros(
+            (cfg.num_layers, self.mgr.num_blocks, cfg.num_kv_heads,
+             self.mgr.block_tokens, cfg.head_dim), page_dtype)
+        self._pv = jnp.zeros_like(self._pk)
+
+    def seed(self, ids, cache):
+        """Match + device gather out of the pool into the fresh cache —
+        zero H2D (``dwt_kvcache_h2d_bytes_total`` stays 0 structurally:
+        this class never moves bytes through the host).  The pin is
+        released right after the gather dispatch: device ops execute in
+        dispatch order, so a later store/evict can never overwrite the
+        pages before the gather reads them."""
+        import jax.numpy as jnp
+
+        from ...models.base import KVCache
+        from .device import seed_cache_from_pages
+        if ids.shape[0] != 1:
+            return 0, cache
+        lease = self.mgr.match(np.asarray(ids[0]))
+        if lease is None:
+            return 0, cache
+        m = lease.tokens
+        ck, cv = seed_cache_from_pages(
+            cache.keys, cache.values, self._pk, self._pv,
+            jnp.asarray(lease.block_ids, jnp.int32))
+        lease.release()
+        return m, KVCache(ck, cv, jnp.int32(m))
+
+    def store(self, ids, cache) -> None:
+        """Allocate pages for the prompt's MISSING tail blocks, scatter
+        the matching cache columns into them on device (zero D2H), and
+        hand them to the radix tree.  Blocks the tree already covers
+        (``peek``) allocate and write nothing — a warm store must not
+        evict hot prefixes to stage pages the tree would immediately
+        decline.  ``peek`` is capped below the prompt length and an
+        eviction can race the coverage read, so the tree-side contract
+        (``store_shared`` with None placeholders) stops insertion at
+        any block the caller brought no page for — caching less is
+        always correct; genuinely redundant tail pages are declined and
+        freed here."""
+        import jax.numpy as jnp
+
+        from .device import store_cache_to_pages
+        if ids.shape[0] != 1:
+            return
+        prompt = np.asarray(ids[0])
+        n_blocks = len(prompt) // self.mgr.block_tokens
+        if n_blocks < 1:
+            return
+        covered = self.mgr.peek(prompt) // self.mgr.block_tokens
+        missing = n_blocks - covered         # >= 1: peek caps at len-1
+        block_ids = self.mgr.alloc(missing)
+        if block_ids is None:
+            return      # every evictable page pinned: caching less is fine
+        self._pk, self._pv = store_cache_to_pages(
+            self._pk, self._pv, cache.keys, cache.values,
+            jnp.asarray(block_ids, jnp.int32), jnp.int32(covered))
+        adopted, lease = self.mgr.store_shared(
+            prompt, [None] * covered + list(block_ids))
+        if lease is not None:
+            # nothing outlives this call that references the pages (the
+            # engine decodes against its own cache copy) — release now
+            lease.release()
+        declined = set(block_ids) - set(adopted)
+        if declined:
+            self.mgr.free(sorted(declined))
+
+    @property
+    def stats(self) -> dict:
+        return self.mgr.stats
+
+    def snapshot(self) -> dict:
+        return self.mgr.snapshot()
+
+    def debug_state(self) -> dict:
+        return self.mgr.debug_state()
+
+    def reset_stats(self) -> None:
+        self.mgr.reset_stats()
+
+
+def make_kv_backend(cfg, kv_cache_blocks: Optional[int],
+                    kv_block_tokens: Optional[int], *, layout: str,
+                    dtype=None, default_blocks: int = 0):
+    """The one constructor every engine calls: resolve the block-count /
+    block-tokens knobs (CLI over env over ``default_blocks``) and build
+    the layout's backend — or None when the pool is off (0 blocks, or a
+    ``DWT_KVCACHE_BYTES`` ceiling below one block: a knob documented as
+    a ceiling must never crash engine construction)."""
+    n_blocks, block_tokens = resolve_kvcache_config(
+        kv_cache_blocks, kv_block_tokens, default_blocks=default_blocks)
+    if n_blocks < 1:
+        return None
+    if layout == "paged":
+        dtype_ = dtype if dtype is not None else cfg.dtype
+        block_bytes = (2 * int(cfg.num_layers) * int(cfg.num_kv_heads)
+                       * int(block_tokens) * int(cfg.head_dim)
+                       * np.dtype(dtype_).itemsize)
+        if apply_byte_budget(n_blocks, block_bytes) < 1:
+            return None
+        return PagedKVBackend(cfg, n_blocks, block_tokens, dtype=dtype)
+    mgr = KVCacheManager.for_model(cfg, n_blocks, block_tokens,
+                                   dtype=dtype)
+    return DenseKVBackend(mgr) if mgr is not None else None

@@ -64,6 +64,51 @@ def seed_row_from_pages(pk, pv, table):
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
+def seed_cache_from_pages(ck, cv, pk, pv, table):
+    """Gather a matched block run out of the page pool into a (fresh,
+    donatable) engine cache's columns ``[0, n*bt)`` — the PAGED twin of
+    :func:`seed_prefix_cache`: pages ``[L, N, H, bt, D]`` + table ``[n]``
+    of real page ids -> cache ``[L, 1, H, S, D]``.  Device-to-device:
+    a prefix hit on the paged backend moves zero bytes through the host
+    (``dwt_kvcache_h2d_bytes_total`` stays 0 by construction).  Compiled
+    per matched length, like the dense seed program it mirrors."""
+    L, N, H, bt, D = pk.shape
+    n = table.shape[0]
+    rk = jnp.take(pk, table, axis=1)          # [L, n, H, bt, D]
+    rv = jnp.take(pv, table, axis=1)
+    rk = rk.transpose(0, 2, 1, 3, 4).reshape(L, 1, H, n * bt, D)
+    rv = rv.transpose(0, 2, 1, 3, 4).reshape(L, 1, H, n * bt, D)
+    zero = jnp.zeros((), jnp.int32)
+    idx = (zero, zero, zero, zero, zero)
+    return (jax.lax.dynamic_update_slice(ck, rk.astype(ck.dtype), idx),
+            jax.lax.dynamic_update_slice(cv, rv.astype(cv.dtype), idx))
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def store_cache_to_pages(pk, pv, ck, cv, table, start):
+    """Scatter an engine cache's full blocks ``[start, start + n)`` into
+    the page pool at ``table``'s ids — the paged store: cache ``[L, 1,
+    H, S, D]`` columns ``[start*bt, (start+n)*bt)`` land in pages
+    ``table[0..n)`` in place on device, zero D2H (the dense manager's
+    per-store host slice is the copy this program deletes).  ``start``
+    (traced block offset) is the tail-only store seam: blocks the radix
+    tree already covers are neither re-allocated nor re-written.  The
+    cache is read, not donated — the caller keeps decoding against it;
+    only the pool buffers rotate."""
+    L, N, H, bt, D = pk.shape
+    n = table.shape[0]
+    run_k = jax.lax.dynamic_slice_in_dim(ck[:, 0], start * bt, n * bt,
+                                         axis=2)
+    run_v = jax.lax.dynamic_slice_in_dim(cv[:, 0], start * bt, n * bt,
+                                         axis=2)
+    rk = run_k.reshape(L, H, n, bt, D).transpose(0, 2, 1, 3, 4)
+    rv = run_v.reshape(L, H, n, bt, D).transpose(0, 2, 1, 3, 4)
+    pk = pk.at[:, table].set(rk.astype(pk.dtype), mode="drop")
+    pv = pv.at[:, table].set(rv.astype(pv.dtype), mode="drop")
+    return pk, pv
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
 def write_row_to_pages(pk, pv, row_k, row_v, table):
     """Scatter a prefilled dense row ``[L, 1, H, W*bt, D]`` into the page
     pool at ``table``'s ids — the paged store: blocks land in place on

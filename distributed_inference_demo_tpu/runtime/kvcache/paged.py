@@ -221,10 +221,16 @@ class PagedKVCacheManager:
         caller's pages: ``block_ids[j]`` must already hold block ``j``'s
         K/V on device.  Blocks the tree already covers are declined (the
         caller keeps owning its redundant copies); adopted ids become
-        tree-owned.  Returns ``(adopted_ids, lease)`` — the lease pins
-        the stored path so eviction cannot free adopted (or
-        prefix-matched) pages while the caller's table still references
-        them; release it at request completion.
+        tree-owned.  ``block_ids[j]`` may be None for blocks the caller
+        BELIEVES are already covered (it allocated no page for them —
+        the backend's tail-only store): if the tree disagrees (an
+        eviction raced the caller's coverage peek), insertion stops
+        there — a stored proper prefix is still a valid cache entry,
+        and adopting a nonexistent page would corrupt the pool.
+        Returns ``(adopted_ids, lease)`` — the lease pins the stored
+        path so eviction cannot free adopted (or prefix-matched) pages
+        while the caller's table still references them; release it at
+        request completion.
         """
         prompt = np.asarray(prompt).reshape(-1)
         bt = self.block_tokens
@@ -241,6 +247,8 @@ class PagedKVCacheManager:
 
         with self._lock:
             def adopt(j):
+                if block_ids[j] is None:
+                    return None          # caller has no page: stop here
                 adopted.append(block_ids[j])
                 return block_ids[j]
 

@@ -65,9 +65,11 @@ class MultimodalEngine:
                  max_seq: Optional[int] = None,
                  sampling: SamplingParams = SamplingParams(),
                  eos_id: Optional[int] = None,
-                 attn_backend: str = "auto"):
+                 attn_backend: str = "auto",
+                 kv_layout: Optional[str] = None):
         self.engine = InferenceEngine(cfg, params, max_seq, sampling,
-                                      eos_id, attn_backend)
+                                      eos_id, attn_backend,
+                                      kv_layout=kv_layout)
         self.cfg = cfg
         self.vcfg = vcfg
         self.vparams = vparams

@@ -94,20 +94,31 @@ class PromptLookupEngine:
                  mesh=None,
                  eos_id: Optional[int] = None,
                  kv_cache_dtype=None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 kv_cache_blocks: Optional[int] = None,
+                 kv_block_tokens: Optional[int] = None,
+                 kv_layout: Optional[str] = None):
         """``mesh``: tp mesh — the target forward runs sharded (see
         InferenceEngine); proposal matching stays replicated VPU work.
         ``kv_cache_dtype``: reduced-precision cache storage, same
         contract as InferenceEngine (insert rounds, attention upcasts,
         jnp path forced).  ``prefill_chunk``: C-token chunked prefill
         (engine.run_chunked_prefill semantics; the proposer's history
-        buffer is host-seeded from the ids and unaffected)."""
+        buffer is host-seeded from the ids and unaffected).
+
+        ``kv_cache_blocks`` / ``kv_block_tokens`` / ``kv_layout``: the
+        block-level KV prefix pool behind the backend seam
+        (docs/DESIGN.md §14), batch 1: a prompt sharing whole leading
+        blocks with an earlier prefill seeds its cache and prefills only
+        the suffix — exactness is a prefill-side property, so it
+        composes with the n-gram proposer untouched (the history buffer
+        still seeds from the full ids).  Default off (0 blocks); layout
+        "paged" (default) keeps the pool device-resident, "dense" is
+        the host-pool escape hatch."""
         if num_draft < 1:
             raise ValueError("num_draft must be >= 1")
-        from .kvcache import require_dense_kv_layout
-        require_dense_kv_layout(
-            "PromptLookupEngine (the n-gram verify rollback decodes "
-            "dense cache rows)")
+        from .kvcache import resolve_kv_layout
+        self.kv_layout = resolve_kv_layout(kv_layout)
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.sampling = sampling
@@ -190,23 +201,34 @@ class PromptLookupEngine:
         from .engine import make_chunk_programs
         self._chunk_mid, self._chunk_last = make_chunk_programs(fwd)
 
+        from .kvcache import make_kv_backend
+        self.kv_cache = make_kv_backend(
+            cfg, kv_cache_blocks, kv_block_tokens, layout=self.kv_layout,
+            dtype=self.kv_cache_dtype, default_blocks=0)
+
     # ------------------------------------------------------------------
 
     def _init_state(self, ids: jnp.ndarray, rng):
         """Prefill + first target-sampled token + seeded history buffer —
-        the state both generate paths start every run from."""
+        the state both generate paths start every run from.  A KV-cache
+        hit (backend seam) seeds the cache's leading columns and
+        prefills only the suffix; the full prompt is stored back before
+        the rounds program donates the cache."""
         b, plen = ids.shape
         cache = KVCache.create(self.cfg, self.cfg.num_layers, b, self._cap,
                                dtype=self.kv_cache_dtype)
         if self._cache_sharding is not None:
             cache = jax.device_put(cache, self._cache_sharding)
-        if self.prefill_chunk is None:
-            last_logits, cache = self._prefill(self.params, ids, cache)
-        else:
-            from .engine import run_chunked_prefill
-            last_logits, cache = run_chunked_prefill(
-                self.params, ids, cache, self.prefill_chunk, self.max_seq,
-                self._chunk_mid, self._chunk_last)
+        start = 0
+        if self.kv_cache is not None:
+            start, cache = self.kv_cache.seed(ids, cache)
+        from .engine import run_seeded_prefill
+        last_logits, cache = run_seeded_prefill(
+            self.params, ids, cache, self.prefill_chunk, self.max_seq,
+            self._prefill, self._chunk_mid,
+            self._chunk_last, start=start)
+        if self.kv_cache is not None:
+            self.kv_cache.store(ids, cache)
         rng, sub = jax.random.split(rng)
         last_tok = sample_logits(last_logits, sub, self.sampling)
         history = jnp.zeros((b, self._cap), jnp.int32)

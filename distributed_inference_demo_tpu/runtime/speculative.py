@@ -268,7 +268,8 @@ class SpeculativeEngine:
                  kv_cache_dtype=None,
                  prefill_chunk: Optional[int] = None,
                  kv_cache_blocks: Optional[int] = None,
-                 kv_block_tokens: Optional[int] = None):
+                 kv_block_tokens: Optional[int] = None,
+                 kv_layout: Optional[str] = None):
         """``kv_cache_dtype``: reduced-precision storage for BOTH the
         target and draft caches (same contract as InferenceEngine /
         ContinuousBatchingEngine: insert rounds via update_kv_cache's
@@ -287,7 +288,15 @@ class SpeculativeEngine:
         suffix; the draft always prefills its full prompt (it is cheap
         by construction, and only the target's logits gate emission, so
         reuse exactness is a target-side property).  Default off; env
-        ``DWT_KVCACHE_*`` knobs apply as in InferenceEngine."""
+        ``DWT_KVCACHE_*`` knobs apply as in InferenceEngine.
+
+        ``kv_layout``: layout of the target-side prefix pool behind the
+        backend seam (docs/DESIGN.md §14) — "paged" (default) keeps it
+        device-resident, so two speculative requests sharing a prompt
+        prefix reference the SAME pages in HBM (the accepted prefix is
+        never duplicated; pinned by the ownership tests) and hits move
+        zero bytes through the host; "dense" is the §10 host-pool
+        escape hatch."""
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
                 f"draft vocab ({draft_cfg.vocab_size}) != target vocab "
@@ -295,10 +304,8 @@ class SpeculativeEngine:
                 "token space")
         if num_draft < 1:
             raise ValueError("num_draft must be >= 1")
-        from .kvcache import require_dense_kv_layout
-        require_dense_kv_layout(
-            "SpeculativeEngine (the draft/verify rollback decodes dense "
-            "cache rows)")
+        from .kvcache import resolve_kv_layout
+        self.kv_layout = resolve_kv_layout(kv_layout)
         self.cfg, self.params = cfg, params
         self.draft_cfg, self.draft_params = draft_cfg, draft_params
         self.max_seq = max_seq or cfg.max_seq_len
@@ -353,13 +360,10 @@ class SpeculativeEngine:
         self._t_chunk_mid, self._t_chunk_last = make_chunk_programs(fwd_t)
         self._d_chunk_mid, _ = make_chunk_programs(fwd_d)
 
-        from .kvcache import KVCacheManager, resolve_kvcache_config
-        n_blocks, block_tokens = resolve_kvcache_config(
-            kv_cache_blocks, kv_block_tokens, default_blocks=0)
-        self.kv_cache = (
-            KVCacheManager.for_model(cfg, n_blocks, block_tokens,
-                                     dtype=self.kv_cache_dtype)
-            if n_blocks > 0 else None)
+        from .kvcache import make_kv_backend
+        self.kv_cache = make_kv_backend(
+            cfg, kv_cache_blocks, kv_block_tokens, layout=self.kv_layout,
+            dtype=self.kv_cache_dtype, default_blocks=0)
 
         def one_round(tparams, dparams, last_tok, tcache, dcache, rng):
             """Draft K, verify K+1 in one target forward, accept/resample.
@@ -474,17 +478,8 @@ class SpeculativeEngine:
         from .engine import run_chunked_prefill
         b, plen = ids.shape
         start = 0
-        if self.kv_cache is not None and b == 1:
-            lease = self.kv_cache.match(np.asarray(ids[0]))
-            if lease is not None:
-                from .kvcache.device import seed_prefix_cache
-                with lease:
-                    start = lease.tokens
-                    pk, pv = lease.gather()
-                tck, tcv = seed_prefix_cache(tcache.keys, tcache.values,
-                                             jnp.asarray(pk[:, None]),
-                                             jnp.asarray(pv[:, None]))
-                tcache = KVCache(tck, tcv, jnp.int32(start))
+        if self.kv_cache is not None:
+            start, tcache = self.kv_cache.seed(ids, tcache)
         if start:
             C = self.prefill_chunk
             suffix = ids[:, start:]
@@ -506,9 +501,8 @@ class SpeculativeEngine:
         else:
             last, tcache, dcache = self._run_prefill_both(ids, tcache,
                                                           dcache)
-        if self.kv_cache is not None and b == 1:
-            self.kv_cache.store(np.asarray(ids[0]), tcache.keys,
-                                tcache.values)
+        if self.kv_cache is not None:
+            self.kv_cache.store(ids, tcache)
         return last, tcache, dcache
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,

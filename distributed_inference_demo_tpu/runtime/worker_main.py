@@ -44,7 +44,9 @@ def build_worker(args):
                            sampling=sampling, seed=args.seed,
                            mesh=local_tp_mesh(getattr(args, "tp", 1)),
                            kv_cache_dtype=getattr(args, "kv_cache_dtype",
-                                                  "") or None)
+                                                  "") or None,
+                           kv_layout=getattr(args, "kv_layout",
+                                             None) or None)
 
     from ..comm.faults import load_fault_plan, maybe_wrap
     transport = maybe_wrap(
@@ -91,6 +93,13 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-cache-dtype", default="",
                     help="reduced-precision KV cache storage for this "
                          "stage, e.g. float8_e4m3fn")
+    ap.add_argument("--kv-layout", default=None,
+                    choices=["dense", "paged"],
+                    help="this stage's request-cache layout (default "
+                         "DWT_KV_LAYOUT, else paged): paged backs every "
+                         "rid with one per-stage page pool — blocks "
+                         "reserved per chunk actually run, freed on "
+                         "end:{rid}; dense keeps per-rid max_seq rows")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over this host's first N "
                          "local devices (pipeline x tp)")

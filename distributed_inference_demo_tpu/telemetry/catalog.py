@@ -108,19 +108,9 @@ BATCH_STEPS = counter(
 BATCH_COMPLETED = counter(
     "dwt_batching_completed_requests_total",
     "Requests fully served by the slot scheduler")
-# deprecated aliases for the pre-kvcache prefix series: same values as
-# their dwt_kvcache_* successors for one release, then delete (dashboards
-# migrate by recording rule, not by flag day)
-PREFIX_HITS = counter(
-    "dwt_batching_prefix_cache_hits_total",
-    "DEPRECATED alias of dwt_kvcache_hits_total (removal next release)")
-PREFIX_MISSES = counter(
-    "dwt_batching_prefix_cache_misses_total",
-    "DEPRECATED alias of dwt_kvcache_misses_total (removal next release)")
-PREFIX_REUSED = counter(
-    "dwt_batching_prefix_reused_tokens_total",
-    "DEPRECATED alias of dwt_kvcache_partial_hit_tokens_total "
-    "(removal next release)")
+# (the deprecated dwt_batching_prefix_* aliases of the dwt_kvcache_*
+# series — kept "one release" by PR 3 — are REMOVED: three releases
+# shipped; dashboards migrate by recording rule, docs/DESIGN.md §10)
 _BATCH_PCT = {
     (name, q): gauge(
         f"dwt_batching_{name}_p{q}_seconds",
@@ -181,8 +171,7 @@ KVCACHE_H2D_BYTES = counter(
 
 def update_kvcache_series(kv: dict) -> None:
     """Bridge a ``KVCacheManager.snapshot()`` dict onto the
-    ``dwt_kvcache_*`` series (+ the deprecated ``dwt_batching_prefix_*``
-    aliases, kept one release for dashboard migration)."""
+    ``dwt_kvcache_*`` series."""
     KVCACHE_HITS.set_cumulative(kv.get("hits", 0))
     KVCACHE_MISSES.set_cumulative(kv.get("misses", 0))
     KVCACHE_PARTIAL_HIT_TOKENS.set_cumulative(
@@ -203,9 +192,6 @@ def update_kvcache_series(kv: dict) -> None:
     KVCACHE_DEVICE_RESIDENT_BYTES.set(kv.get("device_resident_bytes", 0))
     KVCACHE_BLOCKS_IN_USE.set(kv.get("blocks_used", 0))
     KVCACHE_H2D_BYTES.set_cumulative(kv.get("h2d_bytes", 0))
-    PREFIX_HITS.set_cumulative(kv.get("hits", 0))
-    PREFIX_MISSES.set_cumulative(kv.get("misses", 0))
-    PREFIX_REUSED.set_cumulative(kv.get("partial_hit_tokens", 0))
 
 
 SPEC_ROUNDS = counter(

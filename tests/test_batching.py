@@ -285,7 +285,10 @@ def test_kvcache_below_block_and_pool_bound(params, oracle):
         assert snap["resident_bytes"] <= snap["capacity_bytes"]
 
 
-def test_kvcache_disabled(params, oracle):
+def test_kvcache_zero_blocks_means_default_pool(params, oracle):
+    """There is no cache-off mode on the paged-native scheduler (the
+    pool IS the decode cache): kv_cache_blocks=0 resolves to the
+    dense-equivalent default pool and requests still come out exact."""
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                   sampling=GREEDY, prompt_buckets=(16,),
                                   kv_cache_blocks=0) as eng:
@@ -293,7 +296,8 @@ def test_kvcache_disabled(params, oracle):
         for _ in range(2):
             got = eng.submit(prompt, 6).wait(timeout=300)
             np.testing.assert_array_equal(got, expected(oracle, prompt, 6))
-        assert eng.kv_cache is None              # 0 = pre-kvcache behavior
+        assert (eng.kv_cache.num_blocks
+                == eng.max_batch * eng._table_width)
 
 
 def test_submit_validation(params):
@@ -398,7 +402,7 @@ def test_scheduler_crash_fails_waiters(params):
     try:
         def boom(*a, **k):
             raise RuntimeError("injected device failure")
-        eng._step = boom
+        eng._paged_step = boom
         req = eng.submit([1, 2, 3], 20)
         with pytest.raises(RuntimeError, match="injected device failure"):
             req.wait(timeout=120)
@@ -430,7 +434,7 @@ def test_fp8_kv_cache(params):
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                   sampling=GREEDY, prompt_buckets=(16,),
                                   kv_cache_dtype="float8_e4m3fn") as eng:
-        assert str(eng._ck.dtype) == "float8_e4m3fn"
+        assert str(eng._pk.dtype) == "float8_e4m3fn"
         prompt = [3, 14, 15, 92]
         got = eng.submit(prompt, 10).wait(timeout=300)
         # same insert-rounding + f32-upcast contract as the plain engine

@@ -49,11 +49,12 @@ def test_leg_multimodal_structure_tiny():
 
 
 def test_leg_paged_decode_structure_tiny():
-    """The paged_decode leg's full structure (dense run, paged run,
-    primed phase) at CPU-viable scale — proves the leg before it can
-    burn a TPU session attempt, and pins the leg-level acceptance
-    shape: both HBM numbers present, h2d_bytes == 0 on the primed
-    paged path."""
+    """The paged_decode leg's full structure (dense-escape-hatch
+    reference, paged run, admissible table, primed phase) at CPU-viable
+    scale — proves the leg before it can burn a TPU session attempt,
+    and pins the leg-level acceptance shape: both HBM numbers present,
+    a strictly larger admissible batch at every sequence budget, and
+    h2d_bytes == 0 on the primed paged path."""
     out = bench._leg_paged_decode("llama-test", 6, slots=2,
                                   prompt_len=16, max_seq=64,
                                   block_tokens=8, n_req=4,
@@ -68,11 +69,53 @@ def test_leg_paged_decode_structure_tiny():
         "pool_blocks"]
     assert (out["paged"]["peak_bytes_in_use"]
             < out["dense"]["cache_reserved_bytes"])
+    # the §14 acceptance gate: at the fixed dense byte budget, paged
+    # admits a STRICTLY larger batch at every sequence budget
+    for seq in ("4096", "8192", "32768"):
+        adm = out["admissible"][seq]
+        assert adm["paged_max_batch"] > adm["dense_max_batch"]
+        assert adm["budget_bytes"] == out["dense"]["cache_reserved_bytes"]
     # primed wave: radix hits reference device pages, zero H2D
     primed = out["paged_primed"]
     assert primed["hit_rate"] == 1.0
     assert primed["reused_tokens"] >= 4 * 8
     assert primed["h2d_bytes"] == 0
+
+
+def test_leg_serving_relative_structure_tiny():
+    """The serving_relative leg (VERDICT r5 'Next round' #4): the
+    CPU-relative serving ratios — speculative speedup, prompt-lookup
+    acceptance, batching throughput-per-slot — with the platform stamp
+    that keeps a CPU number from masquerading as a TPU one.  Runs the
+    micro variant's shape (the prepass path)."""
+    out = bench.run_leg("serving_relative",
+                        {"model": "llama-test", "batch": 2,
+                         "prompt_len": 32, "new_tokens": 8,
+                         "flagship": "llama-test"}, micro=True)
+    assert "error" not in out
+    assert out["platform"] == "cpu"
+    assert out["relative_only"] is True
+    assert out["micro"] is True
+    assert out["plain_tokens_per_sec"] > 0
+    assert out["speculative"]["speedup_vs_plain"] > 0
+    assert out["speculative"]["acceptance_rate"] is not None
+    assert out["prompt_lookup"]["acceptance_rate"] is not None
+    assert out["batching"]["throughput_per_slot"] > 0
+
+
+def test_long_context_sp_points_structure_tiny(monkeypatch):
+    """The sequence-parallel long-context micro points (carried sweep
+    satellite): both strategies produce a number (or a per-strategy
+    error) — structure proven on the CPU mesh at a shrunken context so
+    the 32k TPU shape can't burn a session attempt on a structural
+    bug."""
+    monkeypatch.setenv("BENCH_LONG_CTX_SP", "256")
+    points = bench._long_context_sp_points("llama-test", new=4)
+    assert [p["strategy"] for p in points] == ["ring", "ulysses"]
+    for p in points:
+        assert "error" not in p, p
+        assert p["sp"] == 2 and p["context"] == 256
+        assert p["tokens_per_sec"] > 0
 
 
 def test_leg_fault_recovery_structure_tiny():

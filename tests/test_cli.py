@@ -601,10 +601,14 @@ def test_cli_kvcache_flags():
     assert rc == 0
     # single cold run: the cache changes nothing about the output
     assert json.loads(cached)["tokens"] == json.loads(plain)["tokens"]
-    # no plumbing -> loud rejection, never a silent ignore
-    rc, _ = _run_cli(argv + ["--kv-cache-blocks", "16",
-                             "--prompt-lookup"])
-    assert rc == 1
+    # the prompt-lookup engine gained block-cache plumbing with the
+    # universal-paged refactor (docs/DESIGN.md §14): the flags compose
+    rc, pld_out = _run_cli(argv + ["--kv-cache-blocks", "16",
+                                   "--kv-block-tokens", "4",
+                                   "--prompt-lookup"])
+    assert rc == 0 and "tokens" in json.loads(pld_out)
+    # stage workers still reject the flags loudly (activations have no
+    # prompt key to match blocks by — a layout question, not this one)
     rc, _ = _run_cli(["worker", "--model", "llama-test", "--stage-id",
                       "0", "--num-stages", "1", "--layer-start", "0",
                       "--layer-end", "1", "--device-id", "w0", "--port",
@@ -624,13 +628,16 @@ def test_cli_serve_batching_kvcache_env_default(monkeypatch):
                                   sampling=GREEDY,
                                   prompt_buckets=(16,)) as eng:
         assert eng.kv_cache is not None
-        assert eng.kv_cache.pool.num_blocks == 5
+        assert eng.kv_cache.num_blocks == 5
         assert eng.kv_cache.block_tokens == 4
     monkeypatch.setenv("DWT_KVCACHE_BLOCKS", "0")
     with ContinuousBatchingEngine(cfg, params, max_seq=64, max_batch=2,
                                   sampling=GREEDY,
                                   prompt_buckets=(16,)) as eng:
-        assert eng.kv_cache is None          # 0 restores old behavior
+        # 0 = the dense-equivalent default pool (the paged-native
+        # scheduler has no cache-off mode: the pool IS the decode cache)
+        assert (eng.kv_cache.num_blocks
+                == eng.max_batch * eng._table_width)
 
 
 def test_stop_matcher_empty_stop_list_passes_through():

@@ -1,0 +1,239 @@
+"""The universal-paged KV contract (docs/DESIGN.md §14).
+
+Paged is the DEFAULT layout everywhere; dense survives as the explicit
+escape hatch on the single-request engines.  The oracle is bit-identity:
+the layout is a memory architecture, never a semantics change — so for
+every engine in the matrix, paged-vs-dense output (greedy AND sampled,
+cold AND radix-primed) must match token for token, and after every
+request the page-leak invariant holds (``used == tree.block_count``
+with zero live leases: pages are tree-owned or free, nothing dangles).
+
+The paged-primed coverage for the batching scheduler, chunked prefill,
+``stream_block`` fusion, and the speculative slot modes lives in
+tests/test_paged_batching.py, tests/test_kvcache.py (which exercise the
+default = paged backend), and tests/test_device_loop.py; this file pins
+what those do not: the dense escape hatch's parity, sampled-path
+parity, the tp-mesh and ring-stage paged paths, and the speculative
+page-sharing ownership story.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+
+from distributed_inference_demo_tpu.models import get_model_config
+from distributed_inference_demo_tpu.models.base import StageSpec
+from distributed_inference_demo_tpu.models.decoder import init_full_params
+from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+from distributed_inference_demo_tpu.runtime import (InferenceEngine,
+                                                    SpeculativeEngine)
+from distributed_inference_demo_tpu.runtime.prompt_lookup import (
+    PromptLookupEngine)
+
+CFG = get_model_config("llama-test")
+GREEDY = SamplingParams(greedy=True)
+SAMPLED = SamplingParams(temperature=0.7, top_k=7)
+POOL = dict(kv_cache_blocks=32, kv_block_tokens=4)
+SHARED = list(range(2, 22))                  # 20 tokens = 5 blocks
+PROMPT = np.asarray([SHARED + [51, 52, 53]])
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_full_params(jax.random.PRNGKey(0), CFG)
+
+
+def assert_drained(backend):
+    """Paged leak invariant: every page is tree-owned or free, and no
+    lease pin outlives its request."""
+    mgr = backend.mgr
+    assert mgr.used_blocks == mgr.tree.block_count
+    assert backend.debug_state()["leased_nodes"] == 0
+
+
+def both_layouts(make):
+    """(dense_result, paged_result) for cold + primed runs of one
+    engine recipe; asserts the paged backend drains and moved zero
+    bytes through the host."""
+    outs = []
+    for layout in ("dense", "paged"):
+        eng = make(layout)
+        prime = np.asarray([SHARED + [90]])
+        run = (lambda p: eng.generate(p, 8)) if not isinstance(
+            eng, tuple) else None
+        cold = eng.generate(PROMPT, 8)
+        eng.generate(prime, 4)               # prime the radix tree
+        primed = eng.generate(PROMPT, 8)
+        snap = eng.kv_cache.snapshot()
+        assert snap["hits"] >= 1, layout
+        if layout == "paged":
+            assert snap["h2d_bytes"] == 0
+            assert_drained(eng.kv_cache)
+        else:
+            assert snap["h2d_bytes"] > 0     # the dense cost paged deletes
+        outs.append((cold, primed))
+    return outs
+
+
+@pytest.mark.quick
+def test_plain_engine_paged_vs_dense_greedy_and_sampled(params):
+    """InferenceEngine: the dense escape hatch and the paged default
+    agree bit-for-bit — greedy and sampled, cold and radix-primed."""
+    greedy_tokens = None
+    for sampling in (GREEDY, SAMPLED):
+        (d_cold, d_primed), (p_cold, p_primed) = both_layouts(
+            lambda layout: InferenceEngine(
+                CFG, params, max_seq=96, sampling=sampling,
+                kv_layout=layout, **POOL))
+        np.testing.assert_array_equal(d_cold.tokens, p_cold.tokens)
+        np.testing.assert_array_equal(d_primed.tokens, p_primed.tokens)
+        np.testing.assert_array_equal(d_cold.tokens, d_primed.tokens)
+        if sampling is GREEDY:
+            greedy_tokens = d_cold.tokens
+    # fused streaming (stream_block > 1) over a PRIMED paged pool: the
+    # device loop's K-token blocks ride the seeded-suffix path too
+    fused = InferenceEngine(CFG, params, max_seq=96, sampling=GREEDY,
+                            stream_block=4, **POOL)
+    fused.generate(np.asarray([SHARED + [90]]), 4)       # prime
+    streamed = np.concatenate(list(fused.generate_stream(PROMPT, 8)))
+    np.testing.assert_array_equal(streamed, greedy_tokens[0])
+    assert fused.kv_cache.stats["hits"] >= 1
+    assert_drained(fused.kv_cache)
+
+
+def _pld_layout_parity(params, sampling):
+    results = {}
+    for layout in ("dense", "paged"):
+        eng = PromptLookupEngine(CFG, params, max_seq=96,
+                                 sampling=sampling, num_draft=3,
+                                 kv_layout=layout, **POOL)
+        cold, _ = eng.generate(PROMPT, 8)
+        eng.generate(np.asarray([SHARED + [90]]), 4)
+        primed, _ = eng.generate(PROMPT, 8)
+        np.testing.assert_array_equal(cold.tokens, primed.tokens)
+        assert eng.kv_cache.stats["hits"] >= 1
+        if layout == "paged":
+            assert eng.kv_cache.snapshot()["h2d_bytes"] == 0
+            assert_drained(eng.kv_cache)
+        results[layout] = cold.tokens
+    np.testing.assert_array_equal(results["dense"], results["paged"])
+
+
+def test_prompt_lookup_engine_paged_vs_dense(params):
+    """PromptLookupEngine (NEW kv-cache consumer): both layouts, cold
+    and primed, greedy parity; paged drains.  (The sampled twin rides
+    the slow lane — same code path, different sampler.)"""
+    _pld_layout_parity(params, GREEDY)
+
+
+@pytest.mark.slow
+def test_prompt_lookup_engine_paged_vs_dense_sampled(params):
+    _pld_layout_parity(params, SAMPLED)
+
+
+def test_speculative_page_sharing_ownership(params):
+    """Speculative target prefills SHARE prefix pages: the second
+    request sharing a prompt prefix adds no new pages for it (the radix
+    tree declines duplicates and the request references the same pages
+    in HBM), h2d stays 0, and completion drains to tree-only
+    ownership."""
+    cfg8 = get_model_config("llama-test-int8")
+    params8 = init_full_params(jax.random.PRNGKey(0), cfg8,
+                               quantize=True)
+    spec = SpeculativeEngine(CFG, params, cfg8, params8, max_seq=96,
+                             sampling=GREEDY, num_draft=3, **POOL)
+    assert spec.kv_layout == "paged"
+    r1, _ = spec.generate(PROMPT, 8)
+    snap1 = spec.kv_cache.snapshot()
+    r2, _ = spec.generate(PROMPT, 8)
+    snap2 = spec.kv_cache.snapshot()
+    np.testing.assert_array_equal(r1.tokens, r2.tokens)
+    # no duplicate pages for the accepted prefix: the re-run stored
+    # nothing new and the pool grew by zero blocks
+    assert snap2["stored_blocks"] == snap1["stored_blocks"]
+    assert snap2["blocks_used"] == snap1["blocks_used"]
+    assert snap2["hits"] >= 1 and snap2["h2d_bytes"] == 0
+    assert_drained(spec.kv_cache)
+    # dense escape hatch agrees token for token
+    dense = SpeculativeEngine(CFG, params, cfg8, params8, max_seq=96,
+                              sampling=GREEDY, num_draft=3,
+                              kv_layout="dense", **POOL)
+    rd, _ = dense.generate(PROMPT, 8)
+    np.testing.assert_array_equal(rd.tokens, r1.tokens)
+
+
+def test_tp_mesh_engine_paged_vs_dense(params, devices):
+    """tp-mesh path: the paged backend's pool composes with the
+    kv-head-sharded working cache — greedy parity across layouts on a
+    2-chip mesh, primed path included."""
+    from distributed_inference_demo_tpu.parallel import (MeshConfig,
+                                                         make_mesh)
+    from distributed_inference_demo_tpu.runtime.engine import (
+        shard_engine_params)
+    mesh = make_mesh(MeshConfig(tp=2), devices[:2])
+    sharded = shard_engine_params(params, CFG, mesh)
+    toks = {}
+    for layout in ("dense", "paged"):
+        eng = InferenceEngine(CFG, sharded, max_seq=96, sampling=GREEDY,
+                              mesh=mesh, kv_layout=layout, **POOL)
+        cold = eng.generate(PROMPT, 8)
+        primed = eng.generate(PROMPT, 8)     # full-prompt radix hit
+        np.testing.assert_array_equal(cold.tokens, primed.tokens)
+        assert eng.kv_cache.stats["hits"] >= 1
+        if layout == "paged":
+            assert_drained(eng.kv_cache)
+        toks[layout] = cold.tokens
+    np.testing.assert_array_equal(toks["dense"], toks["paged"])
+
+
+@pytest.mark.quick
+def test_ring_stage_runtime_paged_vs_dense(params):
+    """The ring-stage path: a loopback single-stage StageRuntime decodes
+    the same greedy tokens on the paged per-stage pool as on dense
+    per-rid rows (prefill chunk + fused-tail steps), and ``free(rid)``
+    returns every page to the pool."""
+    from distributed_inference_demo_tpu.runtime.distributed import (
+        StageRuntime)
+    spec = StageSpec(0, 1, 0, CFG.num_layers)
+    prompt = PROMPT.astype(np.int32)
+    toks = {}
+    for layout in ("dense", "paged"):
+        rt = StageRuntime(CFG, spec, params, max_seq=64,
+                          sampling=GREEDY, kv_layout=layout)
+        out = []
+        tok = rt.run_chunk_sample(7, 0, prompt)
+        out.append(tok.copy())
+        for step in range(1, 6):
+            tok = rt.run_chunk_sample(7, step, tok[:, None])
+            out.append(tok.copy())
+        toks[layout] = np.stack(out, axis=1)
+        if layout == "paged":
+            held = sum(1 for v in rt._tables[7].flat
+                       if v != rt._sentinel)
+            assert held == -(-int(rt._rid_len[7]) // rt._bt)
+            free_before = len(rt._pool_free)
+            rt.free(7)
+            assert len(rt._pool_free) == free_before + held
+            assert not rt._tables
+    np.testing.assert_array_equal(toks["dense"], toks["paged"])
+
+
+def test_sp_backend_accepts_both_layouts(params):
+    """The sp backend accepts the universal layout flag and surfaces it
+    on /stats (its cache is per-request sequence-sharded scratch either
+    way — documented in runtime/sp_backend.py)."""
+    from distributed_inference_demo_tpu.parallel.mesh import local_sp_mesh
+    from distributed_inference_demo_tpu.runtime.sp_backend import (
+        SequenceParallelBackend)
+    mesh = local_sp_mesh(2)
+    be = SequenceParallelBackend(CFG, params, mesh, max_seq=64)
+    assert be.stats()["kv_layout"] == "paged"
+    be2 = SequenceParallelBackend(CFG, params, mesh, max_seq=64,
+                                  kv_layout="dense")
+    assert be2.stats()["kv_layout"] == "dense"

@@ -78,3 +78,52 @@ def test_lint_requires_help_text():
 def test_main_exits_clean():
     lint = _load_lint()
     assert lint.main() == 0
+
+
+def test_deprecated_prefix_aliases_removed():
+    """The dwt_batching_prefix_* aliases (PR 3, 'one release') are gone
+    — and the lint guards the tombstone so they can't quietly return."""
+    lint = _load_lint()
+    names = {m.name for m in REGISTRY.collect()}
+    assert not (lint.FORBIDDEN_SERIES & names)
+    reg = Registry()
+    reg.register(Counter("dwt_batching_prefix_cache_hits_total",
+                         "resurrected alias"))
+    assert any("registered again" in p for p in lint.check_required(reg))
+
+
+def _load_kv_lint():
+    path = (pathlib.Path(__file__).resolve().parents[1] / "tools"
+            / "check_kv_layout.py")
+    spec = importlib.util.spec_from_file_location("check_kv_layout", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.quick
+def test_kv_layout_rejection_matrix_stays_empty():
+    """No production module outside runtime/kvcache/ references
+    require_dense_kv_layout (docs/DESIGN.md §14): the §11 rejection
+    matrix is dissolved and this lint keeps it from silently
+    regrowing."""
+    kv_lint = _load_kv_lint()
+    root = pathlib.Path(__file__).resolve().parents[1]
+    assert kv_lint.check_kv_layout_matrix(root) == []
+    assert kv_lint.main() == 0
+
+
+def test_kv_layout_lint_fires_on_a_regrown_call_site(tmp_path):
+    """The lint actually detects a regrown rejection."""
+    kv_lint = _load_kv_lint()
+    pkg = tmp_path / "distributed_inference_demo_tpu" / "runtime"
+    pkg.mkdir(parents=True)
+    (pkg / "new_engine.py").write_text(
+        "from .kvcache import require_dense_kv_layout\n")
+    allowed = (tmp_path / "distributed_inference_demo_tpu" / "runtime"
+               / "kvcache")
+    allowed.mkdir()
+    (allowed / "__init__.py").write_text(
+        "def require_dense_kv_layout(mode, kv_layout=None): ...\n")
+    problems = kv_lint.check_kv_layout_matrix(tmp_path)
+    assert len(problems) == 1 and "new_engine.py" in problems[0]

@@ -6,7 +6,9 @@ cold AND radix-primed — because the paged layout is a memory
 architecture, never a semantics change.  On top of parity: the
 block-leak invariant (after every request finishes, cancels, or fails,
 the only allocated pages are the radix tree's), zero H2D on primed
-admissions, and the explicit rejections for modes that stay dense.
+admissions, and — since the scheduler went paged-NATIVE (docs/DESIGN.md
+§14) — the speculative slot proposers riding the pool and the loud
+rejection of the deleted dense batch cache.
 
 Runs on CPU through the XLA-gather fallback — the same code path the
 TPU kernel's auto-dispatch falls back to, so tier-1 exercises the
@@ -155,38 +157,46 @@ def test_submit_rejects_request_larger_than_pool(params):
             eng.submit(list(range(1, 30)), 30)
 
 
-def test_paged_rejects_speculative_modes_and_mesh(params):
-    with pytest.raises(ValueError, match="speculative slot modes"):
-        ContinuousBatchingEngine(CFG, params, max_seq=64,
-                                 sampling=GREEDY, kv_layout="paged",
-                                 prompt_lookup=True)
+@pytest.mark.quick
+def test_paged_speculative_slot_modes_and_leak(params, oracle):
+    """The §11 rejection matrix is DISSOLVED (docs/DESIGN.md §14): the
+    speculative slot proposers run on the page pool — prompt-lookup
+    verifies through the frozen tables, the draft model additionally
+    reserves (and drains) its own scratch page pool — with greedy
+    parity against the plain engine and zero leaked pages."""
+    with paged_engine(params, max_batch=2, prompt_lookup=True,
+                      num_draft=3) as eng:
+        p = [5, 4, 3, 2, 5, 4, 3]
+        np.testing.assert_array_equal(eng.submit(p, 9).wait(timeout=300),
+                                      expected(oracle, p, 9))
+        assert_no_leak(eng)
     cfg8 = get_model_config("llama-test-int8")
     params8 = init_full_params(jax.random.PRNGKey(0), cfg8,
                                quantize=True)
-    with pytest.raises(ValueError, match="speculative slot modes"):
+    with paged_engine(params, max_batch=2, draft_cfg=cfg8,
+                      draft_params=params8, num_draft=3) as eng:
+        p = [5, 4, 3, 2]
+        np.testing.assert_array_equal(eng.submit(p, 9).wait(timeout=300),
+                                      expected(oracle, p, 9))
+        assert_no_leak(eng)
+        # the draft half of the leak invariant: scratch pages drained
+        assert eng._dmgr.used_blocks == 0
+
+
+def test_batching_rejects_dense_env_and_flag(params, monkeypatch):
+    """The scheduler is paged-native: kv_layout='dense' (flag or env)
+    must fail loudly — the dense batch cache is deleted and a knob
+    promising it must never silently run paged."""
+    with pytest.raises(ValueError, match="paged-native"):
         ContinuousBatchingEngine(CFG, params, max_seq=64,
-                                 sampling=GREEDY, kv_layout="paged",
-                                 draft_cfg=cfg8, draft_params=params8)
-
-
-def test_dense_engines_reject_paged_env(params, monkeypatch):
-    """DWT_KV_LAYOUT=paged must fail loudly on every dense-only engine,
-    never be silently ignored."""
-    monkeypatch.setenv("DWT_KV_LAYOUT", "paged")
-    with pytest.raises(ValueError, match="paged"):
-        InferenceEngine(CFG, params, max_seq=64, sampling=GREEDY)
-    from distributed_inference_demo_tpu.runtime.prompt_lookup import (
-        PromptLookupEngine)
-    with pytest.raises(ValueError, match="paged"):
-        PromptLookupEngine(CFG, params, max_seq=64, sampling=GREEDY)
-    # the batching engine HONORS it (that is the supported surface)
-    with ContinuousBatchingEngine(CFG, params, max_seq=64,
-                                  sampling=GREEDY,
-                                  prompt_buckets=(16,),
-                                  kv_block_tokens=8) as eng:
-        assert eng.kv_layout == "paged"
-        r = eng.submit([4, 2], 4)
-        assert len(r.wait(timeout=300)) == 4
+                                 sampling=GREEDY, kv_layout="dense")
+    monkeypatch.setenv("DWT_KV_LAYOUT", "dense")
+    with pytest.raises(ValueError, match="paged-native"):
+        ContinuousBatchingEngine(CFG, params, max_seq=64,
+                                 sampling=GREEDY)
+    # the single-request engines HONOR the dense escape hatch
+    eng = InferenceEngine(CFG, params, max_seq=64, sampling=GREEDY)
+    assert eng.kv_layout == "dense"
 
 
 def test_decode_block_fused_parity(params, oracle):

@@ -110,12 +110,17 @@ def test_epoch_bumps_on_store_and_evict():
 
 
 def test_layout_resolution_and_rejection(monkeypatch):
-    assert resolve_kv_layout(None) == "dense"
-    assert resolve_kv_layout("paged") == "paged"
+    # paged is the universal DEFAULT (docs/DESIGN.md §14); dense is the
+    # explicit escape hatch
+    assert resolve_kv_layout(None) == "paged"
+    assert resolve_kv_layout("dense") == "dense"
     with pytest.raises(ValueError):
         resolve_kv_layout("sparse")
+    monkeypatch.setenv("DWT_KV_LAYOUT", "dense")
+    assert resolve_kv_layout(None) == "dense"
+    # the legacy shim (zero production call sites — linted by
+    # tools/check_kv_layout.py) still fails the loud way on paged
     monkeypatch.setenv("DWT_KV_LAYOUT", "paged")
-    assert resolve_kv_layout(None) == "paged"
     with pytest.raises(ValueError, match="not supported by test-mode"):
         require_dense_kv_layout("test-mode")
     monkeypatch.setenv("DWT_KV_LAYOUT", "dense")

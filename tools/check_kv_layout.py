@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Rejection-matrix lint: the paged KV layout is UNIVERSAL.
+
+PR 4 shipped the paged page pool behind an explicit rejection matrix —
+ten ``require_dense_kv_layout`` call sites across the engines and CLI
+(DESIGN.md §11).  PR 7 dissolved it: every engine and CLI mode accepts
+``--kv-layout paged`` (the default), and ``require_dense_kv_layout``
+survives only inside ``runtime/kvcache/`` as a legacy shim for
+out-of-tree callers.
+
+This lint keeps the matrix from silently regrowing: no production
+module outside ``runtime/kvcache/`` may reference
+``require_dense_kv_layout`` (a new dense-only mode must either grow
+paged plumbing or raise its own documented error with its own test).
+Walks every ``.py`` under the package, source-level — a call site that
+never executes on the lint's import path still counts.
+
+Run standalone (``python tools/check_kv_layout.py``, exit 1 on
+violations) or via the tier-1 suite (``tests/test_metrics_names.py``).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import List
+
+PACKAGE = "distributed_inference_demo_tpu"
+ALLOWED_SUBTREE = ("runtime", "kvcache")   # the shim's home
+
+
+def check_kv_layout_matrix(root: pathlib.Path) -> List[str]:
+    """Return human-readable violations (empty = matrix still empty)."""
+    problems: List[str] = []
+    pkg = root / PACKAGE
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(root)
+        if rel.parts[1:3] == ALLOWED_SUBTREE:
+            continue
+        text = path.read_text(encoding="utf-8")
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if "require_dense_kv_layout" in line:
+                problems.append(
+                    f"{rel}:{lineno}: references "
+                    "require_dense_kv_layout — the §11 rejection matrix "
+                    "is dissolved (DESIGN.md §14); paged must be "
+                    "accepted, not rejected")
+    return problems
+
+
+def main() -> int:
+    root = pathlib.Path(__file__).resolve().parents[1]
+    problems = check_kv_layout_matrix(root)
+    for p in problems:
+        print(f"KV LAYOUT LINT: {p}", file=sys.stderr)
+    if problems:
+        print(f"{len(problems)} rejection-matrix violation(s)",
+              file=sys.stderr)
+        return 1
+    print("kv layout matrix OK (no require_dense_kv_layout call sites "
+          f"outside {PACKAGE}/runtime/kvcache/)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

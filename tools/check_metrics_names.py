@@ -124,13 +124,27 @@ def check_registry(registry) -> List[str]:
     return problems
 
 
+# series that must NOT exist: the dwt_batching_prefix_* aliases were
+# deprecated in PR 3 ("one release") and removed three releases later —
+# re-registering one would resurrect a name dashboards already migrated
+# off, so absence is linted like presence (docs/DESIGN.md §10 runbook)
+FORBIDDEN_SERIES = {
+    "dwt_batching_prefix_cache_hits_total",
+    "dwt_batching_prefix_cache_misses_total",
+    "dwt_batching_prefix_reused_tokens_total",
+}
+
+
 def check_required(registry) -> List[str]:
     """Presence lint for the standard catalog (run against the DEFAULT
     registry only — synthetic test registries legitimately hold other
     series sets)."""
     present = {m.name for m in registry.collect()}
-    return [f"required series {name} is not registered"
-            for name in sorted(REQUIRED_SERIES - present)]
+    return ([f"required series {name} is not registered"
+             for name in sorted(REQUIRED_SERIES - present)]
+            + [f"removed series {name} is registered again (the "
+               "deprecated alias was deleted; see FORBIDDEN_SERIES)"
+               for name in sorted(FORBIDDEN_SERIES & present)])
 
 
 def main() -> int:

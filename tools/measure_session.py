@@ -57,6 +57,7 @@ LEG_BUDGETS = {
     "batching": 2400,
     "prefix_reuse": 1800,
     "paged_decode": 1800,
+    "serving_relative": 1800,
     "sweep": 1800,
     "flagship_bf16": 2400,
     "pipeline": 1500,
