@@ -1839,6 +1839,168 @@ def _leg_fault_recovery(model: str, new_tokens: int = 24,
     }
 
 
+def _leg_disagg(model: str, slots: int = 8, bg: int = 7,
+                n_req: int = 5, prompt_len: int = 256,
+                prefill_chunk: int = 16, new_tokens: int = 4,
+                bg_new: int = 4096, max_seq: int = 4096,
+                block_tokens: int = 16,
+                n_prefill_workers: int = 2) -> dict:
+    """Disaggregated prefill/decode vs the colocated engine, measured
+    where the split matters: **TTFT under concurrent decode load**
+    (docs/DESIGN.md §15).
+
+    Both phases run the same decode substrate — ``slots`` continuous-
+    batching slots with ``bg`` of them pinned by long-running decode
+    requests — and then admit ``n_req`` long-prompt requests:
+
+    - *colocated*: the requests chunk-prefill on the SAME engine; every
+      chunk interleaves one decode step of the busy batch (the §5
+      chunked-admission contract), so TTFT pays the batch's decode for
+      every chunk, serially per request.
+    - *disaggregated*: the requests hand off to dedicated prefill
+      workers (loopback transport), which chunk-prefill concurrently
+      and stream KV pages to the decode worker as each chunk lands;
+      the decode engine only runs the adopt + one suffix prefill.
+
+    Loopback on purpose (same rationale as fault_recovery): the number
+    under test is the scheduling structure, not socket noise.  The leg
+    also reports the §15 acceptance gates: decode-side
+    ``dwt_kvcache_h2d_bytes_total`` staying 0 for migrated pages, the
+    page-leak invariant on both pools, and migrated/adopted page
+    parity."""
+    import threading
+
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.comm.transport import (
+        LoopbackNetwork, LoopbackTransport)
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+    from distributed_inference_demo_tpu.runtime.disagg import (
+        DecodeWorker, DisaggCoordinator, PrefillWorker)
+    from distributed_inference_demo_tpu.runtime.stats import _percentile
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    greedy = SamplingParams(greedy=True)
+    rng = np.random.default_rng(0)
+    bg_prompt = (np.arange(24) % 89 + 2).astype(np.int32)
+    bg_new = min(bg_new, max_seq - len(bg_prompt))
+    # distinct long prompts: no radix hit may shortcut the prefill;
+    # one extra prompt warms the compile caches WITHOUT seeding the
+    # radix tree with a measured prompt's blocks
+    prompts = [rng.integers(2, cfg.vocab_size - 1, prompt_len)
+               .astype(np.int32) for _ in range(n_req + 1)]
+    warm_prompt, prompts = prompts[0], prompts[1:]
+
+    def pcts(ttfts):
+        xs = sorted(ttfts)
+        return {"requests": len(xs),
+                "ttft_p50_ms": round(_percentile(xs, 50) * 1e3, 2),
+                "ttft_p95_ms": round(_percentile(xs, 95) * 1e3, 2)}
+
+    def engine_kwargs(chunk):
+        return dict(max_seq=max_seq, max_batch=slots, sampling=greedy,
+                    kv_cache_blocks=0, kv_block_tokens=block_tokens,
+                    prefill_chunk=chunk)
+
+    # -- colocated: prefill chunks interleave with the busy batch ----------
+    eng = ContinuousBatchingEngine(cfg, params, **engine_kwargs(
+        prefill_chunk))
+    bg_reqs = [eng.submit(bg_prompt, bg_new) for _ in range(bg)]
+    # warm the admission/prefill programs before timing (compile noise
+    # would otherwise dominate the first request's TTFT)
+    eng.submit(warm_prompt, 2).wait(timeout=600)
+    reqs = [eng.submit(p, new_tokens) for p in prompts]
+    for r in reqs:
+        r.wait(timeout=600)
+    colocated = pcts([r.t_first - r.t_submit for r in reqs])
+    for r in bg_reqs:
+        r.cancel()
+    steps_colocated = eng.stats()["steps"]
+    eng.close()
+
+    # -- disaggregated: same decode load, prefill on its own workers -------
+    net = LoopbackNetwork()
+    tc = LoopbackTransport("coord", net)
+    pids = [f"p{i}" for i in range(n_prefill_workers)]
+    tps = [LoopbackTransport(pid, net) for pid in pids]
+    td = LoopbackTransport("d0", net)
+    # the decode engine needs no prefill_chunk: its longest admission
+    # is a migrated request's <= one-block suffix
+    deng = ContinuousBatchingEngine(cfg, params, **engine_kwargs(None))
+    pws = [PrefillWorker(cfg, params, t, max_seq=max_seq,
+                         prefill_chunk=prefill_chunk,
+                         kv_block_tokens=block_tokens)
+           for t in tps]
+    dw = DecodeWorker(deng, td)
+    threads = [threading.Thread(target=w.serve_forever, daemon=True)
+               for w in pws + [dw]]
+    for t in threads:
+        t.start()
+    coord = DisaggCoordinator(tc, pids, "d0")
+    bg_reqs = [deng.submit(bg_prompt, bg_new) for _ in range(bg)]
+    # warm EVERY prefill worker (each has its own jit caches) with the
+    # off-tree warm prompt before timing — round robin lands one each
+    for wr in [coord.submit(warm_prompt, 2)
+               for _ in range(n_prefill_workers)]:
+        wr.wait(timeout=600)
+    dreqs = [coord.submit(p, new_tokens) for p in prompts]
+    for r in dreqs:
+        r.wait(timeout=600)
+    disagg = pcts([r.ttft_s for r in dreqs])
+    for r in bg_reqs:
+        r.cancel()
+    for r in bg_reqs:
+        try:
+            r.wait(timeout=600)
+        except Exception:
+            pass
+    time.sleep(0.2)            # let completions release their pages
+    dsnap = deng.kv_cache.snapshot()
+    psnaps = [pw.kv_cache.snapshot() for pw in pws]
+    migrated = sum(pw.stats["migrated_pages"] for pw in pws)
+    migration_ms = [pw.stats["last_migration_ms"] for pw in pws]
+    disagg.update({
+        "migrated_pages": migrated,
+        "migrated_bytes": sum(pw.stats["migrated_bytes"]
+                              for pw in pws),
+        "adopted_pages": dw.stats["adopted_pages"],
+        "retransmitted_frames": sum(pw.stats["retransmitted_frames"]
+                                    for pw in pws),
+        "last_migration_ms": max((m for m in migration_ms
+                                  if m is not None), default=None),
+        # the §15 zero-host-bounce gate: migrated pages join as
+        # block-table references, never a dense-row H2D seed
+        "decode_h2d_bytes": dsnap["h2d_bytes"],
+        # leak invariants, both pools: idle used == tree-owned
+        "decode_pool_leaked_blocks": (dsnap["blocks_used"]
+                                      - dsnap["tree_blocks"]),
+        "prefill_pool_leaked_blocks": sum(
+            s["blocks_used"] - s["tree_blocks"] for s in psnaps),
+    })
+    for w in pws + [dw]:
+        w.stop()
+    coord.close()
+    deng.close()
+
+    return {
+        "model": model, "slots": slots, "background_decodes": bg,
+        "prompt_len": prompt_len, "prefill_chunk": prefill_chunk,
+        "prefill_workers": n_prefill_workers,
+        "colocated": dict(colocated, steps=steps_colocated),
+        "disagg": disagg,
+        "disagg_wins_ttft_p95": (disagg["ttft_p95_ms"]
+                                 < colocated["ttft_p95_ms"]),
+        "ttft_p95_speedup": round(
+            colocated["ttft_p95_ms"] / disagg["ttft_p95_ms"], 3)
+        if disagg["ttft_p95_ms"] else None,
+    }
+
+
 # ---------------------------------------------------------------------------
 
 def micro_shape(p: dict) -> dict:
@@ -1908,12 +2070,30 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
         elif name == "fault_recovery":
             out = (_leg_fault_recovery(model, new_tokens=8) if micro
                    else _leg_fault_recovery(model))
+        elif name == "disagg":
+            # the micro shape keeps decode SATURATED (7 of 8 slots
+            # pinned): the interleaved-step stall the split removes is
+            # only visible under real concurrent decode load
+            out = (_leg_disagg(model, n_req=3, prompt_len=128,
+                               prefill_chunk=8, max_seq=1024,
+                               block_tokens=8) if micro
+                   else _leg_disagg(model))
         elif name == "planner_pipeline":
             out = _leg_planner_pipeline(model, batch, prompt_len,
                                         min(new_tokens, 8))
         elif name == "prefill_long":
             out = (_leg_prefill_long(model, seqs=(512,)) if micro
                    else _leg_prefill_long(model))
+        elif name == "long_context_sp":
+            # the carried >=32k sequence-parallel satellite PROMOTED to
+            # a full-budget headline-order leg: ring AND ulysses points
+            # at >= 32k context (BENCH_LONG_CTX_SP overrides for CPU
+            # structure tests), not just the micro prepass
+            out = {"points": _long_context_sp_points(
+                model, new=8 if micro else 64)}
+            errs = [p for p in out["points"] if "error" in p]
+            if errs and len(errs) == len(out["points"]):
+                out["error"] = errs[0]["error"]
         elif name == "long_context":
             if micro:
                 # one chunk-multiple context that still exercises the
@@ -2156,6 +2336,7 @@ def main() -> None:
     legs = ["roofline_probe", "headline", "roofline_probe_rerun",
             "headline_int8", "decode_fused", "speculative",
             "prompt_lookup", "planner_pipeline", "long_context",
+            "long_context_sp", "disagg",
             "flagship_int8", "batching", "prefix_reuse", "paged_decode",
             "serving_relative", "sweep", "flagship_bf16", "pipeline",
             "fault_recovery", "prefill_long", "moe", "multimodal",
@@ -2168,8 +2349,8 @@ def main() -> None:
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
                                     "batching", "prefix_reuse",
                                     "paged_decode",
-                                    "serving_relative"]),
-            ("BENCH_SKIP_LONGCTX", ["long_context"]),
+                                    "serving_relative", "disagg"]),
+            ("BENCH_SKIP_LONGCTX", ["long_context", "long_context_sp"]),
             ("BENCH_SKIP_PREFILL", ["prefill_long"]),
             ("BENCH_SKIP_MOE_MM", ["moe", "multimodal"]),
             ("BENCH_SKIP_INT4", ["int4"])):

@@ -1124,8 +1124,10 @@ def _add_engine_args(ap):
                          "by reference with zero H2D; every serve/"
                          "generate mode accepts it.  dense: the "
                          "host-pool escape hatch on the single-request "
-                         "engines and pipeline stages (one release); "
-                         "--batch-slots is paged-native and rejects it")
+                         "engines and pipeline stages — DEPRECATED, "
+                         "logs a loud warning and is scheduled for "
+                         "removal in the next release; --batch-slots "
+                         "is paged-native and rejects it")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over the first N local "
                          "devices (Megatron-sliced weights, kv-head-"

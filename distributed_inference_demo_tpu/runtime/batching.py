@@ -736,6 +736,10 @@ class ContinuousBatchingEngine:
             self._spec_step = spec_step
             self._dprefill, self._zero_row_d = dprefill, zero_row_d
         self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
+        # disaggregated-join counters (docs/DESIGN.md §15): requests
+        # admitted with premigrated KV + pages adopted on their behalf
+        self.disagg_stats = {"premigrated_requests": 0,
+                             "adopted_pages": 0}
 
         self._lengths = jnp.zeros((B,), jnp.int32)
         self._last_tok = jnp.zeros((B,), jnp.int32)
@@ -825,7 +829,8 @@ class ContinuousBatchingEngine:
     # ------------------------------------------------------------------
     # public API
 
-    def submit(self, prompt_ids, max_new_tokens: int) -> Request:
+    def submit(self, prompt_ids, max_new_tokens: int,
+               _staged: Optional[dict] = None) -> Request:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         check_capacity(self.max_seq, len(prompt), max_new_tokens)
         if len(prompt) == 0:
@@ -864,11 +869,99 @@ class ContinuousBatchingEngine:
                     retry_after_s=1.0)
         req = Request(prompt=prompt, max_new=max_new_tokens,
                       t_submit=time.perf_counter())
+        # staged premigrated blocks (submit_premigrated) attach BEFORE
+        # the queue put: the scheduler thread may pop the request the
+        # instant it lands, and a late-attached staging would silently
+        # cold-prefill the full prompt instead of importing
+        if _staged is not None:
+            req._staged = _staged
         with self._submit_lock:
             if not self._running:
                 raise RuntimeError("engine is closed")
             self._queue.put(req)
         return req
+
+    def submit_premigrated(self, prompt_ids, max_new_tokens: int,
+                           k_blocks, v_blocks) -> Request:
+        """Decode-side JOIN of a disaggregated request (docs/DESIGN.md
+        §15): the prompt's whole-block K/V was computed by a prefill
+        worker and migrated here as host block payloads
+        ``[n, L, H, bt, D]``.  Admission first lands the blocks in the
+        page pool (one device scatter, ``adopt_blocks_into_pages``) and
+        ADOPTS them into the radix tree (``store_shared`` — the §11
+        ownership-transfer seam, so the invariant `every page owned by
+        tree xor one request` is preserved verbatim); the request then
+        admits through the ordinary paged path, whose ``match`` finds
+        the adopted prefix as block-table references — zero dense-row
+        H2D — and only the ≤ one-block suffix prefills here.  The
+        import runs ON the scheduler thread between steps (the pool
+        buffers are donated every dispatch; a foreign-thread write
+        would race them).
+
+        ``k_blocks=None`` (a short prompt with no migratable whole
+        block) degrades to a plain :meth:`submit`."""
+        if k_blocks is None:
+            return self.submit(prompt_ids, max_new_tokens)
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        k_blocks = np.asarray(k_blocks)
+        v_blocks = np.asarray(v_blocks)
+        bt = self.kv_cache.block_tokens
+        want = (self.cfg.num_layers, self.cfg.num_kv_heads, bt,
+                self.cfg.head_dim)
+        if (k_blocks.shape != v_blocks.shape or k_blocks.ndim != 5
+                or k_blocks.shape[1:] != want):
+            raise ValueError(
+                f"premigrated blocks must be [n, L, H, bt, D] = "
+                f"[n, {want[0]}, {want[1]}, {want[2]}, {want[3]}]; got "
+                f"K {k_blocks.shape} / V {v_blocks.shape}")
+        if k_blocks.shape[0] > len(prompt) // bt:
+            raise ValueError(
+                f"{k_blocks.shape[0]} migrated blocks exceed the "
+                f"prompt's {len(prompt) // bt} whole blocks")
+        return self.submit(prompt, max_new_tokens,
+                           _staged={"k": k_blocks, "v": v_blocks,
+                                    "imported": False})
+
+    def _import_staged(self, req: Request) -> None:
+        """Land a premigrated request's staged blocks in the pool and
+        adopt them into the tree — scheduler thread only, once, before
+        the ordinary ``match``/alloc admission runs.  On pool pressure
+        (alloc infeasible even with eviction) the request goes back to
+        pending via :class:`_BlocksExhausted`, staged data intact."""
+        st = getattr(req, "_staged", None)
+        if st is None or st["imported"]:
+            return
+        mgr = self.kv_cache
+        n = st["k"].shape[0]
+        ids = mgr.alloc(n)
+        if ids is None:
+            req._pkv_blocked = (mgr.epoch, mgr.free_blocks)
+            raise _BlocksExhausted()
+        from .kvcache.device import adopt_blocks_into_pages
+        self._pk, self._pv = adopt_blocks_into_pages(
+            self._pk, self._pv, jnp.asarray(st["k"]),
+            jnp.asarray(st["v"]),
+            jnp.asarray(np.asarray(ids, np.int32)))
+        bt = mgr.block_tokens
+        adopted, lease = mgr.store_shared(req.prompt[:n * bt], ids)
+        adopted_set = set(adopted)
+        leftovers = [b for b in ids if b not in adopted_set]
+        if leftovers:
+            # another request's store covered some blocks first: the
+            # redundant pages go straight back to the pool (the tree
+            # kept the incumbent's copies)
+            mgr.free(leftovers)
+        if lease is not None:
+            # adoption is complete and the pages are tree-owned; the
+            # admission's own match() re-pins them on this same thread
+            # before any other mutation can evict them
+            lease.release()
+        st["imported"] = True
+        st["k"] = st["v"] = None       # staged host buffers are done
+        self.disagg_stats["premigrated_requests"] += 1
+        self.disagg_stats["adopted_pages"] += len(adopted)
+        self._flight.record("disagg_engine_adopt", blocks=len(adopted),
+                            prompt_len=len(req.prompt))
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0, timeout: Optional[float] = None,
@@ -1017,6 +1110,8 @@ class ContinuousBatchingEngine:
         if self.prefill_chunk is not None:
             out["chunked_prefill"] = {"chunk": self.prefill_chunk,
                                       **self.chunk_stats}
+        if self.disagg_stats["premigrated_requests"]:
+            out["disagg"] = dict(self.disagg_stats)
         if self._spec_step is not None or self._pld_step is not None:
             s = self.spec_stats
             out["speculative"] = {
@@ -1041,6 +1136,8 @@ class ContinuousBatchingEngine:
         out = {"anomaly": self.anomaly.state()}
         if self.kv_cache is not None:
             out["kvcache"] = self.kv_cache.debug_state()
+        if self.disagg_stats["premigrated_requests"]:
+            out["disagg"] = dict(self.disagg_stats)
         return out
 
     def reset_stats(self) -> None:
@@ -1107,6 +1204,9 @@ class ContinuousBatchingEngine:
         state = (mgr.epoch, mgr.free_blocks)
         if getattr(req, "_pkv_blocked", None) == state:
             raise _BlocksExhausted()
+        # disaggregated join: land migrated blocks + adopt BEFORE the
+        # match below, which then finds them as an ordinary prefix hit
+        self._import_staged(req)
         lease = mgr.match(req.prompt)
         m = lease.tokens if lease is not None else 0
         n_pref = m // bt
@@ -1185,6 +1285,15 @@ class ContinuousBatchingEngine:
         C = self.prefill_chunk
         if C is None:
             return False
+        st = getattr(req, "_staged", None)
+        if st is not None and not st["imported"]:
+            # premigrated join: the effective suffix after the adopt is
+            # at most prompt - n_blocks*bt tokens regardless of what the
+            # tree holds right now (the import lands before admission);
+            # once imported, the normal peek below sees the adopted
+            # prefix in the tree and classifies the same way
+            return (len(req.prompt)
+                    - st["k"].shape[0] * self.kv_cache.block_tokens) > C
         epoch = self.kv_cache.epoch if self.kv_cache is not None else 0
         cls = getattr(req, "_stream_cls", None)
         if cls is not None and cls[0] == epoch:

@@ -23,6 +23,7 @@ Layout selection: the ``kv_layout`` engine kwarg / ``--kv-layout`` flag
 over the ``DWT_KV_LAYOUT`` env knob over the default ``paged``.
 """
 
+import logging
 import os
 
 from .backend import (DenseKVBackend, PagedKVBackend, make_kv_backend)
@@ -34,13 +35,39 @@ from .radix import RadixTree
 
 KV_LAYOUTS = ("dense", "paged")
 
+# The dense escape hatch is DEPRECATED (ROADMAP item 1 tail): paged has
+# been the universal default since PR 7 and dense survives exactly one
+# release for single-request-engine users who have not migrated.  This
+# names the removal so the warning below can state it, and the delete
+# PR can grep for it.
+DENSE_REMOVAL_RELEASE = "the next release (the PR after disaggregation)"
+_dense_deprecation_warned = False
+
+log = logging.getLogger(__name__)
+
 
 def resolve_kv_layout(kv_layout=None) -> str:
-    """``kv_layout`` arg over ``DWT_KV_LAYOUT`` env over "paged"."""
+    """``kv_layout`` arg over ``DWT_KV_LAYOUT`` env over "paged".
+
+    Resolving to "dense" logs a LOUD once-per-process deprecation
+    warning naming the removal release — the one owner of layout
+    resolution is the one place the deprecation cannot be bypassed
+    (flag, env knob, and direct engine kwarg all funnel here)."""
     layout = kv_layout or os.environ.get("DWT_KV_LAYOUT", "") or "paged"
     if layout not in KV_LAYOUTS:
         raise ValueError(
             f"unknown kv layout {layout!r}; expected one of {KV_LAYOUTS}")
+    if layout == "dense":
+        global _dense_deprecation_warned
+        if not _dense_deprecation_warned:
+            _dense_deprecation_warned = True
+            log.warning(
+                "DEPRECATED: kv_layout='dense' (the host-pool escape "
+                "hatch) is scheduled for REMOVAL in %s; the paged "
+                "layout is the universal default (docs/DESIGN.md §14) "
+                "and every serve/generate mode accepts it — drop "
+                "--kv-layout dense / DWT_KV_LAYOUT=dense now",
+                DENSE_REMOVAL_RELEASE)
     return layout
 
 
@@ -65,4 +92,4 @@ __all__ = ["KVBlockPool", "KVCacheManager", "KVLease",
            "PagedBlockLease", "PagedKVCacheManager", "RadixTree",
            "resolve_kvcache_config", "resolve_kv_layout",
            "require_dense_kv_layout", "DEFAULT_BLOCK_TOKENS",
-           "KV_LAYOUTS"]
+           "KV_LAYOUTS", "DENSE_REMOVAL_RELEASE"]

@@ -109,6 +109,24 @@ def store_cache_to_pages(pk, pv, ck, cv, table, start):
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
+def adopt_blocks_into_pages(pk, pv, k_blocks, v_blocks, table):
+    """Scatter migrated block payloads ``[n, L, H, bt, D]`` into the
+    page pool at ``table``'s ids — the disaggregation import seam
+    (docs/DESIGN.md §15): a decode worker lands a complete migration's
+    staged blocks in ONE device scatter, then the radix tree ADOPTS
+    the pages (``store_shared``) and the joining request's block table
+    references them.  The pool never round-trips through a dense row,
+    so ``dwt_kvcache_h2d_bytes_total`` (the dense-seed counter) stays 0
+    on the decode side by construction; the migration's own bytes are
+    accounted as ``dwt_disagg_migrated_bytes_total``."""
+    pk = pk.at[:, table].set(
+        k_blocks.transpose(1, 0, 2, 3, 4).astype(pk.dtype), mode="drop")
+    pv = pv.at[:, table].set(
+        v_blocks.transpose(1, 0, 2, 3, 4).astype(pv.dtype), mode="drop")
+    return pk, pv
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
 def write_row_to_pages(pk, pv, row_k, row_v, table):
     """Scatter a prefilled dense row ``[L, 1, H, W*bt, D]`` into the page
     pool at ``table``'s ids — the paged store: blocks land in place on

@@ -303,6 +303,62 @@ FAULT_INJECTED = counter(
     ("kind",))
 
 
+# -- disaggregated prefill/decode series (docs/DESIGN.md §15) --------------
+# event-driven from runtime/disagg.py: the prefill worker counts what it
+# migrates, the decode worker what it adopts, the coordinator what it
+# reschedules.  migrated vs adopted pages diverging means migrations are
+# completing on the wire but failing to join (staging drops, manifest
+# mismatches); rescheduled > 0 names prefill-worker deaths.
+
+DISAGG_MIGRATED_PAGES = counter(
+    "dwt_disagg_migrated_pages_total",
+    "KV pages a prefill worker streamed to a decode worker (whole "
+    "prompt blocks; counted once per completed, acknowledged "
+    "migration)")
+DISAGG_MIGRATED_BYTES = counter(
+    "dwt_disagg_migrated_bytes_total",
+    "Wire bytes of page-payload frames in completed migrations "
+    "(CRC-framed K/V block runs + metadata)")
+DISAGG_ADOPTED_PAGES = counter(
+    "dwt_disagg_adopted_pages_total",
+    "Migrated pages the decode worker landed in its pool and the radix "
+    "tree adopted (device scatter + ownership transfer; the join side "
+    "of dwt_disagg_migrated_pages_total)")
+DISAGG_JOINED = counter(
+    "dwt_disagg_joined_requests_total",
+    "Disaggregated requests joined into the decode worker's "
+    "continuous-batching drain after a complete migration")
+DISAGG_RESCHEDULED = counter(
+    "dwt_disagg_rescheduled_requests_total",
+    "Handoffs resent to a different prefill worker after the original "
+    "died or failed mid-migration (each bumps the request's attempt; "
+    "stale-attempt frames are discarded by the decode worker)")
+DISAGG_RETRANSMITTED = counter(
+    "dwt_disagg_retransmitted_frames_total",
+    "Page frames retransmitted after a receiver nack (go-back-n over "
+    "dropped or CRC-rejected frames; a sustained rate means a lossy "
+    "migration path)")
+DISAGG_DROPPED_FRAMES = counter(
+    "dwt_disagg_dropped_frames_total",
+    "Migration frames the decode worker discarded: duplicates and "
+    "reorder holes ((rid, attempt, seq) dedup), stale attempts, and "
+    "frames for already-joined requests — each a retry made idempotent")
+DISAGG_MIGRATION_SECONDS = histogram(
+    "dwt_disagg_migration_seconds",
+    "Prefill-worker wall time from handoff start to migration "
+    "acknowledged (chunked prefill + page streaming + ack)",
+    buckets=LATENCY_BUCKETS_S)
+DISAGG_HANDOFF_QUEUE = gauge(
+    "dwt_disagg_handoff_queue_depth_requests",
+    "Requests submitted to the coordinator that have not yet produced "
+    "their first decode-side token (prefilling, migrating, or waiting "
+    "for a prefill worker)")
+DISAGG_INFLIGHT = gauge(
+    "dwt_disagg_inflight_requests",
+    "Disaggregated requests submitted and not yet finished, all "
+    "phases (handoff + migration + decode)")
+
+
 # -- flight recorder / anomaly series --------------------------------------
 
 FLIGHT_EVENTS = counter(

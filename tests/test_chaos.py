@@ -565,6 +565,168 @@ def test_delayed_duplicated_stale_acks_never_satisfy_reshard():
 
 
 # ---------------------------------------------------------------------------
+# disaggregated prefill/decode migration under faults (DESIGN.md §15)
+
+
+def _build_disagg(cfg, full, prefill_plans, max_seq=64, chunk=8,
+                  ack_timeout=0.5):
+    """Loopback disagg deployment: coordinator + one prefill worker per
+    entry of ``prefill_plans`` (its fault plan, or None) + one decode
+    worker.  Prefill worker threads die on InjectedCrash like a real
+    process would (the crash handler path)."""
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+    from distributed_inference_demo_tpu.runtime.disagg import (
+        DecodeWorker, DisaggCoordinator, PrefillWorker)
+
+    net = LoopbackNetwork()
+    tc = LoopbackTransport("coord", net)
+    pids = [f"p{i}" for i in range(len(prefill_plans))]
+    engine = ContinuousBatchingEngine(
+        cfg, full, max_seq=max_seq, max_batch=2, sampling=GREEDY,
+        kv_cache_blocks=0)
+    pws, threads = [], []
+    for pid, plan in zip(pids, prefill_plans):
+        t = LoopbackTransport(pid, net)
+        if plan is not None:
+            t = FaultyTransport(t, plan)
+        pw = PrefillWorker(cfg, full, t, max_seq=max_seq,
+                           prefill_chunk=chunk, ack_timeout=ack_timeout)
+        pws.append(pw)
+
+        def serve(w=pw):
+            try:
+                w.serve_forever()
+            except InjectedCrash:
+                return            # the injected death IS the scenario
+        th = threading.Thread(target=serve, daemon=True)
+        th.start()
+        threads.append(th)
+    dw = DecodeWorker(engine, LoopbackTransport("d0", net))
+    dth = threading.Thread(target=dw.serve_forever, daemon=True)
+    dth.start()
+    coord = DisaggCoordinator(tc, pids, "d0")
+    return coord, pws, dw, engine, threads, dth
+
+
+def _assert_no_page_leaks(engine, pws):
+    """The §15 ownership acceptance: idle ``used == tree.block_count``
+    on the decode pool (tree + zero in-flight request pages) AND every
+    surviving prefill pool — bounded wait for async completions."""
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        snaps = [engine.kv_cache.snapshot()] + [
+            pw.kv_cache.snapshot() for pw in pws]
+        if all(s["blocks_used"] == s["tree_blocks"] for s in snaps):
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"page leak: {snaps}")
+
+
+def test_chaos_migration_faults_bit_identical(tmp_path):
+    """The migration-tag fault plan satellite: duplicate + corrupt +
+    drop scoped to page-transfer (``pg:``) frames.  The (rid, attempt,
+    seq) dedup makes duplicated/retried page frames idempotent, CRC
+    drops the corrupt frame before any adopt, and the ack-driven
+    go-back-n retransmit refills the holes — greedy output stays
+    bit-identical and neither pool leaks a page."""
+    set_flight_recorder(FlightRecorder(max_events=512))
+    cfg = get_model_config(MODEL)
+    full = init_full_params(jax.random.PRNGKey(0), cfg)
+    prompt = (np.arange(37) % 50 + 3).astype(np.int32)
+    want = reference_tokens(prompt[None], 8)[0]
+
+    plan = FaultPlan(seed=7, rules=[
+        FaultRule(kind="duplicate", tag_prefix="pg:", prob=0.5),
+        FaultRule(kind="corrupt", tag_prefix="pg:", after=1,
+                  max_count=1),
+        FaultRule(kind="drop", tag_prefix="pg:", after=3, max_count=1)])
+    coord, pws, dw, engine, threads, dth = _build_disagg(cfg, full,
+                                                         [plan])
+    try:
+        got = coord.submit(prompt, 8).wait(timeout=120)
+        np.testing.assert_array_equal(got, want)     # bit-identical
+        kinds = {e["kind"] for e in plan.events}
+        assert {"duplicate", "corrupt", "drop"} & kinds, kinds
+        # the faults actually exercised the recovery machinery
+        if "corrupt" in kinds or "drop" in kinds:
+            assert pws[0].stats["retransmitted_frames"] >= 1
+        if "duplicate" in kinds:
+            assert dw.stats["dropped_frames"] >= 1
+        assert engine.kv_cache.snapshot()["h2d_bytes"] == 0
+        _assert_no_page_leaks(engine, pws)
+    finally:
+        for pw in pws:
+            pw.stop()
+        dw.stop()
+        coord.close()
+        engine.close()
+
+
+def test_chaos_prefill_crash_mid_migration_reschedules(tmp_path):
+    """THE §15 chaos acceptance: a prefill worker crashes mid-migration
+    (injected ``crash_after`` fires while page frames are in flight);
+    the coordinator reschedules the request to the surviving worker
+    under a bumped attempt, the decode worker discards the stale
+    attempt's staged frames (which held ZERO pool pages), the greedy
+    stream is bit-identical, the decode-side radix tree keeps its
+    ownership invariant, and the postmortem bundle names the injected
+    fault."""
+    set_flight_recorder(FlightRecorder(max_events=512))
+    postmortem.set_postmortem_writer(PostmortemWriter(str(tmp_path)))
+    cfg = get_model_config(MODEL)
+    full = init_full_params(jax.random.PRNGKey(0), cfg)
+    prompt = (np.arange(37) % 50 + 3).astype(np.int32)
+    want = reference_tokens(prompt[None], 8)[0]
+
+    # msg 1 is the dreq receive, so the crash fires on a page-frame
+    # send — genuinely mid-migration
+    plan = FaultPlan(seed=1, rules=[
+        FaultRule(kind="crash_after", n_msgs=2)])
+    coord, pws, dw, engine, threads, dth = _build_disagg(
+        cfg, full, [plan, None])
+    stop = threading.Event()
+
+    def watch():           # heartbeat stand-in (test_elastic wires the
+        while not stop.is_set():     # real sweeper)
+            if not threads[0].is_alive():
+                coord.signal_failure("p0")
+                return
+            stop.wait(0.05)
+    threading.Thread(target=watch, daemon=True).start()
+    try:
+        req = coord.submit(prompt, 8)
+        got = req.wait(timeout=120)
+        stop.set()
+        np.testing.assert_array_equal(got, want)     # bit-identical
+        assert req.attempt == 1 and req.worker == "p1"
+        assert coord.stats["rescheduled"] == 1
+        assert "crash_after" in {e["kind"] for e in plan.events}
+        # stale attempt fully discarded; no staged residue, no pages
+        assert dw._staged == {}
+        # ownership invariant on the decode tree: used == tree-owned +
+        # in-flight (nothing in flight after completion)
+        _assert_no_page_leaks(engine, [pws[1]])
+
+        # the postmortem bundle names the injected fault
+        bundles = postmortem.get_postmortem_writer().bundle_dirs()
+        assert bundles, "no postmortem bundle for the injected crash"
+        manifests = [json.load(open(f"{b}/manifest.json"))
+                     for b in bundles]
+        inj = [m for m in manifests
+               if m["reason"] == "injected_fault_crash"]
+        assert inj and inj[0]["detail"]["fault"]["kind"] == "crash_after"
+        assert inj[0]["detail"]["plan_seed"] == 1
+    finally:
+        stop.set()
+        for pw in pws:
+            pw.stop()
+        dw.stop()
+        coord.close()
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
 # overload shedding + request deadlines (graceful degradation satellites)
 
 

@@ -28,7 +28,7 @@ from typing import List
 
 SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
               "engine", "control", "anomaly", "flight", "kvcache",
-              "transport", "fault"}
+              "transport", "fault", "disagg"}
 
 # unit suffixes a metric name may end with (after stripping ``_total``).
 # Plain-count units (requests, tokens, ...) double as the unit for
@@ -37,7 +37,8 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "rounds", "hits", "misses", "slots", "spans", "entries",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
          "info", "events", "bundles", "blocks", "nodes",
-         "retries", "reconnects", "frames", "faults", "dispatches"}
+         "retries", "reconnects", "frames", "faults", "dispatches",
+         "pages"}
 
 # exact names exempted from the unit-suffix rule — each entry is a
 # deliberate, documented exception (NOT a new unit: adding a pseudo-unit
@@ -87,6 +88,16 @@ REQUIRED_SERIES = {
     # exactly like a healthy one
     "dwt_engine_host_dispatches_total",
     "dwt_engine_device_loop_steps_total",
+    # the disaggregation set (docs/DESIGN.md §15): migrated vs adopted
+    # pages diverging is the wedged-handoff signal, and rescheduled
+    # staying registered-and-zero is how a scrape PROVES no prefill
+    # worker silently died mid-migration
+    "dwt_disagg_migrated_pages_total",
+    "dwt_disagg_migrated_bytes_total",
+    "dwt_disagg_adopted_pages_total",
+    "dwt_disagg_rescheduled_requests_total",
+    "dwt_disagg_migration_seconds",
+    "dwt_disagg_handoff_queue_depth_requests",
 }
 
 

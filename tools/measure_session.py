@@ -53,6 +53,8 @@ LEG_BUDGETS = {
     "prompt_lookup": 1500,
     "planner_pipeline": 1800,
     "long_context": 1800,
+    "long_context_sp": 1800,
+    "disagg": 1500,
     "flagship_int8": 2400,
     "batching": 2400,
     "prefix_reuse": 1800,
