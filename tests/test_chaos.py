@@ -71,11 +71,21 @@ def _counter_value(c, **labels) -> float:
     return 0.0
 
 
+_REFERENCE_MEMO = {}
+
+
 def reference_tokens(prompt, max_new):
-    cfg = get_model_config(MODEL)
-    params = init_full_params(jax.random.PRNGKey(0), cfg)
-    return InferenceEngine(cfg, params, max_seq=64,
-                           sampling=GREEDY).generate(prompt, max_new).tokens
+    """Memoized per (prompt, max_new): several tests pin against the
+    same fault-free stream, and each cold call costs an engine build."""
+    prompt = np.asarray(prompt)
+    key = (prompt.tobytes(), prompt.shape, max_new)
+    if key not in _REFERENCE_MEMO:
+        cfg = get_model_config(MODEL)
+        params = init_full_params(jax.random.PRNGKey(0), cfg)
+        _REFERENCE_MEMO[key] = InferenceEngine(
+            cfg, params, max_seq=64, sampling=GREEDY).generate(
+            prompt, max_new).tokens
+    return _REFERENCE_MEMO[key]
 
 
 # ---------------------------------------------------------------------------
@@ -447,9 +457,14 @@ def test_chaos_recovery_bit_identical(tmp_path):
         t.join(timeout=30)
 
 
+@pytest.mark.slow
 def test_chaos_corrupt_frames_counted_during_recovery(tmp_path):
     """The corrupt-frame counter moves during the soak (the acceptance
-    bullet: detected by CRC, counted, never a wrong token)."""
+    bullet: detected by CRC, counted, never a wrong token).  Slow lane
+    (redundant-coverage twin): the counter-moves contract is pinned in
+    tier-1 by test_corrupt_frame_detected_not_decoded and
+    test_worker_drops_corrupt_frame_without_forwarding, and the soak
+    recovery path by test_chaos_recovery_bit_identical."""
     set_flight_recorder(FlightRecorder(max_events=512))
     want = reference_tokens(PROMPT, 10)
     before = _counter_value(catalog.TRANSPORT_CORRUPT_FRAMES)

@@ -257,23 +257,21 @@ def test_disagg_debug_surfaces_migration_state(fabric):
 # the engine join seam
 
 
-def test_submit_premigrated_validates_block_shapes(cfg_params):
-    cfg, params = cfg_params
-    with ContinuousBatchingEngine(cfg, params, max_seq=64, max_batch=1,
-                                  sampling=GREEDY,
-                                  kv_cache_blocks=0) as eng:
-        bt = eng.kv_cache.block_tokens
-        prompt = np.arange(2 * bt + 1, dtype=np.int32) + 2
-        good = np.zeros((2, cfg.num_layers, cfg.num_kv_heads, bt,
-                         cfg.head_dim), np.float32)
-        with pytest.raises(ValueError, match="n, L, H, bt, D"):
-            eng.submit_premigrated(prompt, 4, good[:, :, :, :-1],
-                                   good[:, :, :, :-1])
-        with pytest.raises(ValueError, match="exceed the prompt"):
-            eng.submit_premigrated(prompt[:bt], 4, good, good)
-        # None blocks = plain submit (short-prompt degenerate)
-        req = eng.submit_premigrated(prompt, 2, None, None)
-        assert req.wait(timeout=120).shape == (2,)
+def test_submit_premigrated_validates_block_shapes(cfg_params, fabric):
+    cfg, _ = cfg_params
+    eng = fabric[3]        # rides the shared engine: validation raises
+    bt = eng.kv_cache.block_tokens       # before anything is scheduled
+    prompt = np.arange(2 * bt + 1, dtype=np.int32) + 2
+    good = np.zeros((2, cfg.num_layers, cfg.num_kv_heads, bt,
+                     cfg.head_dim), np.float32)
+    with pytest.raises(ValueError, match="n, L, H, bt, D"):
+        eng.submit_premigrated(prompt, 4, good[:, :, :, :-1],
+                               good[:, :, :, :-1])
+    with pytest.raises(ValueError, match="exceed the prompt"):
+        eng.submit_premigrated(prompt[:bt], 4, good, good)
+    # None blocks = plain submit (short-prompt degenerate)
+    req = eng.submit_premigrated(prompt, 2, None, None)
+    assert req.wait(timeout=120).shape == (2,)
 
 
 @pytest.mark.slow
