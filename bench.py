@@ -1179,11 +1179,11 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
     Phases, one workload shape (distinct prompts, then a shared-prefix
     wave on the paged engine):
 
-    - dense reference: the plain InferenceEngine with the explicit
-      ``kv_layout='dense'`` escape hatch at batch = slots — the dense
-      batch cache was deleted from the scheduler, so the surviving
-      dense decode path IS the reference; its cache bytes are measured
-      off the real buffers, not estimated;
+    - dense reference: the plain InferenceEngine at batch = slots —
+      its working cache is dense ``B x max_seq`` rows (the dense pool
+      layout is deleted; the working cache shape is the reference),
+      so its cache bytes are measured off the real buffers, not
+      estimated;
     - paged: tok/s + pool capacity + PEAK blocks/bytes in use (polled
       while the wave decodes) + the analytic max-concurrent-sequences
       at the dense reference's HBM budget;
@@ -1241,11 +1241,13 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
            "prompt_len": prompt_len, "new_tokens": new_tokens,
            "max_seq": max_seq, "block_tokens": block_tokens}
 
-    # phase 1: the dense reference — the surviving dense decode path
-    # (plain engine escape hatch) at batch = slots, dense cache bytes
-    # measured off its real buffers at the serving max_seq
+    # phase 1: the dense-reservation reference — the plain engine's
+    # working cache is dense B x max_seq rows regardless of pool
+    # layout (the dense pool layout itself is deleted), so its real
+    # buffers at batch = slots ARE the dense reservation, measured not
+    # estimated
     dense_eng = InferenceEngine(cfg, params, max_seq=max_seq,
-                                sampling=sampling, kv_layout="dense")
+                                sampling=sampling)
     batch_prompts = np.stack(prompts[:slots])
     dense_eng.generate(batch_prompts, new_tokens, seed=0)     # compile
     dense_cache = dense_eng.new_cache(slots)
@@ -1258,7 +1260,7 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
     dense_dt = time.perf_counter() - t0
     del dense_eng
     out["dense"] = {
-        "engine": "InferenceEngine kv_layout=dense (escape hatch)",
+        "engine": "InferenceEngine dense-row working cache (reference)",
         "tokens_per_sec": round(n_req * new_tokens / dense_dt, 2),
         "cache_reserved_bytes": dense_bytes,
         "reserved_tokens": slots * max_seq,
@@ -2001,6 +2003,283 @@ def _leg_disagg(model: str, slots: int = 8, bg: int = 7,
     }
 
 
+def _leg_gateway_routing(model: str, n_replicas: int = 3, groups: int = 6,
+                         per_group: int = 6, prefix_len: int = 96,
+                         suffix_len: int = 8, new_tokens: int = 16,
+                         slots: int = 4, max_seq: int = 256,
+                         block_tokens: int = 16,
+                         kill_requests: int = 12) -> dict:
+    """Cache-aware gateway routing vs round-robin over N loopback
+    replicas, measured where the router matters (docs/DESIGN.md §16):
+    **prefix reuse and TTFT under a grouped shared-prefix workload**.
+
+    Three phases over the SAME replica fleet (real HTTP all the way —
+    client → gateway → replica — so both policies pay the same proxy
+    hop):
+
+    - *round_robin*: the gateway's router is overridden to cycle
+      through replicas, the classic L4 answer.  Group members scatter,
+      so most requests re-prefill a prefix some OTHER replica already
+      holds.
+    - *cache_aware*: the real PrefixAwareRouter.  The first member of
+      a group lands by rendezvous hash; every later member follows the
+      routing-history index to the replica that already holds the
+      prefix, paying only the suffix prefill.
+    - *kill*: re-issue cache-aware-phase prompts while one replica
+      drains away mid-soak.  Gates: every request completes
+      bit-identically to its phase-2 answer or sheds as 503 — never a
+      hang, never divergent tokens — and the eviction debounce moves
+      ``dwt_gateway_replica_down_total``.
+
+    Phases use DISJOINT prompt groups (fresh prefixes per phase) so
+    phase order cannot lend one policy the other's warm cache."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+    from http.client import HTTPConnection
+
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+    from distributed_inference_demo_tpu.runtime.gateway import (
+        GatewayHTTPServer, PrefixAwareRouter, ReplicaRegistry, RouteDecision)
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        InferenceHTTPServer)
+    from distributed_inference_demo_tpu.runtime.overload import (
+        GatewayOverloaded)
+    from distributed_inference_demo_tpu.runtime.stats import _percentile
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    greedy = SamplingParams(greedy=True)
+    rng = np.random.default_rng(11)
+    min_prefix = min(block_tokens, prefix_len)
+
+    def make_workload():
+        """``groups`` shared prefixes x ``per_group`` unique suffixes,
+        interleaved across groups (g0r0, g1r0, ..., g0r1, ...) — the
+        order that maximally punishes a router that forgets where a
+        group's prefix lives."""
+        per = []
+        for _ in range(groups):
+            prefix = rng.integers(2, cfg.vocab_size - 1, prefix_len)
+            per.append([np.concatenate([
+                prefix, rng.integers(2, cfg.vocab_size - 1, suffix_len)])
+                .astype(np.int32) for _ in range(per_group)])
+        return [per[g][i] for i in range(per_group)
+                for g in range(groups)]
+
+    def send(host, port, prompt, timeout=600):
+        """One streaming /generate; returns status, client-side TTFT,
+        and the decoded row (None on non-200 / severed stream)."""
+        conn = HTTPConnection(host, port, timeout=timeout)
+        try:
+            t0 = time.perf_counter()
+            conn.request("POST", "/generate", body=json.dumps(
+                {"prompt_ids": [prompt.tolist()],
+                 "max_new_tokens": new_tokens, "stream": True}),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                resp.read()
+                return resp.status, None, None
+            toks, ttft, severed = [], None, False
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                d = json.loads(line)
+                if "error" in d:
+                    severed = True
+                    break
+                tl = d.get("tokens")   # flat: one entry per batch row
+                if tl:
+                    toks.append(tl[0])
+            return resp.status, ttft, None if severed else toks
+        except Exception:
+            return -1, None, None
+        finally:
+            conn.close()
+
+    def kv_totals():
+        out = {"partial_hit_tokens": 0, "hits": 0, "misses": 0}
+        for eng in engines:
+            kv = eng.stats()["kvcache"]
+            for k in out:
+                out[k] += kv[k]
+        return out
+
+    def phase_metrics(before, after, ttfts, prompt_tokens):
+        d = {k: after[k] - before[k] for k in before}
+        lookups = d["hits"] + d["misses"]
+        xs = sorted(t for t in ttfts if t is not None)
+        return {
+            "requests": len(ttfts),
+            "ttft_p50_ms": round(_percentile(xs, 50) * 1e3, 2),
+            "ttft_p95_ms": round(_percentile(xs, 95) * 1e3, 2),
+            # fraction of submitted prompt tokens served from a warm
+            # radix tree (full hits won't happen — suffixes are unique
+            # — so reused tokens ARE the prefix-routing signal)
+            "prefix_hit_rate": round(
+                d["partial_hit_tokens"] / prompt_tokens, 4)
+            if prompt_tokens else 0.0,
+            "reused_prefix_tokens": d["partial_hit_tokens"],
+            "radix_lookups": lookups,
+        }
+
+    def scrape_counter(gw, name):
+        conn = HTTPConnection(gw.host, gw.port, timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+        finally:
+            conn.close()
+        for ln in text.splitlines():
+            if ln.startswith(name + " ") or ln.startswith(name + "{"):
+                return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+
+    engines = [ContinuousBatchingEngine(
+        cfg, params, max_seq=max_seq, max_batch=slots, sampling=greedy,
+        kv_cache_blocks=0, kv_block_tokens=block_tokens)
+        for _ in range(n_replicas)]
+    servers = []
+    for eng in engines:
+        srv = InferenceHTTPServer(eng, port=0, model_name=model)
+        srv.start()
+        servers.append(srv)
+
+    # warm every replica's compile caches on BOTH admission shapes the
+    # measured phases hit — the full-prompt bucket and the suffix-only
+    # bucket behind a prefix hit — with an off-workload prefix
+    warm_prefix = rng.integers(2, cfg.vocab_size - 1, prefix_len)
+    for srv in servers:
+        for _ in range(2):     # second send takes the prefix-hit path
+            suffix = rng.integers(2, cfg.vocab_size - 1, suffix_len)
+            warm = np.concatenate([warm_prefix, suffix]).astype(np.int32)
+            st, _, _ = send(srv.host, srv.port, warm)
+            if st != 200:
+                raise RuntimeError(f"warmup failed on {srv.host}:"
+                                   f"{srv.port} (status {st})")
+
+    registry = ReplicaRegistry(
+        [(s.host, s.port) for s in servers], sustain=2,
+        readmit_cooldown_s=60.0, probe_interval_s=0.3)
+
+    class _RoundRobinRouter(PrefixAwareRouter):
+        """The baseline: same gateway, same proxy, zero cache sense."""
+
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self._rr = 0
+
+        def route(self, tokens):
+            ups = sorted(self.registry.up_replicas())
+            if not ups:
+                raise GatewayOverloaded("no replica up", retry_after_s=2.0)
+            rid = ups[self._rr % len(ups)]
+            self._rr += 1
+            return RouteDecision(rid, "hash", 0,
+                                 [r for r in ups if r != rid])
+
+    n_tok = groups * per_group * (prefix_len + suffix_len)
+    results = {}
+
+    # -- phase 1: round-robin baseline -------------------------------------
+    gw = GatewayHTTPServer(registry, _RoundRobinRouter(
+        registry, min_prefix_tokens=min_prefix,
+        block_tokens=block_tokens), port=0)
+    gw.start()
+    before = kv_totals()
+    ttfts = [send(gw.host, gw.port, p)[1] for p in make_workload()]
+    results["round_robin"] = phase_metrics(before, kv_totals(), ttfts,
+                                           n_tok)
+    gw.shutdown()
+
+    # -- phase 2: cache-aware (fresh prefixes) -----------------------------
+    router = PrefixAwareRouter(registry, min_prefix_tokens=min_prefix,
+                               block_tokens=block_tokens)
+    gw = GatewayHTTPServer(registry, router, port=0, retry_limit=2)
+    gw.start()
+    aware_prompts = make_workload()
+    before = kv_totals()
+    aware = [send(gw.host, gw.port, p) for p in aware_prompts]
+    results["cache_aware"] = phase_metrics(
+        before, kv_totals(), [t for _, t, _ in aware], n_tok)
+
+    # -- phase 3: kill one replica mid-soak (same gateway) -----------------
+    down_before = scrape_counter(gw, "dwt_gateway_replica_down_total")
+    expected = {tuple(p.tolist()): toks
+                for p, (st, _, toks) in zip(aware_prompts, aware)
+                if st == 200 and toks}
+    replay = [p for p in aware_prompts
+              if tuple(p.tolist()) in expected][:kill_requests]
+    victim = servers[0]
+    kill_after = max(1, len(replay) // 3)
+    done = []
+
+    def one(i, p):
+        if i == kill_after:
+            victim.shutdown()    # drain: in-flight finish, connects die
+        st, _, toks = send(gw.host, gw.port, p)
+        done.append((tuple(p.tolist()), st, toks))
+
+    with ThreadPoolExecutor(max_workers=3) as ex:
+        list(ex.map(lambda a: one(*a), enumerate(replay)))
+    completed = sum(1 for _, st, _ in done if st == 200)
+    shed = sum(1 for _, st, _ in done if st in (503, 429))
+    hung_or_failed = len(done) - completed - shed
+    identical = all(toks == expected[key]
+                    for key, st, toks in done if st == 200)
+    # the debounce is asynchronous (background probes, sustain strikes):
+    # a short replay can outrun it, so wait for the prober to strike the
+    # dead victim out before reading the eviction counter — bounded, so
+    # a wedged prober fails the gate instead of hanging the leg
+    victim_rid = f"{victim.host}:{victim.port}"
+    deadline = time.perf_counter() + 15.0
+    while registry.is_up(victim_rid) and time.perf_counter() < deadline:
+        time.sleep(0.05)
+    down_moved = (scrape_counter(gw, "dwt_gateway_replica_down_total")
+                  - down_before) >= 1
+    results["kill"] = {
+        "requests": len(done), "completed": completed, "shed_503": shed,
+        "hung_or_failed": hung_or_failed,
+        "bit_identical": bool(identical),
+        "replica_down_moved": bool(down_moved),
+        "survivors": registry.up_replicas(),
+    }
+
+    gw.shutdown()
+    for srv, eng in zip(servers, engines):
+        if srv is not victim:
+            srv.shutdown()
+        eng.close()
+
+    rr, aw, kl = (results["round_robin"], results["cache_aware"],
+                  results["kill"])
+    return {
+        "model": model, "replicas": n_replicas, "groups": groups,
+        "per_group": per_group, "prefix_len": prefix_len,
+        "suffix_len": suffix_len, "new_tokens": new_tokens, **results,
+        # the §16 acceptance gates
+        "cache_aware_wins_hit_rate": (aw["prefix_hit_rate"]
+                                      > rr["prefix_hit_rate"]),
+        "cache_aware_wins_ttft_p95": (aw["ttft_p95_ms"]
+                                      < rr["ttft_p95_ms"]),
+        "kill_zero_hangs": kl["hung_or_failed"] == 0,
+        "kill_bit_identical": kl["bit_identical"],
+        "kill_replica_down_moved": kl["replica_down_moved"],
+    }
+
+
 # ---------------------------------------------------------------------------
 
 def micro_shape(p: dict) -> dict:
@@ -2028,15 +2307,16 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
             out = _bench_engine(model, batch, prompt_len, new_tokens,
                                 quant=True, latency=not micro)
         elif name == "sweep":
-            # micro runs the FULL b8/32/64 x {bf16,int8,int4} grid at
-            # the micro token budget (carried satellite: the sweep
-            # SHAPES bank coarse numbers in the first healthy window;
-            # the full-budget pass keeps its narrower grid — b8 is the
-            # headline legs' point, int4 has its own leg there)
-            out = (_leg_sweep(model, prompt_len, new_tokens,
-                              quants=(False, True, "int4"),
-                              batches=(8, 32, 64)) if micro
-                   else _leg_sweep(model, prompt_len, new_tokens))
+            # the FULL b8/32/64 x {bf16,int8,int4} grid at BOTH budgets
+            # (carried satellite, promoted): the micro prepass banks
+            # coarse numbers for every shape in the first healthy
+            # window, and the full-budget pass now measures the same
+            # grid properly — the narrower b32/64 x {bf16,int8} grid
+            # left the b8 points and the int4 column micro-only for
+            # two rounds running
+            out = _leg_sweep(model, prompt_len, new_tokens,
+                             quants=(False, True, "int4"),
+                             batches=(8, 32, 64))
         elif name == "flagship_int8":
             out = _leg_flagship(flagship, batch, prompt_len,
                                 min(new_tokens, 64), quant=True)
@@ -2078,6 +2358,18 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
                                prefill_chunk=8, max_seq=1024,
                                block_tokens=8) if micro
                    else _leg_disagg(model))
+        elif name == "gateway_routing":
+            # the micro shape keeps the structure (3 replicas, grouped
+            # shared prefixes, a drained replica) at the smallest scale
+            # where the TTFT-p95 gate stays structural: enough requests
+            # per group that cache-aware's full prefills sit below the
+            # percentile while round-robin's sit above it
+            out = (_leg_gateway_routing(model, groups=2, per_group=20,
+                                        prefix_len=300, suffix_len=8,
+                                        new_tokens=4, slots=2,
+                                        max_seq=512, block_tokens=16,
+                                        kill_requests=4) if micro
+                   else _leg_gateway_routing(model))
         elif name == "planner_pipeline":
             out = _leg_planner_pipeline(model, batch, prompt_len,
                                         min(new_tokens, 8))
@@ -2336,7 +2628,7 @@ def main() -> None:
     legs = ["roofline_probe", "headline", "roofline_probe_rerun",
             "headline_int8", "decode_fused", "speculative",
             "prompt_lookup", "planner_pipeline", "long_context",
-            "long_context_sp", "disagg",
+            "long_context_sp", "disagg", "gateway_routing",
             "flagship_int8", "batching", "prefix_reuse", "paged_decode",
             "serving_relative", "sweep", "flagship_bf16", "pipeline",
             "fault_recovery", "prefill_long", "moe", "multimodal",
@@ -2349,7 +2641,8 @@ def main() -> None:
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
                                     "batching", "prefix_reuse",
                                     "paged_decode",
-                                    "serving_relative", "disagg"]),
+                                    "serving_relative", "disagg",
+                                    "gateway_routing"]),
             ("BENCH_SKIP_LONGCTX", ["long_context", "long_context_sp"]),
             ("BENCH_SKIP_PREFILL", ["prefill_long"]),
             ("BENCH_SKIP_MOE_MM", ["moe", "multimodal"]),
@@ -2409,8 +2702,11 @@ def main() -> None:
     # give it more rope than the single-engine legs
     # paged_decode keeps the acceptance shape (new=128, unclamped) and
     # builds two engines + three waves — budget it like batching
+    # gateway_routing runs three replica engines through three phases
+    # (two routed soaks + the drain) — multi-engine, budget it likewise
     leg_timeouts = {"batching": 1500, "prefix_reuse": 1200,
-                    "paged_decode": 1500, "serving_relative": 1500}
+                    "paged_decode": 1500, "serving_relative": 1500,
+                    "gateway_routing": 1500}
     runlog.event("bench_start", params=params, legs=legs)
     results = {}
     for leg in legs:

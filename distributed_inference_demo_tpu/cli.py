@@ -529,6 +529,66 @@ def cmd_serve(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# gateway (replicated serving: cache-aware routing over N replicas)
+# ---------------------------------------------------------------------------
+
+def _parse_replicas(spec: str):
+    """``host:port,host:port,...`` → ``[(host, port), ...]``."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"bad replica {part!r}: expected host:port")
+        out.append((host, int(port)))
+    if not out:
+        raise ValueError("--replicas needs at least one host:port")
+    return out
+
+
+def cmd_gateway(args) -> int:
+    """The prefix-aware replicated serving gateway (docs/DESIGN.md §16):
+    spread /generate traffic across N independent ``serve`` replicas,
+    routing each request to the replica most likely to hold its prompt
+    prefix in its radix cache.  Holds no engine — start the replicas
+    first (``cli serve --batch-slots N ...``), then point the gateway
+    at them."""
+    from .runtime.gateway import (GatewayHTTPServer, PrefixAwareRouter,
+                                  ReplicaRegistry)
+
+    try:
+        replicas = _parse_replicas(args.replicas)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    registry = ReplicaRegistry(
+        replicas, sustain=args.evict_sustain,
+        readmit_cooldown_s=args.readmit_cooldown,
+        probe_interval_s=args.health_interval,
+        probe_timeout_s=args.probe_timeout)
+    router = PrefixAwareRouter(
+        registry, min_prefix_tokens=args.min_prefix_tokens,
+        block_tokens=args.route_block_tokens,
+        load_factor=args.load_factor)
+    server = GatewayHTTPServer(
+        registry, router, host=args.http_host, port=args.http_port,
+        retry_limit=args.retry_limit,
+        proxy_timeout_s=args.proxy_timeout or None)
+    print(f"GATEWAY_READY http://{server.host}:{server.port} "
+          f"replicas={','.join(registry.replica_ids())}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # server (integrated root-server app)
 # ---------------------------------------------------------------------------
 
@@ -628,11 +688,11 @@ def cmd_worker(args) -> int:
                     help="reduced-precision KV cache storage for this "
                          "stage, e.g. float8_e4m3fn")
     ap.add_argument("--kv-layout", default=None,
-                    choices=["dense", "paged"],
-                    help="this stage's request-cache layout (default "
-                         "DWT_KV_LAYOUT, else paged: per-stage page "
-                         "pool, blocks reserved per chunk actually "
-                         "run)")
+                    choices=["paged"],
+                    help="this stage's request-cache layout (paged is "
+                         "the only layout: per-stage page pool, blocks "
+                         "reserved per chunk actually run; 'dense' was "
+                         "removed — docs/DESIGN.md §14)")
     ap.add_argument("--fault-plan", default="",
                     help="CHAOS TESTING ONLY: JSON fault-plan spec "
                          "(path or inline); requires --chaos")
@@ -1114,20 +1174,17 @@ def _add_engine_args(ap):
                          "AND minimum reusable prefix; default "
                          "DWT_KVCACHE_BLOCK_TOKENS, else 16)")
     ap.add_argument("--kv-layout", default=None,
-                    choices=["dense", "paged"],
-                    help="KV cache memory layout (default DWT_KV_LAYOUT, "
-                         "else paged — docs/DESIGN.md §14).  paged: "
-                         "device-resident block pool + block tables "
-                         "(vLLM-style PagedAttention) — HBM reserved "
-                         "per block actually allocated instead of "
-                         "B x max_seq rows, radix prefix hits shared "
-                         "by reference with zero H2D; every serve/"
-                         "generate mode accepts it.  dense: the "
-                         "host-pool escape hatch on the single-request "
-                         "engines and pipeline stages — DEPRECATED, "
-                         "logs a loud warning and is scheduled for "
-                         "removal in the next release; --batch-slots "
-                         "is paged-native and rejects it")
+                    choices=["paged"],
+                    help="KV cache memory layout (docs/DESIGN.md §14). "
+                         "paged is the ONLY layout: device-resident "
+                         "block pool + block tables (vLLM-style "
+                         "PagedAttention) — HBM reserved per block "
+                         "actually allocated instead of B x max_seq "
+                         "rows, radix prefix hits shared by reference "
+                         "with zero H2D.  'dense' (the host-pool "
+                         "escape hatch) was removed after its "
+                         "one-release deprecation; resolving it fails "
+                         "loudly naming this removal")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over the first N local "
                          "devices (Megatron-sliced weights, kv-head-"
@@ -1273,6 +1330,40 @@ def main(argv=None) -> int:
     _add_sp_args(s)
     _add_draft_args(s)
     s.set_defaults(fn=cmd_serve)
+
+    gw = sub.add_parser("gateway", help="replicated serving gateway: "
+                        "prefix-aware routing over N serve replicas")
+    gw.add_argument("--replicas", required=True,
+                    help="comma list of replica host:port (each a running "
+                         "'serve' process)")
+    gw.add_argument("--http-host", default="127.0.0.1")
+    gw.add_argument("--http-port", type=int, default=5080)
+    gw.add_argument("--health-interval", type=float, default=1.0,
+                    help="seconds between /stats health probes")
+    gw.add_argument("--probe-timeout", type=float, default=2.0)
+    gw.add_argument("--evict-sustain", type=int, default=3,
+                    help="consecutive failures before a replica is "
+                         "evicted from routing")
+    gw.add_argument("--readmit-cooldown", type=float, default=5.0,
+                    help="seconds a recovered replica must wait before "
+                         "readmission")
+    gw.add_argument("--min-prefix-tokens", type=int, default=16,
+                    help="shortest prefix match that beats the hash "
+                         "fallback")
+    gw.add_argument("--route-block-tokens", type=int, default=16,
+                    help="prefix-index granularity in tokens (match the "
+                         "replicas' --kv-block-tokens)")
+    gw.add_argument("--load-factor", type=float, default=2.0,
+                    help="hashed picks above load_factor x (1 + fleet "
+                         "mean load) are skipped down the rendezvous "
+                         "order")
+    gw.add_argument("--retry-limit", type=int, default=1,
+                    help="alternate replicas tried when the routed one "
+                         "dies before first token")
+    gw.add_argument("--proxy-timeout", type=float, default=0.0,
+                    help="per-socket replica timeout in seconds "
+                         "(0 = none)")
+    gw.set_defaults(fn=cmd_gateway)
 
     sv = sub.add_parser("server", help="integrated root server: collect, "
                         "profile, plan, distribute, serve")

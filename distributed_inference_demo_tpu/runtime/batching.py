@@ -238,8 +238,8 @@ class ContinuousBatchingEngine:
         like the dense cache did).  ``kv_cache_blocks`` sizes the pool
         (0/None = the dense-equivalent ``B x table_width`` — there is
         no cache-off mode: the pool is the decode cache).  The dense
-        batch cache is deleted; "dense" survives one release as the
-        single-request engines' escape hatch and is rejected here.
+        batch cache is deleted, and since the gateway release the
+        dense layout itself is gone everywhere (docs/DESIGN.md §14).
 
         ``max_queue_depth``: overload shedding — when the admission
         queue (submitted-but-unslotted requests) already holds this
@@ -292,13 +292,10 @@ class ContinuousBatchingEngine:
         self.kv_layout = resolve_kv_layout(kv_layout)
         if self.kv_layout != "paged":
             raise ValueError(
-                "kv_layout='dense' is not supported by the paged-native "
-                "continuous-batching scheduler: its slot cache IS the "
-                "device page pool (docs/DESIGN.md §14) — the dense "
-                "batch cache was deleted when paged became the "
-                "universal default.  The dense escape hatch survives "
-                "on the single-request engines (serve/generate without "
-                "--batch-slots).")
+                f"kv_layout={self.kv_layout!r} is not supported by the "
+                "paged-native continuous-batching scheduler: its slot "
+                "cache IS the device page pool (docs/DESIGN.md §14); "
+                "paged is the only layout (dense was removed).")
         n_blocks_arg, block_tokens = resolve_kvcache_config(
             kv_cache_blocks, kv_block_tokens, default_blocks=0)
         if block_tokens < 1:

@@ -86,8 +86,8 @@ class StageRuntime:
         batching) share the pool instead of each reserving worst-case
         rows.  Pool size: ``DWT_STAGE_KV_BLOCKS`` (default
         ``DWT_STAGE_KV_ROWS`` = 16 rows' worth); exhaustion raises
-        loudly rather than silently evicting live KV.  "dense" keeps
-        the per-rid ``[b, max_seq]`` rows."""
+        loudly rather than silently evicting live KV.  Paged is the
+        only layout ("dense" was removed — docs/DESIGN.md §14)."""
         self.cfg = cfg
         self.spec = spec
         self.max_seq = max_seq

@@ -291,11 +291,12 @@ class InferenceEngine:
         ``runtime/kvcache`` backend seam (docs/DESIGN.md §14).  "paged"
         (the default) keeps the pool device-resident: hits gather pages
         into the fresh cache on device and stores scatter blocks back —
-        zero bytes cross the host boundary either way.  "dense" is the
-        one-release escape hatch: the §10 host pool (H2D on hit, D2H on
-        store).  Either way the ONE request in flight decodes against a
-        dense working cache its decode loop donates — the layout
-        governs the standing pool, which is where reserved HBM lives.
+        zero bytes cross the host boundary either way; it is the ONLY
+        layout ("dense", the §10 host-pool escape hatch, was removed
+        after its one-release deprecation).  The ONE request in flight
+        decodes against a dense working cache its decode loop donates —
+        the layout governs the standing pool, which is where reserved
+        HBM lives.
 
         ``mesh``: a ``jax.sharding.Mesh`` with a ``tp`` axis — every
         forward then runs inside a shard_map with Megatron-sliced weights

@@ -1,0 +1,405 @@
+"""The gateway HTTP process: cache-aware proxy over N engine replicas.
+
+A standalone :class:`GatewayHTTPServer` (docs/DESIGN.md §16) speaking
+the same surface as ``runtime/http_server.py`` — ``/health``,
+``/stats``, ``/metrics``, ``/debugz``, ``/trace`` — plus the one route
+that matters: ``/generate``, proxied to the replica the
+:class:`~.router.PrefixAwareRouter` picks.
+
+Proxy contract (the hard-won parts):
+
+- **one-shot body read, streamed response**: the request body is read
+  once and replayed verbatim on retry; the replica's response streams
+  through line-by-line (replicas emit chunked JSONL), so the gateway
+  adds one line of latency, not one response of buffering.
+- **retry before first token only**: a replica that dies (connect
+  refused, socket reset, anything but a clean HTTP status) before the
+  gateway has forwarded ANY body byte is struck in the registry and
+  the request is replayed on the next candidate — bounded by
+  ``retry_limit``.  The instant one byte has been forwarded the
+  gateway never retries: the client has seen output, and a replay
+  could diverge.  A mid-stream death becomes an ``{"error": ...}``
+  JSONL line + clean termination (the exact contract engines use for
+  their own mid-stream failures), never a hang.
+- **federated admission**: a replica's own ``503/429 + Retry-After``
+  (runtime/overload.py) propagates to the client verbatim — the
+  replica already said precisely what the client should do.  Every
+  candidate down → the gateway's own
+  :class:`~..overload.GatewayOverloaded` 503.
+- **tracing**: every proxied request carries ``X-DWT-Trace-Id``; the
+  replica echoes it and logs it to its flight recorder
+  (runtime/http_server.py), and the gateway records ``route`` +
+  ``proxy`` spans under the same id — one trace id covers
+  gateway→replica, exported at ``GET /trace``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ...telemetry import catalog as _catalog
+from ...telemetry import metrics as _m
+from ...telemetry.flightrecorder import get_flight_recorder
+from ...telemetry.tracing import (SpanClock, TraceRecorder, new_trace_id,
+                                  to_chrome_trace)
+from ..overload import GatewayOverloaded, SchedulerOverloaded
+
+_HOP_HEADERS = {"transfer-encoding", "connection", "keep-alive",
+                "content-length"}
+
+
+class _ReplicaDied(RuntimeError):
+    """The replica failed without producing a clean HTTP response (or
+    its stream broke before the first body byte was forwarded)."""
+
+
+class GatewayHTTPServer:
+    """Threaded HTTP gateway over a registry + router pair."""
+
+    def __init__(self, registry, router, host: str = "127.0.0.1",
+                 port: int = 0, *, retry_limit: int = 1,
+                 proxy_timeout_s: Optional[float] = None):
+        """``retry_limit``: additional replicas tried after the routed
+        one dies before first token.  ``proxy_timeout_s``: per-socket
+        timeout on replica connections (None = no deadline; streams
+        with long decode gaps need None or a generous value)."""
+        self.registry = registry
+        self.router = router
+        self.retry_limit = max(0, int(retry_limit))
+        self.proxy_timeout_s = proxy_timeout_s
+        self.tracer = TraceRecorder("gateway")
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):   # quiet by default
+                pass
+
+            # bounded route labels, same rule as the replica server
+            _ROUTES = frozenset((
+                "/health", "/stats", "/metrics", "/trace", "/debugz",
+                "/generate"))
+
+            def _json(self, code: int, obj: dict,
+                      headers: Optional[dict] = None) -> None:
+                route = self.path.split("?")[0]
+                if route not in self._ROUTES:
+                    route = "other"
+                _catalog.HTTP_REQUESTS.inc(route=route, code=str(code))
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _shed(self, e: SchedulerOverloaded) -> None:
+                _catalog.GATEWAY_SHED.inc()
+                self._json(getattr(e, "http_code", 503),
+                           {"error": str(e)},
+                           headers={"Retry-After":
+                                    str(max(1, int(e.retry_after_s)))})
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    try:
+                        text = _catalog.scrape()
+                        code = 200
+                    except Exception as e:
+                        text = f"# scrape error: {e}\n"
+                        code = 500
+                    body = text.encode("utf-8")
+                    self.send_response(code)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/health":
+                    ups = outer.registry.up_replicas()
+                    self._json(200, {
+                        "status": "ok" if ups else "degraded",
+                        "role": "gateway",
+                        "replicas_up": len(ups),
+                        "replicas": outer.registry.replica_ids(),
+                    })
+                elif path == "/stats":
+                    self._json(200, outer.stats())
+                elif path == "/trace":
+                    self._json(200, to_chrome_trace(outer.tracer.drain()))
+                elif path == "/debugz":
+                    try:
+                        self._json(200, outer._debugz())
+                    except Exception as e:
+                        self._json(500, {"error": str(e)})
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n) or b"{}"
+                    req = json.loads(raw)
+                except (ValueError, KeyError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                try:
+                    outer._proxy_generate(self, raw, req)
+                except SchedulerOverloaded as e:
+                    self._shed(e)
+                except Exception as e:
+                    self._json(500, {"error": str(e)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self.httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the proxy ---------------------------------------------------------
+
+    @staticmethod
+    def _routing_tokens(req: dict):
+        """The token key the router matches on: the first prompt row.
+        Text prompts have no gateway-side tokens (no tokenizer here) —
+        they ride the hash fallback keyed on the text bytes."""
+        ids = req.get("prompt_ids")
+        if ids is None:
+            prompt = req.get("prompt")
+            if isinstance(prompt, str) and prompt:
+                # stable per-byte pseudo-tokens: equal texts share hash
+                # and prefix keys without a tokenizer
+                return [b for b in prompt.encode("utf-8")[:256]]
+            return None
+        try:
+            row = ids[0] if ids and isinstance(ids[0], list) else ids
+            return [int(t) for t in row]
+        except (TypeError, ValueError):
+            return None
+
+    def _proxy_generate(self, handler, raw: bytes, req: dict) -> None:
+        tokens = self._routing_tokens(req)
+        trace_id = new_trace_id()
+        route_clock = SpanClock()
+        decision = self.router.route(tokens)    # raises GatewayOverloaded
+        route_span = self.tracer.record(
+            "gateway.route", trace_id, clock=route_clock,
+            replica=decision.rid, policy=decision.policy,
+            match_tokens=decision.match_tokens)
+
+        candidates = [decision.rid] + decision.candidates[:self.retry_limit]
+        ttft_clock = SpanClock()
+        last_err: Optional[Exception] = None
+        for attempt, rid in enumerate(candidates):
+            if attempt > 0:
+                if not self.registry.is_up(rid):
+                    continue
+                _catalog.GATEWAY_RETRIED.inc()
+                get_flight_recorder().record(
+                    "gateway_retry", replica=rid, attempt=attempt,
+                    trace_id=f"{trace_id:016x}")
+            self.router.acquire(rid)
+            proxy_clock = SpanClock()
+            try:
+                done = self._proxy_once(handler, rid, raw, trace_id,
+                                        ttft_clock, decision, attempt)
+            except _ReplicaDied as e:
+                last_err = e
+                self.registry.record_failure(rid, reason=str(e))
+                continue
+            finally:
+                self.router.release(rid)
+                self.tracer.record(
+                    "gateway.proxy", trace_id, parent_id=route_span,
+                    clock=proxy_clock, replica=rid, attempt=attempt)
+            if done and tokens and decision.policy in ("prefix", "hash"):
+                # the replica now holds this prompt's blocks: teach the
+                # index so the NEXT request sharing the prefix sticks
+                self.router.record(rid, tokens)
+            return
+        raise GatewayOverloaded(
+            "request failed on every candidate replica before first "
+            f"token (tried {len(candidates)}; last error: {last_err})",
+            retry_after_s=2.0)
+
+    def _proxy_once(self, handler, rid: str, raw: bytes, trace_id: int,
+                    ttft_clock: SpanClock, decision, attempt: int) -> bool:
+        """Proxy one attempt to ``rid``.  Returns True on a 2xx the
+        client fully received; raises :class:`_ReplicaDied` when safe
+        to retry (no body byte forwarded); propagates replica HTTP
+        errors (including 503/429 shedding) as final answers."""
+        host, port = self.registry.endpoint(rid)
+        conn = HTTPConnection(host, port, timeout=self.proxy_timeout_s)
+        try:
+            try:
+                conn.request("POST", "/generate", body=raw, headers={
+                    "Content-Type": "application/json",
+                    "X-DWT-Trace-Id": f"{trace_id:016x}",
+                })
+                resp = conn.getresponse()
+            except Exception as e:
+                raise _ReplicaDied(f"{rid}: {e}") from e
+
+            if resp.status in (503, 429):
+                # federated admission: the replica's shed is the
+                # answer — propagate its Retry-After verbatim
+                _catalog.GATEWAY_SHED.inc()
+                body = resp.read()
+                retry_after = resp.getheader("Retry-After") or "1"
+                handler._json(resp.status,
+                              _safe_json(body),
+                              headers={"Retry-After": retry_after})
+                return False
+            if resp.status != 200:
+                handler._json(resp.status, _safe_json(resp.read()))
+                return False
+
+            self.registry.record_success(rid)
+            chunked = (resp.getheader("Transfer-Encoding", "")
+                       .lower() == "chunked")
+            if not chunked:
+                body = resp.read()
+                _catalog.GATEWAY_PROXY_TTFT_SECONDS.observe(
+                    ttft_clock.seconds)
+                _catalog.HTTP_REQUESTS.inc(route="/generate", code="200")
+                handler.send_response(200)
+                ct = resp.getheader("Content-Type", "application/json")
+                handler.send_header("Content-Type", ct)
+                handler.send_header("Content-Length", str(len(body)))
+                handler.send_header("X-DWT-Replica", rid)
+                handler.end_headers()
+                handler.wfile.write(body)
+                return True
+
+            # streaming: forward JSONL lines as our own chunked body.
+            # Pull the FIRST line before committing to 200 (a replica
+            # that dies pre-first-token must stay retryable).
+            try:
+                first = resp.readline()
+            except Exception as e:
+                raise _ReplicaDied(f"{rid}: stream died before first "
+                                   f"token: {e}") from e
+            if not first:
+                raise _ReplicaDied(f"{rid}: empty stream before first "
+                                   "token")
+            _catalog.GATEWAY_PROXY_TTFT_SECONDS.observe(ttft_clock.seconds)
+            _catalog.HTTP_REQUESTS.inc(route="/generate", code="200")
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/jsonl")
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.send_header("X-DWT-Replica", rid)
+            handler.end_headers()
+
+            def chunk(data: bytes) -> None:
+                handler.wfile.write(f"{len(data):x}\r\n".encode())
+                handler.wfile.write(data + b"\r\n")
+
+            sent_any = False
+            try:
+                chunk(first)
+                sent_any = True
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        # readline() reports a SEVERED chunked stream
+                        # as a clean EOF: http.client's peek swallows
+                        # the IncompleteRead AND closes the response,
+                        # so read() cannot re-raise either.  The one
+                        # surviving signal is chunk_left — a clean
+                        # termination walks through the 0-chunk and
+                        # leaves it None; a replica that died without
+                        # it leaves 0 (or the unread remainder)
+                        if resp.chunk_left is not None:
+                            raise RuntimeError(
+                                "chunked stream severed before the "
+                                "terminating chunk")
+                        break
+                    chunk(line)
+            except OSError:
+                return True      # our client went away; nothing to do
+            except Exception as e:
+                # replica died MID-stream, after first token: no retry
+                # (the client saw output) — an error line + clean
+                # termination, the engines' own mid-stream contract
+                if sent_any:
+                    try:
+                        chunk((json.dumps(
+                            {"error": f"replica {rid} died mid-stream: "
+                                      f"{e}"}) + "\n").encode())
+                    except OSError:
+                        return True
+                self.registry.record_failure(rid, reason="mid-stream")
+            try:
+                chunk(b"")
+                handler.wfile.flush()
+            except OSError:
+                pass
+            return True
+        finally:
+            conn.close()
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        ups = self.registry.up_replicas()
+        return {
+            "role": "gateway",
+            "replicas_up": len(ups),
+            "replicas": self.registry.debug_state()["replicas"],
+            "routing": self.router.routing_table(),
+        }
+
+    def _debugz(self) -> dict:
+        from ...telemetry import flightrecorder, postmortem
+        return {
+            "flight": flightrecorder.debug_state(),
+            "registry": self.registry.debug_state(),
+            "routing": self.router.routing_table(),
+            "postmortem": postmortem.debug_state(),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.registry.start()
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self.registry.start()
+        try:
+            self.httpd.serve_forever()
+        finally:
+            self.registry.stop()
+
+    def shutdown(self) -> None:
+        self.registry.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+def _safe_json(body: bytes) -> dict:
+    try:
+        out = json.loads(body)
+        return out if isinstance(out, dict) else {"error": str(out)}
+    except Exception:
+        return {"error": body.decode("utf-8", "replace")[:512]}
+
+
+# re-exported for callers that only import the server module
+__all__ = ["GatewayHTTPServer", "GatewayOverloaded"]

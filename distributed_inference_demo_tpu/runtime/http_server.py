@@ -431,6 +431,9 @@ class InferenceHTTPServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                tid = getattr(self, "_trace_id", None)
+                if tid:
+                    self.send_header("X-DWT-Trace-Id", tid)
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
@@ -520,6 +523,18 @@ class InferenceHTTPServer:
                 if self.path != "/generate":
                     self._json(404, {"error": f"no route {self.path}"})
                     return
+                # gateway trace propagation (docs/DESIGN.md §16): a
+                # proxied request carries the gateway's trace id — echo
+                # it on every response and land it in the flight
+                # recorder, so one id joins gateway spans, replica
+                # flight events, and the client's copy of the response
+                tid = self.headers.get("X-DWT-Trace-Id")
+                if tid:
+                    self._trace_id = tid[:64]
+                    from ..telemetry.flightrecorder import \
+                        get_flight_recorder
+                    get_flight_recorder().record(
+                        "http_generate_proxied", trace_id=self._trace_id)
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -791,6 +806,9 @@ class InferenceHTTPServer:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/jsonl")
                 self.send_header("Transfer-Encoding", "chunked")
+                tid = getattr(self, "_trace_id", None)
+                if tid:
+                    self.send_header("X-DWT-Trace-Id", tid)
                 self.end_headers()
 
                 def chunk(data: bytes) -> None:

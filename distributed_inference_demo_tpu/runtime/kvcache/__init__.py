@@ -1,95 +1,68 @@
 """Block-level KV cache with radix-tree prefix sharing (docs/DESIGN.md
-§10 dense layout, §11 paged layout, §14 universal-paged contract).
+§10 host pool, §11 paged layout, §14 universal-paged contract).
 
-The single prefix-reuse path for the serving stack.  Two layouts share
-the radix tree and the block granularity, both behind the
-:mod:`~.backend` seam every engine consumes:
+The single prefix-reuse path for the serving stack, behind the
+:mod:`~.backend` seam every engine consumes.  The blocks live on
+device — the batching scheduler's slot cache IS a page pool addressed
+through block tables, the ring stage workers hold per-stage page
+pools, and the single-request engines keep a device-resident prefix
+pool (:class:`~.backend.PagedKVBackend`).  Hits are device gathers /
+block-table references, stores are device scatters / ownership
+adoptions — zero bytes cross the host boundary
+(``dwt_kvcache_h2d_bytes_total == 0`` structurally).
 
-- **paged** (the DEFAULT): the blocks live on device — the batching
-  scheduler's slot cache IS a page pool addressed through block tables,
-  the ring stage workers hold per-stage page pools, and the
-  single-request engines keep a device-resident prefix pool
-  (:class:`~.backend.PagedKVBackend`).  Hits are device gathers /
-  block-table references, stores are device scatters / ownership
-  adoptions — zero bytes cross the host boundary
-  (``dwt_kvcache_h2d_bytes_total == 0`` structurally).
-- **dense** (:class:`KVCacheManager` behind
-  :class:`~.backend.DenseKVBackend`): host numpy block pool; hits pay
-  one H2D load, stores one D2H slice.  Survives one release as the
-  explicit ``--kv-layout dense`` escape hatch on the single-request
-  engines; the batching scheduler and the ring stages are paged-native.
+The dense host-pool *layout* (``--kv-layout dense``, deprecated in the
+disaggregation release) is REMOVED: :func:`resolve_kv_layout` fails
+loudly on it.  The §10 host pool itself (:class:`KVCacheManager`)
+survives as a host-staging building block, but no engine runs behind
+it — the dense backend class and the legacy require-dense shim are
+deleted, and ``tools/check_kv_layout.py`` lints that neither identifier
+regrows anywhere in the package.
 
 Layout selection: the ``kv_layout`` engine kwarg / ``--kv-layout`` flag
-over the ``DWT_KV_LAYOUT`` env knob over the default ``paged``.
+over the ``DWT_KV_LAYOUT`` env knob over the default ``paged`` — all
+three funnel through :func:`resolve_kv_layout`, the one owner.
 """
 
-import logging
 import os
 
-from .backend import (DenseKVBackend, PagedKVBackend, make_kv_backend)
+from .backend import PagedKVBackend, make_kv_backend
 from .manager import (DEFAULT_BLOCK_TOKENS, KVCacheManager, KVLease,
                       resolve_kvcache_config)
 from .paged import PagedBlockLease, PagedKVCacheManager
 from .pool import KVBlockPool
 from .radix import RadixTree
 
-KV_LAYOUTS = ("dense", "paged")
+KV_LAYOUTS = ("paged",)
 
-# The dense escape hatch is DEPRECATED (ROADMAP item 1 tail): paged has
-# been the universal default since PR 7 and dense survives exactly one
-# release for single-request-engine users who have not migrated.  This
-# names the removal so the warning below can state it, and the delete
-# PR can grep for it.
-DENSE_REMOVAL_RELEASE = "the next release (the PR after disaggregation)"
-_dense_deprecation_warned = False
-
-log = logging.getLogger(__name__)
+# The message every removed-layout path fails with — one string so the
+# CLI flag, the env knob, and the direct engine kwarg all name the same
+# removal and the same migration.
+_DENSE_REMOVED_MSG = (
+    "kv_layout='dense' was REMOVED in the gateway release "
+    "(docs/DESIGN.md §14): the host-pool escape hatch was deprecated "
+    "for one release and is deleted — drop --kv-layout dense / "
+    "DWT_KV_LAYOUT=dense; the paged layout is the only layout and "
+    "needs no flag")
 
 
 def resolve_kv_layout(kv_layout=None) -> str:
     """``kv_layout`` arg over ``DWT_KV_LAYOUT`` env over "paged".
 
-    Resolving to "dense" logs a LOUD once-per-process deprecation
-    warning naming the removal release — the one owner of layout
-    resolution is the one place the deprecation cannot be bypassed
-    (flag, env knob, and direct engine kwarg all funnel here)."""
+    The one owner of layout resolution: the removed dense layout fails
+    here, loudly, naming the removal — whether it arrives via flag, env
+    knob, or direct engine kwarg (none can bypass this funnel)."""
     layout = kv_layout or os.environ.get("DWT_KV_LAYOUT", "") or "paged"
+    if layout == "dense":
+        raise ValueError(_DENSE_REMOVED_MSG)
     if layout not in KV_LAYOUTS:
         raise ValueError(
             f"unknown kv layout {layout!r}; expected one of {KV_LAYOUTS}")
-    if layout == "dense":
-        global _dense_deprecation_warned
-        if not _dense_deprecation_warned:
-            _dense_deprecation_warned = True
-            log.warning(
-                "DEPRECATED: kv_layout='dense' (the host-pool escape "
-                "hatch) is scheduled for REMOVAL in %s; the paged "
-                "layout is the universal default (docs/DESIGN.md §14) "
-                "and every serve/generate mode accepts it — drop "
-                "--kv-layout dense / DWT_KV_LAYOUT=dense now",
-                DENSE_REMOVAL_RELEASE)
-    return layout
-
-
-def require_dense_kv_layout(mode: str, kv_layout=None) -> str:
-    """LEGACY guard from the §11 rejection-matrix era: honors "dense",
-    raises on "paged".  Every production call site is gone — the matrix
-    is dissolved; every engine and CLI mode accepts the paged layout
-    (docs/DESIGN.md §14) — and ``tools/check_kv_layout.py`` lints that
-    none regrows outside this package.  Kept only so an out-of-tree
-    caller that still imports it fails the same loud way it always did
-    rather than with an ImportError mid-request."""
-    layout = resolve_kv_layout(kv_layout)
-    if layout == "paged":
-        raise ValueError(
-            f"kv layout 'paged' is not supported by {mode}; use the "
-            "dense layout here")
     return layout
 
 
 __all__ = ["KVBlockPool", "KVCacheManager", "KVLease",
-           "DenseKVBackend", "PagedKVBackend", "make_kv_backend",
+           "PagedKVBackend", "make_kv_backend",
            "PagedBlockLease", "PagedKVCacheManager", "RadixTree",
            "resolve_kvcache_config", "resolve_kv_layout",
-           "require_dense_kv_layout", "DEFAULT_BLOCK_TOKENS",
-           "KV_LAYOUTS", "DENSE_REMOVAL_RELEASE"]
+           "DEFAULT_BLOCK_TOKENS", "KV_LAYOUTS"]

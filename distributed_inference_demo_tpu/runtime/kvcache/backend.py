@@ -1,10 +1,9 @@
-"""The KV backend seam: ONE prefix-reuse surface over both layouts.
+"""The KV backend seam: ONE prefix-reuse surface over the paged pool.
 
 Before this seam, every engine special-cased the dense manager inline
 (match → host gather → H2D seed; D2H slice → store) and *rejected*
 ``--kv-layout paged`` outright — the DESIGN.md §11 rejection matrix.
-The seam is the two calls an engine actually needs around its prefill,
-implemented by both layouts so the engines stop caring which one runs:
+The seam is the two calls an engine actually needs around its prefill:
 
 - ``seed(ids, cache) -> (start, cache)`` — write the longest cached
   prefix of the (batch-1) prompt into a fresh engine cache's leading
@@ -14,12 +13,12 @@ implemented by both layouts so the engines stop caring which one runs:
   blocks for the next shared-prefix request.  Runs before the decode
   program donates the cache buffers.
 
-Layouts:
+The dense host-pool backend (a hit paying one H2D gather and a store
+one D2H slice) was deleted with the ``--kv-layout dense`` escape
+hatch; the §10 :class:`~.manager.KVCacheManager` it wrapped survives
+as a host-staging building block only.
 
-- :class:`DenseKVBackend` wraps the §10 host-pool
-  :class:`~.manager.KVCacheManager`: a hit pays one H2D gather, a store
-  one D2H slice (counted in ``dwt_kvcache_h2d_bytes_total``).
-- :class:`PagedKVBackend` owns a DEVICE-resident page pool
+:class:`PagedKVBackend` owns a DEVICE-resident page pool
   ``[L, N, H, bt, D]`` plus the §11 page-id
   :class:`~.paged.PagedKVCacheManager`: seeds gather pages into the
   cache on device and stores scatter cache blocks into freshly
@@ -48,57 +47,8 @@ from typing import Optional
 
 import numpy as np
 
-from .manager import (KVCacheManager, apply_byte_budget,
-                      resolve_kvcache_config)
+from .manager import apply_byte_budget, resolve_kvcache_config
 from .paged import PagedKVCacheManager
-
-
-class DenseKVBackend:
-    """Host block pool behind the seam (docs/DESIGN.md §10)."""
-
-    layout = "dense"
-
-    def __init__(self, mgr: KVCacheManager):
-        self.mgr = mgr
-        self.block_tokens = mgr.block_tokens
-
-    def seed(self, ids, cache):
-        """Match + host gather + one fused H2D write into the fresh
-        cache's columns ``[0, m)``.  Batch-1 only (multi-row prompts
-        have no shared single prefix key)."""
-        import jax.numpy as jnp
-
-        from ...models.base import KVCache
-        from .device import seed_prefix_cache
-        if ids.shape[0] != 1:
-            return 0, cache
-        lease = self.mgr.match(np.asarray(ids[0]))
-        if lease is None:
-            return 0, cache
-        with lease:
-            m = lease.tokens
-            pk, pv = lease.gather()            # host [L, H, m, D]
-        ck, cv = seed_prefix_cache(cache.keys, cache.values,
-                                   jnp.asarray(pk[:, None]),
-                                   jnp.asarray(pv[:, None]))
-        return m, KVCache(ck, cv, jnp.int32(m))
-
-    def store(self, ids, cache) -> None:
-        if ids.shape[0] == 1:
-            self.mgr.store(np.asarray(ids[0]), cache.keys, cache.values)
-
-    @property
-    def stats(self) -> dict:
-        return self.mgr.stats
-
-    def snapshot(self) -> dict:
-        return self.mgr.snapshot()
-
-    def debug_state(self) -> dict:
-        return self.mgr.debug_state()
-
-    def reset_stats(self) -> None:
-        self.mgr.reset_stats()
 
 
 class PagedKVBackend:
@@ -202,18 +152,18 @@ def make_kv_backend(cfg, kv_cache_blocks: Optional[int],
     the layout's backend — or None when the pool is off (0 blocks, or a
     ``DWT_KVCACHE_BYTES`` ceiling below one block: a knob documented as
     a ceiling must never crash engine construction)."""
+    if layout != "paged":
+        raise ValueError(
+            f"unknown kv layout {layout!r}: paged is the only layout "
+            "(the dense backend was removed; docs/DESIGN.md §14)")
     n_blocks, block_tokens = resolve_kvcache_config(
         kv_cache_blocks, kv_block_tokens, default_blocks=default_blocks)
     if n_blocks < 1:
         return None
-    if layout == "paged":
-        dtype_ = dtype if dtype is not None else cfg.dtype
-        block_bytes = (2 * int(cfg.num_layers) * int(cfg.num_kv_heads)
-                       * int(block_tokens) * int(cfg.head_dim)
-                       * np.dtype(dtype_).itemsize)
-        if apply_byte_budget(n_blocks, block_bytes) < 1:
-            return None
-        return PagedKVBackend(cfg, n_blocks, block_tokens, dtype=dtype)
-    mgr = KVCacheManager.for_model(cfg, n_blocks, block_tokens,
-                                   dtype=dtype)
-    return DenseKVBackend(mgr) if mgr is not None else None
+    dtype_ = dtype if dtype is not None else cfg.dtype
+    block_bytes = (2 * int(cfg.num_layers) * int(cfg.num_kv_heads)
+                   * int(block_tokens) * int(cfg.head_dim)
+                   * np.dtype(dtype_).itemsize)
+    if apply_byte_budget(n_blocks, block_bytes) < 1:
+        return None
+    return PagedKVBackend(cfg, n_blocks, block_tokens, dtype=dtype)

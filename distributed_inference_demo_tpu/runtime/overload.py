@@ -3,9 +3,11 @@
 Deliberately a tiny dependency-free module: ``runtime/batching.py``
 (which raises) pulls in jax, and ``runtime/http_server.py`` (which
 catches and maps to ``503 + Retry-After``) must stay importable without
-it.  Graceful degradation is the point — a saturated admission queue
-answers *quickly and honestly* instead of queueing unboundedly until
-every client has timed out anyway (docs/DESIGN.md §12).
+it — as must the replicated serving gateway (``runtime/gateway/``),
+whose whole process holds no engine at all.  Graceful degradation is
+the point — a saturated admission queue answers *quickly and honestly*
+instead of queueing unboundedly until every client has timed out anyway
+(docs/DESIGN.md §12, §16).
 """
 
 from __future__ import annotations
@@ -24,3 +26,12 @@ class SchedulerOverloaded(RuntimeError):
         super().__init__(msg)
         self.retry_after_s = retry_after_s
         self.http_code = http_code
+
+
+class GatewayOverloaded(SchedulerOverloaded):
+    """The gateway's federated-admission rejection (docs/DESIGN.md §16):
+    no admitted replica can take this request — every replica is evicted
+    from routing, or every candidate answered its own 503/429.  A
+    subclass so the HTTP layer's one ``_shed`` path renders it; the
+    distinct type lets tests (and operators reading tracebacks) tell a
+    gateway-level shed from a replica's own admission rejection."""

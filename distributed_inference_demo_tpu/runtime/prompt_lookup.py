@@ -112,9 +112,9 @@ class PromptLookupEngine:
         blocks with an earlier prefill seeds its cache and prefills only
         the suffix — exactness is a prefill-side property, so it
         composes with the n-gram proposer untouched (the history buffer
-        still seeds from the full ids).  Default off (0 blocks); layout
-        "paged" (default) keeps the pool device-resident, "dense" is
-        the host-pool escape hatch."""
+        still seeds from the full ids).  Default off (0 blocks); the
+        pool is device-resident ("paged" is the only layout — "dense"
+        was removed, docs/DESIGN.md §14)."""
         if num_draft < 1:
             raise ValueError("num_draft must be >= 1")
         from .kvcache import resolve_kv_layout

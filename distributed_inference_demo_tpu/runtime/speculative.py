@@ -295,8 +295,8 @@ class SpeculativeEngine:
         device-resident, so two speculative requests sharing a prompt
         prefix reference the SAME pages in HBM (the accepted prefix is
         never duplicated; pinned by the ownership tests) and hits move
-        zero bytes through the host; "dense" is the §10 host-pool
-        escape hatch."""
+        zero bytes through the host; it is the ONLY layout ("dense"
+        was removed — docs/DESIGN.md §14)."""
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
                 f"draft vocab ({draft_cfg.vocab_size}) != target vocab "

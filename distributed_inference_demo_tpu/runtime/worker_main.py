@@ -184,12 +184,12 @@ def main(argv=None) -> int:
                     help="reduced-precision KV cache storage for this "
                          "stage, e.g. float8_e4m3fn")
     ap.add_argument("--kv-layout", default=None,
-                    choices=["dense", "paged"],
-                    help="this stage's request-cache layout (default "
-                         "DWT_KV_LAYOUT, else paged): paged backs every "
-                         "rid with one per-stage page pool — blocks "
-                         "reserved per chunk actually run, freed on "
-                         "end:{rid}; dense keeps per-rid max_seq rows")
+                    choices=["paged"],
+                    help="this stage's request-cache layout (paged is "
+                         "the only layout: every rid backed by one "
+                         "per-stage page pool — blocks reserved per "
+                         "chunk actually run, freed on end:{rid}; "
+                         "'dense' was removed — docs/DESIGN.md §14)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over this host's first N "
                          "local devices (pipeline x tp)")

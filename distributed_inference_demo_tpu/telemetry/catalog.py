@@ -359,6 +359,68 @@ DISAGG_INFLIGHT = gauge(
     "phases (handoff + migration + decode)")
 
 
+# -- replicated serving gateway series (docs/DESIGN.md §16) ----------------
+# event-driven from runtime/gateway/: the gateway process holds no
+# engine backend, so nothing here is snapshot-bridged — every series is
+# incremented at the moment the routing/proxy decision happens.
+
+GATEWAY_PREFIX_ROUTED = counter(
+    "dwt_gateway_prefix_routed_requests_total",
+    "Requests routed by the prefix-aware policy: the chosen replica's "
+    "routing-history index held the longest matching token prefix at "
+    "or above the min-length threshold")
+GATEWAY_HASHED = counter(
+    "dwt_gateway_hashed_requests_total",
+    "Requests routed by the consistent-hash-with-bounded-load "
+    "fallback (no replica's index matched enough prefix, or routing "
+    "keys were unavailable)")
+GATEWAY_RETRIED = counter(
+    "dwt_gateway_retried_requests_total",
+    "Requests re-proxied to an alternate replica after the first "
+    "choice failed BEFORE its first streamed token (past first token "
+    "the gateway never retries: the client already saw output)")
+GATEWAY_SHED = counter(
+    "dwt_gateway_shed_requests_total",
+    "Requests the gateway answered 503/429: every replica down, every "
+    "candidate overloaded, or a replica's Retry-After propagated "
+    "through federated admission")
+GATEWAY_REPLICA_DOWN = counter(
+    "dwt_gateway_replica_down_total",
+    "Replica up->down transitions: health probes (or proxy failures) "
+    "breached the sustain threshold and the registry evicted the "
+    "replica from routing")
+GATEWAY_REPLICA_UP = counter(
+    "dwt_gateway_replica_up_total",
+    "Replica down->up transitions: a probe succeeded after the "
+    "readmission cooldown and the registry restored the replica")
+GATEWAY_UP_REPLICAS = gauge(
+    "dwt_gateway_up_replicas",
+    "Replicas currently admitted to routing (registered minus "
+    "evicted)")
+GATEWAY_PREFIX_HIT_RATIO = gauge(
+    "dwt_gateway_prefix_hit_ratio",
+    "Per-replica estimate of the fraction of routed requests whose "
+    "prefix the replica's cache already held (gateway-side estimate "
+    "from its routing-history index; reconcile against the replica's "
+    "own dwt_kvcache_hits_total)", ("replica",))
+GATEWAY_INDEX_ENTRIES = gauge(
+    "dwt_gateway_index_entries",
+    "Token-prefix routing-history index entries per replica (bounded; "
+    "reconciled against replica-reported dwt_kvcache_* stats)",
+    ("replica",))
+GATEWAY_QUEUE_DEPTH = gauge(
+    "dwt_gateway_queue_depth_requests",
+    "Last replica-reported admission queue depth (from /stats), per "
+    "replica — the bounded-load signal for the hash fallback",
+    ("replica",))
+GATEWAY_PROXY_TTFT_SECONDS = histogram(
+    "dwt_gateway_proxy_ttft_seconds",
+    "Gateway-observed time from accepting /generate to the first "
+    "byte proxied back from the replica (includes routing, replica "
+    "queueing, and prefill)",
+    buckets=LATENCY_BUCKETS_S)
+
+
 # -- flight recorder / anomaly series --------------------------------------
 
 FLIGHT_EVENTS = counter(

@@ -770,7 +770,8 @@ def test_decode_block_eos_mid_block(params, oracle):
         np.testing.assert_array_equal(got, list(ref[:5]))
 
 
-@pytest.mark.parametrize("mode", ["draft", "pld"])
+@pytest.mark.parametrize("mode", [
+    pytest.param("draft", marks=pytest.mark.slow), "pld"])
 def test_decode_block_composes_with_speculation(params, draft_params,
                                                 oracle, mode):
     """decode_block in the speculative modes fuses N draft/verify ROUNDS
@@ -862,7 +863,8 @@ def test_chunked_admission_composes_with_prefix_cache(params, oracle):
         assert eng.stats()["chunked_prefill"]["chunks"] == 3
 
 
-@pytest.mark.parametrize("mode", ["draft", "pld"])
+@pytest.mark.parametrize("mode", [
+    pytest.param("draft", marks=pytest.mark.slow), "pld"])
 def test_chunked_admission_composes_with_speculation(params, draft_params,
                                                      oracle, mode):
     """Chunked target-side admission under both speculative proposers:

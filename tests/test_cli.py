@@ -418,6 +418,7 @@ def test_http_batching_with_draft(http_server):
         backend.close()
 
 
+@pytest.mark.slow
 def test_cli_generate_sp_matches_plain():
     """generate --sp 2 (ring AND ulysses) on the virtual mesh must equal
     plain greedy decode; non-divisible prompts and mode mixing are

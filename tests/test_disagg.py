@@ -14,13 +14,13 @@ The ISSUE-8 invariants, pinned:
 - migration frames are idempotent under duplication (the (rid,
   attempt, seq) dedup) and stale attempts are discarded;
 - both roles surface migration state on their debug surfaces;
-- ``--kv-layout dense`` logs the removal-release deprecation warning.
+- ``--kv-layout dense`` fails loudly naming its removal (the escape
+  hatch was deprecation-staged here and deleted in the gateway PR).
 
 The chaos-side invariants (faulted migration, prefill crash
 rescheduling) live in tests/test_chaos.py.
 """
 
-import logging
 import threading
 import time
 
@@ -343,30 +343,20 @@ def test_worker_cli_stage_role_still_rejects_kv_cache_flags(capsys):
     assert "not supported" in capsys.readouterr().err
 
 
-def test_dense_layout_logs_removal_deprecation(caplog):
-    """ROADMAP item 1 tail: the dense escape hatch is deprecation-
-    staged — resolving to 'dense' (flag, env, or kwarg: one owner)
-    logs a loud warning naming the removal release, once per
-    process."""
+def test_dense_layout_removed_fails_loudly():
+    """ROADMAP item 1 tail, final stage: the dense escape hatch
+    (deprecation-staged in this PR's predecessor) is DELETED —
+    resolving to 'dense' (flag, env, or kwarg: one owner) raises a
+    ValueError naming the removal and the migration, and the
+    once-per-process module-global warning latch is gone with it."""
     import distributed_inference_demo_tpu.runtime.kvcache as kvc
-    kvc._dense_deprecation_warned = False
-    with caplog.at_level(logging.WARNING,
-                         logger="distributed_inference_demo_tpu"
-                                ".runtime.kvcache"):
-        assert kvc.resolve_kv_layout("dense") == "dense"
-    msgs = [r.message for r in caplog.records
-            if "DEPRECATED" in r.message]
-    assert msgs and "REMOVAL" in msgs[0]
-    assert kvc.DENSE_REMOVAL_RELEASE in msgs[0]
-    # once per process: a second resolve stays quiet
-    caplog.clear()
-    with caplog.at_level(logging.WARNING,
-                         logger="distributed_inference_demo_tpu"
-                                ".runtime.kvcache"):
+    with pytest.raises(ValueError) as ei:
         kvc.resolve_kv_layout("dense")
-    assert not [r for r in caplog.records if "DEPRECATED" in r.message]
-    # paged never warns
-    kvc._dense_deprecation_warned = False
-    with caplog.at_level(logging.WARNING):
-        assert kvc.resolve_kv_layout(None) == "paged"
-    assert not [r for r in caplog.records if "DEPRECATED" in r.message]
+    msg = str(ei.value)
+    assert "REMOVED" in msg and "paged" in msg
+    # the deprecation scaffolding is deleted, not just unused
+    assert not hasattr(kvc, "_dense_deprecation_warned")
+    assert not hasattr(kvc, "DENSE_REMOVAL_RELEASE")
+    assert kvc.KV_LAYOUTS == ("paged",)
+    # paged resolves clean
+    assert kvc.resolve_kv_layout(None) == "paged"

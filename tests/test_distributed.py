@@ -78,7 +78,8 @@ PROMPT = np.array([[5, 17, 42, 7, 99, 3, 12, 56]], dtype=np.int32)
     ("llama-test", 2),          # BASELINE config #1 shape: 2-way split
     ("llama-test", 3),
     ("bloom-test", 2),          # reference bloom family
-    ("mixtral-test", 2),        # MoE across the cut
+    # MoE across the cut — slow lane: test_expert pins EP-stage parity
+    pytest.param("mixtral-test", 2, marks=pytest.mark.slow),
 ])
 def test_pipeline_matches_single_engine(model, num_stages):
     want = reference_tokens(model, PROMPT, 12)
@@ -239,6 +240,7 @@ def test_two_process_pipeline_worker_tp(tmp_path):
         header_transport.close()
 
 
+@pytest.mark.slow
 def test_pipeline_fp8_kv_cache_matches_fp8_engine():
     """--chain --kv-cache-dtype: every stage stores its own layers' K/V
     at fp8 with the engine's insert-cast/read-upcast contract, so the
@@ -274,6 +276,7 @@ def test_pipeline_fp8_kv_cache_matches_fp8_engine():
 # dynamic batching over the pipeline (serve --chain --pool-size)
 
 
+@pytest.mark.slow
 def test_dynamic_batching_backend_concurrent_parity():
     """Concurrent requests with DIFFERENT lengths group into
     generate_many windows and each comes out bit-exact; stats/classify

@@ -148,6 +148,7 @@ def test_live_migration_scale_up():
         t.join(timeout=30)
 
 
+@pytest.mark.slow
 def test_failure_mid_generation_resumes():
     """A mid-chain worker dies after 4 chunks; a failure signal triggers
     re-planning and the request resumes, producing the exact reference

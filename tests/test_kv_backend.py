@@ -1,20 +1,28 @@
 """The universal-paged KV contract (docs/DESIGN.md §14).
 
-Paged is the DEFAULT layout everywhere; dense survives as the explicit
-escape hatch on the single-request engines.  The oracle is bit-identity:
-the layout is a memory architecture, never a semantics change — so for
-every engine in the matrix, paged-vs-dense output (greedy AND sampled,
-cold AND radix-primed) must match token for token, and after every
-request the page-leak invariant holds (``used == tree.block_count``
-with zero live leases: pages are tree-owned or free, nothing dangles).
+Paged is the ONLY layout — the dense escape hatch and its backend were
+deleted (the gateway release), which retired the dense-parity twin
+matrix this file used to run.  What survives is everything the twins
+actually proved about the paged path, now pinned directly:
+
+- determinism: cold vs radix-primed runs agree bit-for-bit (a prefix
+  hit is a memory optimization, never a semantics change) — greedy in
+  tier-1, sampled + fused streaming on the slow lane;
+- the zero-copy claim: ``h2d_bytes == 0`` after primed runs (hits are
+  device gathers, never host round-trips);
+- the page-leak invariant after every request: ``used ==
+  tree.block_count`` with zero live leases (pages are tree-owned or
+  free, nothing dangles);
+- speculative page-sharing ownership (two requests sharing a prefix
+  reference the SAME pages in HBM);
+- the ring-stage per-stage pool frees every page on ``free(rid)``;
+- the sp backend surfaces the universal layout and the removed dense
+  layout fails loudly naming the removal.
 
 The paged-primed coverage for the batching scheduler, chunked prefill,
 ``stream_block`` fusion, and the speculative slot modes lives in
-tests/test_paged_batching.py, tests/test_kvcache.py (which exercise the
-default = paged backend), and tests/test_device_loop.py; this file pins
-what those do not: the dense escape hatch's parity, sampled-path
-parity, the tp-mesh and ring-stage paged paths, and the speculative
-page-sharing ownership story.
+tests/test_paged_batching.py, tests/test_kvcache.py, and
+tests/test_device_loop.py.
 """
 
 import sys
@@ -33,8 +41,6 @@ from distributed_inference_demo_tpu.models.decoder import init_full_params
 from distributed_inference_demo_tpu.ops.sampling import SamplingParams
 from distributed_inference_demo_tpu.runtime import (InferenceEngine,
                                                     SpeculativeEngine)
-from distributed_inference_demo_tpu.runtime.prompt_lookup import (
-    PromptLookupEngine)
 
 CFG = get_model_config("llama-test")
 GREEDY = SamplingParams(greedy=True)
@@ -57,28 +63,19 @@ def assert_drained(backend):
     assert backend.debug_state()["leased_nodes"] == 0
 
 
-def both_layouts(make):
-    """(dense_result, paged_result) for cold + primed runs of one
-    engine recipe; asserts the paged backend drains and moved zero
-    bytes through the host."""
-    outs = []
-    for layout in ("dense", "paged"):
-        eng = make(layout)
-        prime = np.asarray([SHARED + [90]])
-        run = (lambda p: eng.generate(p, 8)) if not isinstance(
-            eng, tuple) else None
-        cold = eng.generate(PROMPT, 8)
-        eng.generate(prime, 4)               # prime the radix tree
-        primed = eng.generate(PROMPT, 8)
-        snap = eng.kv_cache.snapshot()
-        assert snap["hits"] >= 1, layout
-        if layout == "paged":
-            assert snap["h2d_bytes"] == 0
-            assert_drained(eng.kv_cache)
-        else:
-            assert snap["h2d_bytes"] > 0     # the dense cost paged deletes
-        outs.append((cold, primed))
-    return outs
+def cold_and_primed(eng):
+    """(cold, primed) results for one engine; asserts the primed run
+    hit the radix tree, moved zero bytes through the host, and the
+    pool drained."""
+    prime = np.asarray([SHARED + [90]])
+    cold = eng.generate(PROMPT, 8)
+    eng.generate(prime, 4)                   # prime the radix tree
+    primed = eng.generate(PROMPT, 8)
+    snap = eng.kv_cache.snapshot()
+    assert snap["hits"] >= 1
+    assert snap["h2d_bytes"] == 0
+    assert_drained(eng.kv_cache)
+    return cold, primed
 
 
 _GREEDY_REF = []
@@ -86,8 +83,8 @@ _GREEDY_REF = []
 
 def greedy_reference(params):
     """The plain-engine greedy token reference, built at most once per
-    process (an engine build costs seconds; several parity tests pin
-    against the same stream)."""
+    process (an engine build costs seconds; several tests pin against
+    the same stream)."""
     if not _GREEDY_REF:
         _GREEDY_REF.append(InferenceEngine(
             CFG, params, max_seq=96, sampling=GREEDY,
@@ -96,76 +93,34 @@ def greedy_reference(params):
 
 
 @pytest.mark.quick
-def test_plain_engine_paged_vs_dense_greedy(params):
-    """InferenceEngine: the dense escape hatch and the paged default
-    agree bit-for-bit — greedy, cold and radix-primed (the tier-1
-    layout-parity oracle; the sampled + fused-streaming matrix rides
-    the slow lane now that dense is deprecation-staged)."""
-    (d_cold, d_primed), (p_cold, p_primed) = both_layouts(
-        lambda layout: InferenceEngine(
-            CFG, params, max_seq=96, sampling=GREEDY,
-            kv_layout=layout, **POOL))
-    np.testing.assert_array_equal(d_cold.tokens, p_cold.tokens)
-    np.testing.assert_array_equal(d_primed.tokens, p_primed.tokens)
-    np.testing.assert_array_equal(d_cold.tokens, d_primed.tokens)
+def test_plain_engine_paged_cold_primed_greedy(params):
+    """InferenceEngine: a radix-primed greedy run agrees bit-for-bit
+    with the cold run and with the shared reference (the tier-1
+    prefix-hit oracle; sampled + fused streaming ride the slow
+    lane)."""
+    cold, primed = cold_and_primed(InferenceEngine(
+        CFG, params, max_seq=96, sampling=GREEDY, **POOL))
+    np.testing.assert_array_equal(cold.tokens, primed.tokens)
+    np.testing.assert_array_equal(cold.tokens, greedy_reference(params))
 
 
 @pytest.mark.slow
-def test_plain_engine_paged_vs_dense_sampled_and_fused(params):
-    """The rest of the plain-engine layout matrix: SAMPLED parity and
-    fused streaming (stream_block > 1) over a primed paged pool.  Slow
-    lane: the greedy oracle above pins the shared code path in tier-1,
-    and dense is deprecation-staged (§14) — the full matrix re-buys
-    ~7 s per run."""
-    (d_cold, d_primed), (p_cold, p_primed) = both_layouts(
-        lambda layout: InferenceEngine(
-            CFG, params, max_seq=96, sampling=SAMPLED,
-            kv_layout=layout, **POOL))
-    np.testing.assert_array_equal(d_cold.tokens, p_cold.tokens)
-    np.testing.assert_array_equal(d_primed.tokens, p_primed.tokens)
-    np.testing.assert_array_equal(d_cold.tokens, d_primed.tokens)
-    greedy_tokens = greedy_reference(params)
+def test_plain_engine_paged_sampled_and_fused(params):
+    """The rest of the plain-engine matrix: seeded SAMPLED runs stay
+    deterministic across a prefix hit, and fused streaming
+    (stream_block > 1) over a primed pool matches the greedy
+    reference."""
+    cold, primed = cold_and_primed(InferenceEngine(
+        CFG, params, max_seq=96, sampling=SAMPLED, **POOL))
+    np.testing.assert_array_equal(cold.tokens, primed.tokens)
     # the device loop's K-token blocks ride the seeded-suffix path too
     fused = InferenceEngine(CFG, params, max_seq=96, sampling=GREEDY,
                             stream_block=4, **POOL)
     fused.generate(np.asarray([SHARED + [90]]), 4)       # prime
     streamed = np.concatenate(list(fused.generate_stream(PROMPT, 8)))
-    np.testing.assert_array_equal(streamed, greedy_tokens[0])
+    np.testing.assert_array_equal(streamed, greedy_reference(params)[0])
     assert fused.kv_cache.stats["hits"] >= 1
     assert_drained(fused.kv_cache)
-
-
-def _pld_layout_parity(params, sampling):
-    results = {}
-    for layout in ("dense", "paged"):
-        eng = PromptLookupEngine(CFG, params, max_seq=96,
-                                 sampling=sampling, num_draft=3,
-                                 kv_layout=layout, **POOL)
-        cold, _ = eng.generate(PROMPT, 8)
-        eng.generate(np.asarray([SHARED + [90]]), 4)
-        primed, _ = eng.generate(PROMPT, 8)
-        np.testing.assert_array_equal(cold.tokens, primed.tokens)
-        assert eng.kv_cache.stats["hits"] >= 1
-        if layout == "paged":
-            assert eng.kv_cache.snapshot()["h2d_bytes"] == 0
-            assert_drained(eng.kv_cache)
-        results[layout] = cold.tokens
-    np.testing.assert_array_equal(results["dense"], results["paged"])
-
-
-@pytest.mark.slow
-def test_prompt_lookup_engine_paged_vs_dense(params):
-    """PromptLookupEngine (NEW kv-cache consumer): both layouts, cold
-    and primed, greedy parity; paged drains.  Slow lane since dense
-    went deprecation-staged (§14): the paged half of this path is
-    pinned in tier-1 by test_prompt_lookup.py, and the greedy plain-
-    engine oracle covers the dense backend."""
-    _pld_layout_parity(params, GREEDY)
-
-
-@pytest.mark.slow
-def test_prompt_lookup_engine_paged_vs_dense_sampled(params):
-    _pld_layout_parity(params, SAMPLED)
 
 
 def test_speculative_page_sharing_ownership(params):
@@ -191,83 +146,49 @@ def test_speculative_page_sharing_ownership(params):
     assert snap2["blocks_used"] == snap1["blocks_used"]
     assert snap2["hits"] >= 1 and snap2["h2d_bytes"] == 0
     assert_drained(spec.kv_cache)
-    # dense escape hatch agrees token for token
-    dense = SpeculativeEngine(CFG, params, cfg8, params8, max_seq=96,
-                              sampling=GREEDY, num_draft=3,
-                              kv_layout="dense", **POOL)
-    rd, _ = dense.generate(PROMPT, 8)
-    np.testing.assert_array_equal(rd.tokens, r1.tokens)
-
-
-@pytest.mark.slow
-def test_tp_mesh_engine_paged_vs_dense(params, devices):
-    """tp-mesh path: the paged backend's pool composes with the
-    kv-head-sharded working cache — greedy parity across layouts on a
-    2-chip mesh, primed path included.  Slow lane since dense went
-    deprecation-staged (§14); tp×paged composition stays covered in
-    tier-1 by test_paged_batching's mesh tests."""
-    from distributed_inference_demo_tpu.parallel import (MeshConfig,
-                                                         make_mesh)
-    from distributed_inference_demo_tpu.runtime.engine import (
-        shard_engine_params)
-    mesh = make_mesh(MeshConfig(tp=2), devices[:2])
-    sharded = shard_engine_params(params, CFG, mesh)
-    toks = {}
-    for layout in ("dense", "paged"):
-        eng = InferenceEngine(CFG, sharded, max_seq=96, sampling=GREEDY,
-                              mesh=mesh, kv_layout=layout, **POOL)
-        cold = eng.generate(PROMPT, 8)
-        primed = eng.generate(PROMPT, 8)     # full-prompt radix hit
-        np.testing.assert_array_equal(cold.tokens, primed.tokens)
-        assert eng.kv_cache.stats["hits"] >= 1
-        if layout == "paged":
-            assert_drained(eng.kv_cache)
-        toks[layout] = cold.tokens
-    np.testing.assert_array_equal(toks["dense"], toks["paged"])
 
 
 @pytest.mark.quick
-def test_ring_stage_runtime_paged_vs_dense(params):
-    """The ring-stage path: a loopback single-stage StageRuntime decodes
-    the same greedy tokens on the paged per-stage pool as on dense
-    per-rid rows (prefill chunk + fused-tail steps), and ``free(rid)``
-    returns every page to the pool."""
+def test_ring_stage_runtime_paged(params):
+    """The ring-stage path: a loopback single-stage StageRuntime
+    decodes the same greedy tokens for two rids sharing one prompt
+    (prefill chunk + fused-tail steps are deterministic over the
+    per-stage page pool), and ``free(rid)`` returns every page."""
     from distributed_inference_demo_tpu.runtime.distributed import (
         StageRuntime)
     spec = StageSpec(0, 1, 0, CFG.num_layers)
     prompt = PROMPT.astype(np.int32)
+    rt = StageRuntime(CFG, spec, params, max_seq=64, sampling=GREEDY)
     toks = {}
-    for layout in ("dense", "paged"):
-        rt = StageRuntime(CFG, spec, params, max_seq=64,
-                          sampling=GREEDY, kv_layout=layout)
+    for rid in (7, 8):
         out = []
-        tok = rt.run_chunk_sample(7, 0, prompt)
+        tok = rt.run_chunk_sample(rid, 0, prompt)
         out.append(tok.copy())
         for step in range(1, 6):
-            tok = rt.run_chunk_sample(7, step, tok[:, None])
+            tok = rt.run_chunk_sample(rid, step, tok[:, None])
             out.append(tok.copy())
-        toks[layout] = np.stack(out, axis=1)
-        if layout == "paged":
-            held = sum(1 for v in rt._tables[7].flat
-                       if v != rt._sentinel)
-            assert held == -(-int(rt._rid_len[7]) // rt._bt)
-            free_before = len(rt._pool_free)
-            rt.free(7)
-            assert len(rt._pool_free) == free_before + held
-            assert not rt._tables
-    np.testing.assert_array_equal(toks["dense"], toks["paged"])
+        toks[rid] = np.stack(out, axis=1)
+    np.testing.assert_array_equal(toks[7], toks[8])
+    held = sum(1 for v in rt._tables[7].flat if v != rt._sentinel)
+    assert held == -(-int(rt._rid_len[7]) // rt._bt)
+    free_before = len(rt._pool_free)
+    rt.free(7)
+    assert len(rt._pool_free) == free_before + held
+    rt.free(8)
+    assert not rt._tables
 
 
-def test_sp_backend_accepts_both_layouts(params):
-    """The sp backend accepts the universal layout flag and surfaces it
-    on /stats (its cache is per-request sequence-sharded scratch either
-    way — documented in runtime/sp_backend.py)."""
+def test_sp_backend_paged_only(params):
+    """The sp backend accepts the universal layout flag, surfaces it on
+    /stats, and fails the removed dense layout loudly (its cache is
+    per-request sequence-sharded scratch — documented in
+    runtime/sp_backend.py)."""
     from distributed_inference_demo_tpu.parallel.mesh import local_sp_mesh
     from distributed_inference_demo_tpu.runtime.sp_backend import (
         SequenceParallelBackend)
     mesh = local_sp_mesh(2)
     be = SequenceParallelBackend(CFG, params, mesh, max_seq=64)
     assert be.stats()["kv_layout"] == "paged"
-    be2 = SequenceParallelBackend(CFG, params, mesh, max_seq=64,
-                                  kv_layout="dense")
-    assert be2.stats()["kv_layout"] == "dense"
+    with pytest.raises(ValueError, match="REMOVED"):
+        SequenceParallelBackend(CFG, params, mesh, max_seq=64,
+                                kv_layout="dense")

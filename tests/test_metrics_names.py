@@ -102,28 +102,29 @@ def _load_kv_lint():
 
 
 @pytest.mark.quick
-def test_kv_layout_rejection_matrix_stays_empty():
-    """No production module outside runtime/kvcache/ references
-    require_dense_kv_layout (docs/DESIGN.md §14): the §11 rejection
-    matrix is dissolved and this lint keeps it from silently
-    regrowing."""
+def test_kv_layout_dense_removal_stays_deleted():
+    """Zero references to the removed dense identifiers anywhere in
+    the package (docs/DESIGN.md §14): the escape hatch is deleted and
+    this lint keeps the deletion from silently regrowing."""
     kv_lint = _load_kv_lint()
     root = pathlib.Path(__file__).resolve().parents[1]
     assert kv_lint.check_kv_layout_matrix(root) == []
     assert kv_lint.main() == 0
 
 
-def test_kv_layout_lint_fires_on_a_regrown_call_site(tmp_path):
-    """The lint actually detects a regrown rejection."""
+def test_kv_layout_lint_fires_on_a_resurrected_identifier(tmp_path):
+    """The lint actually detects a resurrected dense identifier —
+    including inside runtime/kvcache/, the shim's former home."""
     kv_lint = _load_kv_lint()
     pkg = tmp_path / "distributed_inference_demo_tpu" / "runtime"
     pkg.mkdir(parents=True)
     (pkg / "new_engine.py").write_text(
-        "from .kvcache import require_dense_kv_layout\n")
-    allowed = (tmp_path / "distributed_inference_demo_tpu" / "runtime"
-               / "kvcache")
-    allowed.mkdir()
-    (allowed / "__init__.py").write_text(
-        "def require_dense_kv_layout(mode, kv_layout=None): ...\n")
+        "from .kvcache import " + "require_dense_kv_layout\n")
+    former_home = pkg / "kvcache"
+    former_home.mkdir()
+    (former_home / "__init__.py").write_text(
+        "class " + "DenseKVBackend:\n    ...\n")
     problems = kv_lint.check_kv_layout_matrix(tmp_path)
-    assert len(problems) == 1 and "new_engine.py" in problems[0]
+    assert len(problems) == 2
+    assert any("new_engine.py" in p for p in problems)
+    assert any("kvcache" in p for p in problems)

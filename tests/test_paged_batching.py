@@ -184,19 +184,24 @@ def test_paged_speculative_slot_modes_and_leak(params, oracle):
 
 
 def test_batching_rejects_dense_env_and_flag(params, monkeypatch):
-    """The scheduler is paged-native: kv_layout='dense' (flag or env)
-    must fail loudly — the dense batch cache is deleted and a knob
-    promising it must never silently run paged."""
-    with pytest.raises(ValueError, match="paged-native"):
+    """kv_layout='dense' (flag or env) must fail loudly EVERYWHERE:
+    the escape hatch is removed (docs/DESIGN.md §14) and a knob
+    promising it must never silently run paged.  The error names the
+    removal, not a generic unknown-layout complaint."""
+    with pytest.raises(ValueError, match="REMOVED"):
         ContinuousBatchingEngine(CFG, params, max_seq=64,
                                  sampling=GREEDY, kv_layout="dense")
     monkeypatch.setenv("DWT_KV_LAYOUT", "dense")
-    with pytest.raises(ValueError, match="paged-native"):
+    with pytest.raises(ValueError, match="REMOVED"):
         ContinuousBatchingEngine(CFG, params, max_seq=64,
                                  sampling=GREEDY)
-    # the single-request engines HONOR the dense escape hatch
+    # the single-request engines reject it the same way — no engine
+    # honors the removed layout
+    with pytest.raises(ValueError, match="REMOVED"):
+        InferenceEngine(CFG, params, max_seq=64, sampling=GREEDY)
+    monkeypatch.delenv("DWT_KV_LAYOUT")
     eng = InferenceEngine(CFG, params, max_seq=64, sampling=GREEDY)
-    assert eng.kv_layout == "dense"
+    assert eng.kv_layout == "paged"
 
 
 def test_decode_block_fused_parity(params, oracle):

@@ -12,7 +12,7 @@ import pytest
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from distributed_inference_demo_tpu.runtime.kvcache import (
-    PagedKVCacheManager, require_dense_kv_layout, resolve_kv_layout)
+    PagedKVCacheManager, resolve_kv_layout)
 
 
 def mgr(blocks=16, bt=4):
@@ -110,21 +110,20 @@ def test_epoch_bumps_on_store_and_evict():
 
 
 def test_layout_resolution_and_rejection(monkeypatch):
-    # paged is the universal DEFAULT (docs/DESIGN.md §14); dense is the
-    # explicit escape hatch
+    # paged is the ONLY layout (docs/DESIGN.md §14); the removed dense
+    # escape hatch fails loudly NAMING the removal, whichever door it
+    # arrives through (kwarg or env — both funnel here)
     assert resolve_kv_layout(None) == "paged"
-    assert resolve_kv_layout("dense") == "dense"
-    with pytest.raises(ValueError):
+    assert resolve_kv_layout("paged") == "paged"
+    with pytest.raises(ValueError, match="REMOVED"):
+        resolve_kv_layout("dense")
+    with pytest.raises(ValueError, match="unknown kv layout"):
         resolve_kv_layout("sparse")
     monkeypatch.setenv("DWT_KV_LAYOUT", "dense")
-    assert resolve_kv_layout(None) == "dense"
-    # the legacy shim (zero production call sites — linted by
-    # tools/check_kv_layout.py) still fails the loud way on paged
+    with pytest.raises(ValueError, match="REMOVED"):
+        resolve_kv_layout(None)
     monkeypatch.setenv("DWT_KV_LAYOUT", "paged")
-    with pytest.raises(ValueError, match="not supported by test-mode"):
-        require_dense_kv_layout("test-mode")
-    monkeypatch.setenv("DWT_KV_LAYOUT", "dense")
-    assert require_dense_kv_layout("test-mode") == "dense"
+    assert resolve_kv_layout(None) == "paged"
 
 
 def test_infeasible_alloc_does_not_flush_the_cache():

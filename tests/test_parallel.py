@@ -54,6 +54,7 @@ def test_tp_rejects_indivisible_heads(devices):
         make_tp_stage_fn(cfg, _full_spec(cfg), mesh, params)
 
 
+@pytest.mark.slow
 def test_pipeline_train_step_dp_pp_tp(devices):
     """Full training step over a dp=2 x pp=2 x tp=2 mesh: runs, loss finite,
     params update, and loss decreases over a few steps on a fixed batch."""
@@ -119,7 +120,11 @@ def test_shard_params_placement(devices):
                              cfg.num_heads * cfg.head_dim // 2)}
 
 
-@pytest.mark.parametrize("pp,tp", [(2, 1), (1, 2), (2, 2)])
+@pytest.mark.parametrize("pp,tp", [
+    pytest.param(2, 1, marks=pytest.mark.slow),
+    (1, 2),
+    pytest.param(2, 2, marks=pytest.mark.slow),
+])
 def test_pipeline_sgd_update_matches_single_device(pp, tp, devices):
     """Regression: grads through the shard_map pipeline must match the
     single-device gradient in *scale*, not just direction.  With sgd(1.0)

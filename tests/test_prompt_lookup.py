@@ -40,6 +40,7 @@ def test_greedy_exactness(params, oracle):
     assert 0.0 <= stats.acceptance_rate <= 1.0
 
 
+@pytest.mark.slow
 def test_fp8_kv_greedy_matches_fp8_engine(params):
     """Prompt-lookup with an fp8 KV cache matches a plain engine at the
     SAME cache dtype bit-exactly (shared resolve_cache_dtype_backend
@@ -59,7 +60,11 @@ def test_fp8_kv_greedy_matches_fp8_engine(params):
                            kv_cache_dtype="float8_e4m3fn")
 
 
-@pytest.mark.parametrize("plen", [5, 8, 17])
+@pytest.mark.parametrize("plen", [
+    pytest.param(5, marks=pytest.mark.slow),
+    8,
+    pytest.param(17, marks=pytest.mark.slow),
+])
 def test_chunked_prefill_matches_whole(params, oracle, plen):
     """prefill_chunk (C=8) must keep prompt-lookup decode bit-identical
     to whole-prompt prefill (the history buffer is host-seeded and
@@ -196,6 +201,7 @@ def test_capacity_and_validation(params):
         pld.generate(np.zeros((1, 30), np.int64), 10)
 
 
+@pytest.mark.slow
 def test_eos_padding_matches_engine(params):
     """With eos_id set, greedy PLD equals InferenceEngine's eos-padded
     fused scan bit-exactly."""
@@ -213,6 +219,7 @@ def test_eos_padding_matches_engine(params):
     np.testing.assert_array_equal(want, got.tokens)
 
 
+@pytest.mark.slow
 def test_eos_stream_matches_engine_stream(params):
     sampling = SamplingParams(greedy=True)
     base = InferenceEngine(CFG, params, max_seq=160, sampling=sampling)

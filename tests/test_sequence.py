@@ -44,7 +44,8 @@ def _dense_causal(q, k, v, slopes=None):
                      q_pos, jnp.asarray(L, jnp.int32), slopes)
 
 
-@pytest.mark.parametrize("alibi", [False, True])
+@pytest.mark.parametrize("alibi", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_ring_self_attention_matches_dense(sp_mesh, alibi):
     b, L, nh, nkv, hd = 2, 32, 4, 2 if not alibi else 4, 8
     rng = np.random.RandomState(0)

@@ -47,6 +47,7 @@ def test_greedy_matches_target_only(params, draft_params):
     assert stats.rounds >= 1
 
 
+@pytest.mark.slow
 def test_fp8_kv_greedy_matches_fp8_engine(params, draft_params):
     """Standalone spec decode with fp8 KV caches (both models) matches a
     plain engine running the SAME cache dtype bit-exactly — the same
@@ -74,7 +75,8 @@ def test_fp8_kv_greedy_matches_fp8_engine(params, draft_params):
 
 
 @pytest.mark.parametrize("plen", [
-    5, 8,
+    pytest.param(5, marks=pytest.mark.slow),
+    8,
     pytest.param(9, marks=pytest.mark.slow),
     pytest.param(17, marks=pytest.mark.slow),
 ])
@@ -264,6 +266,7 @@ def test_stream_zero_tokens(params, draft_params):
     assert list(spec.generate_stream(np.asarray([[1, 2]]), 0)) == []
 
 
+@pytest.mark.slow
 def test_tp_mesh_parity(params, draft_params):
     """Draft/verify over a tp=2 mesh (both models sharded): greedy output
     equals the single-device speculative engine's."""

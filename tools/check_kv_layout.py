@@ -1,19 +1,20 @@
 #!/usr/bin/env python
-"""Rejection-matrix lint: the paged KV layout is UNIVERSAL.
+"""Dense-removal lint: the paged KV layout is the ONLY layout.
 
 PR 4 shipped the paged page pool behind an explicit rejection matrix —
 ten ``require_dense_kv_layout`` call sites across the engines and CLI
-(DESIGN.md §11).  PR 7 dissolved it: every engine and CLI mode accepts
-``--kv-layout paged`` (the default), and ``require_dense_kv_layout``
-survives only inside ``runtime/kvcache/`` as a legacy shim for
-out-of-tree callers.
+(DESIGN.md §11).  PR 7 dissolved it, PR 8 deprecated the dense escape
+hatch for one release, and the gateway PR deleted it: the dense
+backend class, the legacy require-dense shim, and ``--kv-layout
+dense`` resolution are gone (resolving "dense" fails loudly naming
+the removal).
 
-This lint keeps the matrix from silently regrowing: no production
-module outside ``runtime/kvcache/`` may reference
-``require_dense_kv_layout`` (a new dense-only mode must either grow
-paged plumbing or raise its own documented error with its own test).
-Walks every ``.py`` under the package, source-level — a call site that
-never executes on the lint's import path still counts.
+This lint keeps the deletion deleted: NO module in the package — the
+kvcache subtree included, since the shim's home is gone too — may
+reference either removed identifier.  A new dense-only mode must grow
+its own documented error with its own test, not resurrect the old
+names.  Walks every ``.py`` under the package, source-level — a call
+site that never executes on the lint's import path still counts.
 
 Run standalone (``python tools/check_kv_layout.py``, exit 1 on
 violations) or via the tier-1 suite (``tests/test_metrics_names.py``).
@@ -26,25 +27,26 @@ import sys
 from typing import List
 
 PACKAGE = "distributed_inference_demo_tpu"
-ALLOWED_SUBTREE = ("runtime", "kvcache")   # the shim's home
+
+# identifiers deleted with the dense escape hatch; zero references may
+# remain anywhere in the package (ISSUE 10 acceptance)
+REMOVED_IDENTIFIERS = ("require_dense_kv_layout", "DenseKVBackend")
 
 
 def check_kv_layout_matrix(root: pathlib.Path) -> List[str]:
-    """Return human-readable violations (empty = matrix still empty)."""
+    """Return human-readable violations (empty = removal holds)."""
     problems: List[str] = []
     pkg = root / PACKAGE
     for path in sorted(pkg.rglob("*.py")):
         rel = path.relative_to(root)
-        if rel.parts[1:3] == ALLOWED_SUBTREE:
-            continue
         text = path.read_text(encoding="utf-8")
         for lineno, line in enumerate(text.splitlines(), 1):
-            if "require_dense_kv_layout" in line:
-                problems.append(
-                    f"{rel}:{lineno}: references "
-                    "require_dense_kv_layout — the §11 rejection matrix "
-                    "is dissolved (DESIGN.md §14); paged must be "
-                    "accepted, not rejected")
+            for ident in REMOVED_IDENTIFIERS:
+                if ident in line:
+                    problems.append(
+                        f"{rel}:{lineno}: references {ident} — deleted "
+                        "with the dense escape hatch (DESIGN.md §14); "
+                        "paged is the only layout")
     return problems
 
 
@@ -54,11 +56,11 @@ def main() -> int:
     for p in problems:
         print(f"KV LAYOUT LINT: {p}", file=sys.stderr)
     if problems:
-        print(f"{len(problems)} rejection-matrix violation(s)",
+        print(f"{len(problems)} dense-removal violation(s)",
               file=sys.stderr)
         return 1
-    print("kv layout matrix OK (no require_dense_kv_layout call sites "
-          f"outside {PACKAGE}/runtime/kvcache/)")
+    print("kv layout OK (no references to removed dense identifiers "
+          f"anywhere under {PACKAGE}/)")
     return 0
 
 

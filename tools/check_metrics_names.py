@@ -28,7 +28,7 @@ from typing import List
 
 SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
               "engine", "control", "anomaly", "flight", "kvcache",
-              "transport", "fault", "disagg"}
+              "transport", "fault", "disagg", "gateway"}
 
 # unit suffixes a metric name may end with (after stripping ``_total``).
 # Plain-count units (requests, tokens, ...) double as the unit for
@@ -38,15 +38,20 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
          "info", "events", "bundles", "blocks", "nodes",
          "retries", "reconnects", "frames", "faults", "dispatches",
-         "pages"}
+         "pages", "replicas"}
 
 # exact names exempted from the unit-suffix rule — each entry is a
 # deliberate, documented exception (NOT a new unit: adding a pseudo-unit
 # would let every future misnamed series ending the same way slip
 # through).  dwt_kvcache_blocks_in_use carries its unit (blocks) mid-
 # name; it pairs with dwt_kvcache_used_blocks as the all-owners gauge
-# (docs/DESIGN.md §11 runbook).
-UNIT_SUFFIX_EXEMPT = {"dwt_kvcache_blocks_in_use"}
+# (docs/DESIGN.md §11 runbook).  The gateway replica-transition pair
+# carries its unit (replicas) mid-name too: the ISSUE-10 acceptance
+# pins the exact name dwt_gateway_replica_down_total, and up/down name
+# the transition direction where the unit would sit.
+UNIT_SUFFIX_EXEMPT = {"dwt_kvcache_blocks_in_use",
+                      "dwt_gateway_replica_down_total",
+                      "dwt_gateway_replica_up_total"}
 
 # series the catalog must always register (regressions here would blind
 # the flight-recorder/anomaly layer silently — a scrape with the series
@@ -98,6 +103,19 @@ REQUIRED_SERIES = {
     "dwt_disagg_rescheduled_requests_total",
     "dwt_disagg_migration_seconds",
     "dwt_disagg_handoff_queue_depth_requests",
+    # the gateway set (docs/DESIGN.md §16): replica_down staying
+    # registered-and-zero is how a scrape PROVES no replica was
+    # evicted, and routed/hashed/retried absent would make the
+    # cache-aware-vs-fallback split (the subsystem's whole point)
+    # unobservable
+    "dwt_gateway_prefix_routed_requests_total",
+    "dwt_gateway_hashed_requests_total",
+    "dwt_gateway_retried_requests_total",
+    "dwt_gateway_shed_requests_total",
+    "dwt_gateway_replica_down_total",
+    "dwt_gateway_replica_up_total",
+    "dwt_gateway_up_replicas",
+    "dwt_gateway_proxy_ttft_seconds",
 }
 
 

@@ -55,12 +55,16 @@ LEG_BUDGETS = {
     "long_context": 1800,
     "long_context_sp": 1800,
     "disagg": 1500,
+    "gateway_routing": 1500,
     "flagship_int8": 2400,
     "batching": 2400,
     "prefix_reuse": 1800,
     "paged_decode": 1800,
     "serving_relative": 1800,
-    "sweep": 1800,
+    # the full-budget sweep now runs the promoted b8/32/64 x
+    # {bf16,int8,int4} grid (9 engine builds) — budget like the other
+    # multi-engine legs
+    "sweep": 2400,
     "flagship_bf16": 2400,
     "pipeline": 1500,
     "prefill_long": 1800,
