@@ -424,12 +424,64 @@ def _leg_flagship(model: str, batch: int, prompt_len: int, new_tokens: int,
     return _bench_engine(model, batch, prompt_len, new_tokens, quant=quant)
 
 
+def _bench_batching_kv(model: str, batch: int, prompt_len: int,
+                       new_tokens: int, quant=False,
+                       kv_dtype: str = "bf16") -> dict:
+    """One (weight-dtype x kv-dtype) sweep point on the paged-native
+    batching engine.  The kv-dtype axis can only be measured HERE: the
+    plain engine's dense working cache never touches the page pool, so
+    threading ``kv_dtype`` through ``_bench_engine`` would time a no-op
+    (docs/DESIGN.md §17)."""
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+
+    mode = "int8" if quant is True else quant
+    name = model + (f"-{mode}" if mode else "")
+    cfg = get_model_config(name)
+    params = init_full_params(jax.random.PRNGKey(0), cfg,
+                              quantize=bool(mode))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 1000, size=(prompt_len,)).astype(np.int32)
+               for _ in range(batch)]
+    with ContinuousBatchingEngine(
+            cfg, params, max_seq=prompt_len + new_tokens, max_batch=batch,
+            sampling=SamplingParams(temperature=0.7, top_k=7),
+            kv_layout="paged", kv_dtype=kv_dtype) as eng:
+        eng.submit(prompts[0], 4).wait(timeout=600)       # compile warmup
+        eng.submit(prompts[-1], 4).wait(timeout=600)
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, new_tokens) for p in prompts]
+        for r in reqs:
+            r.wait(timeout=900)
+        dt = time.perf_counter() - t0
+        mgr = eng.kv_cache
+        return {
+            "model": name, "engine": "batching-paged",
+            "kv_dtype": kv_dtype, "batch": batch,
+            "prompt_len": prompt_len, "new_tokens": new_tokens,
+            "decode_tokens_per_sec": round(batch * new_tokens / dt, 2),
+            "block_bytes": int(mgr.block_bytes),
+            "pool_capacity_bytes": int(eng._pk.nbytes + eng._pv.nbytes),
+        }
+
+
 def _leg_sweep(model: str, prompt_len: int, new_tokens: int,
-               quants=(False, True), batches=(32, 64)) -> dict:
+               quants=(False, True), batches=(32, 64),
+               kv_dtypes=()) -> dict:
     """Batch sweep at bf16 and int8 with achieved GB/s per point.
     Points are isolated: one OOMing batch size must not discard the rest.
     (b=8 is omitted — the headline/headline_int8 legs already cover it —
-    to keep total bench wall-clock inside the driver's window.)"""
+    to keep total bench wall-clock inside the driver's window.)
+
+    ``kv_dtypes`` adds the §17 weight-dtype x kv-dtype cross at the
+    largest batch, measured on the paged batching engine (the only
+    engine whose decode reads the page pool): one point per
+    (quant, kv_dtype) pair in ``kv_points``."""
     points = []
     for quant in quants:
         for batch in batches:
@@ -440,7 +492,24 @@ def _leg_sweep(model: str, prompt_len: int, new_tokens: int,
                 points.append({"model": model, "batch": batch,
                                "dtype": "int8" if quant else "bf16",
                                "error": f"{type(e).__name__}: {e}"})
-    return {"points": points}
+    out = {"points": points}
+    if kv_dtypes:
+        kv_points = []
+        batch = max(batches)
+        for quant in quants:
+            for kvd in kv_dtypes:
+                try:
+                    kv_points.append(_bench_batching_kv(
+                        model, batch, prompt_len, new_tokens,
+                        quant=quant, kv_dtype=kvd))
+                except Exception as e:
+                    mode = "int8" if quant is True else quant
+                    kv_points.append({
+                        "model": model + (f"-{mode}" if mode else ""),
+                        "batch": batch, "kv_dtype": kvd,
+                        "error": f"{type(e).__name__}: {e}"})
+        out["kv_points"] = kv_points
+    return out
 
 
 def _leg_roofline_probe(reps: int = 32, rounds_n: int = 3) -> dict:
@@ -1168,7 +1237,8 @@ def _leg_prefix_reuse(model: str, new_tokens: int, slots: int = 8,
 def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
                       prompt_len: int = 64, max_seq: int = 1024,
                       block_tokens: int = 16, n_req: int = 0,
-                      shared_len: int = 48) -> dict:
+                      shared_len: int = 48,
+                      kv_dtypes=("int8", "int4")) -> dict:
     """Paged KV on the (paged-native) batching engine vs dense-layout
     reservation (docs/DESIGN.md §11/§14): decode tok/s parity AND the
     HBM story the paged layout exists for — at a serving-realistic
@@ -1194,7 +1264,11 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
       acceptance gate);
     - paged primed: radix hits on the paged path — ``h2d_bytes`` must
       stay 0 (hits are block-table references, nothing crosses the
-      host boundary)."""
+      host boundary);
+    - kv-dtype axis (docs/DESIGN.md §17): the same wave on int8/int4
+      page pools — tok/s plus the per-dtype admissible table, whose
+      narrower ``block_bytes`` (scale sidecar included) must admit a
+      STRICTLY larger batch than bf16 at the same fixed byte budget."""
     import jax
     import numpy as np
     from distributed_inference_demo_tpu.models import get_model_config
@@ -1280,6 +1354,7 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
             "tokens_per_sec": round(n_req * new_tokens / dt, 2),
             "pool_capacity_bytes": int(eng._pk.nbytes + eng._pv.nbytes),
             "pool_blocks": mgr.num_blocks,
+            "block_bytes": int(mgr.block_bytes),
             "peak_blocks_in_use": int(peak_blocks),
             "peak_bytes_in_use": int(peak_blocks * mgr.block_bytes),
             "blocks_per_request": blocks_per_req,
@@ -1298,23 +1373,28 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
         # the §14 acceptance table: at the dense reservation's byte
         # budget, the max admissible batch per sequence budget — dense
         # pins a padded max_seq row per request; paged pins only the
-        # blocks this workload shape (prompt + new) actually touches
+        # blocks this workload shape (prompt + new) actually touches.
+        # Parameterized on block_bytes so the §17 kv-dtype phase below
+        # reuses the same arithmetic with its narrower pages.
         itemsize = np.dtype(cfg.dtype).itemsize
         kv_row_unit = 2 * cfg.num_layers * cfg.num_kv_heads \
             * cfg.head_dim * itemsize
         used_tokens = prompt_len + new_tokens
-        admissible = {}
-        for seq in (4096, 8192, 32768):
-            dense_row = kv_row_unit * pad_cache_capacity(seq)
-            paged_req = (-(-used_tokens // block_tokens)
-                         * mgr.block_bytes)
-            admissible[str(seq)] = {
-                "budget_bytes": dense_bytes,
-                "dense_max_batch": int(dense_bytes // dense_row),
-                "paged_max_batch": int(dense_bytes // paged_req),
-                "workload_tokens_per_request": used_tokens,
-            }
-        out["admissible"] = admissible
+
+        def admissible_table(blk_bytes):
+            tbl = {}
+            for seq in (4096, 8192, 32768):
+                dense_row = kv_row_unit * pad_cache_capacity(seq)
+                paged_req = -(-used_tokens // block_tokens) * blk_bytes
+                tbl[str(seq)] = {
+                    "budget_bytes": dense_bytes,
+                    "dense_max_batch": int(dense_bytes // dense_row),
+                    "paged_max_batch": int(dense_bytes // paged_req),
+                    "workload_tokens_per_request": used_tokens,
+                }
+            return tbl
+
+        out["admissible"] = admissible_table(mgr.block_bytes)
 
         # phase 3: primed — shared-prefix wave; hits must move 0 bytes
         # through the host (the acceptance gate for the paged path)
@@ -1329,6 +1409,36 @@ def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
             "reused_tokens": snap["partial_hit_tokens"],
             "h2d_bytes": snap["h2d_bytes"],
         }
+
+    # phase 4: the §17 kv-dtype axis — the same cold wave on quantized
+    # page pools.  Each dtype's admissible table reuses the bf16 dense
+    # budget, so paged_max_batch growing strictly with narrowing width
+    # IS the byte-budget claim measured, not asserted.  (Each engine is
+    # opened after the bf16 one closed: pools never coexist, so the leg
+    # fits the same HBM the bf16 phase needed.)
+    out["kv_dtype"] = {}
+    for d in kv_dtypes:
+        with ContinuousBatchingEngine(
+                cfg, params, max_seq=max_seq, max_batch=slots,
+                sampling=sampling, kv_layout="paged",
+                kv_block_tokens=block_tokens, kv_dtype=d) as qeng:
+            qeng.submit(prompts[0], 4).wait(timeout=600)  # compile warmup
+            qeng.submit(prompts[1], 4).wait(timeout=600)
+            dt, peak_q = run_wave(qeng, prompts)
+            qmgr = qeng.kv_cache
+            out["kv_dtype"][d] = {
+                "tokens_per_sec": round(n_req * new_tokens / dt, 2),
+                "vs_bf16_paged": round(
+                    (n_req * new_tokens / dt)
+                    / out["paged"]["tokens_per_sec"], 3),
+                "block_bytes": int(qmgr.block_bytes),
+                "scale_block_bytes": int(qmgr.scale_block_bytes),
+                "pool_capacity_bytes": int(qeng._pk.nbytes
+                                           + qeng._pv.nbytes),
+                "peak_blocks_in_use": int(peak_q),
+                "peak_bytes_in_use": int(peak_q * qmgr.block_bytes),
+                "admissible": admissible_table(qmgr.block_bytes),
+            }
     return out
 
 
@@ -2316,7 +2426,8 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
             # two rounds running
             out = _leg_sweep(model, prompt_len, new_tokens,
                              quants=(False, True, "int4"),
-                             batches=(8, 32, 64))
+                             batches=(8, 32, 64),
+                             kv_dtypes=("bf16", "int8", "int4"))
         elif name == "flagship_int8":
             out = _leg_flagship(flagship, batch, prompt_len,
                                 min(new_tokens, 64), quant=True)

@@ -147,6 +147,7 @@ def _build_spec_engine(args):
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
         prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
         kv_layout=getattr(args, "kv_layout", None),
+        kv_dtype=getattr(args, "kv_dtype", None),
         **_kvcache_from_args(args))
 
 
@@ -173,6 +174,7 @@ def _build_prompt_lookup_engine(args):
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None) or None,
         prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
         kv_layout=getattr(args, "kv_layout", None),
+        kv_dtype=getattr(args, "kv_dtype", None),
         **_kvcache_from_args(args))
 
 
@@ -193,6 +195,7 @@ def _build_engine(args):
         stream_block=getattr(args, "stream_block", None),
         mesh=mesh, eos_id=getattr(args, "eos_id", None),
         kv_layout=getattr(args, "kv_layout", None),
+        kv_dtype=getattr(args, "kv_dtype", None),
         **_kvcache_from_args(args))
 
 
@@ -444,7 +447,8 @@ def cmd_serve(args) -> int:
             sampling=_sampling_from_args(args),
             eos_id=getattr(args, "eos_id", None),
             attn_backend=args.attn_backend,
-            kv_layout=getattr(args, "kv_layout", None)))
+            kv_layout=getattr(args, "kv_layout", None),
+            kv_dtype=getattr(args, "kv_dtype", None)))
         print(f"SERVE_VISION {args.model} tower={args.vision_preset} "
               f"image={vcfg.image_size} patches={vcfg.num_patches}",
               flush=True)
@@ -477,6 +481,7 @@ def cmd_serve(args) -> int:
             decode_block=args.decode_block,
             prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
             kv_layout=getattr(args, "kv_layout", None),
+            kv_dtype=getattr(args, "kv_dtype", None),
             max_queue_depth=getattr(args, "admission_queue_depth", 0),
             **_kvcache_from_args(args))
         kvc = backend.kv_cache
@@ -1173,6 +1178,17 @@ def _add_engine_args(ap):
                     help="tokens per KV cache block (match granularity "
                          "AND minimum reusable prefix; default "
                          "DWT_KVCACHE_BLOCK_TOKENS, else 16)")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=["bf16", "int8", "int4"],
+                    help="KV page WIDTH for the paged pool "
+                         "(docs/DESIGN.md §17): bf16 stores full-width "
+                         "pages (the default); int8 / packed int4 "
+                         "quantize each page at write time with a "
+                         "per-token scale sidecar riding the block "
+                         "table — 2x / 4x the admissible batch at a "
+                         "fixed HBM budget, small pinned accuracy "
+                         "cost.  Default DWT_KV_DTYPE, else bf16; "
+                         "mutually exclusive with --kv-cache-dtype")
     ap.add_argument("--kv-layout", default=None,
                     choices=["paged"],
                     help="KV cache memory layout (docs/DESIGN.md §14). "

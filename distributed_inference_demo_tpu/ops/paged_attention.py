@@ -43,6 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .attention import attention, prepare_kv_chunk
+from .quant import QuantizedKVPages, quantize_kv_like
 
 _NEG = -1e30
 
@@ -65,15 +66,25 @@ def write_paged_kv(
     :func:`ops.attention.prepare_kv_chunk`.
     """
     bt = k_pages.shape[2]
-    k_new, v_new = prepare_kv_chunk(k_new, v_new, k_pages.dtype,
-                                    v_pages.dtype)
+    if isinstance(k_pages, QuantizedKVPages):
+        # quantize ONCE at write time, per token over head_dim: the
+        # scale/zero sidecar leaves take the exact same scatter index
+        # (their trailing axis is a broadcast singleton).
+        k_new, v_new = prepare_kv_chunk(k_new, v_new, jnp.float32,
+                                        jnp.float32)
+    else:
+        k_new, v_new = prepare_kv_chunk(k_new, v_new, k_pages.dtype,
+                                        v_pages.dtype)
+    qk = quantize_kv_like(k_pages, k_new)
+    qv = quantize_kv_like(v_pages, v_new)
     page = jnp.take_along_axis(tables, positions // bt, axis=1)  # [b, s]
     off = positions % bt                                         # [b, s]
     # advanced indices at dims (0, 2) around the head slice: the indexed
     # result layout [b, s, nkv, hd] is exactly the projection layout the
     # chunk arrives in — no transpose.
-    k_pages = k_pages.at[page, :, off].set(k_new, mode="drop")
-    v_pages = v_pages.at[page, :, off].set(v_new, mode="drop")
+    scatter = lambda p, c: p.at[page, :, off].set(c, mode="drop")
+    k_pages = jax.tree.map(scatter, k_pages, qk)
+    v_pages = jax.tree.map(scatter, v_pages, qv)
     return k_pages, v_pages
 
 
@@ -90,12 +101,20 @@ def paged_gather_attention(
 
     Materializes the gathered view (a full cache copy per layer) — fine
     for CPU tests and small batches, which is exactly where it runs; the
-    TPU path is the Pallas kernel."""
+    TPU path is the Pallas kernel.  Quantized pools gather the NARROW
+    leaves through the table first, then dequantize the gathered view to
+    f32 — the same per-element ``convert * scale (+ zero)`` the kernel
+    runs in-register, so the two paths stay bit-exact."""
     num_pages, nkv, bt, hd = k_pages.shape
     safe = jnp.clip(tables, 0, num_pages - 1)
-    k_lin = jnp.take(k_pages, safe, axis=0)      # [b, W, nkv, bt, hd]
-    v_lin = jnp.take(v_pages, safe, axis=0)
+    gather = lambda p: jnp.take(p, safe, axis=0)  # [b, W, nkv, bt, ·]
     b, W = safe.shape
+    if isinstance(k_pages, QuantizedKVPages):
+        k_lin = jax.tree.map(gather, k_pages).dequantize(jnp.float32)
+        v_lin = jax.tree.map(gather, v_pages).dequantize(jnp.float32)
+    else:
+        k_lin = gather(k_pages)
+        v_lin = gather(v_pages)
     k_lin = k_lin.transpose(0, 2, 1, 3, 4).reshape(b, nkv, W * bt, hd)
     v_lin = v_lin.transpose(0, 2, 1, 3, 4).reshape(b, nkv, W * bt, hd)
     return attention(q, k_lin, v_lin, q_positions,
@@ -106,9 +125,8 @@ def paged_gather_attention(
 # Pallas TPU decode kernel
 
 
-def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, slopes_ref,
-                  o_ref, o_acc, m_acc, l_acc, *, block_tokens: int,
-                  groups: int, use_alibi: bool):
+def _paged_kernel(tab_ref, len_ref, q_ref, *refs, block_tokens: int,
+                  groups: int, use_alibi: bool, quantized: bool):
     """Grid (b, nkv, W), page index innermost: each step folds one
     streamed [block_tokens, hd] page into the online-softmax accumulators
     (VMEM scratch persists across the sequential grid).  Rows are the
@@ -116,7 +134,17 @@ def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, slopes_ref,
     same query position ``kv_len - 1``.
 
     tab_ref (SMEM int32 [b, W]): the block tables; len_ref (SMEM int32
-    [b]): per-row valid lengths AFTER the current token's insert."""
+    [b]): per-row valid lengths AFTER the current token's insert.  With
+    ``quantized`` the page refs are int8 and each is followed by its
+    [bt, 1] f32 scale block (same page index map): the dequant happens
+    in-register right after the narrow DMA — HBM traffic stays 1 byte +
+    4/bt per element."""
+    if quantized:
+        (k_ref, ks_ref, v_ref, vs_ref, slopes_ref,
+         o_ref, o_acc, m_acc, l_acc) = refs
+    else:
+        k_ref, v_ref, slopes_ref, o_ref, o_acc, m_acc, l_acc = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     j = pl.program_id(2)
     num_j = pl.num_programs(2)
@@ -136,9 +164,12 @@ def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, slopes_ref,
     def _step():
         q = q_ref[0, 0, :, :].astype(jnp.float32)
         q = q * (1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32)))
-        k_blk = k_ref[0, 0, :, :]
-        v_blk = v_ref[0, 0, :, :]
-        s = jnp.dot(q, k_blk.astype(jnp.float32).T,
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+        if quantized:
+            k_blk = k_blk * ks_ref[0, 0, :, :]      # [bt, hd] * [bt, 1]
+            v_blk = v_blk * vs_ref[0, 0, :, :]
+        s = jnp.dot(q, k_blk.T,
                     preferred_element_type=jnp.float32)     # [rows, bt]
         kv_pos = (j * bt
                   + jax.lax.broadcasted_iota(jnp.int32, (1, bt), 1))
@@ -159,8 +190,7 @@ def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, slopes_ref,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         o_acc[:] = o_acc[:] * alpha + jnp.dot(
-            p, v_blk.astype(jnp.float32),
-            preferred_element_type=jnp.float32)
+            p, v_blk, preferred_element_type=jnp.float32)
         m_acc[:] = jnp.broadcast_to(m_new, m_acc.shape)
         l_acc[:] = jnp.broadcast_to(l_new, l_acc.shape)
 
@@ -177,6 +207,7 @@ def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, slopes_ref,
 def _paged_call(q_g, k_pages, v_pages, tables, kv_lens, slopes, *,
                 block_tokens, use_alibi, interpret):
     b, nkv, rows, hd = q_g.shape
+    quantized = isinstance(k_pages, QuantizedKVPages)
     num_pages = k_pages.shape[0]
     W = tables.shape[1]
     bt = block_tokens
@@ -189,20 +220,30 @@ def _paged_call(q_g, k_pages, v_pages, tables, kv_lens, slopes, *,
         page = jnp.minimum(tab[bb, jj], num_pages - 1)
         return (page, h, 0, 0)
 
+    q_spec = pl.BlockSpec((1, 1, rows, hd),
+                          lambda bb, h, j, tab, lens: (bb, h, 0, 0))
+    slopes_spec = pl.BlockSpec((1, 1, rows),
+                               lambda bb, h, j, tab, lens: (h, 0, 0))
+    page_spec = pl.BlockSpec((1, 1, bt, hd), page_map)
+    if quantized:
+        # the scale sidecar rides the SAME page index map — a [bt, 1]
+        # f32 block DMA'd alongside its narrow page
+        scale_spec = pl.BlockSpec((1, 1, bt, 1), page_map)
+        in_specs = [q_spec, page_spec, scale_spec, page_spec,
+                    scale_spec, slopes_spec]
+        operands = (tables, kv_lens, q_g, k_pages.data, k_pages.scale,
+                    v_pages.data, v_pages.scale, slopes)
+    else:
+        in_specs = [q_spec, page_spec, page_spec, slopes_spec]
+        operands = (tables, kv_lens, q_g, k_pages, v_pages, slopes)
+
     return pl.pallas_call(
         functools.partial(_paged_kernel, block_tokens=bt, groups=rows,
-                          use_alibi=use_alibi),
+                          use_alibi=use_alibi, quantized=quantized),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, nkv, W),
-            in_specs=[
-                pl.BlockSpec((1, 1, rows, hd),
-                             lambda bb, h, j, tab, lens: (bb, h, 0, 0)),
-                pl.BlockSpec((1, 1, bt, hd), page_map),
-                pl.BlockSpec((1, 1, bt, hd), page_map),
-                pl.BlockSpec((1, 1, rows),
-                             lambda bb, h, j, tab, lens: (h, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, rows, hd),
                                    lambda bb, h, j, tab, lens:
                                    (bb, h, 0, 0)),
@@ -214,7 +255,7 @@ def _paged_call(q_g, k_pages, v_pages, tables, kv_lens, slopes, *,
         ),
         out_shape=jax.ShapeDtypeStruct((b, nkv, rows, hd), q_g.dtype),
         interpret=interpret,
-    )(tables, kv_lens, q_g, k_pages, v_pages, slopes)
+    )(*operands)
 
 
 def paged_flash_attention(
@@ -237,6 +278,13 @@ def paged_flash_attention(
     if chunk != 1:
         raise ValueError(f"paged_flash_attention is decode-only (chunk=1), "
                          f"got chunk={chunk}")
+    if isinstance(k_pages, QuantizedKVPages) and k_pages.bits != 8:
+        # int4's nibble lane-interleave is Mosaic-hostile (an unpack in
+        # the lane dimension per element); int4 is the CAPACITY config
+        # and always takes the gather path — a deliberate gate, see
+        # docs/DESIGN.md §17.
+        raise ValueError("the Pallas kernel streams bf16 or int8 pages; "
+                         "int4 KV takes the XLA gather path")
     num_pages, nkv, bt, _ = k_pages.shape
     if bt % 8:
         raise ValueError(f"block_tokens must be a multiple of 8 for the "
@@ -297,8 +345,19 @@ def make_paged_attn_impl(block_tokens: int, backend: str = "auto",
         use_pallas = (backend == "pallas"
                       or (backend == "auto"
                           and jax.default_backend() == "tpu"))
-        if (use_pallas and q.shape[1] == 1
-                and k_pages.shape[2] % 8 == 0):
+        # the pool's own type selects the numerics — no kv_dtype
+        # threading through the seam: int4 never takes the kernel, int8
+        # needs 32-aligned pages on real hardware (the int8 min tile's
+        # sublane granule; forced-"pallas" test runs interpret and may
+        # use smaller pages)
+        bt = k_pages.shape[2]
+        if isinstance(k_pages, QuantizedKVPages):
+            kernel_ok = (k_pages.bits == 8
+                         and (bt % 32 == 0 or backend == "pallas")
+                         and bt % 8 == 0)
+        else:
+            kernel_ok = bt % 8 == 0
+        if (use_pallas and q.shape[1] == 1 and kernel_ok):
             kv_lens = positions[:, -1] + 1
             out = paged_flash_attention(q, k_pages, v_pages, tables,
                                         kv_lens, slopes,

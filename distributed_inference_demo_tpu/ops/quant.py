@@ -14,12 +14,14 @@ leading axis, so pipeline-stage slicing (``base.slice_stage``) works on
 quantized params unchanged.
 """
 
+import os
 from dataclasses import dataclass
 from functools import partial
-from typing import Union
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @partial(jax.tree_util.register_dataclass,
@@ -165,6 +167,167 @@ def maybe_quantize(params, cfg):
                                                     cfg.quantization),
                        embed=params.embed, final_norm=params.final_norm,
                        lm_head=params.lm_head)
+
+
+# ---------------------------------------------------------------------------
+# Quantized KV pages (docs/DESIGN.md §17)
+#
+# The page-pool twin of the weight rails above: K/V pages stored int8 or
+# packed int4 with per-(token, kv-head) float32 scales riding alongside
+# the block table.  Granularity is per-token over the head_dim axis —
+# NOT the weights' per-output-channel — because a page is written once
+# per token at insert time and never revisited: the token's own absmax
+# is the only statistic available at write time, and it keeps the scale
+# sidecar a trailing-singleton leaf so one sharding spec / one scatter
+# index serves data and scales alike.
+
+KV_DTYPES = ("bf16", "int8", "int4")
+
+
+def resolve_kv_dtype(kv_dtype: Optional[str] = None) -> str:
+    """``kv_dtype`` arg over ``DWT_KV_DTYPE`` env over "bf16" — the one
+    owner of KV-width resolution (mirrors ``resolve_kv_layout``), called
+    at every pool-creation site so the env knob reaches engines that
+    never grew an explicit kwarg."""
+    dt = kv_dtype or os.environ.get("DWT_KV_DTYPE", "") or "bf16"
+    if dt not in KV_DTYPES:
+        raise ValueError(
+            f"unknown kv dtype {dt!r}; expected one of {KV_DTYPES}")
+    return dt
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["data", "scale", "zero"], meta_fields=["bits"])
+@dataclass
+class QuantizedKVPages:
+    """Narrow KV pages + per-(…, token) scale sidecar over the last axis.
+
+    ``data``: int8 ``(..., hd)`` (bits=8, symmetric) or uint8
+    ``(..., hd/2)`` (bits=4, asymmetric, low nibble = even lane);
+    ``scale``: f32 ``(..., 1)``; ``zero``: f32 ``(..., 1)`` minimum for
+    int4, ``None`` for int8 (a ``None`` child vanishes from the pytree,
+    so tree-mapped scatters/gathers and sharding-prefix specs see only
+    real leaves).  Every leaf keeps the full leading-axis stack
+    (``[L, N, H, bt, ·]`` pools, per-layer ``[N, H, bt, ·]`` slices,
+    exported ``[n, L, H, bt, ·]`` runs), so the same tree-mapped page
+    program serves them all.
+    """
+
+    data: jax.Array
+    scale: jax.Array
+    zero: Optional[jax.Array]
+    bits: int
+
+    @property
+    def shape(self):
+        """LOGICAL shape (full head_dim, nibbles unpacked)."""
+        d = self.data.shape[-1] * (2 if self.bits == 4 else 1)
+        return (*self.data.shape[:-1], d)
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def nbytes(self):
+        return (self.data.nbytes + self.scale.nbytes
+                + (0 if self.zero is None else self.zero.nbytes))
+
+    def dequantize(self, dtype=jnp.float32) -> jax.Array:
+        if self.bits == 8:
+            return (self.data.astype(jnp.float32)
+                    * self.scale).astype(dtype)
+        lo = (self.data & 0xF).astype(jnp.float32)
+        hi = (self.data >> 4).astype(jnp.float32)
+        v = jnp.stack([lo, hi], axis=-1)            # (..., hd/2, 2)
+        *lead, half, _ = v.shape
+        v = v.reshape(*lead, half * 2)
+        return (v * self.scale + self.zero).astype(dtype)
+
+
+def quantize_kv_pages(x: jax.Array, bits: int) -> QuantizedKVPages:
+    """Per-(…, token) quantization over the LAST axis (head_dim) —
+    shape-agnostic, so pool leaves, projection chunks and exported block
+    runs all go through this one owner.  int8 is symmetric on the
+    weight rails' absmax/127 grid; int4's 15-level grid needs the
+    asymmetric [min, max] span (a symmetric 7-level grid wastes half
+    the codes whenever a token's channels share a sign)."""
+    xf = x.astype(jnp.float32)
+    if bits == 8:
+        absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+        scale = jnp.maximum(absmax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+        return QuantizedKVPages(data=q, scale=scale, zero=None, bits=8)
+    if bits != 4:
+        raise ValueError(f"kv quantization is int8 or int4, got {bits}")
+    mn = jnp.min(xf, axis=-1, keepdims=True)
+    mx = jnp.max(xf, axis=-1, keepdims=True)
+    scale = jnp.maximum(mx - mn, 1e-8) / 15.0
+    q = jnp.clip(jnp.round((xf - mn) / scale), 0, 15).astype(jnp.uint8)
+    *lead, d = q.shape
+    if d % 2:
+        raise ValueError(f"int4 packing needs an even head_dim, got {d}")
+    pairs = q.reshape(*lead, d // 2, 2)
+    packed = (pairs[..., 0] | (pairs[..., 1] << 4)).astype(jnp.uint8)
+    return QuantizedKVPages(data=packed, scale=scale, zero=mn, bits=4)
+
+
+def quantize_kv_like(ref, x: jax.Array):
+    """Payload matching the pool tensor ``ref``: a dtype cast for a
+    plain pool, quantized leaves for a quantized one — so every page
+    scatter site quantizes through one line."""
+    if isinstance(ref, QuantizedKVPages):
+        return quantize_kv_pages(x, ref.bits)
+    return x.astype(ref.dtype)
+
+
+def dequantize_kv(x, dtype=jnp.float32) -> jax.Array:
+    """Full-width view of ``x`` (plain array or QuantizedKVPages)."""
+    if isinstance(x, QuantizedKVPages):
+        return x.dequantize(dtype)
+    return x.astype(dtype)
+
+
+def alloc_kv_pages(shape, kv_dtype: Optional[str], base_dtype):
+    """One zeroed pool tensor for a ``(..., head_dim)`` page-pool shape:
+    a plain ``base_dtype`` array for bf16, :class:`QuantizedKVPages`
+    leaves for int8/int4.  Callers build the V pool with
+    ``jax.tree.map(jnp.zeros_like, pk)`` — works for both."""
+    kv_dtype = resolve_kv_dtype(kv_dtype)
+    *lead, d = shape
+    if kv_dtype == "bf16":
+        return jnp.zeros(shape, base_dtype)
+    if kv_dtype == "int8":
+        return QuantizedKVPages(
+            data=jnp.zeros((*lead, d), jnp.int8),
+            scale=jnp.zeros((*lead, 1), jnp.float32),
+            zero=None, bits=8)
+    return QuantizedKVPages(
+        data=jnp.zeros((*lead, d // 2), jnp.uint8),
+        scale=jnp.zeros((*lead, 1), jnp.float32),
+        zero=jnp.zeros((*lead, 1), jnp.float32), bits=4)
+
+
+def kv_token_head_bytes(head_dim: int, kv_dtype: Optional[str],
+                        base_dtype) -> int:
+    """Bytes one (token, kv-head) of ONE tensor (K or V) occupies in the
+    page pool, scale/zero sidecar INCLUDED — the single owner of the
+    page-width arithmetic shared by the byte-budget admission
+    (``make_kv_backend``) and the manager's accounting, so the two can
+    never disagree about what a block costs."""
+    kv_dtype = resolve_kv_dtype(kv_dtype)
+    if kv_dtype == "bf16":
+        return head_dim * np.dtype(base_dtype).itemsize
+    if kv_dtype == "int8":
+        return head_dim + 4                  # int8 lanes + f32 scale
+    return head_dim // 2 + 8                 # packed nibbles + scale + zero
+
+
+def kv_scale_token_head_bytes(kv_dtype: Optional[str]) -> int:
+    """The sidecar-only share of :func:`kv_token_head_bytes` — what the
+    ``dwt_kvcache_quant_scale_bytes`` gauge reports."""
+    kv_dtype = resolve_kv_dtype(kv_dtype)
+    return {"bf16": 0, "int8": 4, "int4": 8}[kv_dtype]
 
 
 def dense(x: jax.Array,

@@ -24,7 +24,13 @@ def _tp_param_specs(params: StageParams, cfg: ModelConfig) -> StageParams:
                                  vocab_parallel_embed=False)
 
 
-# head-major cache [layers, batch, nkv, seq, hd]: shard the kv-head axis
+# head-major cache [layers, batch, nkv, seq, hd]: shard the kv-head axis.
+# The spec doubles as a pytree PREFIX: a quantized page pool
+# (ops.quant.QuantizedKVPages) hangs data/scale/zero leaves under keys/
+# values, all keeping the [L, N, H, bt, ·] axis order with a trailing
+# singleton on the sidecars — the one rank-5 spec broadcasts over the
+# subtree, so scale tensors shard WITH their pages and no quantized
+# variant of this spec exists (docs/DESIGN.md §17).
 _CACHE_SPEC = KVCache(keys=P(None, None, "tp", None, None),
                       values=P(None, None, "tp", None, None),
                       length=P())

@@ -144,7 +144,7 @@ class ContinuousBatchingEngine:
                  prompt_buckets: tuple = (32, 128, 512, 2048),
                  kv_cache_blocks: Optional[int] = None,
                  kv_block_tokens: Optional[int] = None,
-                 mesh=None, kv_cache_dtype=None,
+                 mesh=None, kv_cache_dtype=None, kv_dtype=None,
                  draft_cfg: Optional[ModelConfig] = None,
                  draft_params: Optional[StageParams] = None,
                  num_draft: int = 4,
@@ -284,6 +284,17 @@ class ContinuousBatchingEngine:
                 raise ValueError("num_draft must be >= 1")
         self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
                                if kv_cache_dtype else None)
+        # kv_dtype (docs/DESIGN.md §17): the page pool's QUANTIZED width
+        # — int8/int4 pages with a per-token scale sidecar.  Exclusive
+        # with the kv_cache_dtype storage CAST (same full-width layout,
+        # different grid): one knob or the other.
+        from ..ops.quant import resolve_kv_dtype
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        if self.kv_dtype != "bf16" and self.kv_cache_dtype is not None:
+            raise ValueError(
+                f"kv_dtype={self.kv_dtype!r} quantizes the page pool and "
+                "cannot compose with a kv_cache_dtype storage cast; drop "
+                "one of the two knobs")
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= self.max_seq
         ) or (self.max_seq,)
@@ -343,17 +354,23 @@ class ContinuousBatchingEngine:
         n_blocks = (n_blocks_arg if n_blocks_arg >= 1
                     else B * self._table_width)
         self.kv_cache = PagedKVCacheManager.for_model(
-            cfg, n_blocks, bt, dtype=self.kv_cache_dtype)
+            cfg, n_blocks, bt, dtype=self.kv_cache_dtype,
+            kv_dtype=self.kv_dtype)
         N = self.kv_cache.num_blocks
         self._page_sentinel = N
         page_dtype = self.kv_cache_dtype or cfg.dtype
         fwd_p, bind_tables, pool_sharding = make_paged_forward_seam(
             cfg, self.spec, mesh, params, bt)
-        self._pk = jnp.zeros(
+        from ..ops.quant import alloc_kv_pages
+        self._pk = alloc_kv_pages(
             (cfg.num_layers, N, cfg.num_kv_heads, bt, cfg.head_dim),
-            page_dtype)
-        self._pv = jnp.zeros_like(self._pk)
+            self.kv_dtype, page_dtype)
+        self._pv = jax.tree.map(jnp.zeros_like, self._pk)
         if pool_sharding is not None:
+            # a single NamedSharding broadcasts over the pool's leaves:
+            # the quantized layouts' data/scale/zero all keep the
+            # [L, N, H(tp), bt, ·] axis order, so the kv-head spec
+            # shards scales WITH their pages
             self._pk = jax.device_put(self._pk, pool_sharding.keys)
             self._pv = jax.device_put(self._pv, pool_sharding.values)
         self._tables = np.full((B, self._table_width), N, np.int32)
@@ -634,13 +651,14 @@ class ContinuousBatchingEngine:
             # used_blocks == 0 whenever no request is in flight (the
             # draft half of the leak invariant)
             self._dmgr = PagedKVCacheManager.for_model(
-                draft_cfg, n_blocks, bt, dtype=self.kv_cache_dtype)
+                draft_cfg, n_blocks, bt, dtype=self.kv_cache_dtype,
+                kv_dtype=self.kv_dtype)
             ND = self._dmgr.num_blocks
             self._dpage_sentinel = ND
-            self._dpk = jnp.zeros(
+            self._dpk = alloc_kv_pages(
                 (draft_cfg.num_layers, ND, draft_cfg.num_kv_heads, bt,
-                 draft_cfg.head_dim), page_dtype)
-            self._dpv = jnp.zeros_like(self._dpk)
+                 draft_cfg.head_dim), self.kv_dtype, page_dtype)
+            self._dpv = jax.tree.map(jnp.zeros_like, self._dpk)
             if dpool_sharding is not None:
                 self._dpk = jax.device_put(self._dpk,
                                            dpool_sharding.keys)
@@ -896,12 +914,28 @@ class ContinuousBatchingEngine:
         would race them).
 
         ``k_blocks=None`` (a short prompt with no migratable whole
-        block) degrades to a plain :meth:`submit`."""
+        block) degrades to a plain :meth:`submit`.
+
+        Quantized migrations (docs/DESIGN.md §17) arrive as
+        :class:`~..ops.quant.QuantizedKVPages` payloads — narrow bytes +
+        scale sidecar, adopted VERBATIM into a matching quantized pool
+        (the decode side holds bit-identical pages to the prefill
+        side); a full-width payload into a quantized pool quantizes at
+        the adopt scatter."""
         if k_blocks is None:
             return self.submit(prompt_ids, max_new_tokens)
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        k_blocks = np.asarray(k_blocks)
-        v_blocks = np.asarray(v_blocks)
+        from ..ops.quant import QuantizedKVPages
+        if isinstance(k_blocks, QuantizedKVPages):
+            if (not isinstance(self._pk, QuantizedKVPages)
+                    or self._pk.bits != k_blocks.bits):
+                raise ValueError(
+                    f"premigrated int{k_blocks.bits} blocks need a "
+                    f"matching quantized pool; this engine's pages are "
+                    f"kv_dtype={self.kv_dtype!r}")
+        else:
+            k_blocks = np.asarray(k_blocks)
+            v_blocks = np.asarray(v_blocks)
         bt = self.kv_cache.block_tokens
         want = (self.cfg.num_layers, self.cfg.num_kv_heads, bt,
                 self.cfg.head_dim)
@@ -936,8 +970,8 @@ class ContinuousBatchingEngine:
             raise _BlocksExhausted()
         from .kvcache.device import adopt_blocks_into_pages
         self._pk, self._pv = adopt_blocks_into_pages(
-            self._pk, self._pv, jnp.asarray(st["k"]),
-            jnp.asarray(st["v"]),
+            self._pk, self._pv, jax.tree.map(jnp.asarray, st["k"]),
+            jax.tree.map(jnp.asarray, st["v"]),
             jnp.asarray(np.asarray(ids, np.int32)))
         bt = mgr.block_tokens
         adopted, lease = mgr.store_shared(req.prompt[:n * bt], ids)

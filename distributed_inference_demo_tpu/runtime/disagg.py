@@ -52,6 +52,13 @@ bytes the decode engine's own cold prefill would write.  Chunked
 prefill is bit-identical to whole-prompt prefill (§10), so greedy
 output through the disaggregated path is bit-identical to the
 colocated engine (pinned by tests/test_disagg.py + the chaos soak).
+Under a quantized ``kv_dtype`` (docs/DESIGN.md §17) the prefill worker
+quantizes ONCE at export; the frames carry the narrow bytes plus the
+scale sidecars (a ``kv_dtype`` tag in the page-frame metadata), and
+the decode pool adopts them verbatim — the migrated pages are
+bit-identical to the prefill side's, so there is exactly one
+quantization rounding on the whole path, the same one a colocated
+quantized engine pays at its own page-write.
 
 Frame tags (rids must not contain ``:``):
 
@@ -122,13 +129,48 @@ def _parse_meta_frame(payload: bytes):
     return meta, tensors[1:], ctx
 
 
-def _page_frame(k_blocks: np.ndarray, v_blocks: np.ndarray,
-                first_block: int, trace=None) -> bytes:
+#: leaves per side on the wire for each page width: bf16 ships the one
+#: full-width tensor (byte-identical to the pre-quantization format),
+#: int8 ships (data, scale), packed int4 (data, scale, zero).
+_WIRE_LEAVES = {"bf16": 1, "int8": 2, "int4": 3}
+
+
+def _kv_leaf_lists(blocks):
+    """Flatten one side's (possibly quantized) host block payload into
+    the wire's flat tensor list + its page-width tag."""
+    from ..ops.quant import QuantizedKVPages
+    if isinstance(blocks, QuantizedKVPages):
+        leaves = [np.asarray(blocks.data), np.asarray(blocks.scale)]
+        if blocks.zero is not None:
+            leaves.append(np.asarray(blocks.zero))
+        return leaves, ("int4" if blocks.bits == 4 else "int8")
+    return [np.asarray(blocks)], "bf16"
+
+
+def _kv_from_leaves(leaves, kv_dtype: str):
+    """Rebuild one side's block payload from its wire leaf list."""
+    if kv_dtype == "bf16":
+        return leaves[0]
+    from ..ops.quant import QuantizedKVPages
+    bits = 4 if kv_dtype == "int4" else 8
+    zero = leaves[2] if bits == 4 else None
+    return QuantizedKVPages(leaves[0], leaves[1], zero, bits)
+
+
+def _page_frame(k_blocks, v_blocks, first_block: int, trace=None) -> bytes:
     """One page-payload frame: ``[n, L, H, bt, D]`` K and V block runs
-    starting at block index ``first_block`` of the migration."""
+    starting at block index ``first_block`` of the migration.  Quantized
+    runs (``ops.quant.QuantizedKVPages``) ship their narrow data plus
+    the scale (and int4 zero-point) sidecars as extra tensors with a
+    ``kv_dtype`` tag in the metadata; full-width frames carry no tag and
+    stay byte-identical to the pre-quantization wire format."""
+    k_leaves, kv_dtype = _kv_leaf_lists(k_blocks)
+    v_leaves, _ = _kv_leaf_lists(v_blocks)
     meta = {"first_block": int(first_block),
             "n_blocks": int(k_blocks.shape[0])}
-    return _meta_frame(meta, (k_blocks, v_blocks), trace=trace)
+    if kv_dtype != "bf16":
+        meta["kv_dtype"] = kv_dtype
+    return _meta_frame(meta, k_leaves + v_leaves, trace=trace)
 
 
 class MigrationError(RuntimeError):
@@ -157,10 +199,13 @@ class PrefillWorker:
                  kv_cache_blocks: Optional[int] = None,
                  kv_block_tokens: Optional[int] = None,
                  ack_timeout: Optional[float] = None,
-                 migration_retries: Optional[int] = None):
+                 migration_retries: Optional[int] = None,
+                 kv_dtype: Optional[str] = None):
+        import jax
         import jax.numpy as jnp
 
         from ..models.base import KVCache, StageSpec
+        from ..ops.quant import alloc_kv_pages, resolve_kv_dtype
         from ..parallel.tensor import make_forward_seam
         from .engine import make_chunk_programs, validate_prefill_chunk
         from .kvcache import PagedKVCacheManager, resolve_kvcache_config
@@ -187,11 +232,17 @@ class PrefillWorker:
         if n_blocks < 1:
             # default pool: enough pages for a handful of max_seq prompts
             n_blocks = 4 * max(1, -(-max_seq // bt))
-        self.kv_cache = PagedKVCacheManager.for_model(cfg, n_blocks, bt)
+        # page width: the local reuse pool, the exported block payloads
+        # and the decode-side engine pool all share ONE kv_dtype so a
+        # migrated page adopts verbatim (docs/DESIGN.md §17)
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        self.kv_cache = PagedKVCacheManager.for_model(
+            cfg, n_blocks, bt, kv_dtype=self.kv_dtype)
         N = self.kv_cache.num_blocks
-        self._pk = jnp.zeros((cfg.num_layers, N, cfg.num_kv_heads, bt,
-                              cfg.head_dim), cfg.dtype)
-        self._pv = jnp.zeros_like(self._pk)
+        self._pk = alloc_kv_pages((cfg.num_layers, N, cfg.num_kv_heads,
+                                   bt, cfg.head_dim), self.kv_dtype,
+                                  cfg.dtype)
+        self._pv = jax.tree.map(jnp.zeros_like, self._pk)
 
         self.tracer = TraceRecorder(f"prefill:{self.device_id}")
         self.stats = {"handoffs": 0, "migrated_pages": 0,
@@ -269,15 +320,29 @@ class PrefillWorker:
     def _export_blocks(self, row_k, row_v, lo: int, hi: int):
         """Blocks ``[lo, hi)`` of a dense prefill row as numpy
         ``[n, L, H, bt, D]`` pairs (one D2H slice each — this IS the
-        wire export; the decode-side adopt stays device-resident)."""
+        wire export; the decode-side adopt stays device-resident).
+        Under a quantized ``kv_dtype`` each side quantizes here, ONCE,
+        before hitting the wire: the frames carry the narrow bytes plus
+        scale sidecars, and the decode pool adopts them verbatim —
+        bit-identical to this worker's own reuse pool."""
+        import jax
+
         bt = self.kv_cache.block_tokens
         L, _, H, _, D = row_k.shape
         n = hi - lo
         k = np.asarray(row_k[:, 0, :, lo * bt:hi * bt, :])
         v = np.asarray(row_v[:, 0, :, lo * bt:hi * bt, :])
-        k = k.reshape(L, H, n, bt, D).transpose(2, 0, 1, 3, 4)
-        v = v.reshape(L, H, n, bt, D).transpose(2, 0, 1, 3, 4)
-        return np.ascontiguousarray(k), np.ascontiguousarray(v)
+        k = np.ascontiguousarray(k.reshape(L, H, n, bt, D)
+                                 .transpose(2, 0, 1, 3, 4))
+        v = np.ascontiguousarray(v.reshape(L, H, n, bt, D)
+                                 .transpose(2, 0, 1, 3, 4))
+        if self.kv_dtype == "bf16":
+            return k, v
+        from ..ops.quant import quantize_kv_pages
+        bits = 4 if self.kv_dtype == "int4" else 8
+        to_host = lambda q: jax.tree.map(np.asarray, q)
+        return (to_host(quantize_kv_pages(k, bits)),
+                to_host(quantize_kv_pages(v, bits)))
 
     def handoff(self, rid: str, attempt: int, prompt: np.ndarray,
                 max_new: int, decode_id: str, reply_to: str,
@@ -607,7 +672,7 @@ class DecodeWorker:
                 self._flight.record("disagg_attempt_superseded", rid=rid,
                                     old=st["attempt"], new=attempt)
             st = {"attempt": attempt, "expected": 0, "k": [], "v": [],
-                  "t0": time.perf_counter()}
+                  "kv_dtype": "bf16", "t0": time.perf_counter()}
             self._staged[rid] = st
             while len(self._staged) > self._STAGED_CAP:
                 victim = min(self._staged, key=lambda r:
@@ -646,8 +711,21 @@ class DecodeWorker:
             # retried page frames idempotent; go-back-n refills holes
             self._drop(tag, "dedup")
             return
-        st["k"].append(np.asarray(tensors[0]))
-        st["v"].append(np.asarray(tensors[1]))
+        kv_dtype = meta.get("kv_dtype", "bf16")
+        nk = _WIRE_LEAVES.get(kv_dtype)
+        if nk is None or len(tensors) != 2 * nk:
+            # a malformed leaf list is a corrupt frame, not a protocol
+            # state: drop it and let the sender's ack round retransmit
+            record_corrupt_frame(
+                self.device_id, tag, len(payload),
+                wire.WireError(f"page frame kv_dtype={kv_dtype!r} with "
+                               f"{len(tensors)} tensors"))
+            return
+        # frames of one migration share one width (one exporter); the
+        # leaf lists stage per frame and concatenate leaf-wise at end
+        st["kv_dtype"] = kv_dtype
+        st["k"].append([np.asarray(t) for t in tensors[:nk]])
+        st["v"].append([np.asarray(t) for t in tensors[nk:]])
         st["expected"] += 1
 
     def _on_end(self, rid: str, attempt: int, payload: bytes,
@@ -674,8 +752,12 @@ class DecodeWorker:
         prompt = np.asarray(tensors[0], np.int32).reshape(-1)
         n_blocks = int(meta["n_blocks"])
         if st["k"]:
-            k_blocks = np.concatenate(st["k"], axis=0)
-            v_blocks = np.concatenate(st["v"], axis=0)
+            k_leaves = [np.concatenate(parts, axis=0)
+                        for parts in zip(*st["k"])]
+            v_leaves = [np.concatenate(parts, axis=0)
+                        for parts in zip(*st["v"])]
+            k_blocks = _kv_from_leaves(k_leaves, st["kv_dtype"])
+            v_blocks = _kv_from_leaves(v_leaves, st["kv_dtype"])
         else:
             k_blocks = v_blocks = None
         if k_blocks is not None and k_blocks.shape[0] != n_blocks:

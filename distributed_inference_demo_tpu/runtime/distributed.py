@@ -66,7 +66,7 @@ class StageRuntime:
     def __init__(self, cfg: ModelConfig, spec: StageSpec, params: StageParams,
                  max_seq: int, sampling: SamplingParams = SamplingParams(),
                  seed: int = 0, mesh=None, kv_cache_dtype=None,
-                 kv_layout=None):
+                 kv_layout=None, kv_dtype=None):
         """``mesh``: a local tp mesh — this stage's layer range then runs
         with Megatron-sliced weights and a kv-head-sharded cache on this
         host's chips (pipeline across hosts x tensor parallelism within
@@ -95,6 +95,13 @@ class StageRuntime:
         self.mesh = mesh
         self.kv_cache_dtype = (jnp.dtype(kv_cache_dtype)
                                if kv_cache_dtype else None)
+        from ..ops.quant import resolve_kv_dtype
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        if self.kv_dtype != "bf16" and self.kv_cache_dtype is not None:
+            raise ValueError(
+                f"kv_dtype={self.kv_dtype!r} quantizes the stage page "
+                "pool and cannot compose with a kv_cache_dtype storage "
+                f"cast ({self.kv_cache_dtype}); drop one of the two knobs")
         from .kvcache import resolve_kv_layout
         self.kv_layout = resolve_kv_layout(kv_layout)
         self._rng_base = jax.random.PRNGKey(seed)
@@ -122,12 +129,15 @@ class StageRuntime:
                 from .engine import shard_engine_params
                 params = shard_engine_params(params, cfg, mesh)
             self.params = params
+            from ..ops.quant import alloc_kv_pages
             page_dtype = self.kv_cache_dtype or cfg.dtype
-            self._pk = jnp.zeros(
+            self._pk = alloc_kv_pages(
                 (spec.num_layers, n_blocks, cfg.num_kv_heads, bt,
-                 cfg.head_dim), page_dtype)
-            self._pv = jnp.zeros_like(self._pk)
+                 cfg.head_dim), self.kv_dtype, page_dtype)
+            self._pv = jax.tree.map(jnp.zeros_like, self._pk)
             if pool_sharding is not None:
+                # single sharding broadcasts over the (possibly
+                # quantized) leaf subtree — sidecars shard with pages
                 self._pk = jax.device_put(self._pk, pool_sharding.keys)
                 self._pv = jax.device_put(self._pv, pool_sharding.values)
             self._sentinel = n_blocks

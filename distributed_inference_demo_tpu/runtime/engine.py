@@ -282,6 +282,7 @@ class InferenceEngine:
                  kv_cache_blocks: Optional[int] = None,
                  kv_block_tokens: Optional[int] = None,
                  kv_layout: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
                  stop_token_ids=None,
                  stream_block: Optional[int] = None):
         """``attn_backend``: "auto" (Pallas flash kernel on TPU, jnp
@@ -324,6 +325,16 @@ class InferenceEngine:
         cache holds); inserts round via ``update_kv_cache``'s cast.
         Forces the jnp attention path (the Pallas kernel is not exercised
         on f8 loads).
+
+        ``kv_dtype``: page WIDTH of the prefix-reuse pool behind the
+        kvcache seam — "bf16" (full width, the default), "int8", or
+        packed "int4" with a per-token scale sidecar riding the same
+        block table (docs/DESIGN.md §17).  Resolved arg over
+        ``DWT_KV_DTYPE`` over bf16 inside ``make_kv_backend``; mutually
+        exclusive with the ``kv_cache_dtype`` storage cast.  The dense
+        working cache for the one request in flight stays full width —
+        quantization happens at the page boundary (store scatter), and
+        seeds dequantize back to full rows.
 
         ``kv_cache_blocks`` / ``kv_block_tokens``: block-level KV prefix
         cache (``runtime/kvcache``, docs/DESIGN.md §10) for the
@@ -404,7 +415,8 @@ class InferenceEngine:
         from .kvcache import make_kv_backend
         self.kv_cache = make_kv_backend(
             cfg, kv_cache_blocks, kv_block_tokens, layout=self.kv_layout,
-            dtype=self.kv_cache_dtype, default_blocks=0)
+            dtype=self.kv_cache_dtype, kv_dtype=kv_dtype,
+            default_blocks=0)
 
         cfg_ = cfg
         spec_ = self.spec

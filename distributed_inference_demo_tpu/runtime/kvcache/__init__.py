@@ -22,10 +22,18 @@ regrows anywhere in the package.
 Layout selection: the ``kv_layout`` engine kwarg / ``--kv-layout`` flag
 over the ``DWT_KV_LAYOUT`` env knob over the default ``paged`` — all
 three funnel through :func:`resolve_kv_layout`, the one owner.
+
+Page WIDTH selection mirrors it (docs/DESIGN.md §17): the ``kv_dtype``
+kwarg / ``--kv-dtype`` flag over ``DWT_KV_DTYPE`` over ``bf16``,
+funneled through :func:`resolve_kv_dtype` (owned by ``ops/quant.py``
+next to the quantized-page rails, re-exported here) — called at every
+pool-creation site, so the env knob reaches engines without an explicit
+kwarg.
 """
 
 import os
 
+from ...ops.quant import KV_DTYPES, resolve_kv_dtype
 from .backend import PagedKVBackend, make_kv_backend
 from .manager import (DEFAULT_BLOCK_TOKENS, KVCacheManager, KVLease,
                       resolve_kvcache_config)
@@ -65,4 +73,5 @@ __all__ = ["KVBlockPool", "KVCacheManager", "KVLease",
            "PagedKVBackend", "make_kv_backend",
            "PagedBlockLease", "PagedKVCacheManager", "RadixTree",
            "resolve_kvcache_config", "resolve_kv_layout",
-           "DEFAULT_BLOCK_TOKENS", "KV_LAYOUTS"]
+           "resolve_kv_dtype", "DEFAULT_BLOCK_TOKENS",
+           "KV_LAYOUTS", "KV_DTYPES"]

@@ -57,16 +57,22 @@ class PagedKVBackend:
     layout = "paged"
 
     def __init__(self, cfg, num_blocks: int, block_tokens: int,
-                 dtype=None):
+                 dtype=None, kv_dtype=None):
+        import jax
         import jax.numpy as jnp
-        self.mgr = PagedKVCacheManager.for_model(cfg, num_blocks,
-                                                 block_tokens, dtype=dtype)
+
+        from ...ops.quant import alloc_kv_pages, resolve_kv_dtype
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        self.mgr = PagedKVCacheManager.for_model(
+            cfg, num_blocks, block_tokens, dtype=dtype,
+            kv_dtype=self.kv_dtype)
         self.block_tokens = self.mgr.block_tokens
         page_dtype = dtype if dtype is not None else cfg.dtype
-        self._pk = jnp.zeros(
+        self._pk = alloc_kv_pages(
             (cfg.num_layers, self.mgr.num_blocks, cfg.num_kv_heads,
-             self.mgr.block_tokens, cfg.head_dim), page_dtype)
-        self._pv = jnp.zeros_like(self._pk)
+             self.mgr.block_tokens, cfg.head_dim), self.kv_dtype,
+            page_dtype)
+        self._pv = jax.tree.map(jnp.zeros_like, self._pk)
 
     def seed(self, ids, cache):
         """Match + device gather out of the pool into the fresh cache —
@@ -146,24 +152,41 @@ class PagedKVBackend:
 
 def make_kv_backend(cfg, kv_cache_blocks: Optional[int],
                     kv_block_tokens: Optional[int], *, layout: str,
-                    dtype=None, default_blocks: int = 0):
+                    dtype=None, kv_dtype=None, default_blocks: int = 0):
     """The one constructor every engine calls: resolve the block-count /
     block-tokens knobs (CLI over env over ``default_blocks``) and build
     the layout's backend — or None when the pool is off (0 blocks, or a
     ``DWT_KVCACHE_BYTES`` ceiling below one block: a knob documented as
-    a ceiling must never crash engine construction)."""
+    a ceiling must never crash engine construction).
+
+    ``kv_dtype`` (arg over ``DWT_KV_DTYPE`` over bf16) selects the page
+    WIDTH; every engine behind this seam inherits it with no per-engine
+    plumbing.  Mutually exclusive with a ``dtype`` storage cast: the
+    cast rescales the same full-width layout, quantization replaces it."""
+    from ...ops.quant import kv_token_head_bytes, resolve_kv_dtype
     if layout != "paged":
         raise ValueError(
             f"unknown kv layout {layout!r}: paged is the only layout "
             "(the dense backend was removed; docs/DESIGN.md §14)")
+    kv_dtype = resolve_kv_dtype(kv_dtype)
+    if kv_dtype != "bf16" and dtype is not None:
+        raise ValueError(
+            f"kv_dtype={kv_dtype!r} quantizes the page pool and cannot "
+            "compose with a kv_cache_dtype storage cast "
+            f"({np.dtype(dtype).name}); drop one of the two knobs")
     n_blocks, block_tokens = resolve_kvcache_config(
         kv_cache_blocks, kv_block_tokens, default_blocks=default_blocks)
     if n_blocks < 1:
         return None
+    # the byte budget admits blocks at their ACTUAL page width (narrow
+    # data + scale sidecar), not the full-width itemsize — one shared
+    # owner with PagedKVCacheManager so admission and accounting agree
     dtype_ = dtype if dtype is not None else cfg.dtype
     block_bytes = (2 * int(cfg.num_layers) * int(cfg.num_kv_heads)
-                   * int(block_tokens) * int(cfg.head_dim)
-                   * np.dtype(dtype_).itemsize)
+                   * int(block_tokens)
+                   * kv_token_head_bytes(int(cfg.head_dim), kv_dtype,
+                                         dtype_))
     if apply_byte_budget(n_blocks, block_bytes) < 1:
         return None
-    return PagedKVBackend(cfg, n_blocks, block_tokens, dtype=dtype)
+    return PagedKVBackend(cfg, n_blocks, block_tokens, dtype=dtype,
+                          kv_dtype=kv_dtype)

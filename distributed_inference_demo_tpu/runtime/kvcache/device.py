@@ -12,6 +12,13 @@ ends of the device seam the engines share:
 
 Kept separate from ``manager.py`` so the manager (and its tests) never
 import jax.
+
+Every paged program below is dtype-polymorphic (docs/DESIGN.md §17): a
+pool tensor is either a plain array or a :class:`QuantizedKVPages` tree
+whose leaves share the pool's leading ``[L, N, H, bt]`` axes, so one
+tree-mapped gather/scatter serves both.  The quantize/dequantize always
+happens HERE, at the row <-> pages seam — dense working rows stay
+full-width, pages hold the narrow bytes + scale sidecar.
 """
 
 from __future__ import annotations
@@ -20,6 +27,28 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from ...ops.quant import (QuantizedKVPages, quantize_kv_like,
+                          quantize_kv_pages)
+
+
+def _gather_run(pool, idx):
+    """``[L, n, H, bt, D]`` FULL-WIDTH block run for index row ``idx``
+    into a pool's page axis — narrow leaves gather first (only the
+    table's bytes move), then the gathered view dequantizes."""
+    g = jax.tree.map(lambda p: jnp.take(p, idx, axis=1), pool)
+    if isinstance(g, QuantizedKVPages):
+        return g.dequantize(jnp.float32)
+    return g
+
+
+def _scatter_run(pool, run, table):
+    """Scatter a full-width ``[L, n, H, bt, D]`` block run into the pool
+    at ``table``'s ids (sentinels drop) — quantizing once, here, when
+    the pool is narrow."""
+    payload = quantize_kv_like(pool, run)
+    return jax.tree.map(
+        lambda p, b: p.at[:, table].set(b, mode="drop"), pool, payload)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -56,8 +85,8 @@ def seed_row_from_pages(pk, pv, table):
     L, N, H, bt, D = pk.shape
     W = table.shape[0]
     safe = jnp.clip(table, 0, N - 1)
-    rk = jnp.take(pk, safe, axis=1)          # [L, W, H, bt, D]
-    rv = jnp.take(pv, safe, axis=1)
+    rk = _gather_run(pk, safe)               # [L, W, H, bt, D]
+    rv = _gather_run(pv, safe)
     rk = rk.transpose(0, 2, 1, 3, 4).reshape(L, 1, H, W * bt, D)
     rv = rv.transpose(0, 2, 1, 3, 4).reshape(L, 1, H, W * bt, D)
     return rk, rv
@@ -74,8 +103,8 @@ def seed_cache_from_pages(ck, cv, pk, pv, table):
     per matched length, like the dense seed program it mirrors."""
     L, N, H, bt, D = pk.shape
     n = table.shape[0]
-    rk = jnp.take(pk, table, axis=1)          # [L, n, H, bt, D]
-    rv = jnp.take(pv, table, axis=1)
+    rk = _gather_run(pk, table)               # [L, n, H, bt, D]
+    rv = _gather_run(pv, table)
     rk = rk.transpose(0, 2, 1, 3, 4).reshape(L, 1, H, n * bt, D)
     rv = rv.transpose(0, 2, 1, 3, 4).reshape(L, 1, H, n * bt, D)
     zero = jnp.zeros((), jnp.int32)
@@ -103,9 +132,7 @@ def store_cache_to_pages(pk, pv, ck, cv, table, start):
                                          axis=2)
     rk = run_k.reshape(L, H, n, bt, D).transpose(0, 2, 1, 3, 4)
     rv = run_v.reshape(L, H, n, bt, D).transpose(0, 2, 1, 3, 4)
-    pk = pk.at[:, table].set(rk.astype(pk.dtype), mode="drop")
-    pv = pv.at[:, table].set(rv.astype(pv.dtype), mode="drop")
-    return pk, pv
+    return _scatter_run(pk, rk, table), _scatter_run(pv, rv, table)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -118,12 +145,24 @@ def adopt_blocks_into_pages(pk, pv, k_blocks, v_blocks, table):
     references them.  The pool never round-trips through a dense row,
     so ``dwt_kvcache_h2d_bytes_total`` (the dense-seed counter) stays 0
     on the decode side by construction; the migration's own bytes are
-    accounted as ``dwt_disagg_migrated_bytes_total``."""
-    pk = pk.at[:, table].set(
-        k_blocks.transpose(1, 0, 2, 3, 4).astype(pk.dtype), mode="drop")
-    pv = pv.at[:, table].set(
-        v_blocks.transpose(1, 0, 2, 3, 4).astype(pv.dtype), mode="drop")
-    return pk, pv
+    accounted as ``dwt_disagg_migrated_bytes_total``.
+
+    Payloads may arrive quantized (a quantized prefill pool ships its
+    narrow bytes + scale sidecar on the wire): matching leaves adopt
+    VERBATIM — the decode pool holds bit-identical pages to the prefill
+    side.  A full-width payload into a quantized pool quantizes here
+    (the premigrated-join escape hatch for full-width exporters)."""
+    def _adopt(pool, blocks):
+        if (isinstance(pool, QuantizedKVPages)
+                and not isinstance(blocks, QuantizedKVPages)):
+            blocks = quantize_kv_pages(blocks.astype(jnp.float32),
+                                       pool.bits)
+        return jax.tree.map(
+            lambda p, b: p.at[:, table].set(
+                jnp.moveaxis(b, 0, 1).astype(p.dtype), mode="drop"),
+            pool, blocks)
+
+    return _adopt(pk, k_blocks), _adopt(pv, v_blocks)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -140,6 +179,4 @@ def write_row_to_pages(pk, pv, row_k, row_v, table):
     W = table.shape[0]
     rk = row_k[:, 0].reshape(L, H, W, bt, D).transpose(0, 2, 1, 3, 4)
     rv = row_v[:, 0].reshape(L, H, W, bt, D).transpose(0, 2, 1, 3, 4)
-    pk = pk.at[:, table].set(rk.astype(pk.dtype), mode="drop")
-    pv = pv.at[:, table].set(rv.astype(pv.dtype), mode="drop")
-    return pk, pv
+    return _scatter_run(pk, rk, table), _scatter_run(pv, rv, table)

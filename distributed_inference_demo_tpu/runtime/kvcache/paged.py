@@ -68,10 +68,20 @@ class PagedKVCacheManager:
     """Radix-tree prefix sharing + page-id allocation, zero data moved."""
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
-                 num_blocks: int, block_tokens: int, dtype):
+                 num_blocks: int, block_tokens: int, dtype,
+                 kv_dtype: Optional[str] = None):
+        from ...ops.quant import (kv_scale_token_head_bytes,
+                                  kv_token_head_bytes, resolve_kv_dtype)
         bt = int(block_tokens)
-        self.block_bytes = (2 * int(num_layers) * int(num_kv_heads) * bt
-                            * int(head_dim) * np.dtype(dtype).itemsize)
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        # block_bytes accounts the ACTUAL page width incl. the quantized
+        # layouts' scale sidecar — one owner (ops/quant.py) shared with
+        # make_kv_backend's byte-budget admission
+        token_heads = 2 * int(num_layers) * int(num_kv_heads) * bt
+        self.block_bytes = token_heads * kv_token_head_bytes(
+            int(head_dim), self.kv_dtype, dtype)
+        self.scale_block_bytes = token_heads * kv_scale_token_head_bytes(
+            self.kv_dtype)
         num_blocks = apply_byte_budget(int(num_blocks), self.block_bytes)
         if num_blocks < 1:
             raise ValueError(
@@ -90,10 +100,11 @@ class PagedKVCacheManager:
 
     @classmethod
     def for_model(cls, cfg, num_blocks: int, block_tokens: int,
-                  dtype=None) -> "PagedKVCacheManager":
+                  dtype=None,
+                  kv_dtype: Optional[str] = None) -> "PagedKVCacheManager":
         dtype = dtype if dtype is not None else cfg.dtype
         return cls(cfg.num_layers, cfg.num_kv_heads, cfg.head_dim,
-                   num_blocks, block_tokens, dtype)
+                   num_blocks, block_tokens, dtype, kv_dtype=kv_dtype)
 
     # ------------------------------------------------------------------
     # lookup (same tree walk as the dense manager)
@@ -295,6 +306,8 @@ class PagedKVCacheManager:
                         resident_bytes=0,
                         device_resident_bytes=used * self.block_bytes,
                         capacity_bytes=self.num_blocks * self.block_bytes,
+                        page_dtype=self.kv_dtype,
+                        quant_scale_bytes=used * self.scale_block_bytes,
                         tree_blocks=self.tree.block_count,
                         nodes=self.tree.node_count - 1)
 

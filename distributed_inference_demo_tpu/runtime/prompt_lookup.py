@@ -97,7 +97,8 @@ class PromptLookupEngine:
                  prefill_chunk: Optional[int] = None,
                  kv_cache_blocks: Optional[int] = None,
                  kv_block_tokens: Optional[int] = None,
-                 kv_layout: Optional[str] = None):
+                 kv_layout: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         """``mesh``: tp mesh — the target forward runs sharded (see
         InferenceEngine); proposal matching stays replicated VPU work.
         ``kv_cache_dtype``: reduced-precision cache storage, same
@@ -204,7 +205,8 @@ class PromptLookupEngine:
         from .kvcache import make_kv_backend
         self.kv_cache = make_kv_backend(
             cfg, kv_cache_blocks, kv_block_tokens, layout=self.kv_layout,
-            dtype=self.kv_cache_dtype, default_blocks=0)
+            dtype=self.kv_cache_dtype, kv_dtype=kv_dtype,
+            default_blocks=0)
 
     # ------------------------------------------------------------------
 

@@ -269,7 +269,8 @@ class SpeculativeEngine:
                  prefill_chunk: Optional[int] = None,
                  kv_cache_blocks: Optional[int] = None,
                  kv_block_tokens: Optional[int] = None,
-                 kv_layout: Optional[str] = None):
+                 kv_layout: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         """``kv_cache_dtype``: reduced-precision storage for BOTH the
         target and draft caches (same contract as InferenceEngine /
         ContinuousBatchingEngine: insert rounds via update_kv_cache's
@@ -363,7 +364,8 @@ class SpeculativeEngine:
         from .kvcache import make_kv_backend
         self.kv_cache = make_kv_backend(
             cfg, kv_cache_blocks, kv_block_tokens, layout=self.kv_layout,
-            dtype=self.kv_cache_dtype, default_blocks=0)
+            dtype=self.kv_cache_dtype, kv_dtype=kv_dtype,
+            default_blocks=0)
 
         def one_round(tparams, dparams, last_tok, tcache, dcache, rng):
             """Draft K, verify K+1 in one target forward, accept/resample.

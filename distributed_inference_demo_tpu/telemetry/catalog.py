@@ -167,6 +167,16 @@ KVCACHE_H2D_BYTES = counter(
     "Bytes copied host-to-device to seed caches from prefix hits "
     "(dense layout's per-hit gather; stays 0 on the paged path, where "
     "hits are device block-table references)")
+KVCACHE_PAGE_DTYPE = gauge(
+    "dwt_kvcache_page_dtype_info",
+    "Page width of the paged KV pool as an info gauge: the series with "
+    "the active --kv-dtype label (bf16 / int8 / int4) reads 1, the "
+    "others 0 (docs/DESIGN.md §17)", ("dtype",))
+KVCACHE_QUANT_SCALE_BYTES = gauge(
+    "dwt_kvcache_quant_scale_bytes",
+    "Device bytes held by quantization scale (and int4 zero-point) "
+    "sidecars of in-use pages — the accounting overhead the narrow "
+    "page width pays; 0 on the bf16 layout")
 
 
 def update_kvcache_series(kv: dict) -> None:
@@ -192,6 +202,12 @@ def update_kvcache_series(kv: dict) -> None:
     KVCACHE_DEVICE_RESIDENT_BYTES.set(kv.get("device_resident_bytes", 0))
     KVCACHE_BLOCKS_IN_USE.set(kv.get("blocks_used", 0))
     KVCACHE_H2D_BYTES.set_cumulative(kv.get("h2d_bytes", 0))
+    page_dtype = kv.get("page_dtype")
+    if page_dtype is not None:
+        from ..ops.quant import KV_DTYPES
+        for d in KV_DTYPES:
+            KVCACHE_PAGE_DTYPE.set(1 if d == page_dtype else 0, dtype=d)
+        KVCACHE_QUANT_SCALE_BYTES.set(kv.get("quant_scale_bytes", 0))
 
 
 SPEC_ROUNDS = counter(

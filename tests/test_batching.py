@@ -49,7 +49,11 @@ def test_single_request_matches_engine(params, oracle):
         np.testing.assert_array_equal(got, expected(oracle, prompt, 12))
 
 
+@pytest.mark.slow
 def test_concurrent_requests_all_match(params, oracle):
+    # slow lane: test_paged_batching's cold-parity concurrent test is
+    # the quick rep for concurrent-request parity on the (paged-native)
+    # scheduler; this is the ragged-lengths twin of the same claim
     prompts = [[3, 14, 15], [9, 2, 6, 5, 3, 5], [1], [7, 7, 7, 7]]
     ns = [10, 14, 8, 12]
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,

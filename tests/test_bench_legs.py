@@ -56,11 +56,13 @@ def test_leg_paged_decode_structure_tiny():
     scale — proves the leg before it can burn a TPU session attempt,
     and pins the leg-level acceptance shape: both HBM numbers present,
     a strictly larger admissible batch at every sequence budget, and
-    h2d_bytes == 0 on the primed paged path."""
+    h2d_bytes == 0 on the primed paged path.  The quick lane runs the
+    int8 kv-dtype phase only (one extra engine compile); the full
+    int8-vs-int4 ordering rides the slow twin below."""
     out = bench._leg_paged_decode("llama-test", 6, slots=2,
                                   prompt_len=16, max_seq=64,
                                   block_tokens=8, n_req=4,
-                                  shared_len=8)
+                                  shared_len=8, kv_dtypes=("int8",))
     assert "error" not in out
     assert out["dense"]["tokens_per_sec"] > 0
     assert out["paged"]["tokens_per_sec"] > 0
@@ -82,6 +84,67 @@ def test_leg_paged_decode_structure_tiny():
     assert primed["hit_rate"] == 1.0
     assert primed["reused_tokens"] >= 4 * 8
     assert primed["h2d_bytes"] == 0
+    # the §17 kv-dtype gate: at the SAME fixed byte budget, int8 pages
+    # (narrower block_bytes, scale sidecar accounted) admit a strictly
+    # larger batch than bf16 pages at every sequence budget — and the
+    # wave really decoded against the quantized pool
+    q = out["kv_dtype"]["int8"]
+    assert q["tokens_per_sec"] > 0
+    assert 0 < q["peak_blocks_in_use"]
+    assert 0 < q["block_bytes"] < out["paged"]["block_bytes"]
+    assert q["scale_block_bytes"] > 0
+    assert q["pool_capacity_bytes"] > 0
+    for seq in ("4096", "8192", "32768"):
+        adm8 = q["admissible"][seq]
+        assert adm8["budget_bytes"] == out["dense"]["cache_reserved_bytes"]
+        assert (adm8["paged_max_batch"]
+                > out["admissible"][seq]["paged_max_batch"])
+
+
+@pytest.mark.slow
+def test_leg_paged_decode_kv_dtype_axis_full():
+    """Slow twin of the quick dryrun above: the FULL §17 kv-dtype axis
+    (int8 AND int4) with the width ordering pinned — int4 blocks are
+    narrower than int8, which are narrower than bf16, and the
+    admissible batch grows strictly with each narrowing at every
+    sequence budget."""
+    out = bench._leg_paged_decode("llama-test", 6, slots=2,
+                                  prompt_len=16, max_seq=64,
+                                  block_tokens=8, n_req=4,
+                                  shared_len=8,
+                                  kv_dtypes=("int8", "int4"))
+    assert "error" not in out
+    q8, q4 = out["kv_dtype"]["int8"], out["kv_dtype"]["int4"]
+    assert q8["tokens_per_sec"] > 0 and q4["tokens_per_sec"] > 0
+    assert q4["block_bytes"] < q8["block_bytes"] < out["paged"][
+        "block_bytes"]
+    # int4 carries the wider sidecar (scale + zero-point per token-head)
+    assert q4["scale_block_bytes"] > q8["scale_block_bytes"] > 0
+    for seq in ("4096", "8192", "32768"):
+        bf16_b = out["admissible"][seq]["paged_max_batch"]
+        assert (q4["admissible"][seq]["paged_max_batch"]
+                > q8["admissible"][seq]["paged_max_batch"]
+                > bf16_b)
+
+
+@pytest.mark.slow
+def test_leg_sweep_kv_points_structure_tiny():
+    """The sweep's §17 weight-dtype x kv-dtype cross: one batching-
+    engine point per pair at the largest batch, each reporting real
+    decode throughput against its page pool (int4-KV points included —
+    the gather path serves them where the kernel refuses)."""
+    out = bench._leg_sweep("llama-test", 16, 4, quants=(False,),
+                           batches=(2,), kv_dtypes=("bf16", "int8"))
+    assert len(out["points"]) == 1
+    kv = out["kv_points"]
+    assert [(p["kv_dtype"], p["batch"]) for p in kv] == [("bf16", 2),
+                                                         ("int8", 2)]
+    for p in kv:
+        assert "error" not in p, p
+        assert p["engine"] == "batching-paged"
+        assert p["decode_tokens_per_sec"] > 0
+        assert p["pool_capacity_bytes"] > 0
+    assert kv[1]["block_bytes"] < kv[0]["block_bytes"]
 
 
 @pytest.mark.slow

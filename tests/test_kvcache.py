@@ -374,9 +374,13 @@ def test_engine_near_capacity_suffix_single_dispatch(tiny):
     assert primed.kv_cache.stats["partial_hit_tokens"] == 28
 
 
+@pytest.mark.slow
 def test_speculative_target_primed_vs_cold_exactness(tiny):
     """SpeculativeEngine path: target-side block reuse keeps greedy
-    output bit-identical to the cold plain engine."""
+    output bit-identical to the cold plain engine.  Slow lane: the
+    quick lane keeps two spec-pool reps — test_kv_backend's
+    page-sharing ownership test (primed == cold equality) and
+    test_kv_quant's speculative cold-oracle/primed-floor test."""
     import jax
     from distributed_inference_demo_tpu.models import get_model_config
     from distributed_inference_demo_tpu.models.decoder import (

@@ -96,10 +96,13 @@ def test_chunked_prefill_matches_whole(params, draft_params, plen):
     np.testing.assert_array_equal(want.tokens, got.tokens)
 
 
+@pytest.mark.slow
 def test_chunked_prefill_padded_past_capacity(params, draft_params):
     """Aligned-last-window regression shape: the chunk-padded prompt
     would spill past max_seq; the left shift must keep spec decode
-    bit-identical (both caches)."""
+    bit-identical (both caches).  Slow lane:
+    test_chunked_prefill_matches_whole[8] keeps the chunked-prefill
+    parity rep quick; this is the capacity-edge twin."""
     sampling = SamplingParams(greedy=True)
     whole = SpeculativeEngine(CFG, params, DRAFT_CFG, draft_params,
                               max_seq=24, sampling=sampling, num_draft=3)
@@ -314,10 +317,13 @@ def test_cache_capacity_sublane_aligned(params, draft_params):
     assert dc.max_seq % 8 == 0
 
 
+@pytest.mark.slow
 def test_eos_padding_matches_engine(params, draft_params):
     """With eos_id set, greedy spec decode equals InferenceEngine's
     eos-padded fused scan bit-exactly (rows pad with eos after their
-    first eos; unfinished rows are untouched)."""
+    first eos; unfinished rows are untouched).  Slow lane:
+    test_eos_stream_matches_engine_stream stays quick and drives the
+    same eos-padding contract through the streamed surface."""
     sampling = SamplingParams(greedy=True)
     base = InferenceEngine(CFG, params, max_seq=96, sampling=sampling)
     prompt = np.asarray([[3, 14, 15, 92, 65], [1, 2, 3, 4, 5]])

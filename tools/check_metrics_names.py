@@ -78,6 +78,8 @@ REQUIRED_SERIES = {
     "dwt_kvcache_device_resident_bytes",
     "dwt_kvcache_blocks_in_use",
     "dwt_kvcache_h2d_bytes_total",
+    "dwt_kvcache_page_dtype_info",
+    "dwt_kvcache_quant_scale_bytes",
     # the transport-reliability / chaos quartet (docs/DESIGN.md §12): a
     # corrupt frame that is silently absent from /metrics is exactly the
     # "decoded garbage into a wrong token" failure this layer exists to
