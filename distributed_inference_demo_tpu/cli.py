@@ -564,6 +564,32 @@ def cmd_gateway(args) -> int:
     from .runtime.gateway import (GatewayHTTPServer, PrefixAwareRouter,
                                   ReplicaRegistry)
 
+    if args.drain or args.undrain:
+        # client mode: flip the drain flag on an ALREADY-RUNNING
+        # gateway at --http-host/--http-port, print its answer, exit
+        import json as _json
+        from http.client import HTTPConnection
+        rid = args.drain or args.undrain
+        body = _json.dumps({"replica": rid,
+                            "draining": bool(args.drain)}).encode()
+        conn = HTTPConnection(args.http_host, args.http_port, timeout=5.0)
+        try:
+            conn.request("POST", "/drain", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            print(resp.read().decode("utf-8", "replace"))
+            return 0 if resp.status == 200 else 1
+        except OSError as e:
+            print(f"gateway at {args.http_host}:{args.http_port} "
+                  f"unreachable: {e}", file=sys.stderr)
+            return 1
+        finally:
+            conn.close()
+
+    if not args.replicas:
+        print("--replicas is required (except with --drain/--undrain)",
+              file=sys.stderr)
+        return 1
     try:
         replicas = _parse_replicas(args.replicas)
     except ValueError as e:
@@ -1349,9 +1375,17 @@ def main(argv=None) -> int:
 
     gw = sub.add_parser("gateway", help="replicated serving gateway: "
                         "prefix-aware routing over N serve replicas")
-    gw.add_argument("--replicas", required=True,
+    gw.add_argument("--replicas", default="",
                     help="comma list of replica host:port (each a running "
-                         "'serve' process)")
+                         "'serve' process); required except with "
+                         "--drain/--undrain")
+    gw.add_argument("--drain", default="",
+                    help="client mode: mark REPLICA (host:port) draining "
+                         "on the running gateway at --http-host/--http-"
+                         "port — new requests stop routing to it while "
+                         "in-flight streams finish (docs/DESIGN.md §18)")
+    gw.add_argument("--undrain", default="",
+                    help="client mode: clear REPLICA's draining flag")
     gw.add_argument("--http-host", default="127.0.0.1")
     gw.add_argument("--http-port", type=int, default=5080)
     gw.add_argument("--health-interval", type=float, default=1.0,

@@ -51,9 +51,11 @@ bit-exact vs InferenceEngine (pinned by tests).
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -104,6 +106,12 @@ class _BlocksExhausted(Exception):
     paged twin of 'no free slot', never a request failure."""
 
 
+# queue sentinel that wakes an idle scheduler without enqueueing work
+# (export_request posts it so a checkpoint never waits on the blocking
+# get of a truly idle loop)
+_WAKE = object()
+
+
 @dataclass
 class Request:
     """One in-flight generation request (row-level)."""
@@ -119,6 +127,9 @@ class Request:
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
     cancelled: bool = False
+    # engine-unique request id (auto-assigned by submit when the caller
+    # passes none) — the address live migration exports/aborts by
+    rid: Optional[str] = None
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self.done.wait(timeout):
@@ -824,6 +835,18 @@ class ContinuousBatchingEngine:
 
         self._slots: List[Optional[Request]] = [None] * B
         self._queue: "queue.Queue" = queue.Queue()
+        # live-migration seam (docs/DESIGN.md §18): rid -> Request for
+        # export_request/active_requests addressing (entries die with
+        # their request), plus the export mailbox the scheduler thread
+        # services between steps (a foreign thread must never touch the
+        # donated pool buffers)
+        self._by_rid: dict = {}
+        self._rid_salt = uuid.uuid4().hex[:8]
+        self._rid_counter = itertools.count()
+        self._export_q: "deque" = deque()
+        self.migration_stats = {"exported_requests": 0,
+                                "imported_requests": 0,
+                                "detached_requests": 0}
         self._flight = get_flight_recorder()
         # online anomaly watch over the same stats() surface /stats
         # serves; throttled to ~1 Hz inside the scheduler loop, and
@@ -845,7 +868,8 @@ class ContinuousBatchingEngine:
     # public API
 
     def submit(self, prompt_ids, max_new_tokens: int,
-               _staged: Optional[dict] = None) -> Request:
+               _staged: Optional[dict] = None,
+               request_id: Optional[str] = None) -> Request:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         check_capacity(self.max_seq, len(prompt), max_new_tokens)
         if len(prompt) == 0:
@@ -884,6 +908,14 @@ class ContinuousBatchingEngine:
                     retry_after_s=1.0)
         req = Request(prompt=prompt, max_new=max_new_tokens,
                       t_submit=time.perf_counter())
+        # every request gets a migration-addressable id: caller-supplied,
+        # or engine-salted auto id (the salt keeps auto rids distinct
+        # across replicas sharing a transport namespace).  Wire frame
+        # tags are colon-delimited, so rids must not contain ':'.
+        if request_id is not None and ":" in request_id:
+            raise ValueError(f"request_id {request_id!r} contains ':'")
+        req.rid = (request_id if request_id is not None
+                   else f"r{self._rid_salt}-{next(self._rid_counter)}")
         # staged premigrated blocks (submit_premigrated) attach BEFORE
         # the queue put: the scheduler thread may pop the request the
         # instant it lands, and a late-attached staging would silently
@@ -893,6 +925,7 @@ class ContinuousBatchingEngine:
         with self._submit_lock:
             if not self._running:
                 raise RuntimeError("engine is closed")
+            self._by_rid[req.rid] = req
             self._queue.put(req)
         return req
 
@@ -993,6 +1026,237 @@ class ContinuousBatchingEngine:
         self.disagg_stats["adopted_pages"] += len(adopted)
         self._flight.record("disagg_engine_adopt", blocks=len(adopted),
                             prompt_len=len(req.prompt))
+
+    # ------------------------------------------------------------------
+    # live migration (docs/DESIGN.md §18): checkpoint a decoding row out
+    # of this engine / adopt one into it
+
+    def export_request(self, rid, *, detach: bool = False,
+                       timeout: Optional[float] = 30.0) -> dict:
+        """Snapshot everything a decoding row owns — used KV pages
+        (verbatim, quantized pools included), emitted tokens/logprobs,
+        the sampler rng key, valid length + last token, budget and
+        kv_dtype tags — as a host-side checkpoint dict
+        :meth:`import_request` resumes from.
+
+        Runs ON the scheduler thread between steps (posted via a
+        mailbox; the caller blocks up to ``timeout``), so the snapshot
+        is step-consistent: no token is half-recorded and the page
+        gather can't race a donated-pool dispatch.
+
+        ``detach=True`` additionally removes the request from the
+        engine — slot freed, pages released back to the pool — while
+        leaving its ``stream`` OPEN and ``done`` unset: the caller now
+        owns delivery (the migration relay feeds the stream from the
+        target replica).  Detach is the atomic-handoff freeze point: the
+        row decodes up to the step before the snapshot and never after
+        it, so the target resuming AT the snapshot replays at most the
+        in-flight step — never skips one.
+
+        Plain decode slots only: the speculative proposers' draft-pool /
+        n-gram history state is not checkpointed."""
+        req = rid if isinstance(rid, Request) else self._by_rid.get(rid)
+        if req is None:
+            raise KeyError(f"unknown request id {rid!r}")
+        box = {"req": req, "detach": detach, "ckpt": None, "err": None,
+               "claimed": False, "abandoned": False,
+               "event": threading.Event()}
+        with self._submit_lock:
+            if not self._running:
+                raise RuntimeError("engine is closed")
+            self._export_q.append(box)
+            self._queue.put(_WAKE)
+        if not box["event"].wait(timeout):
+            # a scheduler stalled past the timeout (first-step jit
+            # compile, pool-pressure wave) may still service this box
+            # LATER — with detach=True that would orphan the request:
+            # pages released, stream never fed, no caller left to own
+            # delivery.  Abandon the box so a late service is a no-op;
+            # if the scheduler claimed it in the race window the export
+            # is executing right now, so wait the result out instead.
+            with self._submit_lock:
+                if not box["claimed"]:
+                    box["abandoned"] = True
+            if box["abandoned"]:
+                raise TimeoutError(
+                    "export_request timed out waiting for the "
+                    "scheduler; the export was abandoned and the "
+                    "request left untouched")
+            box["event"].wait()
+        if box["err"] is not None:
+            raise box["err"]
+        return box["ckpt"]
+
+    def _service_exports(self) -> None:
+        """Serve queued export_request mailboxes — scheduler thread,
+        once per iteration, between steps.  The claimed/abandoned
+        handshake (under ``_submit_lock``) makes a timed-out caller's
+        box a no-op: servicing it anyway could detach a row nobody
+        owns."""
+        while self._export_q:
+            box = self._export_q.popleft()
+            with self._submit_lock:
+                if box.get("abandoned"):
+                    continue
+                box["claimed"] = True
+            try:
+                box["ckpt"] = self._export_one(box["req"], box["detach"])
+            except BaseException as e:
+                box["err"] = e
+            box["event"].set()
+
+    def _export_one(self, req: Request, detach: bool) -> dict:
+        if req.done.is_set():
+            raise ValueError(f"request {req.rid!r} already finished")
+        if req.cancelled:
+            raise ValueError(f"request {req.rid!r} was cancelled")
+        if self._spec_step is not None or self._pld_step is not None:
+            raise ValueError(
+                "export_request supports plain decode slots only (the "
+                "speculative proposers' draft/history state is not "
+                "checkpointed)")
+        slot = next((i for i, r in enumerate(self._slots) if r is req),
+                    None)
+        if (slot is None and self._adm is not None
+                and self._adm["req"] is req):
+            raise ValueError(
+                f"request {req.rid!r} is mid-chunked-admission; retry "
+                "after its final prefill lands")
+        ckpt = {"rid": req.rid,
+                "prompt": np.asarray(req.prompt, np.int32),
+                "max_new": int(req.max_new),
+                "tokens": list(req.tokens), "lps": list(req.lps),
+                "kv_dtype": self.kv_dtype,
+                "block_tokens": int(self.kv_cache.block_tokens),
+                "eos_id": self.eos_id}
+        if slot is None:
+            # still queued: a cold checkpoint (no pages, nothing
+            # emitted) — the importer degrades it to a plain submit
+            ckpt.update(length=0, last_tok=0, k=None, v=None, rng=None)
+            n_used = 0
+        else:
+            # KV validity: prefill writes [0, plen) and samples token 1;
+            # each decode step writes last_tok's KV at `lengths` then
+            # increments — after T emitted tokens lengths = plen + T - 1
+            # and KV [0, lengths) is valid.  The partial tail block
+            # ships verbatim: its columns past `lengths` hold garbage
+            # the stale-slot invariant already covers (decode rewrites
+            # them before any query attends).
+            length = int(np.asarray(self._lengths)[slot])
+            last_tok = int(np.asarray(self._last_tok)[slot])
+            bt = self.kv_cache.block_tokens
+            n_used = -(-length // bt)
+            ids = np.asarray(self._tables[slot][:n_used], np.int32)
+            from .kvcache.device import export_blocks_from_pages
+            k_run, v_run = export_blocks_from_pages(
+                self._pk, self._pv, jnp.asarray(ids))
+            ckpt.update(length=length, last_tok=last_tok,
+                        k=jax.tree.map(np.asarray, k_run),
+                        v=jax.tree.map(np.asarray, v_run),
+                        rng=np.asarray(self._rng).copy())
+        self.migration_stats["exported_requests"] += 1
+        if detach:
+            if slot is not None:
+                self._slots[slot] = None
+                self._sentinel_slot(slot)
+                self._release_request_kv(req)
+            else:
+                try:
+                    self._pending.remove(req)
+                except ValueError:
+                    pass
+            if req.rid is not None and self._by_rid.get(req.rid) is req:
+                del self._by_rid[req.rid]
+            req._detached = True
+            self.migration_stats["detached_requests"] += 1
+        self._flight.record("migration_export", rid=req.rid,
+                            tokens=len(req.tokens), blocks=n_used,
+                            detach=detach)
+        return ckpt
+
+    def import_request(self, ckpt: dict,
+                       request_id: Optional[str] = None) -> Request:
+        """Adopt an :meth:`export_request` checkpoint: the shipped pages
+        land in freshly allocated pool pages (one device scatter, the
+        same ``adopt_blocks_into_pages`` join premigrated prefills use),
+        whole-PROMPT blocks are adopted into the radix tree (pages
+        holding generated tokens stay request-private — `page owned by
+        tree xor request` holds verbatim), and decode resumes at the
+        checkpointed length with NO prefill dispatch and zero dense-row
+        h2d.  Restoring the rng key makes a single-request resume
+        sample-exact; greedy streams are bit-identical regardless."""
+        rid = request_id if request_id is not None else ckpt.get("rid")
+        if not ckpt.get("tokens") or int(ckpt.get("length") or 0) <= 0:
+            # cold checkpoint: nothing decoded yet — plain admission
+            return self.submit(ckpt["prompt"], ckpt["max_new"],
+                               request_id=rid)
+        if self._spec_step is not None or self._pld_step is not None:
+            raise ValueError(
+                "import_request supports plain decode slots only")
+        if ckpt.get("kv_dtype", "bf16") != self.kv_dtype:
+            raise ValueError(
+                f"checkpoint kv_dtype {ckpt.get('kv_dtype')!r} does not "
+                f"match this engine's {self.kv_dtype!r} pages")
+        bt = self.kv_cache.block_tokens
+        if int(ckpt.get("block_tokens", bt)) != bt:
+            raise ValueError(
+                f"checkpoint block_tokens {ckpt.get('block_tokens')} != "
+                f"pool block_tokens {bt}")
+        prompt = np.asarray(ckpt["prompt"], np.int32).reshape(-1)
+        max_new = int(ckpt["max_new"])
+        tokens = [int(t) for t in ckpt["tokens"]]
+        if len(tokens) >= max_new:
+            raise ValueError("checkpointed request has no budget left")
+        check_capacity(self.max_seq, len(prompt), max_new)
+        need = -(-(len(prompt) + max_new + self._slack_tokens) // bt)
+        if need > self.kv_cache.num_blocks:
+            raise ValueError(
+                f"checkpoint needs {need} KV blocks but the pool holds "
+                f"only {self.kv_cache.num_blocks}")
+        length = int(ckpt["length"])
+        if length != len(prompt) + len(tokens) - 1:
+            raise ValueError(
+                f"checkpoint length {length} != prompt {len(prompt)} + "
+                f"emitted {len(tokens)} - 1")
+        n_used = -(-length // bt)
+        n_shipped = jax.tree.leaves(ckpt["k"])[0].shape[0]
+        if n_shipped != n_used:
+            raise ValueError(
+                f"checkpoint ships {n_shipped} blocks; length "
+                f"{length} needs {n_used}")
+        req = Request(prompt=prompt, max_new=max_new,
+                      t_submit=time.perf_counter())
+        req.rid = rid
+        req.tokens = tokens
+        req.lps = [float(x) for x in (ckpt.get("lps") or [])]
+        req.t_first = time.perf_counter()
+        req._resume = {"k": ckpt["k"], "v": ckpt["v"], "length": length,
+                       "last_tok": int(ckpt["last_tok"]),
+                       "rng": ckpt.get("rng")}
+        with self._submit_lock:
+            if not self._running:
+                raise RuntimeError("engine is closed")
+            if rid is not None:
+                self._by_rid[rid] = req
+            self._queue.put(req)
+        return req
+
+    def get_request(self, rid: str) -> Optional[Request]:
+        """The live Request registered under ``rid`` (None once it
+        finished or was detached) — the migration relay grabs the handle
+        BEFORE the detaching export removes the registration."""
+        return self._by_rid.get(rid)
+
+    def active_requests(self) -> list:
+        """``[(rid, emitted, remaining)]`` for currently decoding slots
+        — the migration controller's load view.  Racy read-only snapshot
+        (any thread); rows mid-admission or queued are excluded."""
+        out = []
+        for r in list(self._slots):
+            if r is not None and r.rid is not None and not r.cancelled:
+                out.append((r.rid, len(r.tokens),
+                            r.max_new - len(r.tokens)))
+        return out
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0, timeout: Optional[float] = None,
@@ -1143,6 +1407,8 @@ class ContinuousBatchingEngine:
                                       **self.chunk_stats}
         if self.disagg_stats["premigrated_requests"]:
             out["disagg"] = dict(self.disagg_stats)
+        if any(self.migration_stats.values()):
+            out["migration"] = dict(self.migration_stats)
         if self._spec_step is not None or self._pld_step is not None:
             s = self.spec_stats
             out["speculative"] = {
@@ -1169,6 +1435,8 @@ class ContinuousBatchingEngine:
             out["kvcache"] = self.kv_cache.debug_state()
         if self.disagg_stats["premigrated_requests"]:
             out["disagg"] = dict(self.disagg_stats)
+        if any(self.migration_stats.values()):
+            out["migration"] = dict(self.migration_stats)
         return out
 
     def reset_stats(self) -> None:
@@ -1316,6 +1584,10 @@ class ContinuousBatchingEngine:
         C = self.prefill_chunk
         if C is None:
             return False
+        if getattr(req, "_resume", None) is not None:
+            # a live-migration resume never prefills: its checkpoint IS
+            # the row state, one adopt scatter regardless of prompt size
+            return False
         st = getattr(req, "_staged", None)
         if st is not None and not st["imported"]:
             # premigrated join: the effective suffix after the adopt is
@@ -1338,9 +1610,66 @@ class ContinuousBatchingEngine:
         return needs
 
     def _admit_request(self, slot: int, req: Request):
+        if getattr(req, "_resume", None) is not None:
+            self._admit_resume(slot, req)
+            return
         start, row_k, row_v = self._row_for(req)
         self._finish_admission(slot, req, start, row_k, row_v,
                                req.prompt[start:])
+
+    def _admit_resume(self, slot: int, req: Request) -> None:
+        """Adopt a live-migration checkpoint into a free slot (docs/
+        DESIGN.md §18): scatter the shipped blocks into freshly
+        allocated pages, adopt the whole-PROMPT blocks into the radix
+        tree (pages holding generated tokens stay request-private), and
+        install the slot state at the checkpointed length/last-token —
+        no prefill dispatch, decode resumes exactly where the source
+        froze.  Restoring the rng key hands over the sampler state (the
+        pre-split order makes the key the whole of it)."""
+        rs = req._resume
+        mgr = self.kv_cache
+        bt = mgr.block_tokens
+        plen = len(req.prompt)
+        n_total = -(-(plen + req.max_new + self._slack_tokens) // bt)
+        # same pool-pressure retry gate as _row_for
+        state = (mgr.epoch, mgr.free_blocks)
+        if getattr(req, "_pkv_blocked", None) == state:
+            raise _BlocksExhausted()
+        ids = mgr.alloc(n_total)
+        if ids is None:
+            req._pkv_blocked = state
+            raise _BlocksExhausted()
+        req._pkv_blocked = None
+        length = rs["length"]
+        n_used = -(-length // bt)
+        from .kvcache.device import adopt_blocks_into_pages
+        self._pk, self._pv = adopt_blocks_into_pages(
+            self._pk, self._pv, jax.tree.map(jnp.asarray, rs["k"]),
+            jax.tree.map(jnp.asarray, rs["v"]),
+            jnp.asarray(np.asarray(ids[:n_used], np.int32)))
+        adopted, store_lease = (), None
+        if plen // bt >= 1:
+            adopted, store_lease = mgr.store_shared(
+                req.prompt, ids[:plen // bt])
+        table = np.full((self._table_width,), self._page_sentinel,
+                        np.int32)
+        table[:n_total] = ids
+        req._pkv = {"lease": None, "store_lease": store_lease,
+                    "private": ids, "adopted": tuple(adopted),
+                    "n_pref": 0, "table": table, "dprivate": None,
+                    "dtable": None, "released": False}
+        self._tables[slot] = table
+        self._lengths, self._last_tok = self._set_slot_state(
+            self._lengths, self._last_tok, jnp.int32(slot),
+            jnp.int32(length), jnp.int32(rs["last_tok"]))
+        if rs.get("rng") is not None:
+            self._rng = jnp.asarray(np.asarray(rs["rng"]))
+        self._slots[slot] = req
+        req._resume = None          # staged host buffers are done
+        self.migration_stats["imported_requests"] += 1
+        self._flight.record("migration_import", slot=slot, rid=req.rid,
+                            length=length, tokens=len(req.tokens),
+                            blocks=n_used)
 
     def _start_admission(self, req: Request) -> bool:
         """Park a chunk-needing prompt as the in-progress admission the
@@ -1523,6 +1852,8 @@ class ContinuousBatchingEngine:
                     (req.t_done - req.t_first) / (len(req.tokens) - 1))
             req.stream.put(None)
             req.done.set()
+            if req.rid is not None and self._by_rid.get(req.rid) is req:
+                del self._by_rid[req.rid]
             self._slots[slot] = None
             # completion frees the pages: pins released, private
             # non-adopted pages back to the pool (target AND draft),
@@ -1544,6 +1875,8 @@ class ContinuousBatchingEngine:
         req.error = err
         req.stream.put(None)
         req.done.set()
+        if req.rid is not None and self._by_rid.get(req.rid) is req:
+            del self._by_rid[req.rid]
         if err is not None:
             get_flight_recorder().record(
                 "batch_fail", error=type(err).__name__,
@@ -1562,12 +1895,16 @@ class ContinuousBatchingEngine:
             self._adm = None
         while self._pending:
             self._fail_request(self._pending.popleft(), err)
+        while self._export_q:
+            box = self._export_q.popleft()
+            box["err"] = err
+            box["event"].set()
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 return
-            if req is not None:
+            if req is not None and req is not _WAKE:
                 self._fail_request(req, err)
 
     def _sweep_cancelled(self) -> None:
@@ -1712,6 +2049,8 @@ class ContinuousBatchingEngine:
                 except queue.Empty:
                     break
                 timeout = 0.0
+                if req is _WAKE:           # export_request nudge
+                    continue
                 if req is None:            # close() sentinel
                     break
                 self._pending.append(req)
@@ -1747,6 +2086,10 @@ class ContinuousBatchingEngine:
                     still.append(req)      # waiting for a slot
             self._pending = still
             self._sweep_cancelled()
+            # serve export checkpoints between steps: state is
+            # consistent here (pending drained, cancels swept, no
+            # dispatch in flight)
+            self._service_exports()
             if not any(self._slots):
                 continue
 

@@ -177,6 +177,178 @@ class MigrationError(RuntimeError):
     """A migration could not complete within its retry budget."""
 
 
+class PageStager:
+    """Host-side ``(rid, attempt)`` page-frame staging shared by the §15
+    admission join (:class:`DecodeWorker`) and the §18 live-migration
+    handoff (``runtime.migration.MigrationWorker``).
+
+    One store serves both protocols because their bulk-transfer leg is
+    the same ``pg:`` frame stream — when a decode replica runs both
+    workers on one transport, they SHARE one stager (the worker whose
+    completion frame arrives — ``pge:`` or ``rsd:`` — claims the staged
+    record), so an inbound page frame never needs to announce which
+    protocol it belongs to.
+
+    Invariants the stager owns:
+
+    - staging is HOST memory only — zero pool pages held until a
+      complete, CRC-verified frame set is adopted (crash cleanup is
+      structural);
+    - ``staged_bytes`` tracks every staged tensor byte and every removal
+      path (abort, adopt, supersede, eviction) funnels through
+      :meth:`clear`, so an aborted handoff provably leaves
+      ``staged_bytes == 0`` and no record behind;
+    - an ABORTED ``(rid, attempt)`` is remembered (bounded markers): a
+      late frame of that attempt drops instead of silently restaging a
+      leak the abort already cleaned up;
+    - bounded: past ``STAGED_CAP`` records the OLDEST evicts — the
+      backstop for migrations orphaned by a sender that died without an
+      abort reaching us.  Evicting a still-live migration is safe: its
+      next frame restages from seq 0, the end frame nacks, and the
+      sender's go-back-n retransmits the lot.
+
+    Record schema (the keys tests may pin): ``attempt``, ``expected``
+    (next seq), ``frames`` ({seq: (first_block, k_leaves, v_leaves)}),
+    ``kv_dtype``, ``bytes``, ``t0``, plus ``state_meta`` /
+    ``state_tensors`` / ``ctx`` slots the live-migration manifest
+    fills."""
+
+    STAGED_CAP = 256
+    MARK_CAP = 4096
+
+    def __init__(self, device_id: str, on_evict=None):
+        self.device_id = device_id
+        self._staged: Dict[str, dict] = {}
+        from collections import OrderedDict
+        self._aborted: "OrderedDict[str, int]" = OrderedDict()
+        self.staged_bytes = 0
+        self._on_evict = on_evict
+        self._flight = get_flight_recorder()
+
+    def clear(self, rid: str) -> Optional[dict]:
+        """Pop a staging record AND its byte accounting."""
+        st = self._staged.pop(rid, None)
+        if st is not None:
+            self.staged_bytes -= st["bytes"]
+        return st
+
+    def mark_aborted(self, rid: str, attempt: int) -> None:
+        self._aborted[rid] = attempt
+        self._aborted.move_to_end(rid)
+        while len(self._aborted) > self.MARK_CAP:
+            self._aborted.popitem(last=False)
+
+    def staging(self, rid: str, attempt: int) -> Optional[dict]:
+        """The record for ``(rid, attempt)``: fresh on the first frame
+        of a NEWER attempt (superseding the stale one), None for a stale
+        or aborted attempt (the caller drops the frame)."""
+        if self._aborted.get(rid, -1) >= attempt:
+            return None
+        st = self._staged.get(rid)
+        if st is None or st["attempt"] < attempt:
+            if st is not None:
+                self.clear(rid)
+                self._flight.record("disagg_attempt_superseded", rid=rid,
+                                    old=st["attempt"], new=attempt)
+            st = {"attempt": attempt, "expected": 0, "frames": {},
+                  "kv_dtype": "bf16", "bytes": 0,
+                  "state_meta": None, "state_tensors": None, "ctx": None,
+                  "t0": time.perf_counter()}
+            self._staged[rid] = st
+            while len(self._staged) > self.STAGED_CAP:
+                victim = min(self._staged,
+                             key=lambda r: self._staged[r]["t0"])
+                self.clear(victim)
+                self._flight.record("disagg_staging_evicted", rid=victim)
+                if self._on_evict is not None:
+                    self._on_evict(victim)
+            return st
+        if st["attempt"] > attempt:
+            return None
+        return st
+
+    def stage_page(self, rid: str, attempt: int, seq: int,
+                   payload: bytes, tag: str) -> str:
+        """Stage one ``pg:`` frame; returns ``"staged"`` or the drop
+        reason (``"corrupt"`` frames are counted via
+        :func:`record_corrupt_frame` here — the sender's ack round
+        retransmits them)."""
+        try:
+            meta, tensors, _ = _parse_meta_frame(payload)
+        except wire.WireError as e:
+            record_corrupt_frame(self.device_id, tag, len(payload), e)
+            return "corrupt"
+        st = self.staging(rid, attempt)
+        if st is None:
+            return "stale_attempt"
+        if seq != st["expected"]:
+            # duplicate (seq < expected) or a reorder hole (seq >
+            # expected): drop — the (rid, attempt, seq) dedup that makes
+            # retried page frames idempotent; go-back-n refills holes
+            return "dedup"
+        kv_dtype = meta.get("kv_dtype", "bf16")
+        nk = _WIRE_LEAVES.get(kv_dtype)
+        if nk is None or len(tensors) != 2 * nk:
+            # a malformed leaf list is a corrupt frame, not a protocol
+            # state: drop it and let the sender's ack round retransmit
+            record_corrupt_frame(
+                self.device_id, tag, len(payload),
+                wire.WireError(f"page frame kv_dtype={kv_dtype!r} with "
+                               f"{len(tensors)} tensors"))
+            return "corrupt"
+        # frames of one migration share one width (one exporter); the
+        # leaf lists stage per frame and concatenate leaf-wise on adopt
+        st["kv_dtype"] = kv_dtype
+        nb = int(sum(t.nbytes for t in tensors))
+        st["frames"][seq] = (int(meta["first_block"]),
+                             [np.asarray(t) for t in tensors[:nk]],
+                             [np.asarray(t) for t in tensors[nk:]])
+        st["bytes"] += nb
+        st["expected"] += 1
+        self.staged_bytes += nb
+        return "staged"
+
+    def concat_blocks(self, st: dict, n_blocks: int):
+        """``(k_blocks, v_blocks)`` assembled from a complete frame set:
+        frames apply in seq order at their ``first_block`` offsets, so a
+        later frame's version of a block (the live handoff's re-shipped
+        partial tail) OVERWRITES an earlier one's.  Raises
+        :class:`MigrationError` on block holes (a manifest/frames
+        mismatch — the caller fails the migration rather than adopting
+        the wrong pages)."""
+        if not st["frames"]:
+            return None, None
+        slots: List[Optional[tuple]] = [None] * n_blocks
+        overrun = 0
+        for seq in sorted(st["frames"]):
+            first, k_leaves, v_leaves = st["frames"][seq]
+            n = k_leaves[0].shape[0]
+            for j in range(n):
+                if 0 <= first + j < n_blocks:
+                    slots[first + j] = (
+                        [lv[j:j + 1] for lv in k_leaves],
+                        [lv[j:j + 1] for lv in v_leaves])
+                else:
+                    overrun += 1
+        holes = sum(s is None for s in slots)
+        if holes or overrun:
+            raise MigrationError(
+                f"staged frames cover {n_blocks - holes}/{n_blocks} "
+                f"blocks ({overrun} out of range)")
+        k_leaves = [np.concatenate(parts, axis=0)
+                    for parts in zip(*(s[0] for s in slots))]
+        v_leaves = [np.concatenate(parts, axis=0)
+                    for parts in zip(*(s[1] for s in slots))]
+        return (_kv_from_leaves(k_leaves, st["kv_dtype"]),
+                _kv_from_leaves(v_leaves, st["kv_dtype"]))
+
+    def debug_state(self) -> dict:
+        return {rid: {"attempt": st["attempt"],
+                      "frames_staged": st["expected"],
+                      "bytes": st["bytes"]}
+                for rid, st in list(self._staged.items())}
+
+
 # ---------------------------------------------------------------------------
 # prefill worker
 # ---------------------------------------------------------------------------
@@ -567,13 +739,16 @@ class DecodeWorker:
     pages``) holds unconditionally on this side.
     """
 
-    def __init__(self, engine, transport):
+    def __init__(self, engine, transport, stager: "PageStager" = None):
         self.engine = engine
         self.transport = transport
         self.device_id = transport.device_id
         self.tracer = TraceRecorder(f"decode:{self.device_id}")
-        # rid -> staging record (attempt, expected seq, k/v chunks)
-        self._staged: Dict[str, dict] = {}
+        # (rid, attempt) page-frame staging — shared with a co-serving
+        # live-migration worker when one is chained (docs/DESIGN.md §18)
+        self.stager = stager or PageStager(
+            self.device_id, on_evict=self._evicted)
+        self._staged = self.stager._staged       # test seam (schema pin)
         # rid -> attempt that joined (re-ack + duplicate suppression).
         # BOUNDED: oldest markers evict past _JOINED_CAP — a marker
         # only matters while late retransmits/reschedules of its rid
@@ -585,6 +760,9 @@ class DecodeWorker:
                       "last_migration_ms": None}
         self._stop = threading.Event()
         self._flight = get_flight_recorder()
+
+    def _evicted(self, rid: str) -> None:
+        self.stats["aborted_migrations"] += 1
 
     _JOINED_CAP = 4096
 
@@ -653,39 +831,6 @@ class DecodeWorker:
         except TransportError:
             pass                 # sender timeout/retry path recovers
 
-    _STAGED_CAP = 256
-
-    def _staging(self, rid: str, attempt: int) -> Optional[dict]:
-        """The staging record for (rid, attempt): created fresh on the
-        first frame of a NEWER attempt (discarding the stale one — a
-        rescheduled migration supersedes its predecessor), None for a
-        STALE attempt (its frames are dropped).
-
-        Bounded: past ``_STAGED_CAP`` records the OLDEST one evicts —
-        the backstop for migrations orphaned by a sender that died
-        without an abort reaching us.  Evicting a still-live migration
-        is safe: its next frame restages from seq 0, the end frame
-        nacks, and the sender's go-back-n retransmits the lot."""
-        st = self._staged.get(rid)
-        if st is None or st["attempt"] < attempt:
-            if st is not None:
-                self._flight.record("disagg_attempt_superseded", rid=rid,
-                                    old=st["attempt"], new=attempt)
-            st = {"attempt": attempt, "expected": 0, "k": [], "v": [],
-                  "kv_dtype": "bf16", "t0": time.perf_counter()}
-            self._staged[rid] = st
-            while len(self._staged) > self._STAGED_CAP:
-                victim = min(self._staged, key=lambda r:
-                             self._staged[r]["t0"])
-                self._staged.pop(victim)
-                self.stats["aborted_migrations"] += 1
-                self._flight.record("disagg_staging_evicted",
-                                    rid=victim)
-            return st
-        if st["attempt"] > attempt:
-            return None
-        return st
-
     def _on_page(self, rid: str, attempt: int, seq: int, payload: bytes,
                  tag: str) -> None:
         if rid in self._joined:
@@ -694,39 +839,9 @@ class DecodeWorker:
             # sender happy without a second join
             self._drop(tag, "already_joined")
             return
-        try:
-            meta, tensors, _ = _parse_meta_frame(payload)
-        except wire.WireError as e:
-            # CRC (or structure) rejected the frame BEFORE any adopt:
-            # counted, dropped; the sender's ack round retransmits it
-            record_corrupt_frame(self.device_id, tag, len(payload), e)
-            return
-        st = self._staging(rid, attempt)
-        if st is None:
-            self._drop(tag, "stale_attempt")
-            return
-        if seq != st["expected"]:
-            # duplicate (seq < expected) or a reorder hole (seq >
-            # expected): drop — the (rid, attempt, seq) dedup that makes
-            # retried page frames idempotent; go-back-n refills holes
-            self._drop(tag, "dedup")
-            return
-        kv_dtype = meta.get("kv_dtype", "bf16")
-        nk = _WIRE_LEAVES.get(kv_dtype)
-        if nk is None or len(tensors) != 2 * nk:
-            # a malformed leaf list is a corrupt frame, not a protocol
-            # state: drop it and let the sender's ack round retransmit
-            record_corrupt_frame(
-                self.device_id, tag, len(payload),
-                wire.WireError(f"page frame kv_dtype={kv_dtype!r} with "
-                               f"{len(tensors)} tensors"))
-            return
-        # frames of one migration share one width (one exporter); the
-        # leaf lists stage per frame and concatenate leaf-wise at end
-        st["kv_dtype"] = kv_dtype
-        st["k"].append([np.asarray(t) for t in tensors[:nk]])
-        st["v"].append([np.asarray(t) for t in tensors[nk:]])
-        st["expected"] += 1
+        status = self.stager.stage_page(rid, attempt, seq, payload, tag)
+        if status in ("stale_attempt", "dedup"):
+            self._drop(tag, status)
 
     def _on_end(self, rid: str, attempt: int, payload: bytes,
                 tag: str) -> None:
@@ -739,7 +854,7 @@ class DecodeWorker:
         if rid in self._joined:
             self._ack(rid, attempt, prefill_id, True, 0)
             return
-        st = self._staging(rid, attempt)
+        st = self.stager.staging(rid, attempt)
         if st is None:
             self._drop(tag, "stale_attempt")
             return
@@ -751,21 +866,14 @@ class DecodeWorker:
             return
         prompt = np.asarray(tensors[0], np.int32).reshape(-1)
         n_blocks = int(meta["n_blocks"])
-        if st["k"]:
-            k_leaves = [np.concatenate(parts, axis=0)
-                        for parts in zip(*st["k"])]
-            v_leaves = [np.concatenate(parts, axis=0)
-                        for parts in zip(*st["v"])]
-            k_blocks = _kv_from_leaves(k_leaves, st["kv_dtype"])
-            v_blocks = _kv_from_leaves(v_leaves, st["kv_dtype"])
-        else:
-            k_blocks = v_blocks = None
-        if k_blocks is not None and k_blocks.shape[0] != n_blocks:
+        try:
+            k_blocks, v_blocks = self.stager.concat_blocks(st, n_blocks)
+        except MigrationError:
             # manifest/frames disagree — treat as a failed migration
             # rather than adopting the wrong pages
             self._drop(tag, "manifest_mismatch")
             self._ack(rid, attempt, prefill_id, False, 0)
-            self._staged.pop(rid, None)
+            self.stager.clear(rid)
             return
         try:
             req = self.engine.submit_premigrated(
@@ -776,7 +884,7 @@ class DecodeWorker:
             # complete (the migration itself arrived — retransmitting
             # cannot fix admission) and surface the error to the
             # requester through the ordinary fin path
-            self._staged.pop(rid, None)
+            self.stager.clear(rid)
             self._mark_joined(rid, attempt)
             self._flight.record("disagg_join_rejected", rid=rid,
                                 error=type(e).__name__, detail=str(e))
@@ -791,7 +899,7 @@ class DecodeWorker:
                 pass
             return
         self._mark_joined(rid, attempt)
-        self._staged.pop(rid, None)
+        self.stager.clear(rid)
         self.stats["joined_requests"] += 1
         self.stats["adopted_pages"] += n_blocks
         dt = time.perf_counter() - st["t0"]
@@ -817,11 +925,18 @@ class DecodeWorker:
         t.start()
 
     def _on_abort(self, rid: str) -> None:
+        """Abort a staged migration: the host buffers AND their byte
+        accounting clear (``staged_bytes`` back to what it was before
+        frame 1), and the attempt is marked aborted so a late frame of
+        the same handoff drops instead of restaging a leak."""
         if rid in self._joined:
             return               # too late: the request is decoding
-        if self._staged.pop(rid, None) is not None:
+        st = self.stager.clear(rid)
+        if st is not None:
+            self.stager.mark_aborted(rid, st["attempt"])
             self.stats["aborted_migrations"] += 1
-            self._flight.record("disagg_abort", rid=rid)
+            self._flight.record("disagg_abort", rid=rid,
+                                attempt=st["attempt"])
 
     def _drain(self, req, rid: str, reply_to: str) -> None:
         """Forward one joined request's token stream to the requester
@@ -854,10 +969,9 @@ class DecodeWorker:
         """``GET /debugz`` fragment for the decode role: staged
         (in-flight) migrations, joined/adopted counters, the engine's
         KV picture."""
-        staged = {rid: {"attempt": st["attempt"],
-                        "frames_staged": st["expected"]}
-                  for rid, st in list(self._staged.items())}
-        out = {"role": "decode", "staged_migrations": staged,
+        out = {"role": "decode",
+               "staged_migrations": self.stager.debug_state(),
+               "staged_bytes": self.stager.staged_bytes,
                "migration": dict(self.stats)}
         try:
             out["engine"] = self.engine.debug_state()

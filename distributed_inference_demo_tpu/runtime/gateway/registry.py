@@ -22,6 +22,13 @@ Failures come from two doors with ONE streak: the background prober and
 mid-handshake is evidence exactly like a failed probe).  The clock and
 the prober are injectable so the debounce is testable without sockets
 or sleeps.
+
+Orthogonal to health is **draining** (docs/DESIGN.md §18): an operator
+(or the migration controller) marks a replica draining and it leaves
+:meth:`ReplicaRegistry.routable_replicas` — no NEW request routes to it
+— without burning an eviction strike or touching its in-flight streams.
+Surfaced on ``/debugz`` and the ``dwt_gateway_draining_replicas``
+gauge.
 """
 
 from __future__ import annotations
@@ -61,7 +68,7 @@ class Replica:
     """One replica's registry row (mutated only under the registry
     lock)."""
 
-    __slots__ = ("rid", "host", "port", "up", "fail_streak",
+    __slots__ = ("rid", "host", "port", "up", "draining", "fail_streak",
                  "down_at", "last_stats", "probes", "failures")
 
     def __init__(self, rid: str, host: str, port: int):
@@ -69,6 +76,7 @@ class Replica:
         self.host = host
         self.port = int(port)
         self.up = True
+        self.draining = False
         self.fail_streak = 0
         self.down_at: Optional[float] = None
         self.last_stats: dict = {}
@@ -128,10 +136,42 @@ class ReplicaRegistry:
         with self._lock:
             return [r.rid for r in self._replicas.values() if r.up]
 
+    def routable_replicas(self) -> List[str]:
+        """Replicas NEW requests may be routed to: up and not draining.
+        Health (``up``) and drain intent are orthogonal — a draining
+        replica still probes, still proxies its in-flight streams, and
+        still accepts migration traffic; it just stops attracting new
+        work."""
+        with self._lock:
+            return [r.rid for r in self._replicas.values()
+                    if r.up and not r.draining]
+
     def is_up(self, rid: str) -> bool:
         with self._lock:
             r = self._replicas.get(rid)
             return bool(r and r.up)
+
+    def is_draining(self, rid: str) -> bool:
+        with self._lock:
+            r = self._replicas.get(rid)
+            return bool(r and r.draining)
+
+    def set_draining(self, rid: str, flag: bool = True) -> None:
+        """Mark/unmark ``rid`` as draining.  NOT a failure strike: the
+        replica keeps its health state, keeps probing, and keeps
+        serving in-flight requests — it only leaves
+        :meth:`routable_replicas` so no NEW request lands on it."""
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is None or r.draining == flag:
+                return
+            r.draining = flag
+            n_draining = sum(
+                1 for x in self._replicas.values() if x.draining)
+        _catalog.GATEWAY_DRAINING.set(n_draining)
+        get_flight_recorder().record(
+            "gateway_replica_draining" if flag
+            else "gateway_replica_undrained", replica=rid)
 
     def get(self, rid: str) -> Replica:
         with self._lock:
@@ -244,7 +284,8 @@ class ReplicaRegistry:
                 "sustain": self.sustain,
                 "readmit_cooldown_s": self.readmit_cooldown_s,
                 "replicas": {
-                    r.rid: {"up": r.up, "fail_streak": r.fail_streak,
+                    r.rid: {"up": r.up, "draining": r.draining,
+                            "fail_streak": r.fail_streak,
                             "probes": r.probes, "failures": r.failures,
                             "queue_depth": r.queue_depth,
                             "down_for_s": (round(self._clock() - r.down_at, 3)

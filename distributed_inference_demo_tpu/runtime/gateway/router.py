@@ -192,11 +192,11 @@ class PrefixAwareRouter:
         """Pick a replica for ``tokens`` (None/empty = no routing key:
         straight to the hash fallback with an empty key).  Raises
         :class:`GatewayOverloaded` when no replica is admitted."""
-        ups = self.registry.up_replicas()
+        ups = self.registry.routable_replicas()
         if not ups:
             raise GatewayOverloaded(
                 "no replica is admitted to routing (all evicted by the "
-                "health debounce)", retry_after_s=2.0)
+                "health debounce or draining)", retry_after_s=2.0)
         toks = list(tokens) if tokens is not None else []
 
         best_rid, best_len = None, 0
@@ -260,6 +260,7 @@ class PrefixAwareRouter:
                 "replicas": {
                     rid: {
                         "up": self.registry.is_up(rid),
+                        "draining": self.registry.is_draining(rid),
                         "index_entries": len(self._index.get(rid, ())),
                         "routed": self._routed.get(rid, 0),
                         "prefix_routed": self._prefix_hits.get(rid, 0),

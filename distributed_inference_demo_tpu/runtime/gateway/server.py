@@ -84,7 +84,7 @@ class GatewayHTTPServer:
             # bounded route labels, same rule as the replica server
             _ROUTES = frozenset((
                 "/health", "/stats", "/metrics", "/trace", "/debugz",
-                "/generate"))
+                "/generate", "/drain"))
 
             def _json(self, code: int, obj: dict,
                       headers: Optional[dict] = None) -> None:
@@ -127,10 +127,12 @@ class GatewayHTTPServer:
                     self.wfile.write(body)
                 elif path == "/health":
                     ups = outer.registry.up_replicas()
+                    routable = outer.registry.routable_replicas()
                     self._json(200, {
-                        "status": "ok" if ups else "degraded",
+                        "status": "ok" if routable else "degraded",
                         "role": "gateway",
                         "replicas_up": len(ups),
+                        "replicas_routable": len(routable),
                         "replicas": outer.registry.replica_ids(),
                     })
                 elif path == "/stats":
@@ -146,7 +148,7 @@ class GatewayHTTPServer:
                     self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
-                if self.path != "/generate":
+                if self.path not in ("/generate", "/drain"):
                     self._json(404, {"error": f"no route {self.path}"})
                     return
                 try:
@@ -155,6 +157,9 @@ class GatewayHTTPServer:
                     req = json.loads(raw)
                 except (ValueError, KeyError) as e:
                     self._json(400, {"error": str(e)})
+                    return
+                if self.path == "/drain":
+                    self._json(*outer._handle_drain(req))
                     return
                 try:
                     outer._proxy_generate(self, raw, req)
@@ -348,6 +353,23 @@ class GatewayHTTPServer:
             return True
         finally:
             conn.close()
+
+    # -- drain control -----------------------------------------------------
+
+    def _handle_drain(self, req: dict) -> tuple:
+        """``POST /drain {"replica": rid, "draining": bool}``: flip the
+        registry's drain flag.  Routing changes take effect on the next
+        :meth:`~.router.PrefixAwareRouter.route` call; in-flight
+        proxies are untouched.  Moving the replica's requests off is
+        the migration controller's job, not the gateway's."""
+        rid = req.get("replica")
+        if not isinstance(rid, str) or rid not in self.registry.replica_ids():
+            return 400, {"error": f"unknown replica {rid!r}",
+                         "replicas": self.registry.replica_ids()}
+        flag = bool(req.get("draining", True))
+        self.registry.set_draining(rid, flag)
+        return 200, {"replica": rid, "draining": flag,
+                     "routable": self.registry.routable_replicas()}
 
     # -- introspection -----------------------------------------------------
 

@@ -165,6 +165,31 @@ def adopt_blocks_into_pages(pk, pv, k_blocks, v_blocks, table):
     return _adopt(pk, k_blocks), _adopt(pv, v_blocks)
 
 
+@jax.jit
+def export_blocks_from_pages(pk, pv, table):
+    """Gather block payloads ``[n, L, H, bt, D]`` out of the page pool at
+    ``table``'s (real) ids — the EXACT inverse of
+    :func:`adopt_blocks_into_pages` and the live-migration export seam
+    (docs/DESIGN.md §18): a decode replica snapshots a mid-flight
+    request's pages in one device gather, ships them, and the target's
+    adopt scatter lands bit-identical pages.
+
+    Quantized pools gather their narrow leaves VERBATIM (no dequantize /
+    re-quantize round trip) — the payload stays a
+    :class:`QuantizedKVPages` tree with block-leading leaves, which the
+    adopt side recognizes and writes back untouched.  The caller slices
+    the table to the request's used blocks; the partial tail block ships
+    as-is (its columns past the valid length hold garbage the stale-slot
+    invariant already covers — decode rewrites them before any query
+    attends)."""
+    def _export(pool):
+        return jax.tree.map(
+            lambda p: jnp.moveaxis(jnp.take(p, table, axis=1), 0, 1),
+            pool)
+
+    return _export(pk), _export(pv)
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def write_row_to_pages(pk, pv, row_k, row_v, table):
     """Scatter a prefilled dense row ``[L, 1, H, W*bt, D]`` into the page

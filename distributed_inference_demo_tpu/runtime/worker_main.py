@@ -119,7 +119,18 @@ def build_role_worker(args):
         kv_cache_blocks=args.kv_cache_blocks,
         kv_block_tokens=args.kv_block_tokens,
         kv_cache_dtype=getattr(args, "kv_cache_dtype", "") or None)
-    return DecodeWorker(engine, transport), transport, engine
+    worker = DecodeWorker(engine, transport)
+    if getattr(args, "live_migration", False):
+        # co-serve the §18 live decode-to-decode migration protocol on
+        # the same transport; both protocols share ONE PageStager so
+        # their pg:/pgx: frames resolve to the same staging records
+        from .migration import CoServingWorker, MigrationWorker
+        mig = MigrationWorker(engine, transport,
+                              ack_timeout=args.migration_ack_timeout,
+                              retries=args.migration_retries,
+                              stager=worker.stager)
+        worker = CoServingWorker(worker, mig)
+    return worker, transport, engine
 
 
 def main(argv=None) -> int:
@@ -161,14 +172,21 @@ def main(argv=None) -> int:
                          "prefill whose chunk boundaries the page "
                          "migration streams on")
     ap.add_argument("--migration-ack-timeout", type=float, default=None,
-                    help="--role prefill: seconds to wait for a "
-                         "migration ack before retransmitting (default "
+                    help="--role prefill (or decode --live-migration): "
+                         "seconds to wait for a migration ack before "
+                         "retransmitting (default "
                          "DWT_DISAGG_ACK_TIMEOUT_S, else 2.0)")
     ap.add_argument("--migration-retries", type=int, default=None,
-                    help="--role prefill: bounded end/retransmit rounds "
-                         "before the handoff is reported failed "
-                         "(default DWT_DISAGG_MIGRATION_RETRIES, "
-                         "else 5)")
+                    help="--role prefill (or decode --live-migration): "
+                         "bounded end/retransmit rounds before the "
+                         "handoff is reported failed (default "
+                         "DWT_DISAGG_MIGRATION_RETRIES, else 5)")
+    ap.add_argument("--live-migration", action="store_true",
+                    help="--role decode: co-serve the live decode-to-"
+                         "decode migration protocol (docs/DESIGN.md "
+                         "§18) on this worker's transport, so the "
+                         "replica can export and import mid-flight "
+                         "requests for rebalance/drain/defragment")
     ap.add_argument("--eos-id", type=int, default=None)
     ap.add_argument("--max-seq", type=int, default=256)
     ap.add_argument("--dtype", default="",
@@ -223,6 +241,11 @@ def main(argv=None) -> int:
         load_fault_plan(args.fault_plan, args.chaos)  # validate EARLY:
     except FaultConfigError as e:   # a leaked env plan must not reach
         print(str(e), file=sys.stderr)     # the serve loop
+        return 1
+    if args.live_migration and args.role != "decode":
+        print("--live-migration requires --role decode (live handoffs "
+              "move mid-flight requests between decode replicas)",
+              file=sys.stderr)
         return 1
     if args.role == "stage":
         if args.kv_cache_blocks or args.kv_block_tokens:

@@ -413,6 +413,12 @@ GATEWAY_UP_REPLICAS = gauge(
     "dwt_gateway_up_replicas",
     "Replicas currently admitted to routing (registered minus "
     "evicted)")
+GATEWAY_DRAINING = gauge(
+    "dwt_gateway_draining_replicas",
+    "Replicas marked draining by an operator or the migration "
+    "controller: excluded from NEW routing decisions (no eviction "
+    "strike — health is orthogonal) while in-flight proxies keep "
+    "streaming.  Stuck nonzero means a drain is not converging")
 GATEWAY_PREFIX_HIT_RATIO = gauge(
     "dwt_gateway_prefix_hit_ratio",
     "Per-replica estimate of the fraction of routed requests whose "
@@ -435,6 +441,54 @@ GATEWAY_PROXY_TTFT_SECONDS = histogram(
     "byte proxied back from the replica (includes routing, replica "
     "queueing, and prefill)",
     buckets=LATENCY_BUCKETS_S)
+
+
+# -- live decode-to-decode migration series (docs/DESIGN.md §18) -----------
+# event-driven from runtime/migration.py: the source counts what it
+# exports and replays, the target what it imports and aborts.  exported
+# vs imported diverging means handoffs complete on the wire but fail to
+# admit (capacity, dtype mismatch) — pair with failed_migrations in
+# /debugz.  replayed_steps > 1 per migration means the freeze window is
+# too wide (raise DWT_MIGRATION_FRAME_BLOCKS or check target load).
+
+MIGRATION_EXPORTED = counter(
+    "dwt_migration_exported_requests_total",
+    "Mid-flight requests a source replica froze, shipped, and handed "
+    "off to a target replica (counted once per acknowledged handoff; "
+    "the source keeps relaying the stream to its client)")
+MIGRATION_IMPORTED = counter(
+    "dwt_migration_imported_requests_total",
+    "Mid-flight requests a target replica admitted from staged pages "
+    "+ state and resumed decoding (the import side of "
+    "dwt_migration_exported_requests_total)")
+MIGRATION_ABORTED = counter(
+    "dwt_migration_aborted_requests_total",
+    "Staged migrations the target discarded on a source abort (pgx "
+    "frame), staging-cap eviction, or supersession by a newer attempt "
+    "— staging bytes are freed and late frames of the attempt drop")
+MIGRATION_REPLAYED = counter(
+    "dwt_migration_replayed_steps_total",
+    "Decode steps the target re-emitted that the source had already "
+    "streamed (the at-most-one-step overlap of the atomic handoff; "
+    "deduped by absolute step index, never forwarded twice)")
+MIGRATION_MOVED_PAGES = counter(
+    "dwt_migration_moved_pages_total",
+    "KV pages shipped in acknowledged live migrations (phase-1 "
+    "snapshot plus phase-2 delta blocks)")
+MIGRATION_MOVED_BYTES = counter(
+    "dwt_migration_moved_bytes_total",
+    "Wire bytes of page-payload frames in acknowledged live "
+    "migrations (CRC-framed K/V block runs + metadata)")
+MIGRATION_HANDOFF_SECONDS = histogram(
+    "dwt_migration_handoff_seconds",
+    "Target-side wall time from first staged frame to the request "
+    "resuming decode (staging + adopt scatter + admission)",
+    buckets=LATENCY_BUCKETS_S)
+MIGRATION_INFLIGHT = gauge(
+    "dwt_migration_inflight_requests",
+    "Live migrations currently between phase-1 start and handoff "
+    "ack on the source replica (stuck nonzero means a wedged "
+    "target or a partitioned migration path)")
 
 
 # -- flight recorder / anomaly series --------------------------------------

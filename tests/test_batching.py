@@ -380,6 +380,7 @@ def test_tp_mesh_batching_parity(params, oracle):
         assert eng.kv_cache.stats["hits"] >= 1   # block reuse under tp
 
 
+@pytest.mark.slow
 def test_int8_weights_through_batching():
     """Quantized params flow through the slot engine unchanged (dense()
     dequantizes at the matmul): greedy parity vs the int8 plain engine."""
@@ -428,6 +429,7 @@ def test_close_fails_inflight(params):
     # (a fast machine may finish the request before close(); both are fine)
 
 
+@pytest.mark.slow
 def test_fp8_kv_cache(params):
     """Reduced-precision cache storage through the slot engine: runs end
     to end with finite outputs, and the tp combination is rejected."""
@@ -528,6 +530,7 @@ def test_spec_concurrent_requests_all_match(params, draft_params, oracle):
                                           expected(oracle, p, n))
 
 
+@pytest.mark.slow
 def test_spec_late_joiner_matches(params, draft_params, oracle):
     """Admission between speculative rounds must stay bit-exact for both
     the in-flight and the joining request."""
@@ -545,6 +548,7 @@ def test_spec_late_joiner_matches(params, draft_params, oracle):
                                       expected(oracle, [5, 4, 3, 2], 40))
 
 
+@pytest.mark.slow
 def test_spec_self_draft_accepts_everything(params):
     """Draft == target: greedy acceptance must be 1.0 and rounds must
     emit num_draft+1 tokens each (per-row advance, no lockstep min)."""
@@ -575,6 +579,7 @@ def test_spec_eos_terminates_row_mid_block(params, draft_params, oracle):
         np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_spec_stream_matches_plain_stream(params, draft_params):
     """Streaming through the speculative slot loop yields the same
     per-step rows as the non-draft batching engine."""
@@ -595,6 +600,7 @@ def test_spec_draft_vocab_mismatch_rejected(params):
                                  draft_cfg=bad, draft_params=params)
 
 
+@pytest.mark.slow
 def test_spec_sampled_self_draft_accepts_everything(params):
     """Temperature sampling through the slot-loop speculative path with
     draft == target: q == p exactly, so the accept rule (u*q_d < p_d)
@@ -1003,6 +1009,7 @@ def test_chunked_admission_failure_fails_only_that_request(params, oracle):
                                       expected(oracle, [8, 8, 1], 3))
 
 
+@pytest.mark.slow
 def test_chunked_admission_prefix_hit_passes_streaming_prompt(params,
                                                               oracle):
     """A long prompt whose cached prefix shrinks it to ONE dispatch must

@@ -17,6 +17,7 @@ sys.path.insert(0, str(REPO))
 import bench  # noqa: E402
 
 
+@pytest.mark.slow
 def test_leg_moe_structure_tiny():
     out = bench._leg_moe(2, 8, 4, moe_model="mixtral-test",
                          dense_model="llama-test")
@@ -39,6 +40,7 @@ def test_bench_engine_latency_percentiles_tiny():
         assert p50 <= p95 <= p99
 
 
+@pytest.mark.slow
 def test_leg_multimodal_structure_tiny():
     out = bench._leg_multimodal(2, 4, scale="tiny",
                                 decoder_model="llama-test")
@@ -50,6 +52,7 @@ def test_leg_multimodal_structure_tiny():
     assert e2e["image_tokens"] == enc["patches_per_image"]
 
 
+@pytest.mark.slow
 def test_leg_paged_decode_structure_tiny():
     """The paged_decode leg's full structure (dense-escape-hatch
     reference, paged run, admissible table, primed phase) at CPU-viable
@@ -202,6 +205,7 @@ def test_leg_fault_recovery_structure_tiny():
     assert out["chaos_seconds"] > 0 and out["clean_seconds"] > 0
 
 
+@pytest.mark.slow
 def test_leg_disagg_structure_tiny():
     """The disagg leg's CPU dryrun (the ISSUE-8 acceptance shape):
     TTFT p95 under concurrent decode load for colocated vs
@@ -231,6 +235,7 @@ def test_leg_disagg_structure_tiny():
     assert dis["prefill_pool_leaked_blocks"] == 0
 
 
+@pytest.mark.slow
 def test_leg_gateway_routing_structure_tiny():
     """The gateway leg's CPU dryrun (the ISSUE-10 acceptance shape):
     cache-aware routing beats round-robin on BOTH prefix hit-rate and
@@ -287,6 +292,7 @@ def test_leg_long_context_sp_full_budget_structure(monkeypatch):
         assert pt["sp"] == 2 and pt["tokens_per_sec"] > 0
 
 
+@pytest.mark.slow
 def test_leg_prefix_reuse_structure_tiny():
     """The prefix_reuse leg's full structure (cache-off run, cache-on
     run, hit/reuse/saved report) at CPU-viable scale — the dryrun that
@@ -308,6 +314,7 @@ def test_leg_prefix_reuse_structure_tiny():
     assert out["blocks_resident"] <= 16
 
 
+@pytest.mark.slow
 def test_leg_decode_fused_structure_tiny():
     """The decode_fused leg's full structure (per-point engines across
     batch x stream_block K, measured dispatches/token) at CPU-viable

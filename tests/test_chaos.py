@@ -391,6 +391,7 @@ def _assert_no_kv_leaks(header, workers, threads):
                 f"{w.transport.device_id} leaked KV slots")
 
 
+@pytest.mark.slow
 def test_chaos_recovery_bit_identical(tmp_path):
     """THE acceptance scenario: drop + delay + duplicate + corrupt +
     worker crash on a 3-stage loopback elastic pipeline; after recovery
@@ -872,3 +873,169 @@ def test_http_request_timeout_cancels_and_returns_504():
             eng.submit(np.arange(8, dtype=np.int32), 2).wait(timeout=60)
         finally:
             srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# §18 live-migration chaos (the ISSUE-14 acceptance): seeded faults on
+# the pg:/rs: frame stream of a MID-DECODE handoff, and a source that
+# crashes partway through the two-phase protocol
+
+
+MIG_PROMPT = (np.arange(17) % 50 + 3).astype(np.int32)
+# a LONG runway: the faulted handoff (rs: drop -> ack-timeout stall,
+# corrupt/reorder -> nack rounds) takes ~0.5s, and the row must still
+# be decoding when phase 2 freezes it
+MIG_MAX_NEW = 480
+
+
+@pytest.fixture(scope="module")
+def mig_pair():
+    """Two decode replicas on one loopback fabric, the target's
+    migration worker serving; each test wires its own (faulty) source
+    transport.  The fault-free reference stream is computed on the
+    source engine itself — exact parity by construction."""
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+    from distributed_inference_demo_tpu.runtime.migration import (
+        MigrationWorker)
+    cfg = get_model_config(MODEL)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+
+    def mk():
+        return ContinuousBatchingEngine(
+            cfg, params, max_seq=512, max_batch=2, sampling=GREEDY,
+            kv_cache_blocks=80, kv_block_tokens=8)
+
+    net = LoopbackNetwork()
+    src_e, dst_e = mk(), mk()
+    dst_w = MigrationWorker(dst_e, LoopbackTransport("dst", net),
+                            ack_timeout=10.0)
+    th = threading.Thread(target=dst_w.serve_forever, daemon=True)
+    th.start()
+    ref = [int(t) for t in src_e.submit(MIG_PROMPT,
+                                        MIG_MAX_NEW).wait(120)]
+    from types import SimpleNamespace
+    yield SimpleNamespace(net=net, src_e=src_e, dst_e=dst_e,
+                          dst_w=dst_w, ref=ref,
+                          MigrationWorker=MigrationWorker)
+    dst_w.stop()
+    th.join(timeout=2)
+    src_e.close()
+    dst_e.close()
+
+
+def _mig_no_pool_leaks(*engines):
+    deadline = time.monotonic() + 5.0
+    while True:
+        snaps = [e.kv_cache.snapshot() for e in engines]
+        if all(s["blocks_used"] == s["tree_blocks"] for s in snaps):
+            return
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                "page leak: " + ", ".join(
+                    f"{s['blocks_used']}/{s['tree_blocks']}"
+                    for s in snaps))
+        time.sleep(0.05)
+
+
+def test_chaos_live_migration_faults_bit_identical(mig_pair):
+    """Seeded drop + corrupt + duplicate + reorder on the pg:/rs: frame
+    stream of a LIVE mid-decode handoff: the go-back-n/nack machinery
+    heals every fault, the handoff still completes, and the client
+    stream is bit-identical to the never-migrated run with zero pool
+    pages leaked on either replica."""
+    # the CPU decode can FINISH before a badly-stalled handoff freezes
+    # the row — a legal local resolution; retry with a fresh rid
+    for i in range(4):
+        rid = f"cm{i}"
+        plan = FaultPlan(seed=7 + i, rules=[
+            FaultRule(kind="duplicate", tag_prefix="pg:", prob=0.5),
+            FaultRule(kind="corrupt", tag_prefix="pg:", after=1,
+                      max_count=1),
+            FaultRule(kind="drop", tag_prefix="pg:", after=2,
+                      max_count=1),
+            FaultRule(kind="reorder", tag_prefix="pg:", prob=0.4),
+            FaultRule(kind="drop", tag_prefix="rs:", max_count=1)])
+        src_w = mig_pair.MigrationWorker(
+            mig_pair.src_e,
+            FaultyTransport(LoopbackTransport(f"cmsrc{i}", mig_pair.net),
+                            plan),
+            ack_timeout=0.25, retries=10)
+        # the source must serve its own transport: after the handoff
+        # the client stream is fed by the target's tok:/fin: relay
+        th = threading.Thread(target=src_w.serve_forever, daemon=True)
+        th.start()
+        req = mig_pair.src_e.submit(MIG_PROMPT, MIG_MAX_NEW,
+                                    request_id=rid)
+        deadline = time.monotonic() + 30
+        while len(req.tokens) < 2 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        moved = src_w.migrate_out(rid, "dst")
+        got = [int(t) for t in req.wait(60)]
+        src_w.stop()
+        th.join(timeout=2)
+        assert got == mig_pair.ref
+        assert req.error is None and req.done.is_set()
+        if moved:
+            break
+    else:
+        pytest.fail("handoff never outran the decode in 4 attempts")
+    assert plan.events, "no fault fired — the plan never engaged"
+    assert src_w.stats["migrated_out"] == 1
+    assert mig_pair.dst_w.stats["migrated_in"] >= 1
+    # the faulted staging fully drained into the adoption
+    deadline = time.monotonic() + 5.0
+    while (rid in mig_pair.dst_w.stager._staged
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert rid not in mig_pair.dst_w.stager._staged
+    _mig_no_pool_leaks(mig_pair.src_e, mig_pair.dst_e)
+
+
+def test_chaos_source_crash_mid_migration_promotes_or_survives(
+        mig_pair):
+    """crash_after on the source transport mid-protocol.  Wherever the
+    crash lands, no token is ever lost: before the phase-1 manifest the
+    never-frozen row completes locally; after it the target holds a
+    complete staged checkpoint and ``promote_staged`` resumes it — the
+    promoted stream (snapshot prefix + re-decoded tail) is bit-identical
+    to the reference, and staging held ZERO pool pages throughout."""
+    promoted = None
+    for i in range(3):
+        rid = f"cp{i}"
+        plan = FaultPlan(seed=31 + i, rules=[
+            FaultRule(kind="crash_after", n_msgs=2 + i)])
+        src_w = mig_pair.MigrationWorker(
+            mig_pair.src_e,
+            FaultyTransport(LoopbackTransport(f"cpsrc{i}", mig_pair.net),
+                            plan),
+            ack_timeout=0.5, retries=1)
+        req = mig_pair.src_e.submit(MIG_PROMPT, MIG_MAX_NEW,
+                                    request_id=rid)
+        deadline = time.monotonic() + 30
+        while len(req.tokens) < 2 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        with pytest.raises(InjectedCrash):
+            src_w.migrate_out(rid, "dst")
+        # give the already-delivered frames a beat to process, then try
+        # to promote the orphaned staging on the target
+        deadline = time.monotonic() + 3.0
+        while promoted is None and time.monotonic() < deadline:
+            promoted = mig_pair.dst_w.promote_staged(rid)
+            if promoted is None:
+                time.sleep(0.05)
+        if promoted is not None:
+            break
+        # crash landed before the phase-1 manifest: staging is partial
+        # (zero pool pages by construction) — the source row, never
+        # frozen, just keeps decoding to the bit-identical stream
+        assert [int(t) for t in req.wait(60)] == mig_pair.ref
+        assert req.error is None
+        mig_pair.dst_w.handle_message(f"pgx:{rid}", b"")
+        assert rid not in mig_pair.dst_w.stager._staged
+        assert mig_pair.dst_w.staged_bytes == 0
+    else:
+        pytest.fail("no crash point left a promotable checkpoint")
+    assert [int(t) for t in promoted.wait(60)] == mig_pair.ref
+    assert mig_pair.dst_w.stats["promoted_requests"] >= 1
+    _mig_no_pool_leaks(mig_pair.dst_e)

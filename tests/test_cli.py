@@ -225,6 +225,7 @@ def test_cli_generate_speculative_self_draft():
     assert spec["speculative"]["tokens_per_round"] > 1.0
 
 
+@pytest.mark.slow
 def test_cli_generate_prompt_lookup():
     """--prompt-lookup greedy must match plain greedy; exclusive with
     --draft-model."""
@@ -243,6 +244,7 @@ def test_cli_generate_prompt_lookup():
     assert rc == 1
 
 
+@pytest.mark.slow
 def test_cli_generate_tp():
     """generate --tp 2 on the virtual mesh matches single-device greedy;
     --tp combined with another serve mode is rejected."""
@@ -363,6 +365,7 @@ def test_cli_bench_runs():
     assert body["unit"] == "tokens/sec" and body["value"] > 0
 
 
+@pytest.mark.slow
 def test_cli_bench_prompt_lookup():
     """bench --prompt-lookup reports baseline + speculative tok/s with
     acceptance stats on one workload."""
@@ -392,6 +395,7 @@ def test_serve_mode_pairing_rules(capsys):
     capsys.readouterr()
 
 
+@pytest.mark.slow
 def test_http_batching_with_draft(http_server):
     """The composed serving shape (continuous batching x speculative
     decoding) over HTTP: greedy output matches the plain engine, /stats

@@ -53,7 +53,8 @@ PROMPT = np.asarray([[3, 14, 15, 92, 65], [7, 6, 5, 4, 3]], np.int32)
 
 # ---------------------------------------------------------------- parity
 
-@pytest.mark.parametrize("K", [4, 16])
+@pytest.mark.parametrize("K", [
+    4, pytest.param(16, marks=pytest.mark.slow)])
 def test_stream_block_greedy_bit_identical(params, K):
     ref = stream_tokens(make_engine(params, stream_block=1), PROMPT, 24)
     got = stream_tokens(make_engine(params, stream_block=K), PROMPT, 24)
@@ -136,6 +137,7 @@ def _nth_greedy_token(params, n, prompt=None):
     return int(toks[n][0])
 
 
+@pytest.mark.slow
 def test_all_rows_eos_ends_device_loop_early(params):
     """All-rows-EOS at step j < K must end the loop after j+1 steps —
     the remaining K−(j+1) steps are NOT run (device-reported count)."""
@@ -170,6 +172,7 @@ def test_fused_generate_early_exits_on_eos(params):
 
 # ------------------------------------------------- on-device stop ids
 
+@pytest.mark.slow
 def test_stop_token_ids_cut_matches_per_token_path(params):
     stop_tok = _nth_greedy_token(params, 3)
     outs = {}
@@ -244,6 +247,7 @@ def test_batching_fused_block_reports_actual_steps(params):
     assert stats["device_loop_steps"] >= 4
 
 
+@pytest.mark.slow
 def test_paged_fused_block_reports_actual_steps(params):
     oracle = make_engine(params)
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
@@ -300,6 +304,7 @@ def test_ring_fused_tail_parity(params, monkeypatch):
     np.testing.assert_array_equal(split, fused)
 
 
+@pytest.mark.slow
 def test_ring_fused_tail_halves_tail_dispatches(monkeypatch):
     """Tail dispatch accounting: the fused tail pays 1 host dispatch
     per token where the split pair paid 2."""

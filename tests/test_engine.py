@@ -36,7 +36,9 @@ def test_stream_matches_fused_scan(engine):
     np.testing.assert_array_equal(fused, streamed)
 
 
-@pytest.mark.parametrize("plen", [7, 8, 9, 17])
+@pytest.mark.parametrize("plen", [
+    pytest.param(7, marks=pytest.mark.slow), 8,
+    pytest.param(9, marks=pytest.mark.slow), 17])
 def test_chunked_prefill_matches_whole(engine, plen):
     """Chunked prefill (C=8) must produce the same greedy tokens as
     whole-prompt prefill for every remainder shape: plen < C, == C,
@@ -179,6 +181,7 @@ def test_logprobs(engine):
             np.testing.assert_allclose(res.logprobs[b, t], want, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_eos_padding_in_fused_scan(engine):
     """Once a row emits eos_id, the fused scan pads its remaining steps
     with eos (mirrors the streaming path's early stop, row-wise)."""

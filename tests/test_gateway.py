@@ -235,6 +235,46 @@ def test_route_raises_gateway_overloaded_when_all_replicas_down():
         router.route(list(range(2, 18)))
 
 
+@pytest.mark.quick
+def test_draining_replica_stops_routing_without_a_strike():
+    """The §18 drain satellite: a draining replica leaves
+    routable_replicas (no NEW request routes to it) while staying UP —
+    no eviction strike, health debounce untouched — and undraining
+    restores it."""
+    reg = _registry()
+    router = PrefixAwareRouter(reg, min_prefix_tokens=8, block_tokens=8)
+    victim = reg.replica_ids()[0]
+    reg.set_draining(victim)
+    assert reg.is_draining(victim)
+    assert reg.is_up(victim)                 # health is orthogonal
+    assert reg.get(victim).fail_streak == 0  # drain is NOT a strike
+    assert victim in reg.up_replicas()
+    assert victim not in reg.routable_replicas()
+    # the router never picks it, prefix history or not
+    router.record(victim, list(range(2, 34)))
+    for salt in range(12):
+        d = router.route(list(range(2, 34)) + [salt])
+        assert d.rid != victim and victim not in d.candidates
+    # surfaced on the debug planes
+    assert reg.debug_state()["replicas"][victim]["draining"] is True
+    assert router.routing_table()["replicas"][victim]["draining"] is True
+    # idempotent set + undrain restores routing
+    reg.set_draining(victim)
+    reg.set_draining(victim, False)
+    assert victim in reg.routable_replicas()
+    assert not reg.is_draining(victim)
+
+
+@pytest.mark.quick
+def test_every_replica_draining_sheds_like_all_down():
+    reg = _registry(2)
+    router = PrefixAwareRouter(reg, min_prefix_tokens=8, block_tokens=8)
+    for rid in reg.replica_ids():
+        reg.set_draining(rid)
+    with pytest.raises(GatewayOverloaded, match="draining"):
+        router.route(list(range(2, 18)))
+
+
 # ---------------------------------------------------------------------------
 # HTTP-level: stub replicas (no engine, no jax compute)
 # ---------------------------------------------------------------------------
@@ -501,6 +541,69 @@ def test_gateway_metrics_debugz_and_trace_surfaces():
     finally:
         gw.shutdown()
         stub.close()
+
+
+@pytest.mark.quick
+def test_drain_endpoint_flips_routing_and_keeps_proxying(params=None):
+    """POST /drain: the drained stub stops receiving NEW requests (they
+    all land on the other replica) while /health degrades gracefully
+    and /debugz names the drained replica; undrain restores it."""
+    stubs = [_StubReplica(lines=2), _StubReplica(lines=2)]
+    gw = _gateway([(s.host, s.port) for s in stubs], min_prefix=8,
+                  block_tokens=8)
+    try:
+        conn = HTTPConnection(gw.host, gw.port, timeout=10)
+        conn.request("POST", "/drain", body=json.dumps(
+            {"replica": stubs[0].rid}))
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert out["draining"] is True
+        assert out["routable"] == [stubs[1].rid]
+        # unknown replica: 400, names the fleet
+        conn = HTTPConnection(gw.host, gw.port, timeout=10)
+        conn.request("POST", "/drain", body=json.dumps(
+            {"replica": "nope:1"}))
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert "replicas" in json.loads(resp.read())
+        conn.close()
+        # every generate lands on the OTHER stub
+        before = stubs[0].requests
+        for i in range(6):
+            st, headers, _, _ = _post_stream(
+                gw.host, gw.port,
+                {"prompt_ids": [list(range(2, 18)) + [i]],
+                 "max_new_tokens": 2, "stream": True})
+            assert st == 200
+            assert headers["X-DWT-Replica"] == stubs[1].rid
+        assert stubs[0].requests == before
+        # surfaced: /health stays ok (one routable), /debugz names it
+        conn = HTTPConnection(gw.host, gw.port, timeout=10)
+        conn.request("GET", "/health")
+        health = json.loads(conn.getresponse().read())
+        conn.close()
+        assert health["status"] == "ok"
+        assert health["replicas_routable"] == 1
+        conn = HTTPConnection(gw.host, gw.port, timeout=10)
+        conn.request("GET", "/debugz")
+        dbg = json.loads(conn.getresponse().read())
+        conn.close()
+        assert dbg["registry"]["replicas"][stubs[0].rid]["draining"]
+        # undrain restores routing
+        conn = HTTPConnection(gw.host, gw.port, timeout=10)
+        conn.request("POST", "/drain", body=json.dumps(
+            {"replica": stubs[0].rid, "draining": False}))
+        resp = conn.getresponse()
+        assert json.loads(resp.read())["draining"] is False
+        conn.close()
+        assert set(gw.registry.routable_replicas()) == {
+            stubs[0].rid, stubs[1].rid}
+    finally:
+        gw.shutdown()
+        for s in stubs:
+            s.close()
 
 
 # ---------------------------------------------------------------------------

@@ -219,6 +219,7 @@ def _tiny_clip():
     return vcfg, model
 
 
+@pytest.mark.slow
 def test_vision_tower_matches_clip():
     """clip_arch + feature_layer=-2 reproduces HF hidden_states[-2] minus
     the class token — the exact feature LLaVA-1.5 projects.  The weights

@@ -1,0 +1,784 @@
+"""Live KV migration between decode replicas (docs/DESIGN.md §18).
+
+The ISSUE-14 invariants, pinned:
+
+- a request that migrates MID-DECODE from one replica to another keeps
+  one unbroken client stream, greedy output BIT-IDENTICAL to the
+  never-migrated run — at most one boundary step replays (deduped by
+  the (rid, step) rule) and no step is ever skipped;
+- the checkpoint seam (``export_request``/``import_request``) is exact:
+  a detached checkpoint re-imported elsewhere resumes at the freeze
+  step with zero prefill dispatch;
+- every failure path leaves both pools leak-free: an unreachable
+  target fails the migration loudly while the request completes
+  locally; a lost phase-2 ack self-heals by local re-import; a staged
+  checkpoint whose source died promotes on the target;
+- cancel crossing a handoff is forwarded and terminates cleanly on the
+  replica that owns the row — no hang, every page released;
+- the adopted/aborted gates are attempt-AWARE, so a request can bounce
+  A → B → A and each hop stages fresh (higher attempt) instead of
+  being dropped as a duplicate;
+- the DecodeWorker abort path clears staged bytes exactly and blocks
+  restaging by late frames of the aborted attempt (the §15 accounting
+  this PR's shared PageStager must preserve);
+- :class:`MigrationController` picks hot → light rebalances off the
+  gateway registry's load view and drives a draining replica empty.
+
+The chaos-side §18 acceptance (seeded faults on pg:/rs: frames, source
+crash mid-migration) lives in tests/test_chaos.py.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_inference_demo_tpu.comm.faults import (
+    FaultPlan, FaultRule, FaultyTransport)
+from distributed_inference_demo_tpu.comm.transport import (
+    LoopbackNetwork, LoopbackTransport, TransportError)
+from distributed_inference_demo_tpu.models import get_model_config
+from distributed_inference_demo_tpu.models.decoder import init_full_params
+from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+from distributed_inference_demo_tpu.runtime.batching import (
+    ContinuousBatchingEngine)
+from distributed_inference_demo_tpu.runtime.disagg import (
+    DecodeWorker, MigrationError, PageStager, _meta_frame, _page_frame)
+from distributed_inference_demo_tpu.runtime.migration import (
+    CoServingWorker, MigrationController, MigrationWorker, _state_meta,
+    _state_tensors)
+from distributed_inference_demo_tpu.telemetry.tracing import to_chrome_trace
+
+GREEDY = SamplingParams(greedy=True)
+MODEL = "llama-test"
+# CPU timing reality: llama-test decodes a token every few ms, so the
+# migration tests need enough remaining budget that the two-phase
+# handoff lands while the row is still decoding — 17-token prompt, 96
+# new tokens, migrate after ~2 (max_seq must cover 17 + 96)
+PROMPT = (np.arange(17) % 50 + 3).astype(np.int32)
+MAX_NEW = 96
+
+
+def _mk_engine(cfg, params):
+    return ContinuousBatchingEngine(
+        cfg, params, max_seq=160, max_batch=2, sampling=GREEDY,
+        kv_cache_blocks=32, kv_block_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = get_model_config(MODEL)
+    return cfg, init_full_params(jax.random.PRNGKey(0), cfg)
+
+
+@pytest.fixture(scope="module")
+def reference(cfg_params):
+    """Memoized fault-free greedy stream per (prompt, max_new)."""
+    cfg, params = cfg_params
+    memo = {}
+
+    def run(prompt, max_new):
+        prompt = np.asarray(prompt, np.int32)
+        key = (prompt.tobytes(), int(max_new))
+        if key not in memo:
+            eng = _mk_engine(cfg, params)
+            try:
+                memo[key] = [int(t)
+                             for t in eng.submit(prompt, max_new).wait(120)]
+            finally:
+                eng.close()
+        return memo[key]
+    return run
+
+
+@pytest.fixture(scope="module")
+def pair(cfg_params):
+    """Two decode replicas ("src", "dst") with live-migration workers on
+    one loopback fabric, plus spare endpoints the failure-path tests
+    address: "ghost" (registered, never served), "deadsrc"/"client0"
+    (ack/relay sinks for the manually-staged promote test)."""
+    cfg, params = cfg_params
+    net = LoopbackNetwork()
+    src_e, dst_e = _mk_engine(cfg, params), _mk_engine(cfg, params)
+    src_w = MigrationWorker(src_e, LoopbackTransport("src", net),
+                            ack_timeout=10.0)
+    dst_w = MigrationWorker(dst_e, LoopbackTransport("dst", net),
+                            ack_timeout=10.0)
+    for extra in ("ghost", "deadsrc", "client0"):
+        LoopbackTransport(extra, net)
+    threads = [threading.Thread(target=w.serve_forever, daemon=True)
+               for w in (src_w, dst_w)]
+    for t in threads:
+        t.start()
+    yield SimpleNamespace(net=net, src_e=src_e, dst_e=dst_e,
+                          src_w=src_w, dst_w=dst_w)
+    src_w.stop()
+    dst_w.stop()
+    for t in threads:
+        t.join(timeout=2)
+    src_e.close()
+    dst_e.close()
+
+
+def _wait_tokens(req, n, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while len(req.tokens) < n:
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"only {len(req.tokens)}/{n} tokens within {timeout}s")
+        time.sleep(0.002)
+    return len(req.tokens)
+
+
+def _idle_no_leaks(*engines):
+    """§11 ownership invariant on every pool: idle ⇒ every allocated
+    page is tree-owned (request pages freed, adopted pages handed over)
+    — bounded wait for the async completions."""
+    deadline = time.monotonic() + 5.0
+    while True:
+        snaps = [e.kv_cache.snapshot() for e in engines]
+        if all(s["blocks_used"] == s["tree_blocks"] for s in snaps):
+            return
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                "page leak: " + ", ".join(
+                    f"{s['blocks_used']}/{s['tree_blocks']}"
+                    for s in snaps))
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# unit: staging gates + abort accounting (no engine)
+
+
+def _blk(cfg):
+    return np.zeros((1, cfg.num_layers, cfg.num_kv_heads, 16,
+                     cfg.head_dim), np.float32)
+
+
+def test_decode_abort_clears_bytes_and_blocks_restaging(cfg_params):
+    """The satellite-2 pin: DecodeWorker._on_abort pops the staged
+    record AND its byte accounting (``staged_bytes`` back to 0 exactly),
+    and a late frame of the aborted attempt drops instead of silently
+    restaging the leak the abort just cleaned up."""
+    cfg, _ = cfg_params
+
+    class _FakeEngine:
+        def submit_premigrated(self, *a, **k):
+            raise AssertionError("no join expected in this test")
+
+    net = LoopbackNetwork()
+    dw = DecodeWorker(_FakeEngine(), LoopbackTransport("dz", net))
+    LoopbackTransport("pz", net)
+    blk = _blk(cfg)
+    dw.handle_message("pg:ra:1:0", _page_frame(blk, blk, 0))
+    dw.handle_message("pg:ra:1:1", _page_frame(blk, blk, 1))
+    assert dw._staged["ra"]["expected"] == 2
+    before = dw.stager.staged_bytes
+    assert before > 0
+    assert dw.handle_message("pgx:ra", b"")
+    assert dw._staged == {}
+    assert dw.stager.staged_bytes == 0
+    assert dw.stats["aborted_migrations"] == 1
+    # late retransmit of the ABORTED attempt: dropped, never restaged
+    dw.handle_message("pg:ra:1:2", _page_frame(blk, blk, 2))
+    assert dw._staged == {} and dw.stager.staged_bytes == 0
+    # a second abort for the same rid is a no-op, not a double count
+    dw.handle_message("pgx:ra", b"")
+    assert dw.stats["aborted_migrations"] == 1
+    # a NEWER attempt is a fresh migration: stages normally
+    dw.handle_message("pg:ra:2:0", _page_frame(blk, blk, 0))
+    assert dw._staged["ra"]["attempt"] == 2
+    assert dw.stager.staged_bytes == before // 2
+
+
+def test_migration_worker_gates_are_attempt_aware(cfg_params):
+    """The adopted/aborted gates compare ATTEMPTS, not rids: a request
+    that migrated away and bounces back under a higher attempt stages
+    fresh, while retransmits at or below the resolved attempt drop."""
+    cfg, _ = cfg_params
+    net = LoopbackNetwork()
+    mw = MigrationWorker(object(), LoopbackTransport("mw", net))
+    LoopbackTransport("peer", net)
+    blk = _blk(cfg)
+    mw.handle_message("pg:rb:1:0", _page_frame(blk, blk, 0))
+    assert mw.stager._staged["rb"]["attempt"] == 1
+    mw.handle_message("pgx:rb", b"")
+    assert mw.stager._staged == {} and mw.staged_bytes == 0
+    assert mw.stats["aborted_migrations"] == 1
+    # late frame of the aborted attempt: dropped
+    mw.handle_message("pg:rb:1:1", _page_frame(blk, blk, 1))
+    assert mw.stager._staged == {}
+    # attempt 2 adopted here: its frames re-ack/drop, attempt 3 stages
+    mw._mark_adopted("rb", 2)
+    mw.handle_message("pg:rb:2:0", _page_frame(blk, blk, 0))
+    assert mw.stager._staged == {}
+    mw.handle_message("pg:rb:3:0", _page_frame(blk, blk, 0))
+    assert mw.stager._staged["rb"]["attempt"] == 3
+    assert not mw._is_adopted("rb", 3)
+
+
+def test_coserving_worker_requires_one_shared_stager():
+    """pg:/pgx: tags are shared by the §15 join and the §18 handoff —
+    two stagers on one transport would split the dedup/abort state, so
+    the co-serving seam refuses to build that way."""
+    net = LoopbackNetwork()
+    t = LoopbackTransport("cs", net)
+    dec = SimpleNamespace(stager=PageStager("cs"), transport=t,
+                          device_id="cs")
+    with pytest.raises(ValueError, match="share one PageStager"):
+        CoServingWorker(dec, SimpleNamespace(stager=PageStager("cs")))
+    co = CoServingWorker(dec, SimpleNamespace(stager=dec.stager))
+    assert co.device_id == "cs" and co.transport is t
+
+
+# ---------------------------------------------------------------------------
+# unit: controller policy (fake registry, no engine)
+
+
+class _FakeRegistry:
+    def __init__(self, loads, draining=(), down=()):
+        self.loads = dict(loads)
+        self.draining = set(draining)
+        self.down = set(down)
+
+    def replica_ids(self):
+        return sorted(self.loads)
+
+    def is_up(self, rid):
+        return rid not in self.down
+
+    def is_draining(self, rid):
+        return rid in self.draining
+
+    def routable_replicas(self):
+        return [r for r in sorted(self.loads)
+                if r not in self.down and r not in self.draining]
+
+    def set_draining(self, rid, flag=True):
+        (self.draining.add if flag else self.draining.discard)(rid)
+
+    def get(self, rid):
+        if rid not in self.loads:
+            return None
+        return SimpleNamespace(
+            last_stats={"active_slots": self.loads[rid],
+                        "queue_depth": 0})
+
+
+def test_controller_pick_rebalance_policy():
+    mover = lambda s, d, n: n                                  # noqa: E731
+    # hot → light when the gap clears load_gap; n = half the gap
+    c = MigrationController(_FakeRegistry({"a": 5, "b": 1}), mover,
+                            load_gap=2, max_moves_per_round=4)
+    assert c.pick_rebalance() == ("a", "b", 2)
+    # max_moves caps the pick
+    c = MigrationController(_FakeRegistry({"a": 9, "b": 1}), mover,
+                            load_gap=2, max_moves_per_round=1)
+    assert c.pick_rebalance() == ("a", "b", 1)
+    # balanced fleet: no move
+    c = MigrationController(_FakeRegistry({"a": 2, "b": 1}), mover,
+                            load_gap=2)
+    assert c.pick_rebalance() is None
+    # a DRAINING source moves even below the gap — its whole load goes
+    c = MigrationController(
+        _FakeRegistry({"a": 2, "b": 1}, draining={"a"}), mover,
+        load_gap=5, max_moves_per_round=8)
+    assert c.pick_rebalance() == ("a", "b", 2)
+    # nowhere routable to put the load: no move
+    c = MigrationController(
+        _FakeRegistry({"a": 3, "b": 1}, draining={"a", "b"}), mover)
+    assert c.pick_rebalance() is None
+    # a single replica can never be its own target
+    c = MigrationController(_FakeRegistry({"a": 7}), mover, load_gap=1)
+    assert c.pick_rebalance() is None
+
+
+def test_controller_rebalance_once_counts_moved():
+    calls = []
+
+    def mover(src, dst, n):
+        calls.append((src, dst, n))
+        return 1
+
+    reg = _FakeRegistry({"a": 6, "b": 0})
+    c = MigrationController(reg, mover, load_gap=2, max_moves_per_round=2)
+    assert c.rebalance_once() == 1
+    assert calls == [("a", "b", 2)]
+    assert c.stats["rebalances"] == 1
+    assert c.stats["moved_requests"] == 1
+    # a mover that moved nothing records nothing
+    c2 = MigrationController(reg, lambda s, d, n: 0, load_gap=2)
+    assert c2.rebalance_once() == 0
+    assert c2.stats["rebalances"] == 0
+
+
+def test_controller_drain_drives_replica_empty():
+    reg = _FakeRegistry({"a": 3, "b": 0})
+
+    def mover(src, dst, n):
+        moved = min(n, reg.loads[src])
+        reg.loads[src] -= moved
+        reg.loads[dst] += moved
+        return moved
+
+    c = MigrationController(reg, mover, max_moves_per_round=1)
+    moved = c.drain("a", deadline_s=5.0, poll_s=0.01)
+    assert moved == 3
+    assert reg.loads == {"a": 0, "b": 3}
+    assert "a" in reg.draining           # stays draining until undrained
+    assert c.stats["drained_requests"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the checkpoint seam
+
+
+def test_export_import_roundtrip_bit_identical(pair, reference):
+    """Detach on one replica, import on another, with NO wire in
+    between: the checkpoint alone carries everything a resume needs,
+    and the combined stream is bit-identical to the un-migrated run."""
+    ref = reference(PROMPT, MAX_NEW)
+    req = pair.src_e.submit(PROMPT, MAX_NEW, request_id="seam")
+    _wait_tokens(req, 2)
+    ckpt = pair.src_e.export_request("seam", detach=True)
+    # the §18 checkpoint schema (docs/DESIGN.md table) — a missing key
+    # here breaks cross-version migration silently
+    assert {"rid", "prompt", "max_new", "tokens", "lps", "length",
+            "last_tok", "kv_dtype", "block_tokens", "k", "v",
+            "rng"} <= set(ckpt)
+    assert ckpt["tokens"] == ref[:len(ckpt["tokens"])]
+    # the freeze point: the source never steps this row again
+    assert pair.src_e.get_request("seam") is None
+    resumed = pair.dst_e.import_request(ckpt)
+    assert [int(t) for t in resumed.wait(60)] == ref
+    with pytest.raises(KeyError):
+        pair.src_e.export_request("no-such-rid")
+    _idle_no_leaks(pair.src_e, pair.dst_e)
+
+
+# ---------------------------------------------------------------------------
+# the loopback e2e (the -m quick live-migration rep)
+
+
+@pytest.mark.quick
+def test_live_migration_loopback_bit_identical_and_leak_free(
+        pair, reference):
+    """THE tentpole scenario at test scale: a request decoding on src
+    migrates mid-flight to dst; the client stream never breaks, the
+    greedy output is bit-identical to the never-migrated run, at most
+    one boundary step replays, both pools end leak-free, and one trace
+    id spans the source's export/freeze/handoff and the target's
+    adopt."""
+    ref = reference(PROMPT, MAX_NEW)
+    pair.src_w.tracer.drain()
+    pair.dst_w.tracer.drain()
+    req = pair.src_e.submit(PROMPT, MAX_NEW, request_id="m1")
+    _wait_tokens(req, 2)
+    assert "m1" in pair.src_w.pick_migratable(4)
+    replay_before = pair.src_w.stats["replayed_steps"]
+    assert pair.src_w.migrate_out("m1", "dst") is True
+    got = [int(t) for t in req.wait(60)]
+    assert got == ref
+    assert req.error is None and req.done.is_set()
+    # the handoff moved the row: dst decoded the tail, src freed it
+    assert pair.src_w.stats["migrated_out"] >= 1
+    assert pair.dst_w.stats["migrated_in"] >= 1
+    assert pair.src_w.stats["moved_pages"] > 0
+    assert pair.src_w.stats["moved_bytes"] > 0
+    # at most the one in-flight boundary step replayed, none skipped
+    assert pair.src_w.stats["replayed_steps"] - replay_before <= 1
+    # target staging fully drained into the pool adoption
+    assert pair.dst_w.stager._staged == {}
+    assert pair.dst_w.staged_bytes == 0
+    # a late pgx for the adopted attempt is a no-op, not an abort
+    aborted = pair.dst_w.stats["aborted_migrations"]
+    pair.dst_w.handle_message("pgx:m1", b"")
+    assert pair.dst_w.stats["aborted_migrations"] == aborted
+    # ONE trace id stitches source and target spans (Perfetto export)
+    spans = pair.src_w.tracer.drain() + pair.dst_w.tracer.drain()
+    names = {s["name"] for s in spans}
+    assert {"migration_export", "migration_freeze", "migration_handoff",
+            "migration_adopt"} <= names
+    tids = {s["trace_id"] for s in spans}
+    assert len(tids) == 1
+    chrome = to_chrome_trace(spans)
+    procs = {e["args"]["name"] for e in chrome["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"migration:src", "migration:dst"} <= procs
+    wire_tids = {e["args"]["trace_id"] for e in chrome["traceEvents"]
+                 if e["ph"] == "X"}
+    assert len(wire_tids) == 1
+    # debug surfaces on both sides
+    assert pair.src_w.debug_state()["migration"]["migrated_out"] >= 1
+    assert pair.dst_w.debug_state()["migration"]["migrated_in"] >= 1
+    _idle_no_leaks(pair.src_e, pair.dst_e)
+
+
+def test_cancel_after_handoff_forwards_and_frees_both_pools(
+        pair, reference):
+    """The satellite-3 race: the client cancels AFTER the row handed
+    off.  The source forwards the cancel (mcx:), the target's sweep
+    frees its slot/pages, fin reports the clean termination — a clean
+    terminal stream (tokens so far, no error), never a hang, and both
+    replicas release every page."""
+    ref = reference(PROMPT, MAX_NEW)
+    req = pair.src_e.submit(PROMPT, MAX_NEW, request_id="m2")
+    _wait_tokens(req, 2)
+    assert pair.src_w.migrate_out("m2", "dst") is True
+    req.cancel()
+    got = [int(t) for t in req.wait(30)]
+    assert req.done.is_set() and req.error is None
+    # every emitted token is a real step: a prefix of the reference
+    assert got == ref[:len(got)]
+    # relay + adoption bookkeeping cleaned up on both sides
+    deadline = time.monotonic() + 5.0
+    while (("m2" in pair.src_w._relays or "m2" in pair.dst_w._imported)
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert "m2" not in pair.src_w._relays
+    assert "m2" not in pair.dst_w._imported
+    _idle_no_leaks(pair.src_e, pair.dst_e)
+
+
+def test_phase1_unreachable_target_fails_loudly_request_survives(
+        pair, reference):
+    """A target that never acks phase 1 fails the migration with a
+    MigrationError — and the request, never frozen, just keeps decoding
+    locally to the bit-identical stream."""
+    ref = reference(PROMPT, MAX_NEW)
+    src2 = MigrationWorker(pair.src_e, LoopbackTransport("src2", pair.net),
+                           ack_timeout=0.15, retries=1)
+    req = pair.src_e.submit(PROMPT, MAX_NEW, request_id="mf")
+    _wait_tokens(req, 2)
+    with pytest.raises(MigrationError, match="phase-1"):
+        src2.migrate_out("mf", "ghost")
+    assert src2.stats["failed_migrations"] == 1
+    assert src2.stats["migrated_out"] == 0
+    assert [int(t) for t in req.wait(60)] == ref
+    assert req.error is None
+    _idle_no_leaks(pair.src_e)
+
+
+def test_phase2_ack_loss_self_heals_locally(pair, reference):
+    """Every rsd: frame dropped: the freeze already happened, so the
+    source re-imports its own detached checkpoint — the client stream
+    survives on the ORIGINAL Request object, the target's staging is
+    aborted (pgx:), and the caller still sees the loud MigrationError."""
+    ref = reference(PROMPT, MAX_NEW)
+    plan = FaultPlan(seed=11, rules=[
+        FaultRule(kind="drop", tag_prefix="rsd:")])
+    srcf = MigrationWorker(
+        pair.src_e,
+        FaultyTransport(LoopbackTransport("srcf", pair.net), plan),
+        ack_timeout=0.2, retries=1)
+    aborted_before = pair.dst_w.stats["aborted_migrations"]
+    req = pair.src_e.submit(PROMPT, MAX_NEW, request_id="mh")
+    _wait_tokens(req, 2)
+    with pytest.raises(MigrationError, match="re-imported locally"):
+        srcf.migrate_out("mh", "dst")
+    assert srcf.stats["healed_requests"] == 1
+    assert srcf.stats["failed_migrations"] == 1
+    assert [int(t) for t in req.wait(60)] == ref
+    assert req.error is None and req.done.is_set()
+    # the target aborted its (complete) phase-1 staging
+    deadline = time.monotonic() + 5.0
+    while ("mh" in pair.dst_w.stager._staged
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert "mh" not in pair.dst_w.stager._staged
+    assert pair.dst_w.stats["aborted_migrations"] == aborted_before + 1
+    assert len(plan.events) > 0          # the faults really fired
+    _idle_no_leaks(pair.src_e, pair.dst_e)
+
+
+class _DiesAfterPhase1Ack:
+    """Delegating transport whose peer hard-dies the moment the phase-1
+    ack lands: every later send raises TransportError outright — the
+    worst failure point, AFTER the freeze decision, BEFORE the handoff,
+    with no ack-timeout path to soften it."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.device_id = inner.device_id
+        self.dead = False
+
+    def send(self, peer, tag, body):
+        if self.dead:
+            raise TransportError(f"{peer} is gone")
+        return self._inner.send(peer, tag, body)
+
+    def recv(self, tag, timeout=None):
+        out = self._inner.recv(tag, timeout=timeout)
+        if tag.startswith("pga:"):
+            self.dead = True
+        return out
+
+    def recv_any(self, timeout=None):
+        return self._inner.recv_any(timeout=timeout)
+
+
+def test_target_dies_after_phase1_ack_heals_not_orphans(pair, reference):
+    """A raw TransportError on the post-detach sends (dead peer, not a
+    quiet ack timeout) must run the SAME self-heal as a lost ack: the
+    detached checkpoint re-imports locally and the caller sees the loud
+    MigrationError — never an orphaned request whose pages are released
+    and whose stream nobody owns."""
+    ref = reference(PROMPT, MAX_NEW)
+    t = _DiesAfterPhase1Ack(LoopbackTransport("srcd", pair.net))
+    srcd = MigrationWorker(pair.src_e, t, ack_timeout=0.2, retries=1)
+    req = pair.src_e.submit(PROMPT, MAX_NEW, request_id="md")
+    _wait_tokens(req, 2)
+    with pytest.raises(MigrationError, match="re-imported locally"):
+        srcd.migrate_out("md", "dst")
+    assert t.dead                        # the failure mode really fired
+    assert srcd.stats["healed_requests"] == 1
+    assert srcd.stats["failed_migrations"] == 1
+    assert "md" not in srcd._relays
+    assert [int(tok) for tok in req.wait(60)] == ref
+    assert req.error is None and req.done.is_set()
+    # the pgx: abort never reached the dead wire — clean the target's
+    # phase-1 staging up by hand so later tests see empty staging
+    pair.dst_w.handle_message("pgx:md", b"")
+    assert "md" not in pair.dst_w.stager._staged
+    _idle_no_leaks(pair.src_e, pair.dst_e)
+
+
+class _SendLog:
+    """Delegating transport that records every sent tag."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.device_id = inner.device_id
+        self.sent = []
+
+    def send(self, peer, tag, body):
+        self.sent.append(tag)
+        return self._inner.send(peer, tag, body)
+
+    def recv(self, tag, timeout=None):
+        return self._inner.recv(tag, timeout=timeout)
+
+    def recv_any(self, timeout=None):
+        return self._inner.recv_any(timeout=timeout)
+
+
+def test_phase2_ack_lost_after_adopt_cancels_duplicate(pair, reference):
+    """The adopted-ack-lost corner: the target ADOPTS the handoff but
+    every rsa: ack back is dropped.  The source cannot distinguish this
+    from a dead target, so it heals locally (correct) — and because
+    pgx: deliberately ignores adopted rids, an mcx: must ride along so
+    the target cancels its duplicate row instead of burning a slot
+    decoding it to completion."""
+    ref = reference(PROMPT, MAX_NEW)
+    plan = FaultPlan(seed=13, rules=[
+        FaultRule(kind="drop", tag_prefix="rsa:")])
+    dstr_w = MigrationWorker(
+        pair.dst_e,
+        FaultyTransport(LoopbackTransport("dstr", pair.net), plan),
+        ack_timeout=10.0)
+    th = threading.Thread(target=dstr_w.serve_forever, daemon=True)
+    th.start()
+    try:
+        t = _SendLog(LoopbackTransport("srcr", pair.net))
+        srcr = MigrationWorker(pair.src_e, t, ack_timeout=0.25, retries=1)
+        req = pair.src_e.submit(PROMPT, MAX_NEW, request_id="mr")
+        _wait_tokens(req, 2)
+        with pytest.raises(MigrationError, match="re-imported locally"):
+            srcr.migrate_out("mr", "dstr")
+        assert plan.events                     # the acks really dropped
+        assert srcr.stats["healed_requests"] == 1
+        # the target DID adopt — only the ack back was lost
+        assert dstr_w.stats["migrated_in"] == 1
+        # the heal sent the duplicate-reaper alongside the abort
+        assert "mcx:mr" in t.sent and "pgx:mr" in t.sent
+        # the client stream survives on the healed local copy
+        assert [int(tok) for tok in req.wait(60)] == ref
+        assert req.error is None and req.done.is_set()
+        # the duplicate terminates on the target and its slot/pages free
+        deadline = time.monotonic() + 10.0
+        while "mr" in dstr_w._imported and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert "mr" not in dstr_w._imported
+        _idle_no_leaks(pair.src_e, pair.dst_e)
+    finally:
+        dstr_w.stop()
+        th.join(timeout=2)
+
+
+def test_export_timeout_abandons_mailbox_never_detaches(
+        cfg_params, reference):
+    """A scheduler stalled past export_request's timeout must not
+    execute the export later with no caller left to own delivery — a
+    late detach would orphan the request (pages released, stream never
+    fed).  The timed-out box is ABANDONED: its service is a no-op and
+    the row keeps decoding locally to the bit-identical stream."""
+    from distributed_inference_demo_tpu.runtime import batching as B
+    cfg, params = cfg_params
+    eng = _mk_engine(cfg, params)
+    try:
+        ref = reference(PROMPT, MAX_NEW)
+        req = eng.submit(PROMPT, MAX_NEW, request_id="ab")
+        _wait_tokens(req, 1)
+        entered = threading.Event()
+        release = threading.Event()
+
+        class _WedgedDone:
+            @staticmethod
+            def is_set():
+                entered.set()
+                release.wait(20)
+                return True       # -> ValueError("already finished")
+
+        wedge = {"req": SimpleNamespace(rid="wedge", cancelled=False,
+                                        done=_WedgedDone()),
+                 "detach": False, "ckpt": None, "err": None,
+                 "claimed": False, "abandoned": False,
+                 "event": threading.Event()}
+        with eng._submit_lock:
+            eng._export_q.append(wedge)
+            eng._queue.put(B._WAKE)
+        assert entered.wait(20)   # scheduler is now wedged mid-export
+        detached_before = eng.migration_stats["detached_requests"]
+        with pytest.raises(TimeoutError, match="abandoned"):
+            eng.export_request("ab", detach=True, timeout=0.2)
+        release.set()
+        # the late service of the abandoned box must NOT detach the row
+        assert [int(tok) for tok in req.wait(60)] == ref
+        assert req.error is None
+        assert eng.migration_stats["detached_requests"] == detached_before
+        assert eng.get_request("ab") is None   # finished, not orphaned
+        assert wedge["event"].is_set()
+        assert isinstance(wedge["err"], ValueError)
+    finally:
+        release.set()
+        eng.close()
+
+
+def test_promote_staged_resumes_after_source_death(pair, reference):
+    """Phase 1 completed, then the source died before the handoff: the
+    target promotes the staged bulk checkpoint and resumes at step T —
+    replayed steps dedup downstream, none skip, and the promoted stream
+    completes bit-identically from the snapshot."""
+    ref = reference(PROMPT, MAX_NEW)
+    # build the "dead source"'s phase-1 traffic by hand from a real
+    # detached checkpoint (detach == the source never steps again)
+    req = pair.src_e.submit(PROMPT, MAX_NEW, request_id="mp")
+    _wait_tokens(req, 2)
+    ckpt = pair.src_e.export_request("mp", detach=True)
+    bt = pair.src_e.kv_cache.block_tokens
+    n_blocks = -(-ckpt["length"] // bt)
+    frames = []
+    for first in range(0, n_blocks, 4):
+        sl = slice(first, min(first + 4, n_blocks))
+        kb = jax.tree.map(lambda a: a[sl], ckpt["k"])
+        vb = jax.tree.map(lambda a: a[sl], ckpt["v"])
+        frames.append((f"pg:mp:1:{len(frames)}",
+                       _page_frame(kb, vb, first)))
+    meta = _state_meta(ckpt, rid="mp", attempt=1, n_frames=len(frames),
+                       n_blocks=n_blocks, source_id="deadsrc",
+                       reply_to="client0")
+    for tag, body in frames:
+        pair.dst_w.handle_message(tag, body)
+    pair.dst_w.handle_message(
+        "rs:mp:1", _meta_frame(meta, _state_tensors(ckpt)))
+    assert pair.dst_w.stager._staged["mp"]["state_meta"] is not None
+    # nothing promotable under an unknown rid
+    assert pair.dst_w.promote_staged("nope") is None
+    promoted = pair.dst_w.promote_staged("mp")
+    assert promoted is not None
+    assert [int(t) for t in promoted.wait(60)] == ref
+    assert pair.dst_w.stats["promoted_requests"] == 1
+    # staging fully consumed; a second promote finds nothing
+    assert "mp" not in pair.dst_w.stager._staged
+    assert pair.dst_w.promote_staged("mp") is None
+    _idle_no_leaks(pair.src_e, pair.dst_e)
+
+
+def test_bounce_migration_src_to_dst_and_back(pair, reference):
+    """A → B → A: the second hop runs under a HIGHER attempt, so A —
+    which still remembers shipping the request away — stages it fresh
+    instead of dropping its own request as a duplicate.  The chained
+    relay (A's adopt streams to B, B forwards to the original Request)
+    still delivers one unbroken bit-identical stream."""
+    ref = reference(PROMPT, MAX_NEW)
+    # the CPU decode is fast enough that the row can FINISH before the
+    # second hop freezes it — a legal local resolution, not a bounce.
+    # Retry with a fresh rid until a bounce lands (each attempt still
+    # pins bit-identical output, bounced or not).
+    for i in range(4):
+        rid = f"mb{i}"
+        req = pair.src_e.submit(PROMPT, MAX_NEW, request_id=rid)
+        _wait_tokens(req, 2)
+        if not pair.src_w.migrate_out(rid, "dst"):
+            continue                     # finished before hop 1's freeze
+        assert pair.src_w._attempts[rid] == 1
+        try:
+            bounced = pair.dst_w.migrate_out(rid, "src")
+        except KeyError:
+            bounced = False              # finished on dst pre-freeze
+        got = [int(t) for t in req.wait(60)]
+        assert got == ref
+        assert req.error is None
+        if bounced:
+            break
+    else:
+        pytest.fail("bounce never landed in 4 attempts")
+    # the attempt counter chained through the adoption: hop 2 used 2,
+    # and src — the original source — staged its own request fresh
+    assert pair.dst_w._attempts[rid] == 2
+    assert pair.src_w._attempts[rid] == 2
+    assert pair.src_w.stats["migrated_in"] >= 1
+    assert pair.dst_w.stats["migrated_out"] >= 1
+    _idle_no_leaks(pair.src_e, pair.dst_e)
+
+
+# ---------------------------------------------------------------------------
+# slow soak: concurrent migrations under load
+
+
+@pytest.mark.slow
+def test_concurrent_migrations_under_load(cfg_params, reference):
+    """Three requests decoding concurrently; two migrate mid-flight
+    (picked by pick_migratable, the controller's mechanism) while the
+    third stays — every stream bit-identical, both pools leak-free."""
+    cfg, params = cfg_params
+    net = LoopbackNetwork()
+    src_e, dst_e = _mk_engine(cfg, params), _mk_engine(cfg, params)
+    src_w = MigrationWorker(src_e, LoopbackTransport("s", net),
+                            ack_timeout=10.0)
+    dst_w = MigrationWorker(dst_e, LoopbackTransport("d", net),
+                            ack_timeout=10.0)
+    threads = [threading.Thread(target=w.serve_forever, daemon=True)
+               for w in (src_w, dst_w)]
+    for t in threads:
+        t.start()
+    try:
+        prompts = [PROMPT, (np.arange(23) % 47 + 2).astype(np.int32),
+                   (np.arange(11) % 31 + 5).astype(np.int32)]
+        refs = [reference(p, MAX_NEW) for p in prompts]
+        reqs = [src_e.submit(p, MAX_NEW, request_id=f"c{i}")
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            _wait_tokens(r, 2)
+        moved = 0
+        for rid in src_w.pick_migratable(2):
+            if src_w.migrate_out(rid, "d"):
+                moved += 1
+        assert moved >= 1
+        for r, want in zip(reqs, refs):
+            assert [int(t) for t in r.wait(120)] == want
+        assert src_w.stats["migrated_out"] == moved
+        assert dst_w.stats["migrated_in"] == moved
+        _idle_no_leaks(src_e, dst_e)
+    finally:
+        src_w.stop()
+        dst_w.stop()
+        for t in threads:
+            t.join(timeout=2)
+        src_e.close()
+        dst_e.close()

@@ -72,6 +72,7 @@ def test_image_changes_generation(setup):
     assert not np.array_equal(t1, t2)
 
 
+@pytest.mark.slow
 def test_text_only_prefix_matches_plain_engine(setup):
     """Engine parity on the text-only suffix: a multimodal prefill whose
     prefix is exactly the token embeddings must reproduce the plain
@@ -92,6 +93,7 @@ def test_text_only_prefix_matches_plain_engine(setup):
     np.testing.assert_array_equal(np.asarray(toks), want)
 
 
+@pytest.mark.slow
 def test_pipeline_vision_node_matches_engine(setup):
     """The VERDICT's done-bar: stage 0's vision encoder lives on its own
     transport node, decoder stages decode — tokens equal the single-process

@@ -83,6 +83,7 @@ def test_cold_parity_concurrent_requests(params, oracle):
         assert_no_leak(eng)
 
 
+@pytest.mark.slow
 def test_primed_parity_and_zero_h2d(params, oracle):
     """Radix-primed admission: the second request block-table-references
     the first one's pages — identical greedy tokens, h2d_bytes == 0

@@ -23,7 +23,9 @@ def _full_spec(cfg):
     return StageSpec(0, 1, 0, cfg.num_layers)
 
 
-@pytest.mark.parametrize("name", ["llama-test", "bloom-test", "mixtral-test"])
+@pytest.mark.parametrize("name", [
+    "llama-test", "bloom-test",
+    pytest.param("mixtral-test", marks=pytest.mark.slow)])
 def test_manual_tp_matches_single_device(name, devices):
     """shard_map TP forward (tp=2) must reproduce single-device logits."""
     cfg = get_model_config(name)
@@ -232,7 +234,8 @@ def test_grad_scaling_rule_at_4x4(pp, tp):
     assert out["uniform"], out
 
 
-@pytest.mark.parametrize("pp,tp", [(2, 1), (2, 2), (4, 1)])
+@pytest.mark.parametrize("pp,tp", [
+    (2, 1), pytest.param(2, 2, marks=pytest.mark.slow), (4, 1)])
 def test_pipeline_generate_matches_engine(pp, tp, devices):
     """SPMD circular-pipeline decode (ppermute ring + token lane) must
     reproduce the single-chip engine's greedy tokens for every microbatch

@@ -79,6 +79,7 @@ def test_chunked_prefill_matches_whole(params, oracle, plen):
     np.testing.assert_array_equal(want.tokens, got.tokens)
 
 
+@pytest.mark.slow
 def test_lookup_accelerates_self_repetition(params, oracle):
     """Greedy decode of a tiny random model falls into loops; once the
     loop is in the history the lookup proposer should ride it, emitting
@@ -162,6 +163,7 @@ def test_http_serve_backend(params, oracle):
         server.shutdown()
 
 
+@pytest.mark.slow
 def test_tp_mesh_parity(params, oracle):
     """Prompt lookup over a tp=2 mesh: greedy output equals the plain
     single-device engine (TP + speculation compose)."""

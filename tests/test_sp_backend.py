@@ -224,7 +224,8 @@ def test_sp_serve_mode_pairing_rules(capsys):
     assert "--stream-block" in err
 
 
-@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+@pytest.mark.parametrize("strategy", [
+    "ring", pytest.param("ulysses", marks=pytest.mark.slow)])
 def test_sp_backend_fp8_cache_matches_fp8_engine(strategy):
     """serve --sp --kv-cache-dtype: the backend's reduced-precision cache
     matches the fp8 single-device engine token for token."""
@@ -241,7 +242,8 @@ def test_sp_backend_fp8_cache_matches_fp8_engine(strategy):
     np.testing.assert_array_equal(got, want)
 
 
-@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+@pytest.mark.parametrize("strategy", [
+    "ring", pytest.param("ulysses", marks=pytest.mark.slow)])
 def test_sp_stream_fns_greedy_parity_and_partial_block(strategy):
     """The step-split stream path is bit-identical to the fused
     generate() for greedy decoding, including a final PARTIAL block
@@ -304,7 +306,8 @@ def test_sp_stream_is_incremental():
     np.testing.assert_array_equal(got6, got9[:, :6])
 
 
-@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+@pytest.mark.parametrize("strategy", [
+    "ring", pytest.param("ulysses", marks=pytest.mark.slow)])
 def test_sp_backend_eos_matches_engine_and_stops_early(strategy):
     """eos on the sp backend: generate() pads finished rows with eos
     exactly like the single-device engine, and the stream stops

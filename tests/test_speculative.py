@@ -119,6 +119,7 @@ def test_chunked_prefill_padded_past_capacity(params, draft_params):
                           max_seq=24, sampling=sampling, prefill_chunk=0)
 
 
+@pytest.mark.slow
 def test_greedy_matches_across_dispatch_sizes(params, draft_params):
     """Rounds-per-dispatch is a pure batching knob: R=1 and R=8 agree."""
     sampling = SamplingParams(greedy=True)
@@ -167,6 +168,7 @@ def test_sampled_tokens_in_range(params, draft_params):
     assert 0.0 <= stats.acceptance_rate <= 1.0
 
 
+@pytest.mark.slow
 def test_topk_sampling_respects_support(params, draft_params):
     """Every emitted token must lie in the TARGET's top-k support at its
     position (accepted drafts are filtered by the accept rule; resamples
@@ -217,6 +219,7 @@ def test_stream_matches_generate(params, draft_params):
     assert streamed.shape == (2, 15)
 
 
+@pytest.mark.slow
 def test_http_backend_surface(params, draft_params):
     """serve --draft-model's backend: /generate, streaming, and /stats
     acceptance diagnostics over the HTTP server."""

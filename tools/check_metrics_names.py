@@ -28,7 +28,7 @@ from typing import List
 
 SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
               "engine", "control", "anomaly", "flight", "kvcache",
-              "transport", "fault", "disagg", "gateway"}
+              "transport", "fault", "disagg", "gateway", "migration"}
 
 # unit suffixes a metric name may end with (after stripping ``_total``).
 # Plain-count units (requests, tokens, ...) double as the unit for
@@ -118,6 +118,23 @@ REQUIRED_SERIES = {
     "dwt_gateway_replica_up_total",
     "dwt_gateway_up_replicas",
     "dwt_gateway_proxy_ttft_seconds",
+    # draining (docs/DESIGN.md §18): a drain whose gauge vanished from
+    # /metrics reads as "nothing draining" — exactly the stuck-drain
+    # incident the gauge exists to surface
+    "dwt_gateway_draining_replicas",
+    # the live-migration set (docs/DESIGN.md §18): exported vs imported
+    # diverging is the failed-admission signal, replayed staying
+    # registered-and-zero is how a scrape PROVES the atomic handoff
+    # never re-emitted a step to a client, and inflight stuck nonzero
+    # names a wedged migration path
+    "dwt_migration_exported_requests_total",
+    "dwt_migration_imported_requests_total",
+    "dwt_migration_aborted_requests_total",
+    "dwt_migration_replayed_steps_total",
+    "dwt_migration_moved_pages_total",
+    "dwt_migration_moved_bytes_total",
+    "dwt_migration_handoff_seconds",
+    "dwt_migration_inflight_requests",
 }
 
 
