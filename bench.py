@@ -1150,6 +1150,137 @@ def _leg_batching(model: str, prompt_len: int, new_tokens: int) -> dict:
     return out
 
 
+def _leg_mixed_batching(model: str, prompt_len: int = 256,
+                        new_tokens: int = 48, slots: int = 8,
+                        n_req: int = 24, prefill_chunk: int = 32,
+                        decode_block: int = 4,
+                        token_budget: int = 0,
+                        arrival_s: float = 0.02,
+                        block_tokens: int = 16) -> dict:
+    """Mixed token-budget dispatch vs the alternating baseline
+    (docs/DESIGN.md §19) under a fixed arrival load.
+
+    Both modes serve the SAME schedule: ``slots - 1`` long-decode
+    background rows pin the batch, then ``n_req`` chunk-heavy prompts
+    arrive at a fixed interval.  The baseline is the serialized
+    interleave this repo shipped pre-§19 (chunk dispatches alternating
+    with decode steps, fused-loop suppression while an admission is in
+    flight); mixed packs the chunks INTO the fused decode dispatches
+    under the token budget.  Reported per mode: aggregate tok/s over
+    the measured window (arrival-stream tokens PLUS the background
+    rows' tokens produced inside it — the baseline's suppression
+    stalls the background decode during every admission, and that
+    stalled decode is exactly the cost §19 removes), TTFT p95 (engine
+    reservoir, background rows excluded by the post-warmup reset),
+    and dispatches/step — the 1/K-vs-1 structural signature the §19
+    acceptance pins."""
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    sampling = SamplingParams(greedy=True)
+    budget = token_budget or slots * decode_block + 2 * prefill_chunk
+    bg_rows = max(1, slots - 1)
+    # background rows must outlive the arrival stream; they are
+    # cancelled once the measured requests finish
+    bg_new = max(64, n_req * new_tokens)
+    max_seq = max(prompt_len + new_tokens, 8 + bg_new)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, 1000, size=(n_req, prompt_len)).astype(
+        np.int32)
+    warm = rng.integers(0, 1000, size=(2, prompt_len)).astype(np.int32)
+
+    def run(mixed: bool) -> dict:
+        kw = {"mixed_token_budget": budget} if mixed else {}
+        with ContinuousBatchingEngine(
+                cfg, params, max_seq=max_seq, max_batch=slots,
+                sampling=sampling, prefill_chunk=prefill_chunk,
+                decode_block=decode_block, kv_block_tokens=block_tokens,
+                **kw) as eng:
+            # compile pass 1: a full-shape admission on an idle engine
+            eng.submit(warm[0], 2).wait(timeout=600)
+            bg = [eng.submit(np.asarray([7, i + 1, 3], np.int32), bg_new)
+                  for i in range(bg_rows)]
+            deadline = time.monotonic() + 600
+            for r in bg:               # every background row decoding
+                while not r.tokens:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("background rows never "
+                                           "admitted")
+                    time.sleep(0.002)
+            # compile pass 2: an admission UNDER decode load — the
+            # baseline's suppressed per-token step and the mixed
+            # engine's no-finals slab variant both compile here, not
+            # inside the measured window
+            eng.submit(warm[1], 2).wait(timeout=600)
+            eng.reset_stats()
+            bg_before = sum(len(r.tokens) for r in bg)
+            t0 = time.perf_counter()
+            reqs = []
+            for p in prompts:
+                reqs.append(eng.submit(p, new_tokens))
+                if arrival_s:
+                    time.sleep(arrival_s)
+            for r in reqs:
+                r.wait(timeout=900)
+            dt = time.perf_counter() - t0
+            bg_tokens = sum(len(r.tokens) for r in bg) - bg_before
+            st = eng.stats()
+            ls = dict(eng.loop_stats)
+            for r in bg:
+                r.cancel()
+            for r in bg:
+                try:
+                    r.wait(timeout=600)
+                except Exception:
+                    pass
+            out = {
+                "tokens_per_sec": round(
+                    (n_req * new_tokens + bg_tokens) / dt, 2),
+                "stream_tokens_per_sec": round(
+                    n_req * new_tokens / dt, 2),
+                "background_tokens": bg_tokens,
+                "ttft_p95_ms": st["latency"].get("ttft_p95_ms"),
+                "host_dispatches": ls["host_dispatches"],
+                "device_loop_steps": ls["device_loop_steps"],
+                "dispatches_per_step": round(
+                    ls["host_dispatches"]
+                    / max(1, ls["device_loop_steps"]), 4),
+            }
+            if mixed:
+                out["mixed_dispatches"] = st["mixed"]["dispatches"]
+                out["prefill_tokens"] = st["mixed"]["prefill_tokens"]
+                out["budget_utilization"] = st["mixed"][
+                    "budget_utilization"]
+            mgr = eng.kv_cache
+            out["leaked_blocks"] = (mgr.used_blocks
+                                    - mgr.tree.block_count)
+            return out
+
+    baseline = run(mixed=False)
+    mixed = run(mixed=True)
+    return {
+        "model": model, "slots": slots, "requests": n_req,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "prefill_chunk": prefill_chunk, "decode_block": decode_block,
+        "token_budget": budget, "arrival_s": arrival_s,
+        "background_rows": bg_rows,
+        "baseline": baseline, "mixed": mixed,
+        "mixed_wins_tokens_per_sec": (
+            mixed["tokens_per_sec"] > baseline["tokens_per_sec"]),
+        "mixed_ttft_p95_le_baseline": (
+            mixed["ttft_p95_ms"] is not None
+            and baseline["ttft_p95_ms"] is not None
+            and mixed["ttft_p95_ms"] <= baseline["ttft_p95_ms"]),
+    }
+
+
 def _leg_prefix_reuse(model: str, new_tokens: int, slots: int = 8,
                       n_req: int = 16, shared_len: int = 96,
                       tail_len: int = 32, block_tokens: int = 16,
@@ -2687,6 +2818,18 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
             out = _leg_prompt_lookup(model, new_tokens)
         elif name == "batching":
             out = _leg_batching(model, prompt_len, min(new_tokens, 64))
+        elif name == "mixed_batching":
+            # the micro shape keeps the §19 gate structural on CPU:
+            # 12-chunk prompts over 4 slots with 3 pinned decode rows,
+            # all arrivals at once — the serialized baseline pays one
+            # suppressed per-token dispatch per step PLUS one dispatch
+            # per chunk, mixed pays ~1 per decode_block with the
+            # chunks riding along
+            out = (_leg_mixed_batching(model, prompt_len=96,
+                                       new_tokens=16, slots=4, n_req=8,
+                                       prefill_chunk=8, decode_block=4,
+                                       arrival_s=0.0, block_tokens=8)
+                   if micro else _leg_mixed_batching(model))
         elif name == "prefix_reuse":
             out = _leg_prefix_reuse(model, min(new_tokens, 64))
         elif name == "paged_decode":
@@ -2987,7 +3130,8 @@ def main() -> None:
             "headline_int8", "decode_fused", "speculative",
             "prompt_lookup", "planner_pipeline", "long_context",
             "long_context_sp", "disagg", "gateway_routing",
-            "flagship_int8", "batching", "prefix_reuse", "paged_decode",
+            "flagship_int8", "batching", "mixed_batching",
+            "prefix_reuse", "paged_decode",
             "serving_relative", "sweep", "flagship_bf16", "pipeline",
             "fault_recovery", "prefill_long", "moe", "multimodal",
             "int4"]
@@ -2997,8 +3141,8 @@ def main() -> None:
                                      "fault_recovery"]),
             ("BENCH_SKIP_SWEEP", ["sweep"]),
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
-                                    "batching", "prefix_reuse",
-                                    "paged_decode",
+                                    "batching", "mixed_batching",
+                                    "prefix_reuse", "paged_decode",
                                     "serving_relative", "disagg",
                                     "gateway_routing"]),
             ("BENCH_SKIP_LONGCTX", ["long_context", "long_context_sp"]),
@@ -3062,7 +3206,8 @@ def main() -> None:
     # builds two engines + three waves — budget it like batching
     # gateway_routing runs three replica engines through three phases
     # (two routed soaks + the drain) — multi-engine, budget it likewise
-    leg_timeouts = {"batching": 1500, "prefix_reuse": 1200,
+    leg_timeouts = {"batching": 1500, "mixed_batching": 1500,
+                    "prefix_reuse": 1200,
                     "paged_decode": 1500, "serving_relative": 1500,
                     "gateway_routing": 1500}
     runlog.event("bench_start", params=params, legs=legs)

@@ -480,6 +480,8 @@ def cmd_serve(args) -> int:
             num_draft=args.num_draft, prompt_lookup=pld,
             decode_block=args.decode_block,
             prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
+            mixed_token_budget=getattr(args, "mixed_token_budget", 0)
+            or None,
             kv_layout=getattr(args, "kv_layout", None),
             kv_dtype=getattr(args, "kv_dtype", None),
             max_queue_depth=getattr(args, "admission_queue_depth", 0),
@@ -1324,6 +1326,15 @@ def main(argv=None) -> int:
                         "--prompt-lookup) per dispatch when no admission "
                         "could land anyway (one host sync per block; "
                         "admission latency <= N steps)")
+    s.add_argument("--mixed-token-budget", type=int, default=0,
+                   help="with --batch-slots and --prefill-chunk: pack "
+                        "prefill chunk tokens from admitting prompts "
+                        "into the SAME dispatch as the fused decode "
+                        "block, up to N tokens total per step "
+                        "(docs/DESIGN.md §19; decode fusion survives "
+                        "admission and output stays bit-identical to "
+                        "the serialized interleave; default "
+                        "DWT_MIXED_TOKEN_BUDGET or 0 = serialized)")
     s.add_argument("--vision", action="store_true",
                    help="LLaVA-style multimodal serving: /generate takes "
                         "an optional 'image' field ([H][W][C] floats); "

@@ -61,9 +61,14 @@ def write_paged_kv(
 
     Sentinel table entries route the write out of bounds, where scatter
     ``mode="drop"`` discards it — the paged twin of the dense layout's
-    "stale writes land on the row's own dead columns".  Write contract
-    (stale-slot invariant, shared with the dense path):
-    :func:`ops.attention.prepare_kv_chunk`.
+    "stale writes land on the row's own dead columns".  A position PAST
+    the table (``p // bt >= W`` — a padded prefill tail running off the
+    end of a full-width table) is routed to the sentinel too: the naive
+    ``take_along_axis`` would CLAMP the page index to the last table
+    entry, and for a request whose table is fully populated that is a
+    real page — the write would corrupt a live position ``p % bt`` deep
+    into it.  Write contract (stale-slot invariant, shared with the
+    dense path): :func:`ops.attention.prepare_kv_chunk`.
     """
     bt = k_pages.shape[2]
     if isinstance(k_pages, QuantizedKVPages):
@@ -77,7 +82,10 @@ def write_paged_kv(
                                         v_pages.dtype)
     qk = quantize_kv_like(k_pages, k_new)
     qv = quantize_kv_like(v_pages, v_new)
-    page = jnp.take_along_axis(tables, positions // bt, axis=1)  # [b, s]
+    num_pages, W = k_pages.shape[0], tables.shape[1]
+    pidx = positions // bt                                       # [b, s]
+    page = jnp.take_along_axis(tables, jnp.minimum(pidx, W - 1), axis=1)
+    page = jnp.where(pidx < W, page, num_pages)  # past-table -> drop
     off = positions % bt                                         # [b, s]
     # advanced indices at dims (0, 2) around the head slice: the indexed
     # result layout [b, s, nkv, hd] is exactly the projection layout the
@@ -312,6 +320,221 @@ def paged_flash_attention(
 
 
 # ---------------------------------------------------------------------------
+# Pallas TPU prefill kernel (docs/DESIGN.md §19)
+
+
+def _paged_prefill_kernel(tab_ref, start_ref, q_ref, *refs,
+                          block_tokens: int, chunk: int, groups: int,
+                          use_alibi: bool, quantized: bool):
+    """Grid (b, nkv, W), page index innermost — the prefill twin of
+    :func:`_paged_kernel`.  Rows are (chunk position, q-head group
+    member) pairs: row ``r`` is query position ``start + r // g`` of
+    q head ``h*g + r % g``, so the whole C-token segment of one kv
+    head folds each streamed page into the online-softmax accumulators
+    in ONE grid pass.  The causal bound is per ROW (``kv_pos <=
+    start + r // g``), not the single shared decode position — in-chunk
+    keys were already written to the pages by ``write_paged_kv``
+    (write-before-attend inside the layer), so causality alone makes a
+    query see exactly its prefix plus its own earlier in-chunk keys.
+
+    tab_ref (SMEM int32 [b, W]): block tables; start_ref (SMEM int32
+    [b]): per-row segment start offsets (position of chunk column 0)."""
+    if quantized:
+        (k_ref, ks_ref, v_ref, vs_ref, slopes_ref,
+         o_ref, o_acc, m_acc, l_acc) = refs
+    else:
+        k_ref, v_ref, slopes_ref, o_ref, o_acc, m_acc, l_acc = refs
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    num_j = pl.num_programs(2)
+    rows, hd = q_ref.shape[2], q_ref.shape[3]
+    start = start_ref[b]
+    bt = block_tokens
+    g = groups
+
+    @pl.when(j == 0)
+    def _init():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, _NEG)
+        l_acc[:] = jnp.zeros_like(l_acc)
+
+    kv_len = start + chunk
+    n_live = (kv_len + bt - 1) // bt
+
+    @pl.when(j < n_live)
+    def _step():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        q = q * (1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32)))
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+        if quantized:
+            k_blk = k_blk * ks_ref[0, 0, :, :]      # [bt, hd] * [bt, 1]
+            v_blk = v_blk * vs_ref[0, 0, :, :]
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32)     # [rows, bt]
+        kv_pos = (j * bt
+                  + jax.lax.broadcasted_iota(jnp.int32, (1, bt), 1))
+        # per-row query position: padding rows (r >= chunk*g) see a
+        # position past the segment — their garbage output is sliced
+        # away by the caller
+        q_pos = (start
+                 + jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // g)
+        valid = kv_pos <= q_pos                             # [rows, bt]
+        if use_alibi:
+            slope = slopes_ref[0, 0, :][:, None]            # [rows, 1]
+            dist = (q_pos - kv_pos).astype(jnp.float32)
+            s = s - slope * dist
+        s = jnp.where(valid, s, _NEG)
+
+        m = jnp.max(m_acc[:], axis=-1, keepdims=True)       # [rows, 1]
+        l = jnp.max(l_acc[:], axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_acc[:] = o_acc[:] * alpha + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        m_acc[:] = jnp.broadcast_to(m_new, m_acc.shape)
+        l_acc[:] = jnp.broadcast_to(l_new, l_acc.shape)
+
+    @pl.when(j == num_j - 1)
+    def _finalize():
+        l = jnp.max(l_acc[:], axis=-1, keepdims=True)
+        o_ref[0, 0, :, :] = (o_acc[:]
+                             / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_tokens", "chunk", "groups",
+                                    "use_alibi", "interpret"))
+def _paged_prefill_call(q_g, k_pages, v_pages, tables, starts, slopes, *,
+                        block_tokens, chunk, groups, use_alibi,
+                        interpret):
+    b, nkv, rows, hd = q_g.shape
+    quantized = isinstance(k_pages, QuantizedKVPages)
+    num_pages = k_pages.shape[0]
+    W = tables.shape[1]
+    bt = block_tokens
+
+    def page_map(bb, h, j, tab, starts_):
+        # clamp to the segment's live frontier (start + chunk tokens):
+        # beyond it the index repeats (no DMA, pl.when skips compute);
+        # sentinel entries clamp in-range
+        live = (starts_[bb] + chunk + bt - 1) // bt
+        jj = jnp.minimum(j, jnp.maximum(live - 1, 0))
+        page = jnp.minimum(tab[bb, jj], num_pages - 1)
+        return (page, h, 0, 0)
+
+    q_spec = pl.BlockSpec((1, 1, rows, hd),
+                          lambda bb, h, j, tab, starts_: (bb, h, 0, 0))
+    slopes_spec = pl.BlockSpec((1, 1, rows),
+                               lambda bb, h, j, tab, starts_: (h, 0, 0))
+    page_spec = pl.BlockSpec((1, 1, bt, hd), page_map)
+    if quantized:
+        scale_spec = pl.BlockSpec((1, 1, bt, 1), page_map)
+        in_specs = [q_spec, page_spec, scale_spec, page_spec,
+                    scale_spec, slopes_spec]
+        operands = (tables, starts, q_g, k_pages.data, k_pages.scale,
+                    v_pages.data, v_pages.scale, slopes)
+    else:
+        in_specs = [q_spec, page_spec, page_spec, slopes_spec]
+        operands = (tables, starts, q_g, k_pages, v_pages, slopes)
+
+    return pl.pallas_call(
+        functools.partial(_paged_prefill_kernel, block_tokens=bt,
+                          chunk=chunk, groups=groups,
+                          use_alibi=use_alibi, quantized=quantized),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, nkv, W),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, rows, hd),
+                                   lambda bb, h, j, tab, starts_:
+                                   (bb, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, hd), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, rows, hd), q_g.dtype),
+        interpret=interpret,
+    )(*operands)
+
+
+# one kernel invocation's query rows = chunk * group; past this the
+# f32 VMEM accumulators (rows x hd + 2 x rows x 128) crowd the page
+# stream — larger chunks take the gather path
+PREFILL_KERNEL_MAX_ROWS = 512
+
+
+def paged_prefill_attention(
+    q: jnp.ndarray,          # [batch, chunk, nh, hd], chunk >= 1
+    k_pages: jnp.ndarray,    # [num_pages, nkv, block_tokens, hd]
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,     # [batch, W] int32
+    q_positions: jnp.ndarray,  # [batch, chunk]; CONTIGUOUS per row
+    slopes: Optional[jnp.ndarray] = None,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas paged PREFILL attention: each row's chunk of queries
+    attends causally over its own prior pages plus the in-chunk keys
+    (already present — ``write_paged_kv`` runs before attention inside
+    the layer).  Numerics match :func:`paged_gather_attention` (f32
+    online softmax, same masking).
+
+    Contract: ``q_positions[b] == q_positions[b, 0] + arange(chunk)``
+    (every caller of the paged seam issues contiguous chunks); only the
+    per-row start rides scalar prefetch, the rest is recovered from the
+    static chunk length.  Same page-dtype gates as the decode kernel:
+    bf16 or int8 pages, ``block_tokens % 8 == 0``; int4 takes the
+    gather path."""
+    b, chunk, nh, hd = q.shape
+    if isinstance(k_pages, QuantizedKVPages) and k_pages.bits != 8:
+        raise ValueError("the Pallas kernel streams bf16 or int8 pages; "
+                         "int4 KV takes the XLA gather path")
+    num_pages, nkv, bt, _ = k_pages.shape
+    if bt % 8:
+        raise ValueError(f"block_tokens must be a multiple of 8 for the "
+                         f"Pallas kernel, got {bt}")
+    g = nh // nkv
+    rows_real = chunk * g
+    rows = max(8, -(-rows_real // 8) * 8)
+    if rows > PREFILL_KERNEL_MAX_ROWS:
+        raise ValueError(
+            f"prefill kernel rows {rows} (chunk {chunk} x group {g}) "
+            f"exceed {PREFILL_KERNEL_MAX_ROWS}; use the gather path")
+
+    # [b, chunk, nh, hd] -> [b, nkv, chunk*g, hd]: row c*g + r of kv
+    # head h is chunk position c of q head h*g + r
+    q_g = q.reshape(b, chunk, nkv, g, hd).transpose(0, 2, 1, 3, 4)
+    q_g = q_g.reshape(b, nkv, rows_real, hd)
+    if rows > rows_real:
+        q_g = jnp.pad(q_g, ((0, 0), (0, 0), (0, rows - rows_real),
+                            (0, 0)))
+    if slopes is None:
+        slopes_g = jnp.zeros((nkv, 1, rows), jnp.float32)
+    else:
+        # per-row slope = slopes[h*g + r % g]: the g-vector repeats
+        # once per chunk position
+        slopes_g = jnp.tile(
+            slopes.astype(jnp.float32).reshape(nkv, 1, g),
+            (1, 1, chunk))
+        slopes_g = jnp.pad(slopes_g,
+                           ((0, 0), (0, 0), (0, rows - rows_real)))
+
+    out = _paged_prefill_call(
+        q_g, k_pages, v_pages, tables.astype(jnp.int32),
+        q_positions[:, 0].astype(jnp.int32), slopes_g,
+        block_tokens=bt, chunk=chunk, groups=g,
+        use_alibi=slopes is not None, interpret=interpret)
+    out = out[:, :, :rows_real, :].reshape(b, nkv, chunk, g, hd)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, chunk, nh, hd)
+
+
+# ---------------------------------------------------------------------------
 # the attn_impl seam (models/decoder.py hook)
 
 
@@ -327,8 +550,10 @@ def make_paged_attn_impl(block_tokens: int, backend: str = "auto",
     tracing (the layer scan closes over it as a loop constant).
 
     ``backend``: "auto" (Pallas on TPU, XLA gather elsewhere), "xla", or
-    "pallas".  The Pallas path covers 1-token decode chunks with
-    8-aligned pages; anything else takes the gather path.
+    "pallas".  The Pallas decode kernel covers 1-token chunks and the
+    prefill kernel covers multi-token chunks up to
+    ``PREFILL_KERNEL_MAX_ROWS`` query rows, both with 8-aligned pages;
+    anything else takes the gather path.
     """
     if backend not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown paged attention backend {backend!r}; "
@@ -357,11 +582,18 @@ def make_paged_attn_impl(block_tokens: int, backend: str = "auto",
                          and bt % 8 == 0)
         else:
             kernel_ok = bt % 8 == 0
-        if (use_pallas and q.shape[1] == 1 and kernel_ok):
+        chunk = q.shape[1]
+        groups = q.shape[2] // k.shape[2]
+        if (use_pallas and chunk == 1 and kernel_ok):
             kv_lens = positions[:, -1] + 1
             out = paged_flash_attention(q, k_pages, v_pages, tables,
                                         kv_lens, slopes,
                                         interpret=interpret)
+        elif (use_pallas and chunk > 1 and kernel_ok
+              and -(-(chunk * groups) // 8) * 8 <= PREFILL_KERNEL_MAX_ROWS):
+            out = paged_prefill_attention(q, k_pages, v_pages, tables,
+                                          positions, slopes,
+                                          interpret=interpret)
         else:
             out = paged_gather_attention(q, k_pages, v_pages, tables,
                                          positions, slopes)

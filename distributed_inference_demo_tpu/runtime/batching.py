@@ -30,13 +30,19 @@ TPU-first design:
   per-row (``kv_pos <= q_position`` — ops/attention.py), so ragged slot
   lengths need no extra masking; writes scatter at
   ``(table[p // bt], p % bt)`` (ops/paged_attention.py).
-- **Admission = batch-1 prefill into a temp row + page scatter.**  The
-  prompt is padded to a small set of bucket lengths (one compile per
-  bucket, reused), prefilled into a dense temp row seeded straight out
-  of the pool (matched prefix pages gather device-to-device), and the
-  finished row scatters into the request's own reserved pages — a
-  handful of dispatches, between steps, while the other slots' state
-  stays on device.
+- **Admission = PAGED prefill straight into the pool.**  The prompt is
+  padded to a small set of bucket lengths (one compile per bucket,
+  reused) and forwarded through the same block-table seam decode uses
+  (ops/paged_attention.paged_prefill_attention): each chunk's K/V
+  scatters directly into the request's reserved pages and its queries
+  attend causally over the prior pages plus the in-chunk keys.  Matched
+  prefix pages are shared table entries — no temp row, no
+  gather/scatter round trip, zero H2D.  Under a token budget
+  (``mixed_token_budget``) admission chunks ride INSIDE the decode
+  dispatch: one jitted program packs every active row's fused decode
+  tokens plus prefill chunk segments from one or more admitting
+  prompts, so batch-mates never lose their decode fusion while a
+  prompt streams in (Orca/Sarathi-style stall-free mixed batching).
 - **Stale-slot safety** is the same invariant speculative decoding relies
   on: garbage KV only ever sits at positions >= a row's valid length, a
   query at position p attends only kv_pos <= p, and position p is always
@@ -67,13 +73,12 @@ import numpy as np
 
 from ..models.base import (KVCache, ModelConfig, StageParams,
                            StageSpec, pad_cache_capacity)
-from ..models.decoder import stage_forward
 from ..ops.sampling import SamplingParams, filtered_logits, sample_logits
 from ..telemetry import postmortem
 from ..telemetry.anomaly import AnomalyMonitor
 from ..telemetry.flightrecorder import get_flight_recorder
 from .engine import (GenerationResult, check_capacity,
-                     make_chunk_programs, validate_prefill_chunk)
+                     make_paged_chunk_programs, validate_prefill_chunk)
 from .speculative import verify_emit_per_row
 
 
@@ -163,7 +168,8 @@ class ContinuousBatchingEngine:
                  decode_block: int = 1,
                  prefill_chunk: Optional[int] = None,
                  kv_layout: Optional[str] = None,
-                 max_queue_depth: Optional[int] = None):
+                 max_queue_depth: Optional[int] = None,
+                 mixed_token_budget: Optional[int] = None):
         """``kv_cache_blocks`` / ``kv_block_tokens``: the block-level KV
         cache (``runtime/kvcache``, docs/DESIGN.md §10) — automatic
         prefix reuse at ``kv_block_tokens`` granularity.  A new prompt
@@ -258,11 +264,28 @@ class ContinuousBatchingEngine:
         :class:`~.overload.SchedulerOverloaded` instead of queueing
         unboundedly (the HTTP layer maps it to ``503 + Retry-After``).
         ``None`` defers to ``DWT_MAX_QUEUE_DEPTH``; 0 (the default)
-        keeps the queue unbounded."""
+        keeps the queue unbounded.
+
+        ``mixed_token_budget``: MIXED prefill+decode dispatch (docs/
+        DESIGN.md §19) — each scheduler iteration becomes ONE jitted
+        program packing every active decode row's ``decode_block``
+        fused-loop tokens plus prefill chunk segments from one or more
+        admitting prompts, up to this many tokens per dispatch.  Decode
+        fusion survives admission (the serialized mode's fuse
+        suppression is gone) and several prompts stream chunks
+        concurrently.  Requires ``prefill_chunk``; exclusive with the
+        speculative modes (draft/prompt-lookup ride the serialized
+        path).  ``None`` defers to ``DWT_MIXED_TOKEN_BUDGET``; 0 (the
+        default) keeps the serialized interleave, which is the
+        bit-identity reference the mixed path is pinned against."""
         if max_queue_depth is None:
             from ..telemetry._env import env_int
             max_queue_depth = env_int("DWT_MAX_QUEUE_DEPTH", 0)
         self.max_queue_depth = max(0, int(max_queue_depth))
+        if mixed_token_budget is None:
+            from ..telemetry._env import env_int
+            mixed_token_budget = env_int("DWT_MIXED_TOKEN_BUDGET", 0)
+        self.mixed_token_budget = max(0, int(mixed_token_budget))
         self.cfg, self.params = cfg, params
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_batch = max_batch
@@ -278,6 +301,21 @@ class ContinuousBatchingEngine:
                                                     self.max_seq)
         if decode_block < 1:
             raise ValueError("decode_block must be >= 1")
+        if self.mixed_token_budget > 0:
+            if self.prefill_chunk is None:
+                raise ValueError(
+                    "mixed_token_budget needs prefill_chunk: the budget "
+                    "is packed with C-token prefill segments")
+            if prompt_lookup or draft_cfg is not None:
+                raise ValueError(
+                    "mixed_token_budget composes with plain decode only; "
+                    "the speculative modes ride the serialized "
+                    "chunked-admission path")
+            if self.mixed_token_budget < self.prefill_chunk:
+                raise ValueError(
+                    f"mixed_token_budget ({self.mixed_token_budget}) must "
+                    f"fit at least one prefill chunk "
+                    f"({self.prefill_chunk} tokens)")
         if prompt_lookup and draft_cfg is not None:
             raise ValueError(
                 "prompt_lookup and draft_cfg are exclusive proposers")
@@ -345,8 +383,6 @@ class ContinuousBatchingEngine:
 
         from ..parallel.tensor import (make_forward_seam,
                                        make_paged_forward_seam)
-        fwd, self._cache_sharding = make_forward_seam(
-            cfg, self.spec, mesh, params, attn_impl=slot_attention_impl)
 
         # ------------------------------------------------------------------
         # the DEVICE-resident page pool (docs/DESIGN.md §11/§14): HBM
@@ -358,8 +394,7 @@ class ContinuousBatchingEngine:
         # causally-masked garbage.  Under a tp mesh the pool shards by
         # kv head (axis 2), exactly like the dense cache did.
         from .kvcache import PagedKVCacheManager
-        from .kvcache.device import (seed_row_from_pages,
-                                     write_row_to_pages)
+        from .kvcache.device import write_row_to_pages
         bt = block_tokens
         self._table_width = S // bt
         n_blocks = (n_blocks_arg if n_blocks_arg >= 1
@@ -385,7 +420,10 @@ class ContinuousBatchingEngine:
             self._pk = jax.device_put(self._pk, pool_sharding.keys)
             self._pv = jax.device_put(self._pv, pool_sharding.values)
         self._tables = np.full((B, self._table_width), N, np.int32)
-        self._seed_row = seed_row_from_pages
+        # write_row_to_pages survives for the DRAFT side only: the draft
+        # prefill still runs a dense temp row (the draft is small by
+        # construction) and scatters it into the scratch pool; the
+        # TARGET's temp-row path is deleted — prefill pages directly
         self._write_row = write_row_to_pages
 
         def _emitted_logprob(logits, tok):
@@ -422,7 +460,8 @@ class ContinuousBatchingEngine:
             return cache.keys, cache.values, lengths, tok, lp
 
         def _fused_loop(step_fn, params, cache, lengths, last_tok,
-                        active, rng, eos, budget, num_steps):
+                        active, rng, eos, budget, num_steps,
+                        done0=None):
             """The device-resident fused-block loop shared by the dense
             and paged multi-step jits (docs/DESIGN.md §13): up to
             ``num_steps`` lockstep steps in one dispatch (one host sync
@@ -442,12 +481,15 @@ class ContinuousBatchingEngine:
             the on-device active count that tells it how many steps
             actually ran.  rng is pre-split per step (the fixed-trip
             scan's consumption order), so sampled fused blocks keep
-            their exact historical streams."""
+            their exact historical streams.  ``done0``: rows already
+            done at entry — a mixed dispatch's freshly installed row
+            whose first sampled token hit eos."""
             B = last_tok.shape[0]
             keys = jax.random.split(rng, num_steps)
             toks0 = jnp.zeros((B, num_steps), jnp.int32)
             lps0 = jnp.zeros((B, num_steps), jnp.float32)
-            done0 = jnp.zeros((B,), bool)
+            if done0 is None:
+                done0 = jnp.zeros((B,), bool)
 
             def cond(carry):
                 j, cache, lengths, tok, row_done, toks, lps = carry
@@ -495,52 +537,126 @@ class ContinuousBatchingEngine:
             return (lengths.at[slot].set(new_len),
                     last_tok.at[slot].set(new_tok))
 
-        @partial(jax.jit, donate_argnums=(3, 4))
-        def prefill(params, ids, start, row_k, row_v, real_len, rng):
-            """Batch-1 (suffix) prefill over a padded bucket at offset
-            ``start`` of a caller-provided row cache; samples token #1.
+        kv_dtype = self.kv_cache_dtype
 
-            Cold path: start=0 and a zero row.  Prefix-reuse path: start=m
-            and a row preloaded with the shared prefix's K/V.  Padded tail
-            tokens do write garbage K/V past ``start + real_len``, but
-            those positions are exactly the ones decode overwrites before
-            any query can attend them (stale-slot invariant above)."""
+        # paged chunk programs: the SHARED factory
+        # (engine.make_paged_chunk_programs — one owner of paged chunk
+        # semantics).  Chunks write K/V straight into the request's
+        # reserved pages through its block table — no dense temp row,
+        # no gather/scatter round trip, zero H2D across cold admission.
+        self._paged_chunk_mid, slab_body = make_paged_chunk_programs(
+            fwd_p, bind_tables)
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def paged_prefill(params, pk, pv, ids, table, start, real_len,
+                          rng):
+            """Batch-1 (suffix) PAGED prefill over a padded bucket at
+            offset ``start``, straight through the request's block
+            table [1, W]; samples token #1 at the prompt's true last
+            position.
+
+            Cold path: start=0.  Prefix-reuse path: start=m with the
+            matched tree pages already in the table (reads only —
+            writes begin at ``start``, which is at/past the shared
+            pages' frontier).  Padded tail tokens write garbage K/V
+            past ``start + real_len`` into the request's OWN reserved
+            pages (or sentinel-drop past the reservation), and decode
+            overwrites each such position before any query can attend
+            it (stale-slot invariant above)."""
+            bind_tables(table)
             b, s = ids.shape
             pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
-            cache = KVCache(row_k, row_v, jnp.zeros((), jnp.int32))
-            logits, cache = fwd(params, ids, cache, pos, False)
+            cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
+            logits, cache = fwd_p(params, ids, cache, pos, False)
             last = jax.lax.dynamic_index_in_dim(
                 logits, real_len - 1, axis=1, keepdims=False)  # [1, V]
             tok = sample_logits(last, rng, samp_)
             lp = _emitted_logprob(last, tok)
             return cache.keys, cache.values, tok[0], lp[0]
 
-        # rows are born on their kv-head shards under a mesh (out_shardings
-        # None = unconstrained) so admission never pays a reshard into the
-        # prefill shard_map
-        row_shardings = (None if self._cache_sharding is None else
-                         (self._cache_sharding.keys,
-                          self._cache_sharding.values))
-
-        kv_dtype = self.kv_cache_dtype
-
-        @partial(jax.jit, out_shardings=row_shardings)
-        def zero_row():
-            """Fresh zero row for the cold prefill path (prefill donates
-            its row buffers, so the row must be new each admission)."""
-            row = KVCache.create(cfg_, cfg_.num_layers, 1, S, dtype=kv_dtype)
-            return row.keys, row.values
-
-        # mid-chunk program for chunked admission: the SHARED factory
-        # (engine.make_chunk_programs — one owner of chunk semantics), so
-        # non-final chunks extend the row cache without materializing
-        # logits or sampling (XLA drops the LM head entirely)
-        self._chunk_mid, _ = make_chunk_programs(fwd)
-
-        self._prefill, self._zero_row = prefill, zero_row
+        self._paged_prefill = paged_prefill
         self._paged_step = paged_step
         self._paged_multi_step = paged_multi_step
         self._set_slot_state = set_slot_state
+
+        # ------------------------------------------------------------------
+        # the MIXED token-budget dispatch (docs/DESIGN.md §19): one jit
+        # packing a [n_seg, C] prefill slab (chunk segments from one or
+        # more admitting prompts, final segments sampling token #1 and
+        # installing their slot in-program) with the fused decode loop
+        # over all active rows.  Segment count is FIXED at
+        # budget // C (unused rows ride all-sentinel tables and slot=B,
+        # so every install drops), giving exactly two compiled variants
+        # (with_finals x num_steps is static per decode_block).
+        self._mixed_step = None
+        self._mixed_seg_cap = 0
+        if self.mixed_token_budget > 0:
+            C_mixed = self.prefill_chunk
+            n_seg = max(1, self.mixed_token_budget // C_mixed)
+            self._mixed_seg_cap = n_seg
+
+            @partial(jax.jit, donate_argnums=(1, 2),
+                     static_argnums=(17, 18))
+            def mixed_step(params, pk, pv, seg_ids, seg_tables,
+                           seg_starts, seg_lens, seg_slot, seg_plen,
+                           seg_keys, dec_tables, lengths, last_tok,
+                           active, dec_rng, eos, budget, num_steps,
+                           with_finals):
+                """One mixed dispatch.  Prefill slab first: row r of
+                ``seg_ids`` [n_seg, C] runs at positions
+                ``seg_starts[r] + arange(C)`` through ``seg_tables[r]``
+                (sentinel rows compute into dropped writes).  If
+                ``with_finals``, each row samples token #1 at
+                ``seg_lens[r] - 1`` from its OWN batch-1 rng key
+                (``seg_keys[r]`` — the serialized prefill's exact
+                spend) and installs itself at ``seg_slot[r]``
+                (slot = B = not-a-final, the install drops).  Then the
+                fused decode loop runs over ``dec_tables`` with the
+                updated row state — freshly installed rows decode in
+                the SAME dispatch, rows whose token #1 was eos enter
+                the loop already done."""
+                B_ = last_tok.shape[0]
+                cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
+                logits, cache = slab_body(params, cache, seg_ids,
+                                          seg_tables, seg_starts)
+                if with_finals:
+                    f_toks, f_lps = [], []
+                    for r in range(n_seg):
+                        # batch-1 sampling per final row, its own key:
+                        # bit-identical to the serialized final prefill
+                        last = jax.lax.dynamic_index_in_dim(
+                            logits[r], seg_lens[r] - 1, axis=0,
+                            keepdims=True)                     # [1, V]
+                        tok_r = sample_logits(last, seg_keys[r], samp_)
+                        f_toks.append(tok_r[0])
+                        f_lps.append(_emitted_logprob(last, tok_r)[0])
+                    final_toks = jnp.stack(f_toks).astype(jnp.int32)
+                    final_lps = jnp.stack(f_lps)
+                    lengths = lengths.at[seg_slot].set(
+                        seg_plen, mode="drop")
+                    last_tok = last_tok.at[seg_slot].set(
+                        final_toks, mode="drop")
+                    active = active.at[seg_slot].set(True, mode="drop")
+                    done0 = jnp.zeros((B_,), bool).at[seg_slot].set(
+                        (eos >= 0) & (final_toks == eos), mode="drop")
+                    # a max_new=1 install has nothing left to decode:
+                    # it enters the loop already done (pre-existing
+                    # rows always have budget >= 1 — completed rows
+                    # free their slot at drain time)
+                    done0 = done0 | (budget <= 0)
+                else:
+                    final_toks = jnp.zeros((n_seg,), jnp.int32)
+                    final_lps = jnp.zeros((n_seg,), jnp.float32)
+                    done0 = None
+                bind_tables(dec_tables)
+                cache, lengths, tok, toks, lps, steps = _fused_loop(
+                    paged_one_step, params, cache, lengths, last_tok,
+                    active, dec_rng, eos, budget, num_steps,
+                    done0=done0)
+                return (cache.keys, cache.values, lengths, tok,
+                        final_toks, final_lps, toks, lps, steps)
+
+            self._mixed_step = mixed_step
 
         def verify_slots(params, cache, drafts, q_logits, lengths,
                          last_tok, active, rng):
@@ -648,10 +764,18 @@ class ContinuousBatchingEngine:
             dcfg_ = draft_cfg
             dspec = StageSpec(0, 1, 0, draft_cfg.num_layers)
             # dense temp-row prefill (slot impl) + paged decode seam —
-            # the draft twins of the target's fwd / fwd_p pair
-            fwd_d, _ = make_forward_seam(
+            # the draft keeps the temp-row admission path the target
+            # dropped (it is small by construction, and its pool is
+            # pure scratch)
+            fwd_d, dcache_sharding = make_forward_seam(
                 draft_cfg, dspec, mesh, draft_params,
                 attn_impl=slot_attention_impl)
+            # draft rows are born on their kv-head shards under a mesh
+            # (out_shardings None = unconstrained) so admission never
+            # pays a reshard into the prefill shard_map
+            drow_shardings = (None if dcache_sharding is None else
+                              (dcache_sharding.keys,
+                               dcache_sharding.values))
             fwd_dp, bind_dtables, dpool_sharding = \
                 make_paged_forward_seam(draft_cfg, dspec, mesh,
                                         draft_params, bt)
@@ -753,7 +877,7 @@ class ContinuousBatchingEngine:
                 _, dcache = fwd_d(dparams, ids, dcache, pos, True)
                 return dcache.keys, dcache.values
 
-            @partial(jax.jit, out_shardings=row_shardings)
+            @partial(jax.jit, out_shardings=drow_shardings)
             def zero_row_d():
                 row = KVCache.create(dcfg_, dcfg_.num_layers, 1, S,
                                      dtype=kv_dtype)
@@ -776,14 +900,18 @@ class ContinuousBatchingEngine:
         # steps (or speculative rounds) that actually ran inside it —
         # early exit makes steps < decode_block visible here
         self.loop_stats = {"host_dispatches": 0, "device_loop_steps": 0}
-        self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
-        # resumable chunked admission: at most ONE prompt streams its
-        # chunks at a time (scheduler state, advanced one dispatch per
-        # loop iteration).  _pending holds popped-but-unserved requests:
-        # chunk-needing prompts waiting their streaming turn, and short
-        # prompts waiting for a free slot — served FIFO each iteration,
-        # with serviceable requests passing blocked ones
+        self._reset_chunk_stats()
+        # resumable chunked admission.  Serialized mode (_adm): at most
+        # ONE prompt streams its chunks at a time (scheduler state,
+        # advanced one dispatch per loop iteration).  Mixed mode
+        # (_adms): several admissions stream concurrently, their chunks
+        # packed into each iteration's token-budget dispatch.  _pending
+        # holds popped-but-unserved requests: chunk-needing prompts
+        # waiting their streaming turn, and short prompts waiting for a
+        # free slot — served FIFO each iteration, with serviceable
+        # requests passing blocked ones
         self._adm: Optional[dict] = None
+        self._adms: List[dict] = []
         self._pending: "deque[Request]" = deque()
         # completed-request latency reservoirs (seconds), bounded FIFO —
         # the /stats percentile source (reference analog: the per-stage
@@ -792,7 +920,9 @@ class ContinuousBatchingEngine:
                      "per_token": deque(maxlen=512)}
         self._completed = 0
 
-        if self.decode_block > 1:
+        # (mixed mode never dispatches the serialized step programs —
+        # its two mixed_step variants compile on first use instead)
+        if self.decode_block > 1 and self.mixed_token_budget == 0:
             # compile BOTH round-count variants now: the non-fused
             # variant's first use otherwise lands as a multi-second
             # XLA compile in the middle of serving (all-inactive mask:
@@ -856,7 +986,8 @@ class ContinuousBatchingEngine:
         self.anomaly = AnomalyMonitor(config={
             "engine": type(self).__name__, "max_batch": max_batch,
             "max_seq": self.max_seq, "decode_block": decode_block,
-            "prefill_chunk": prefill_chunk})
+            "prefill_chunk": prefill_chunk,
+            "mixed_token_budget": self.mixed_token_budget})
         self._running = True
         # serializes submit() against close(): no request can be enqueued
         # after close() returns, so none can slip past the shutdown drain
@@ -1117,8 +1248,9 @@ class ContinuousBatchingEngine:
                 "checkpointed)")
         slot = next((i for i, r in enumerate(self._slots) if r is req),
                     None)
-        if (slot is None and self._adm is not None
-                and self._adm["req"] is req):
+        mid_adm = ((self._adm is not None and self._adm["req"] is req)
+                   or any(a["req"] is req for a in self._adms))
+        if slot is None and mid_adm:
             raise ValueError(
                 f"request {req.rid!r} is mid-chunked-admission; retry "
                 "after its final prefill lands")
@@ -1372,6 +1504,29 @@ class ContinuousBatchingEngine:
                 if not r.done.is_set():
                     r.cancel()
 
+    def _pending_prefill_tokens(self) -> int:
+        """Queued + mid-admission prompt tokens still awaiting prefill —
+        the gateway's bounded-load router weighs this BACKLOG, not just
+        request counts (one 10k-token prompt loads a replica far more
+        than ten 30-token chats, docs/DESIGN.md §19).  Racy snapshot
+        reads of scheduler-owned state: a gauge, not an invariant."""
+        import copy as _copy
+        total = 0
+        # queue.Queue's underlying deque: __copy__ is atomic under the
+        # GIL (same idiom as the latency reservoirs below); sentinels
+        # (_WAKE, shutdown None) are filtered by the isinstance check
+        for r in _copy.copy(self._queue.queue):
+            if isinstance(r, Request):
+                total += len(r.prompt)
+        for r in _copy.copy(self._pending):
+            total += len(r.prompt)
+        adm = self._adm
+        if adm is not None:
+            total += max(0, len(adm["req"].prompt) - adm["start"])
+        for a in list(self._adms):
+            total += max(0, len(a["req"].prompt) - a["start"])
+        return total
+
     def stats(self) -> dict:
         """Scheduler counters for the HTTP ``/stats`` surface."""
         import copy as _copy
@@ -1383,6 +1538,7 @@ class ContinuousBatchingEngine:
                # unslotted requests vs slots mid-decode (racy reads of
                # scheduler-owned state — gauges, not invariants)
                "queue_depth": self._queue.qsize() + len(self._pending),
+               "pending_prefill_tokens": self._pending_prefill_tokens(),
                "active_slots": sum(1 for s in self._slots
                                    if s is not None)}
         if self.kv_cache is not None:
@@ -1403,8 +1559,23 @@ class ContinuousBatchingEngine:
                 lat[f"{name}_p95_ms"] = round(_percentile(xs, 95) * 1e3, 3)
         out["latency"] = lat
         if self.prefill_chunk is not None:
-            out["chunked_prefill"] = {"chunk": self.prefill_chunk,
-                                      **self.chunk_stats}
+            cs = self.chunk_stats
+            out["chunked_prefill"] = {
+                "chunk": self.prefill_chunk,
+                "chunks": cs["chunks"],
+                "interleaved_steps": cs["interleaved_steps"]}
+        if self.mixed_token_budget > 0:
+            cs = self.chunk_stats
+            out["mixed"] = {
+                "token_budget": self.mixed_token_budget,
+                "dispatches": cs["mixed_dispatches"],
+                "prefill_tokens": cs["mixed_prefill_tokens"],
+                # fraction of offered budget actually carried (prefill
+                # segment tokens + fused decode tokens per dispatch)
+                "budget_utilization": (
+                    round(cs["mixed_packed_tokens"]
+                          / cs["mixed_budget_tokens"], 4)
+                    if cs["mixed_budget_tokens"] else None)}
         if self.disagg_stats["premigrated_requests"]:
             out["disagg"] = dict(self.disagg_stats)
         if any(self.migration_stats.values()):
@@ -1445,10 +1616,22 @@ class ContinuousBatchingEngine:
         if self.kv_cache is not None:
             self.kv_cache.reset_stats()
         self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
-        self.chunk_stats = {"chunks": 0, "interleaved_steps": 0}
+        self._reset_chunk_stats()
         self._completed = 0
         for res in self._lat.values():
             res.clear()
+
+    def _reset_chunk_stats(self) -> None:
+        """ONE owner of the chunk/mixed counter shape — __init__ and
+        reset_stats both call it, so the two sites cannot drift.
+        ``mixed_packed_tokens`` counts prefill + decode tokens a mixed
+        dispatch actually carried; ``mixed_budget_tokens`` the budget it
+        was offered — their ratio is the budget-utilization gauge."""
+        self.chunk_stats = {"chunks": 0, "interleaved_steps": 0,
+                            "mixed_dispatches": 0,
+                            "mixed_prefill_tokens": 0,
+                            "mixed_packed_tokens": 0,
+                            "mixed_budget_tokens": 0}
 
     def close(self):
         self._running = False
@@ -1470,9 +1653,9 @@ class ContinuousBatchingEngine:
                 return b
         return self.max_seq
 
-    def _row_for(self, req: Request):
+    def _reserve_pages(self, req: Request) -> int:
         """Paged admission, phase 1: reserve the request's pages and
-        build its prefill row — all on device.
+        build its block table; returns the matched-prefix length m.
 
         - ``match`` returns page IDS for the matched prefix (pinned by a
           lease held until the request completes: the slot's table will
@@ -1485,10 +1668,12 @@ class ContinuousBatchingEngine:
           the target's; if even eviction cannot free enough,
           :class:`_BlocksExhausted` sends the request back to pending
           (a completion will free pages);
-        - the prefill row is gathered straight OUT of the page pool
-          (``seed_row_from_pages``): a prefix hit moves zero bytes
-          through the host — ``dwt_kvcache_h2d_bytes`` stays 0 on this
-          path by construction."""
+        - prefill then runs THROUGH the table (paged_prefill /
+          _paged_chunk_mid / the mixed slab): a prefix hit reads the
+          shared pages in place and writes start at the private
+          frontier — zero bytes through the host,
+          ``dwt_kvcache_h2d_bytes`` stays 0 on this path by
+          construction."""
         mgr = self.kv_cache
         bt = mgr.block_tokens
         plen = len(req.prompt)
@@ -1542,9 +1727,7 @@ class ContinuousBatchingEngine:
                     "private": private, "adopted": (), "n_pref": n_pref,
                     "table": table, "dprivate": dprivate,
                     "dtable": dtable, "released": False}
-        row_k, row_v = self._seed_row(self._pk, self._pv,
-                                      jnp.asarray(table))
-        return m, row_k, row_v
+        return m
 
     def _release_request_kv(self, req: Request) -> None:
         """Return a paged request's KV resources: release its pins
@@ -1571,7 +1754,7 @@ class ContinuousBatchingEngine:
         it admit in a single dispatch?  Classified by the EFFECTIVE
         suffix (a KV-cache hit may shrink a long prompt to one
         dispatch — it must not wait behind an unrelated stream).  Pure
-        peek: hit/miss accounting stays with ``_row_for``.
+        peek: hit/miss accounting stays with ``_reserve_pages``.
 
         The decision is memoized on the request (``_stream_cls``),
         validated against the manager's mutation epoch: a blocked
@@ -1613,9 +1796,8 @@ class ContinuousBatchingEngine:
         if getattr(req, "_resume", None) is not None:
             self._admit_resume(slot, req)
             return
-        start, row_k, row_v = self._row_for(req)
-        self._finish_admission(slot, req, start, row_k, row_v,
-                               req.prompt[start:])
+        start = self._reserve_pages(req)
+        self._finish_admission(slot, req, start, req.prompt[start:])
 
     def _admit_resume(self, slot: int, req: Request) -> None:
         """Adopt a live-migration checkpoint into a free slot (docs/
@@ -1631,7 +1813,7 @@ class ContinuousBatchingEngine:
         bt = mgr.block_tokens
         plen = len(req.prompt)
         n_total = -(-(plen + req.max_new + self._slack_tokens) // bt)
-        # same pool-pressure retry gate as _row_for
+        # same pool-pressure retry gate as _reserve_pages
         state = (mgr.epoch, mgr.free_blocks)
         if getattr(req, "_pkv_blocked", None) == state:
             raise _BlocksExhausted()
@@ -1681,14 +1863,14 @@ class ContinuousBatchingEngine:
         request's pages yet — the caller requeues it (everything else,
         including failure, is handled here)."""
         try:
-            start, row_k, row_v = self._row_for(req)
+            start = self._reserve_pages(req)
         except _BlocksExhausted:
             return False
         except BaseException as e:
             self._fail_request(req, e)
             return True
-        self._adm = {"req": req, "start": start, "row_k": row_k,
-                     "row_v": row_v, "suffix": req.prompt[start:]}
+        self._adm = {"req": req, "start": start, "m": start,
+                     "suffix": req.prompt[start:]}
         return True
 
     def _advance_admission(self, free: list) -> None:
@@ -1712,10 +1894,9 @@ class ContinuousBatchingEngine:
             try:
                 head = jnp.asarray(
                     np.asarray(a["suffix"][:C], np.int32)[None])
-                row = self._chunk_mid(
-                    self.params, head,
-                    KVCache(a["row_k"], a["row_v"],
-                            jnp.zeros((), jnp.int32)),
+                self._pk, self._pv = self._paged_chunk_mid(
+                    self.params, self._pk, self._pv, head,
+                    jnp.asarray(req._pkv["table"][None]),
                     jnp.int32(a["start"]))
             except BaseException as e:
                 # a per-request failure fails that request, never the
@@ -1724,7 +1905,6 @@ class ContinuousBatchingEngine:
                 self._adm = None
                 self._fail_request(req, e)
                 return
-            a["row_k"], a["row_v"] = row.keys, row.values
             a["start"] += C
             a["suffix"] = a["suffix"][C:]
             self.chunk_stats["chunks"] += 1
@@ -1732,31 +1912,30 @@ class ContinuousBatchingEngine:
             self._adm = None
             try:
                 self._finish_admission(free.pop(0), req, a["start"],
-                                       a["row_k"], a["row_v"], a["suffix"])
+                                       a["suffix"], prefix_reused=a["m"])
             except BaseException as e:
                 self._fail_request(req, e)
 
     def _finish_admission(self, slot: int, req: Request, start: int,
-                          row_k, row_v, suffix) -> None:
+                          suffix, prefix_reused: Optional[int] = None
+                          ) -> None:
         """The sampling final prefill + slot install, shared by one-shot
-        admission and the last dispatch of a chunked one."""
+        admission and the last dispatch of a chunked one.  The prefill
+        runs THROUGH the request's block table straight into its
+        reserved pages (no temp row, no scatter round trip); writes
+        begin at ``start``, at/past the matched-prefix frontier, so the
+        tree-owned shared pages are read-only by construction
+        (prepare_kv_chunk's write contract)."""
         plen = len(req.prompt)
+        st = req._pkv
         bucket = self._bucket(len(suffix))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(suffix)] = suffix
         self._rng, sub = jax.random.split(self._rng)
-        row_k, row_v, tok, lp0 = self._prefill(
-            self.params, jnp.asarray(padded), jnp.int32(start),
-            row_k, row_v, jnp.int32(len(suffix)), sub)
-        st = req._pkv
-        # scatter the prefilled row into the request's OWN pages
-        # (device-to-device, zero D2H): the matched-prefix entries
-        # are sentineled out — those pages are tree-owned and
-        # immutable (prepare_kv_chunk's write contract)
-        wtable = st["table"].copy()
-        wtable[:st["n_pref"]] = self._page_sentinel
-        self._pk, self._pv = self._write_row(
-            self._pk, self._pv, row_k, row_v, jnp.asarray(wtable))
+        self._pk, self._pv, tok, lp0 = self._paged_prefill(
+            self.params, self._pk, self._pv, jnp.asarray(padded),
+            jnp.asarray(st["table"][None]), jnp.int32(start),
+            jnp.int32(len(suffix)), sub)
         # store at PREFILL time, by ADOPTION: the tree takes
         # ownership of the full-prompt pages it was missing — the
         # next shared-prefix request block-table-references the
@@ -1795,7 +1974,8 @@ class ContinuousBatchingEngine:
         self._slots[slot] = req
         self._flight.record("batch_admit", slot=slot, prompt_len=plen,
                             max_new=req.max_new,
-                            prefix_reused=start)
+                            prefix_reused=(start if prefix_reused is None
+                                           else prefix_reused))
         # lps stay empty (not a stale 1-entry list) in the speculative
         # modes, whose drains never score emitted tokens
         plain = self._spec_step is None and self._pld_step is None
@@ -1893,6 +2073,9 @@ class ContinuousBatchingEngine:
         if self._adm is not None:
             self._fail_request(self._adm["req"], err)
             self._adm = None
+        for a in self._adms:
+            self._fail_request(a["req"], err)
+        self._adms = []
         while self._pending:
             self._fail_request(self._pending.popleft(), err)
         while self._export_q:
@@ -2005,6 +2188,244 @@ class ContinuousBatchingEngine:
                     self._record_token(i, req, int(tok_np[i]),
                                        float(lp_np[i]))
 
+    def _mixed_iteration(self) -> None:
+        """One MIXED-mode scheduler iteration (docs/DESIGN.md §19):
+        intake, start concurrent admissions, then ONE token-budget
+        dispatch carrying every active row's fused decode block plus
+        packed prefill segments.  The serialized loop's per-iteration
+        bookkeeping (cancel sweep, export service) rides along at the
+        same points."""
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        # block for work only when truly idle: nothing decoding, no
+        # admission mid-stream, nothing waiting to be served
+        timeout = (None if not (any(self._slots) or self._adms
+                                or self._pending)
+                   else 0.0)
+        while True:
+            try:
+                req = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                break
+            timeout = 0.0
+            if req is _WAKE:               # export_request nudge
+                continue
+            if req is None:                # close() sentinel
+                break
+            self._pending.append(req)
+        # serve pending FIFO.  Live-migration resumes adopt straight
+        # into a free slot (their checkpoint IS the row state — no
+        # prefill to pack); everything else becomes a concurrent
+        # admission whose chunks the dispatch packs.  Admissions are
+        # capped at the free-slot count (reserving pages for prompts
+        # that cannot land yet just wedges the pool), floor 1 so a
+        # fully busy batch still streams one prompt's chunks (the
+        # serialized path's overlap property).  Serviceable requests
+        # pass blocked ones.
+        still: "deque[Request]" = deque()
+        for req in self._pending:
+            if req.cancelled:              # dropped while waiting
+                self._fail_request(req, None)
+            elif getattr(req, "_resume", None) is not None:
+                if free:
+                    slot = free.pop(0)
+                    try:
+                        self._admit_request(slot, req)
+                    except _BlocksExhausted:
+                        free.insert(0, slot)
+                        still.append(req)
+                    except BaseException as e:
+                        self._fail_request(req, e)
+                else:
+                    still.append(req)      # waiting for a slot
+            elif len(self._adms) < max(1, len(free)):
+                try:
+                    start = self._reserve_pages(req)
+                except _BlocksExhausted:
+                    still.append(req)      # retry when pages free up
+                    continue
+                except BaseException as e:  # surface to the waiter
+                    self._fail_request(req, e)
+                    continue
+                self._adms.append({"req": req, "start": start,
+                                   "m": start,
+                                   "suffix": req.prompt[start:]})
+            else:
+                still.append(req)
+        self._pending = still
+        # drop cancelled admissions between dispatches (cancel latency
+        # bounded by one dispatch, the serialized path's property)
+        for a in list(self._adms):
+            if a["req"].cancelled:
+                self._adms.remove(a)
+                self._fail_request(a["req"], None)
+        self._sweep_cancelled()
+        self._service_exports()
+        if not any(self._slots) and not self._adms:
+            return
+        self._dispatch_mixed(
+            [i for i, s in enumerate(self._slots) if s is None])
+
+    def _dispatch_mixed(self, free: list) -> None:
+        """Build and run ONE mixed token-budget dispatch, then drain it.
+
+        Packing policy (docs/DESIGN.md §19): every active decode row
+        contributes its ``decode_block`` fused-loop tokens off the top
+        of the budget; the remainder packs C-token prefill segments
+        FIFO over the concurrent admissions — each contributes its
+        sequential chunks, and its bucket-free FINAL segment (sampling
+        token #1, installing the slot in-program) once a free slot
+        pops.  At least one segment is always packed when an admission
+        is in flight, so a saturated decode batch cannot starve
+        prefill.  rng split order: one batch-1 split per packed final
+        in pack order, then ONE decode split iff any row decodes —
+        exactly the serialized path's spend, which keeps cold-start
+        sampled streams bit-identical."""
+        B = self.max_batch
+        C = self.prefill_chunk
+        W = self._table_width
+        n_seg = self._mixed_seg_cap
+        n_active = sum(1 for s in self._slots if s is not None)
+        room = max(0, self.mixed_token_budget
+                   - n_active * self.decode_block)
+        want = min(n_seg, max(1, room // C)) if self._adms else 0
+        seg_ids = np.zeros((n_seg, C), np.int32)
+        seg_tables = np.full((n_seg, W), self._page_sentinel, np.int32)
+        seg_starts = np.zeros((n_seg,), np.int32)
+        seg_lens = np.ones((n_seg,), np.int32)
+        seg_slot = np.full((n_seg,), B, np.int32)
+        seg_plen = np.zeros((n_seg,), np.int32)
+        seg_keys = np.zeros((n_seg, 2), np.uint32)
+        packed = []          # (row, admission, is_final, slot)
+        prefill_tokens = 0
+        r = 0
+        for a in self._adms:
+            if r >= want:
+                break
+            req = a["req"]
+            while r < want and len(a["suffix"]) > C:
+                seg_ids[r, :] = np.asarray(a["suffix"][:C], np.int32)
+                seg_tables[r] = req._pkv["table"]
+                seg_starts[r] = a["start"]
+                packed.append((r, a, False, -1))
+                prefill_tokens += C
+                a["start"] += C
+                a["suffix"] = a["suffix"][C:]
+                self.chunk_stats["chunks"] += 1
+                r += 1
+            if r >= want or len(a["suffix"]) > C:
+                break
+            if not free:
+                continue     # final parked until a slot frees; later
+                             # admissions may still pack their chunks
+            slot = free.pop(0)
+            n = len(a["suffix"])
+            seg_ids[r, :n] = np.asarray(a["suffix"], np.int32)
+            seg_tables[r] = req._pkv["table"]
+            seg_starts[r] = a["start"]
+            seg_lens[r] = n
+            seg_slot[r] = slot
+            seg_plen[r] = len(req.prompt)
+            # the final's batch-1 sampling key: the serialized
+            # prefill's exact split, spent in pack order
+            self._rng, sub = jax.random.split(self._rng)
+            seg_keys[r] = np.asarray(sub)
+            # decode inside this dispatch pages through the installed
+            # row — its table must be live BEFORE the dispatch; the
+            # radix adoption (below) waits until the pages hold data
+            self._tables[slot] = req._pkv["table"]
+            packed.append((r, a, True, slot))
+            prefill_tokens += n
+            r += 1
+        with_finals = any(f for (_, _, f, _) in packed)
+        active_mask = np.array([s is not None for s in self._slots])
+        # budget: remaining tokens per pre-existing row; a freshly
+        # installed final's row has max_new - 1 left (token #1 came
+        # from its prefill logits)
+        budget_vec = np.array(
+            [(s.max_new - len(s.tokens)) if s is not None else 0
+             for s in self._slots], np.int32)
+        for (_, a, is_final, slot) in packed:
+            if is_final:
+                budget_vec[slot] = a["req"].max_new - 1
+        if n_active > 0 or with_finals:
+            # ONE decode split per dispatch that decodes — the
+            # serialized loop's spend (it skips the split when no slot
+            # is active)
+            self._rng, dec_sub = jax.random.split(self._rng)
+        else:
+            dec_sub = jax.random.PRNGKey(0)   # prefill-only: loop is
+        try:                                  # a 0-step no-op
+            (self._pk, self._pv, self._lengths, tok, final_toks,
+             final_lps, toks, lps, steps) = self._mixed_step(
+                self.params, self._pk, self._pv, jnp.asarray(seg_ids),
+                jnp.asarray(seg_tables), jnp.asarray(seg_starts),
+                jnp.asarray(seg_lens), jnp.asarray(seg_slot),
+                jnp.asarray(seg_plen), jnp.asarray(seg_keys),
+                jnp.asarray(self._tables), self._lengths,
+                self._last_tok, jnp.asarray(active_mask), dec_sub,
+                self._eos_scalar(), jnp.asarray(budget_vec),
+                self.decode_block, with_finals)
+        except BaseException as e:
+            # a per-request failure fails the packed requests, never
+            # the engine — same contract as the serialized admission
+            # dispatches.  A pure-decode failure (nothing packed) IS an
+            # engine failure: re-raise into the crash drain.
+            if not packed:
+                raise
+            failed = []
+            for (_, a, is_final, slot) in packed:
+                if a["req"] not in failed:
+                    failed.append(a["req"])
+                if is_final:
+                    self._tables[slot] = self._page_sentinel
+            self._adms = [a for a in self._adms
+                          if a["req"] not in failed]
+            for req in failed:
+                self._fail_request(req, e)
+            return
+        self._last_tok = tok
+        cs = self.chunk_stats
+        cs["mixed_dispatches"] += 1
+        cs["mixed_prefill_tokens"] += prefill_tokens
+        cs["mixed_budget_tokens"] += self.mixed_token_budget
+        # finals first: install host state + radix adoption, record
+        # token #1.  The adoption waits until after the dispatch — the
+        # tree must never serve pages whose K/V is still in flight.
+        if with_finals:
+            final_toks_np = np.asarray(final_toks)
+            final_lps_np = np.asarray(final_lps)
+            for (r0, a, is_final, slot) in packed:
+                if not is_final:
+                    continue
+                req = a["req"]
+                self._adms.remove(a)
+                st = req._pkv
+                plen = len(req.prompt)
+                bt = self.kv_cache.block_tokens
+                if plen // bt >= 1:
+                    adopted, store_lease = self.kv_cache.store_shared(
+                        req.prompt, st["table"][:plen // bt])
+                    st["adopted"] = adopted
+                    st["store_lease"] = store_lease
+                self._slots[slot] = req
+                self._flight.record("batch_admit", slot=slot,
+                                    prompt_len=plen,
+                                    max_new=req.max_new,
+                                    prefix_reused=a["m"])
+                self._record_token(slot, req, int(final_toks_np[r0]),
+                                   float(final_lps_np[r0]))
+        steps = int(steps)           # the on-device active count
+        cs["mixed_packed_tokens"] += (prefill_tokens
+                                      + n_active * steps)
+        if steps > 0:
+            self._count_loop(steps)
+            self._step_count += steps
+            self._record_row_blocks(
+                np.asarray(toks), np.full(len(self._slots), steps),
+                np.asarray(lps))
+        if steps > 0 and self._adms:
+            cs["interleaved_steps"] += 1
+
     def _loop(self):
         try:
             self._loop_body()
@@ -2030,6 +2451,18 @@ class ContinuousBatchingEngine:
                 self._drain_all(e)
 
     def _loop_body(self):
+        if self.mixed_token_budget > 0:
+            # MIXED mode: one token-budget dispatch per iteration —
+            # decode fusion survives admission (no fuse suppression,
+            # no one-admission-at-a-time rule).  The serialized loop
+            # below is untouched: it is the bit-identity reference and
+            # the bench baseline.
+            while self._running:
+                self.anomaly.observe(self.stats)
+                self._mixed_iteration()
+            self._drain_all(
+                RuntimeError("engine closed while request in flight"))
+            return
         while self._running:
             # anomaly watch rides the loop (throttled internally; the
             # stats() snapshot is only built when an observation is due)

@@ -152,6 +152,49 @@ def make_chunk_programs(fwd):
     return chunk_mid, chunk_last
 
 
+def make_paged_chunk_programs(fwd_p, bind_tables):
+    """``(chunk_mid, slab_body)`` prefill programs over a PAGED forward
+    seam (``make_paged_forward_seam``): chunks write K/V straight to the
+    page pool through the block tables — no dense temp row, no
+    gather/scatter round trip, ``dwt_kvcache_h2d_bytes_total`` stays 0.
+
+    ``chunk_mid`` is the jitted non-final-chunk program (pool donated,
+    logits dropped) used by serialized chunked admission; ``slab_body``
+    is the UNJITTED traced body for a [n_seg, C] slab of segments at
+    per-row start offsets — the mixed token-budget dispatch composes it
+    with the fused decode loop inside ONE jit (batching._mixed_step),
+    so it must stay a plain function.  Both rely on the paged attention
+    path's prefill contract: in-chunk keys are written before the
+    gather/kernel inside each layer, and causal masking keeps a
+    segment's queries on its own prior pages plus in-chunk keys
+    (ops/paged_attention.paged_prefill_attention)."""
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def chunk_mid(params, pk, pv, ids, tables, start):
+        """One non-final prompt chunk at global offset ``start``,
+        written through ``tables`` [b, W]: extend the pool, drop
+        logits."""
+        bind_tables(tables)
+        b, s = ids.shape
+        pos = start + jnp.broadcast_to(jnp.arange(s), (b, s))
+        cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
+        _, cache = fwd_p(params, ids, cache, pos, True)
+        return cache.keys, cache.values
+
+    def slab_body(params, cache, ids, tables, starts):
+        """Traced slab forward: row r of ``ids`` [n, s] runs at
+        positions ``starts[r] + arange(s)`` through ``tables[r]``;
+        returns all-position logits (callers slice their own final
+        positions) and the extended cache."""
+        bind_tables(tables)
+        b, s = ids.shape
+        pos = starts[:, None] + jnp.arange(s)[None, :]
+        logits, cache = fwd_p(params, ids, cache, pos, False)
+        return logits, cache
+
+    return chunk_mid, slab_body
+
+
 def run_chunked_prefill(params, ids, cache, C: int, max_seq: int,
                         chunk_mid, chunk_last=None, start: int = 0):
     """The chunked-prefill driver, shared by InferenceEngine and

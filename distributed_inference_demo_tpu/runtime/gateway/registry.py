@@ -87,6 +87,10 @@ class Replica:
     def queue_depth(self) -> int:
         return int(self.last_stats.get("queue_depth", 0))
 
+    @property
+    def pending_prefill_tokens(self) -> int:
+        return int(self.last_stats.get("pending_prefill_tokens", 0))
+
 
 class ReplicaRegistry:
     """Debounced replica membership (see module docstring)."""
@@ -185,6 +189,11 @@ class ReplicaRegistry:
     def queue_depth(self, rid: str) -> int:
         with self._lock:
             return self._replicas[rid].queue_depth
+
+    def pending_prefill_tokens(self, rid: str) -> int:
+        with self._lock:
+            r = self._replicas.get(rid)
+            return r.pending_prefill_tokens if r is not None else 0
 
     # -- the debounce ------------------------------------------------------
 

@@ -66,17 +66,24 @@ class PrefixAwareRouter:
 
     def __init__(self, registry, *, min_prefix_tokens: int = 16,
                  block_tokens: int = 16, max_index_entries: int = 4096,
-                 max_key_tokens: int = 512, load_factor: float = 2.0):
+                 max_key_tokens: int = 512, load_factor: float = 2.0,
+                 prefill_token_weight: int = 256):
         if min_prefix_tokens < 1:
             raise ValueError("min_prefix_tokens must be >= 1")
         if block_tokens < 1:
             raise ValueError("block_tokens must be >= 1")
+        if prefill_token_weight < 0:
+            raise ValueError("prefill_token_weight must be >= 0")
         self.registry = registry
         self.min_prefix_tokens = min_prefix_tokens
         self.block_tokens = block_tokens
         self.max_index_entries = max_index_entries
         self.max_key_tokens = max_key_tokens
         self.load_factor = load_factor
+        # prefill-backlog weighting: N pending prompt tokens count as
+        # one queued request in the bounded-load check (0 = ignore the
+        # backlog, depth-only load as before ISSUE-15)
+        self.prefill_token_weight = prefill_token_weight
         self._lock = threading.Lock()
         # rid -> OrderedDict[prefix-key-bytes, n_tokens] (LRU: move on
         # touch, evict oldest past the cap)
@@ -182,9 +189,17 @@ class PrefixAwareRouter:
     def _load(self, rid: str) -> float:
         """In-flight proxies plus the replica's last reported queue
         depth — the gateway's own concurrency signal reacts instantly,
-        the probed depth covers traffic from other gateways."""
-        return (self._inflight.get(rid, 0)
+        the probed depth covers traffic from other gateways — plus the
+        reported prefill backlog scaled to request units: two replicas
+        at equal depth are NOT equally loaded when one still has tens
+        of thousands of prompt tokens to chew through before its queue
+        moves (docs/DESIGN.md §19)."""
+        load = (self._inflight.get(rid, 0)
                 + self.registry.queue_depth(rid))
+        if self.prefill_token_weight:
+            load += (self.registry.pending_prefill_tokens(rid)
+                     / float(self.prefill_token_weight))
+        return load
 
     # -- the decision ------------------------------------------------------
 
@@ -257,6 +272,7 @@ class PrefixAwareRouter:
                 "min_prefix_tokens": self.min_prefix_tokens,
                 "block_tokens": self.block_tokens,
                 "load_factor": self.load_factor,
+                "prefill_token_weight": self.prefill_token_weight,
                 "replicas": {
                     rid: {
                         "up": self.registry.is_up(rid),
@@ -265,6 +281,8 @@ class PrefixAwareRouter:
                         "routed": self._routed.get(rid, 0),
                         "prefix_routed": self._prefix_hits.get(rid, 0),
                         "inflight": self._inflight.get(rid, 0),
+                        "pending_prefill_tokens":
+                            self.registry.pending_prefill_tokens(rid),
                         "replica_tree_nodes":
                             self._replica_nodes.get(rid),
                     } for rid in sorted(rids)},

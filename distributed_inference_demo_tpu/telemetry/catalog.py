@@ -119,6 +119,20 @@ _BATCH_PCT = {
                        ("e2e", "request end-to-end latency"),
                        ("per_token", "per-output-token latency"))
     for q in (50, 95)}
+BATCH_MIXED_DISPATCHES = counter(
+    "dwt_batching_mixed_dispatches_total",
+    "Mixed prefill+decode dispatches executed under the token budget "
+    "(docs/DESIGN.md §19; each packs the fused decode block plus zero "
+    "or more prefill chunk segments into one program)")
+BATCH_MIXED_PREFILL_TOKENS = counter(
+    "dwt_batching_mixed_prefill_tokens_total",
+    "Prompt tokens prefilled inside mixed dispatches (piggybacked on "
+    "the decode step instead of a serialized admission dispatch)")
+BATCH_TOKEN_BUDGET_UTILIZATION = gauge(
+    "dwt_batching_token_budget_utilization",
+    "Packed tokens (prefill segments + decode-loop steps x active "
+    "rows) over budgeted tokens across mixed dispatches; NaN until "
+    "the first mixed dispatch")
 
 # -- block KV cache (runtime/kvcache), bridged from manager snapshots ------
 
@@ -246,6 +260,14 @@ def update_batching_series(stats: dict) -> None:
         v = lat.get(f"{name}_p{q}_ms")
         # NaN on empty/reset reservoirs, as in update_stage_series
         g.set(v / 1e3 if v is not None else float("nan"))
+    mx = stats.get("mixed") or {}
+    if mx:
+        BATCH_MIXED_DISPATCHES.set_cumulative(mx.get("dispatches", 0))
+        BATCH_MIXED_PREFILL_TOKENS.set_cumulative(
+            mx.get("prefill_tokens", 0))
+        u = mx.get("budget_utilization")
+        BATCH_TOKEN_BUDGET_UTILIZATION.set(
+            u if u is not None else float("nan"))
     kv = stats.get("kvcache") or {}
     if kv:
         update_kvcache_series(kv)

@@ -483,7 +483,7 @@ def test_stream_surfaces_scheduler_error(params):
                                   prompt_buckets=(16,)) as eng:
         def boom(*a, **k):
             raise RuntimeError("injected device failure")
-        eng._prefill = boom            # admission path fails in the loop
+        eng._paged_prefill = boom            # admission path fails in the loop
         with pytest.raises(RuntimeError, match="injected device failure"):
             for _ in eng.generate_stream(np.asarray([1, 2, 3]), 4):
                 pass
@@ -911,7 +911,7 @@ def test_chunked_admission_cancel_bounded_by_one_chunk(params):
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=2,
                                   sampling=GREEDY, prompt_buckets=(16, 64),
                                   prefill_chunk=4) as eng:
-        orig = eng._chunk_mid
+        orig = eng._paged_chunk_mid
         box, armed = {}, threading.Event()
 
         def hook(*a, **k):
@@ -920,7 +920,7 @@ def test_chunked_admission_cancel_bounded_by_one_chunk(params):
             box["req"].cancelled = True      # cancel after chunk #1 lands
             return out
 
-        eng._chunk_mid = hook
+        eng._paged_chunk_mid = hook
         box["req"] = eng.submit(list(range(1, 20)), 10)   # 4 full chunks
         armed.set()
         got = box["req"].wait(timeout=300)
@@ -940,7 +940,7 @@ def test_chunked_admission_no_head_of_line_blocking(params, oracle):
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=4,
                                   sampling=GREEDY, prompt_buckets=(16, 64),
                                   prefill_chunk=4) as eng:
-        orig = eng._chunk_mid
+        orig = eng._paged_chunk_mid
         box = {}
 
         def hook(*a, **k):
@@ -948,7 +948,7 @@ def test_chunked_admission_no_head_of_line_blocking(params, oracle):
             seen.append((eng.chunk_stats["chunks"], done))
             return orig(*a, **k)
 
-        eng._chunk_mid = hook
+        eng._paged_chunk_mid = hook
         a = eng.submit(long_prompt, 6)
         box["short"] = eng.submit(short, 2)
         np.testing.assert_array_equal(a.wait(timeout=300),
@@ -971,13 +971,13 @@ def test_chunked_admission_streams_while_slots_busy(params, oracle):
     with ContinuousBatchingEngine(CFG, params, max_seq=96, max_batch=1,
                                   sampling=GREEDY, prompt_buckets=(16, 64),
                                   prefill_chunk=4) as eng:
-        orig = eng._chunk_mid
+        orig = eng._paged_chunk_mid
 
         def hook(*a, **k):
             busy_at_chunk.append(eng._slots[0] is not None)
             return orig(*a, **k)
 
-        eng._chunk_mid = hook
+        eng._paged_chunk_mid = hook
         a = eng.submit([5, 4, 3, 2], 40)           # holds the only slot
         b = eng.submit(long_prompt, 6)
         np.testing.assert_array_equal(a.wait(timeout=300),
@@ -997,7 +997,7 @@ def test_chunked_admission_failure_fails_only_that_request(params, oracle):
         def boom(*a, **k):
             raise RuntimeError("injected chunk failure")
 
-        eng._chunk_mid = boom
+        eng._paged_chunk_mid = boom
         a = eng.submit([5, 4, 3, 2], 4)            # short: never chunks
         b = eng.submit(list(range(1, 20)), 4)      # chunk-needing
         np.testing.assert_array_equal(a.wait(timeout=300),
@@ -1025,7 +1025,7 @@ def test_chunked_admission_prefix_hit_passes_streaming_prompt(params,
                                   kv_block_tokens=4) as eng:
         np.testing.assert_array_equal(eng.submit(base, 4).wait(timeout=300),
                                       expected(oracle, base, 4))
-        orig = eng._chunk_mid
+        orig = eng._paged_chunk_mid
         box = {}
 
         def hook(*a, **k):
@@ -1033,7 +1033,7 @@ def test_chunked_admission_prefix_hit_passes_streaming_prompt(params,
             seen.append(done)
             return orig(*a, **k)
 
-        eng._chunk_mid = hook
+        eng._paged_chunk_mid = hook
         a = eng.submit(streamer, 4)
         box["hit"] = eng.submit(hit, 2)
         np.testing.assert_array_equal(a.wait(timeout=300),

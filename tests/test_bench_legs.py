@@ -336,6 +336,44 @@ def test_leg_decode_fused_structure_tiny():
     assert out["best_decode_tokens_per_sec"] > 0
 
 
+@pytest.mark.slow
+def test_leg_mixed_batching_gates_tiny():
+    """The §19 acceptance leg at the de-noised CPU shape: mixed
+    token-budget dispatch must strictly beat the alternating baseline
+    on aggregate tok/s at equal-or-better TTFT p95, with the 1/K
+    structural signature on dispatches/step.  The shape is the one
+    run_leg pins for --micro: chunk-heavy prompts through one free
+    slot while three background rows decode, all arrivals at once —
+    admission pressure covers the whole measured window, which is
+    where the baseline's fused-loop suppression costs and the mixed
+    packing pays."""
+    K = 4
+    out = bench._leg_mixed_batching("llama-test", prompt_len=96,
+                                    new_tokens=16, slots=4, n_req=8,
+                                    prefill_chunk=8, decode_block=K,
+                                    arrival_s=0.0, block_tokens=8)
+    assert "error" not in out
+    assert out["token_budget"] == 4 * K + 2 * 8
+    base, mixed = out["baseline"], out["mixed"]
+    for mode in (base, mixed):
+        assert mode["tokens_per_sec"] > 0
+        assert mode["ttft_p95_ms"] is not None
+        assert mode["leaked_blocks"] == 0
+    # every prompt token of the measured stream went through a packed
+    # prefill segment
+    assert mixed["prefill_tokens"] == 8 * 96
+    assert mixed["mixed_dispatches"] > 0
+    assert 0.0 < mixed["budget_utilization"] <= 1.5
+    # the structural signature: mixed keeps the fused decode cadence
+    # under admission (~1/K dispatches/step); the baseline's
+    # suppression drags it toward per-token dispatch
+    assert mixed["dispatches_per_step"] <= 1 / K + 0.12, mixed
+    assert base["dispatches_per_step"] > mixed["dispatches_per_step"] * 2
+    # the acceptance gates (3/3 stable on CPU at this shape)
+    assert out["mixed_wins_tokens_per_sec"] is True, (base, mixed)
+    assert out["mixed_ttft_p95_le_baseline"] is True, (base, mixed)
+
+
 def test_run_leg_micro_variants_stamp_and_shrink():
     """--micro runs the same leg structure at the smallest meaningful
     shape and stamps the result so a micro number can never masquerade

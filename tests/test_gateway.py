@@ -133,6 +133,57 @@ def test_bounded_load_skips_the_hot_hashed_pick():
 
 
 @pytest.mark.quick
+def test_bounded_load_weighs_prefill_backlog_decision_table():
+    """ISSUE-15 satellite: the bounded-load walk counts a replica's
+    reported prefill backlog (``pending_prefill_tokens`` scaled by
+    ``prefill_token_weight``) as queued work — a deep prompt backlog at
+    ZERO queue depth sheds hashed traffic exactly like a deep queue,
+    weight=0 restores the depth-only behavior, and a uniform backlog
+    raises the mean with the load so it causes no churn."""
+    toks = list(range(2, 34))
+
+    def scenario(weight, depths, backlogs):
+        reg = _registry()
+        router = PrefixAwareRouter(reg, min_prefix_tokens=64,
+                                   block_tokens=8, load_factor=1.0,
+                                   prefill_token_weight=weight)
+        d0 = router.route(toks)
+        order = [d0.rid] + d0.candidates     # rendezvous order for toks
+        for rid, dep, back in zip(order, depths, backlogs):
+            reg.record_success(rid, {"queue_depth": dep,
+                                     "pending_prefill_tokens": back})
+        return order, router.route(toks).rid, router
+
+    # nothing reported: the rendezvous-first replica serves
+    order, got, _ = scenario(256, (0, 0, 0), (0, 0, 0))
+    assert got == order[0]
+
+    # deep backlog at zero depth sheds the pick: 4096/256 = 16
+    # request-equivalents > bound 1.0 * (1 + 16/3)
+    order, got, router = scenario(256, (0, 0, 0), (4096, 0, 0))
+    assert got == order[1]
+    assert router._load(order[0]) == 16.0
+
+    # the same backlog with weight=0 is invisible (depth-only load)
+    order, got, _ = scenario(0, (0, 0, 0), (4096, 0, 0))
+    assert got == order[0]
+
+    # uniform backlog raises the mean with the load: no churn
+    order, got, _ = scenario(256, (0, 0, 0), (4096, 4096, 4096))
+    assert got == order[0]
+
+    # depth and backlog ADD: 2 + 1024/256 = 6 > bound 1.0 * (1 + 8/3);
+    # the walk settles on the next replica (load 1)
+    order, got, router = scenario(256, (2, 1, 1), (1024, 0, 0))
+    assert got == order[1]
+
+    # both knobs and the per-replica backlog surface on /debugz
+    tab = router.routing_table()
+    assert tab["prefill_token_weight"] == 256
+    assert tab["replicas"][order[0]]["pending_prefill_tokens"] == 1024
+
+
+@pytest.mark.quick
 def test_prefix_tie_breaks_toward_the_lighter_replica():
     reg = _registry()
     router = PrefixAwareRouter(reg, min_prefix_tokens=8, block_tokens=8)

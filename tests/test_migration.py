@@ -706,28 +706,44 @@ def test_bounce_migration_src_to_dst_and_back(pair, reference):
     relay (A's adopt streams to B, B forwards to the original Request)
     still delivers one unbroken bit-identical stream."""
     ref = reference(PROMPT, MAX_NEW)
-    # the CPU decode is fast enough that the row can FINISH before the
-    # second hop freezes it — a legal local resolution, not a bounce.
-    # Retry with a fresh rid until a bounce lands (each attempt still
-    # pins bit-identical output, bounced or not).
-    for i in range(4):
-        rid = f"mb{i}"
-        req = pair.src_e.submit(PROMPT, MAX_NEW, request_id=rid)
-        _wait_tokens(req, 2)
-        if not pair.src_w.migrate_out(rid, "dst"):
-            continue                     # finished before hop 1's freeze
-        assert pair.src_w._attempts[rid] == 1
-        try:
-            bounced = pair.dst_w.migrate_out(rid, "src")
-        except KeyError:
-            bounced = False              # finished on dst pre-freeze
-        got = [int(t) for t in req.wait(60)]
-        assert got == ref
-        assert req.error is None
-        if bounced:
-            break
-    else:
-        pytest.fail("bounce never landed in 4 attempts")
+    # the CPU decode can FINISH the row before a hop's freeze lands — a
+    # legal local resolution, not a bounce.  THROTTLE both engines'
+    # decode dispatch (a sleep around the same program: bit-identity
+    # untouched) so each freeze has a wide window, and retry with a
+    # fresh rid as the backstop (each attempt still pins bit-identical
+    # output, bounced or not).
+    throttled = []
+    for e in (pair.src_e, pair.dst_e):
+        orig = e._paged_multi_step
+
+        def slow(*a, _orig=orig, **k):
+            time.sleep(0.003)
+            return _orig(*a, **k)
+
+        throttled.append((e, orig))
+        e._paged_multi_step = slow
+    try:
+        for i in range(8):
+            rid = f"mb{i}"
+            req = pair.src_e.submit(PROMPT, MAX_NEW, request_id=rid)
+            _wait_tokens(req, 2)
+            if not pair.src_w.migrate_out(rid, "dst"):
+                continue                 # finished before hop 1's freeze
+            assert pair.src_w._attempts[rid] == 1
+            try:
+                bounced = pair.dst_w.migrate_out(rid, "src")
+            except KeyError:
+                bounced = False          # finished on dst pre-freeze
+            got = [int(t) for t in req.wait(60)]
+            assert got == ref
+            assert req.error is None
+            if bounced:
+                break
+        else:
+            pytest.fail("bounce never landed in 8 attempts")
+    finally:
+        for e, orig in throttled:
+            e._paged_multi_step = orig
     # the attempt counter chained through the adoption: hop 2 used 2,
     # and src — the original source — staged its own request fresh
     assert pair.dst_w._attempts[rid] == 2

@@ -51,7 +51,12 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
 # the transition direction where the unit would sit.
 UNIT_SUFFIX_EXEMPT = {"dwt_kvcache_blocks_in_use",
                       "dwt_gateway_replica_down_total",
-                      "dwt_gateway_replica_up_total"}
+                      "dwt_gateway_replica_up_total",
+                      # ISSUE-15 pins this exact name: a dimensionless
+                      # packed/budgeted fraction (a _ratio in spirit;
+                      # "utilization" is the roofline-adjacent term the
+                      # §19 runbook and bench leg both use)
+                      "dwt_batching_token_budget_utilization"}
 
 # series the catalog must always register (regressions here would blind
 # the flight-recorder/anomaly layer silently — a scrape with the series
@@ -89,6 +94,13 @@ REQUIRED_SERIES = {
     "dwt_transport_reconnects_total",
     "dwt_transport_corrupt_frames_total",
     "dwt_fault_injected_faults_total",
+    # the mixed-dispatch triple (docs/DESIGN.md §19): utilization absent
+    # would make "the budget is actually being packed" unverifiable, and
+    # mixed_dispatches staying registered-and-zero is how a scrape PROVES
+    # an engine is running the serialized interleave, not mixed mode
+    "dwt_batching_mixed_dispatches_total",
+    "dwt_batching_mixed_prefill_tokens_total",
+    "dwt_batching_token_budget_utilization",
     # the device-loop pair (docs/DESIGN.md §13): dispatches/token ≈ 1/K
     # is the dispatch-floor claim — with either series absent, a fused
     # loop that silently fell back to per-token dispatch would scrape

@@ -58,6 +58,10 @@ LEG_BUDGETS = {
     "gateway_routing": 1500,
     "flagship_int8": 2400,
     "batching": 2400,
+    # two full engines (serialized baseline + mixed) with background
+    # saturation rows and a fixed-arrival measured stream — budget like
+    # batching
+    "mixed_batching": 2400,
     "prefix_reuse": 1800,
     "paged_decode": 1800,
     "serving_relative": 1800,
