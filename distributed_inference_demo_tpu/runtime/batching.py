@@ -77,6 +77,8 @@ from ..ops.sampling import SamplingParams, filtered_logits, sample_logits
 from ..telemetry import postmortem
 from ..telemetry.anomaly import AnomalyMonitor
 from ..telemetry.flightrecorder import get_flight_recorder
+from ..telemetry.slo import get_slo_ledger, sanitize_tenant
+from ..telemetry.tracing import TraceRecorder, to_chrome_trace
 from .engine import (GenerationResult, check_capacity,
                      make_paged_chunk_programs, validate_prefill_chunk)
 from .speculative import verify_emit_per_row
@@ -135,6 +137,23 @@ class Request:
     # engine-unique request id (auto-assigned by submit when the caller
     # passes none) — the address live migration exports/aborts by
     rid: Optional[str] = None
+    # fleet observability (docs/DESIGN.md §7): tenant identity and the
+    # gateway-propagated trace id ride the request through batching rows
+    # AND the migration export/import seam; the wall-clock submit plus
+    # the scheduler-pickup marker decompose TTFT into queue wait vs
+    # prefill, and migration_pause accumulates freeze→first-relayed-
+    # token gaps so a migrated request's timeline still sums to e2e
+    tenant: str = "default"
+    trace_id: int = 0
+    t_submit_wall: float = 0.0     # epoch seconds at admission
+    t_sched: float = 0.0           # perf_counter at scheduler pickup
+    migration_pause: float = 0.0   # accumulated seconds frozen
+    migrated: bool = False         # was live-migrated out at least once
+    # adopted (migrated-IN) requests never close a timeline here: the
+    # source replica keeps the client connection, so its view is the
+    # user-visible one — the adopting engine closing too would double-
+    # count the tenant's tokens across the fleet
+    adopted: bool = False
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self.done.wait(timeout):
@@ -978,6 +997,16 @@ class ContinuousBatchingEngine:
                                 "imported_requests": 0,
                                 "detached_requests": 0}
         self._flight = get_flight_recorder()
+        # per-engine span sink for the fleet trace stitch (docs/DESIGN.md
+        # §7): prefill/decode spans tagged with the gateway-propagated
+        # trace id, exported by GET /trace and merged by /trace/fleet.
+        # The rid salt keeps proc rows distinct when tests co-locate
+        # several engines in one process.
+        self.tracer = TraceRecorder(f"engine:{self._rid_salt}")
+        # co-located span sources (the migration worker registers its
+        # recorder here) drain through export_trace alongside our own,
+        # so one replica /trace carries engine AND migration spans
+        self._aux_tracers: list = []
         # online anomaly watch over the same stats() surface /stats
         # serves; throttled to ~1 Hz inside the scheduler loop, and
         # bundles only materialize when postmortem capture is configured
@@ -1000,7 +1029,9 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt_ids, max_new_tokens: int,
                _staged: Optional[dict] = None,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               trace_id: int = 0) -> Request:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         check_capacity(self.max_seq, len(prompt), max_new_tokens)
         if len(prompt) == 0:
@@ -1038,7 +1069,10 @@ class ContinuousBatchingEngine:
                     "shedding instead of queueing unboundedly",
                     retry_after_s=1.0)
         req = Request(prompt=prompt, max_new=max_new_tokens,
-                      t_submit=time.perf_counter())
+                      t_submit=time.perf_counter(),
+                      t_submit_wall=time.time(),
+                      tenant=sanitize_tenant(tenant),
+                      trace_id=int(trace_id or 0))
         # every request gets a migration-addressable id: caller-supplied,
         # or engine-salted auto id (the salt keeps auto rids distinct
         # across replicas sharing a transport namespace).  Wire frame
@@ -1260,7 +1294,12 @@ class ContinuousBatchingEngine:
                 "tokens": list(req.tokens), "lps": list(req.lps),
                 "kv_dtype": self.kv_dtype,
                 "block_tokens": int(self.kv_cache.block_tokens),
-                "eos_id": self.eos_id}
+                "eos_id": self.eos_id,
+                # observability identity rides the checkpoint so the
+                # adopting replica's spans/accounting stay attributed
+                "tenant": req.tenant, "trace_id": int(req.trace_id),
+                "t_submit_wall": float(req.t_submit_wall),
+                "migration_pause": float(req.migration_pause)}
         if slot is None:
             # still queued: a cold checkpoint (no pages, nothing
             # emitted) — the importer degrades it to a plain submit
@@ -1300,6 +1339,11 @@ class ContinuousBatchingEngine:
             if req.rid is not None and self._by_rid.get(req.rid) is req:
                 del self._by_rid[req.rid]
             req._detached = True
+            # freeze point: the migration pause runs from here until the
+            # first RELAYED token lands on the request's stream (the
+            # relay's _on_tok closes it) — the timeline's pause field
+            req.migrated = True
+            req._pause_t0 = time.perf_counter()
             self.migration_stats["detached_requests"] += 1
         self._flight.record("migration_export", rid=req.rid,
                             tokens=len(req.tokens), blocks=n_used,
@@ -1320,8 +1364,14 @@ class ContinuousBatchingEngine:
         rid = request_id if request_id is not None else ckpt.get("rid")
         if not ckpt.get("tokens") or int(ckpt.get("length") or 0) <= 0:
             # cold checkpoint: nothing decoded yet — plain admission
-            return self.submit(ckpt["prompt"], ckpt["max_new"],
-                               request_id=rid)
+            # (still marked adopted: the source relay owns the client-
+            # visible timeline even for a cold handoff)
+            req = self.submit(ckpt["prompt"], ckpt["max_new"],
+                              request_id=rid,
+                              tenant=ckpt.get("tenant"),
+                              trace_id=int(ckpt.get("trace_id") or 0))
+            req.adopted = True
+            return req
         if self._spec_step is not None or self._pld_step is not None:
             raise ValueError(
                 "import_request supports plain decode slots only")
@@ -1357,7 +1407,13 @@ class ContinuousBatchingEngine:
                 f"checkpoint ships {n_shipped} blocks; length "
                 f"{length} needs {n_used}")
         req = Request(prompt=prompt, max_new=max_new,
-                      t_submit=time.perf_counter())
+                      t_submit=time.perf_counter(),
+                      tenant=sanitize_tenant(ckpt.get("tenant")),
+                      trace_id=int(ckpt.get("trace_id") or 0),
+                      t_submit_wall=float(ckpt.get("t_submit_wall") or 0),
+                      migration_pause=float(
+                          ckpt.get("migration_pause") or 0),
+                      migrated=True, adopted=True)
         req.rid = rid
         req.tokens = tokens
         req.lps = [float(x) for x in (ckpt.get("lps") or [])]
@@ -1392,7 +1448,8 @@ class ContinuousBatchingEngine:
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  seed: int = 0, timeout: Optional[float] = None,
-                 logprobs: bool = False) -> GenerationResult:
+                 logprobs: bool = False, tenant: Optional[str] = None,
+                 trace_id: int = 0) -> GenerationResult:
         """Engine-surface convenience: submit each row as its own request
         (they batch with whatever else is in flight) and wait for all.
         ``seed`` is accepted for surface compatibility but not honored —
@@ -1413,7 +1470,8 @@ class ContinuousBatchingEngine:
         if ids.ndim == 1:
             ids = ids[None, :]
         t0 = time.perf_counter()
-        reqs = self._submit_rows(ids, max_new_tokens)
+        reqs = self._submit_rows(ids, max_new_tokens, tenant=tenant,
+                                 trace_id=trace_id)
         try:
             rows = [r.wait(timeout=timeout) for r in reqs]
         except TimeoutError:
@@ -1433,7 +1491,9 @@ class ContinuousBatchingEngine:
                                 seconds=time.perf_counter() - t0,
                                 logprobs=lps)
 
-    def _submit_rows(self, ids: np.ndarray, max_new_tokens: int) -> list:
+    def _submit_rows(self, ids: np.ndarray, max_new_tokens: int,
+                     tenant: Optional[str] = None,
+                     trace_id: int = 0) -> list:
         """Submit every row or none: if a later row is shed by the
         admission-depth gate, rows already admitted are cancelled before
         the SchedulerOverloaded propagates — a 503'd multi-row request
@@ -1442,7 +1502,8 @@ class ContinuousBatchingEngine:
         reqs = []
         try:
             for row in ids:
-                reqs.append(self.submit(row, max_new_tokens))
+                reqs.append(self.submit(row, max_new_tokens,
+                                        tenant=tenant, trace_id=trace_id))
         except Exception:
             for r in reqs:
                 r.cancel()
@@ -1450,7 +1511,8 @@ class ContinuousBatchingEngine:
         return reqs
 
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
-                        seed: int = 0, timeout: Optional[float] = None):
+                        seed: int = 0, timeout: Optional[float] = None,
+                        tenant: Optional[str] = None, trace_id: int = 0):
         """Yield [batch] token arrays per step (HTTP streaming surface).
         Single-row streaming only batches trivially; multi-row prompts
         stream in lockstep of the slowest admitted row.  An ABANDONED
@@ -1465,7 +1527,8 @@ class ContinuousBatchingEngine:
         if ids.ndim == 1:
             ids = ids[None, :]
         deadline = None if not timeout else time.monotonic() + timeout
-        reqs = self._submit_rows(ids, max_new_tokens)
+        reqs = self._submit_rows(ids, max_new_tokens, tenant=tenant,
+                                 trace_id=trace_id)
         fetched = [[] for _ in reqs]
         finished = [False] * len(reqs)   # row's None sentinel was consumed
         try:
@@ -1588,6 +1651,14 @@ class ContinuousBatchingEngine:
                 "num_draft": self.num_draft, "rounds": s["rounds"],
                 "acceptance_rate": (round(s["accepted"] / s["drafted"], 4)
                                     if s["drafted"] else None)}
+        # per-tenant SLO rollup (goodput + burn rates) rides the same
+        # stats surface: the gateway's health prober stores it per
+        # replica (the /debugz fleet summary) and the anomaly layer's
+        # slo_burn detector consumes it below
+        try:
+            out["slo"] = get_slo_ledger().summary()
+        except Exception:
+            pass
         # anomaly watch rides every stats() reader as well as the
         # scheduler loop: an HTTP /metrics scrape runs on its OWN thread,
         # so the stalled-pipeline watchdog still observes (and fires)
@@ -1793,6 +1864,11 @@ class ContinuousBatchingEngine:
         return needs
 
     def _admit_request(self, slot: int, req: Request):
+        # scheduler pickup: everything before this is queue wait,
+        # everything from here to the first token is prefill (the
+        # timeline ledger's TTFT decomposition)
+        if req.t_sched == 0.0:
+            req.t_sched = time.perf_counter()
         if getattr(req, "_resume", None) is not None:
             self._admit_resume(slot, req)
             return
@@ -2044,6 +2120,7 @@ class ContinuousBatchingEngine:
             self._flight.record("batch_done", slot=slot,
                                 tokens=len(req.tokens),
                                 reason="eos" if hit_eos else "length")
+            self._close_timeline(req)
 
     def _fail_request(self, req: Request, err: Optional[BaseException]):
         """Finish a request (with an error, or cleanly for err=None).
@@ -2061,6 +2138,74 @@ class ContinuousBatchingEngine:
             get_flight_recorder().record(
                 "batch_fail", error=type(err).__name__,
                 tokens=len(req.tokens))
+        self._close_timeline(
+            req, error=(type(err).__name__ if err is not None
+                        else ("cancelled" if req.cancelled else None)))
+
+    def _close_timeline(self, req: Request,
+                        error: Optional[str] = None) -> None:
+        """Close ``req`` into the process SLO ledger exactly once —
+        completion, failure, cancel, and the migration relay's fin (on
+        the SOURCE replica, which owns the client connection) all funnel
+        here.  Adopted (migrated-in) requests are skipped so a tenant's
+        tokens are never double-counted across the fleet.  Best-effort:
+        accounting must never add a failure to the request path."""
+        if req.adopted or getattr(req, "_timeline_closed", False):
+            return
+        req._timeline_closed = True
+        t_done = req.t_done if req.t_done else time.perf_counter()
+        t_first = req.t_first if req.t_first else t_done
+        t_sched = req.t_sched if req.t_sched else t_first
+        try:
+            get_slo_ledger().close_request(
+                rid=req.rid or "", tenant=req.tenant,
+                trace_id=req.trace_id,
+                t_submit_wall=req.t_submit_wall,
+                queue_wait_s=max(0.0, t_sched - req.t_submit),
+                ttft_s=max(0.0, t_first - req.t_submit),
+                e2e_s=max(0.0, t_done - req.t_submit),
+                tokens=len(req.tokens),
+                migration_pause_s=req.migration_pause,
+                migrated=req.migrated, replica=self.tracer.proc,
+                error=error)
+            if req.trace_id:
+                # engine spans for the fleet trace stitch: wall-clock
+                # starts are reconstructed from the submit wall time +
+                # perf_counter offsets, so the spans line up with the
+                # gateway's proxy span without mixing clocks mid-span
+                base = req.t_submit_wall or (time.time()
+                                             - (t_done - req.t_submit))
+                self.tracer.record(
+                    "engine.prefill", req.trace_id,
+                    ts=base + max(0.0, t_sched - req.t_submit),
+                    dur=max(0.0, t_first - t_sched),
+                    rid=req.rid, tenant=req.tenant)
+                if t_done > t_first and len(req.tokens) > 1:
+                    self.tracer.record(
+                        "engine.decode", req.trace_id,
+                        ts=base + max(0.0, t_first - req.t_submit),
+                        dur=t_done - t_first, rid=req.rid,
+                        tenant=req.tenant, tokens=len(req.tokens))
+        except Exception:
+            pass
+
+    def register_aux_tracer(self, tracer) -> None:
+        """Attach a co-located recorder (e.g. the migration worker's)
+        so :meth:`export_trace` drains it with the engine's own spans."""
+        self._aux_tracers.append(tracer)
+
+    def export_trace(self) -> dict:
+        """Chrome trace of the engine's span sink plus any registered
+        auxiliary recorders (the replica ``GET /trace`` surface;
+        ``/trace/fleet`` merges these across replicas).  Drains: each
+        span exports exactly once."""
+        spans = self.tracer.drain()
+        for t in self._aux_tracers:
+            try:
+                spans.extend(t.drain())
+            except Exception:
+                pass
+        return to_chrome_trace(spans)
 
     def _drain_all(self, err: BaseException):
         """Fail every in-flight slot, mid-admission, backlogged, and

@@ -4,7 +4,19 @@ A standalone :class:`GatewayHTTPServer` (docs/DESIGN.md §16) speaking
 the same surface as ``runtime/http_server.py`` — ``/health``,
 ``/stats``, ``/metrics``, ``/debugz``, ``/trace`` — plus the one route
 that matters: ``/generate``, proxied to the replica the
-:class:`~.router.PrefixAwareRouter` picks.
+:class:`~.router.PrefixAwareRouter` picks.  Being the fleet's front
+door, it also serves the fleet-wide observability surfaces
+(docs/DESIGN.md §7):
+
+- ``GET /metrics/fleet`` — every replica's ``/metrics`` re-labeled
+  with ``replica="host:port"`` and merged with the gateway's own
+  registry (:class:`~.federation.FleetScraper`: debounced, bounded
+  staleness);
+- ``GET /trace/fleet`` — every replica's ``/trace`` export stitched
+  with the gateway's proxy spans into ONE Chrome trace; a request's
+  gateway ``route``/``proxy`` spans, its engine spans, and any
+  migration spans share the ``X-DWT-Trace-Id`` the gateway minted, so
+  Perfetto shows the whole cross-process story on one lane.
 
 Proxy contract (the hard-won parts):
 
@@ -30,7 +42,12 @@ Proxy contract (the hard-won parts):
   replica echoes it and logs it to its flight recorder
   (runtime/http_server.py), and the gateway records ``route`` +
   ``proxy`` spans under the same id — one trace id covers
-  gateway→replica, exported at ``GET /trace``.
+  gateway→replica, exported at ``GET /trace`` (and stitched with the
+  replicas' engine/migration spans at ``GET /trace/fleet``).
+- **tenant identity**: a ``tenant`` body field or ``X-DWT-Tenant``
+  header rides the proxy hop as ``X-DWT-Tenant`` so the replica's SLO
+  ledger (telemetry/slo.py) attributes the request's goodput to the
+  right tenant.
 """
 
 from __future__ import annotations
@@ -45,9 +62,11 @@ from typing import Optional
 from ...telemetry import catalog as _catalog
 from ...telemetry import metrics as _m
 from ...telemetry.flightrecorder import get_flight_recorder
-from ...telemetry.tracing import (SpanClock, TraceRecorder, new_trace_id,
+from ...telemetry.tracing import (SpanClock, TraceRecorder,
+                                  merge_chrome_traces, new_trace_id,
                                   to_chrome_trace)
 from ..overload import GatewayOverloaded, SchedulerOverloaded
+from .federation import FleetScraper
 
 _HOP_HEADERS = {"transfer-encoding", "connection", "keep-alive",
                 "content-length"}
@@ -63,16 +82,25 @@ class GatewayHTTPServer:
 
     def __init__(self, registry, router, host: str = "127.0.0.1",
                  port: int = 0, *, retry_limit: int = 1,
-                 proxy_timeout_s: Optional[float] = None):
+                 proxy_timeout_s: Optional[float] = None,
+                 fleet_scrape_interval_s: float = 1.0,
+                 fleet_max_stale_s: float = 30.0,
+                 metrics_fetcher=None):
         """``retry_limit``: additional replicas tried after the routed
         one dies before first token.  ``proxy_timeout_s``: per-socket
         timeout on replica connections (None = no deadline; streams
-        with long decode gaps need None or a generous value)."""
+        with long decode gaps need None or a generous value).
+        ``fleet_scrape_interval_s`` / ``fleet_max_stale_s`` /
+        ``metrics_fetcher``: the ``/metrics/fleet`` federation knobs
+        (see :class:`~.federation.FleetScraper`)."""
         self.registry = registry
         self.router = router
         self.retry_limit = max(0, int(retry_limit))
         self.proxy_timeout_s = proxy_timeout_s
         self.tracer = TraceRecorder("gateway")
+        self.fleet = FleetScraper(
+            registry, min_interval_s=fleet_scrape_interval_s,
+            max_stale_s=fleet_max_stale_s, fetcher=metrics_fetcher)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -83,8 +111,9 @@ class GatewayHTTPServer:
 
             # bounded route labels, same rule as the replica server
             _ROUTES = frozenset((
-                "/health", "/stats", "/metrics", "/trace", "/debugz",
-                "/generate", "/drain"))
+                "/health", "/stats", "/metrics", "/metrics/fleet",
+                "/trace", "/trace/fleet", "/debugz", "/generate",
+                "/drain"))
 
             def _json(self, code: int, obj: dict,
                       headers: Optional[dict] = None) -> None:
@@ -103,28 +132,45 @@ class GatewayHTTPServer:
 
             def _shed(self, e: SchedulerOverloaded) -> None:
                 _catalog.GATEWAY_SHED.inc()
+                get_flight_recorder().record("gateway_shed",
+                                             reason=str(e)[:256])
                 self._json(getattr(e, "http_code", 503),
                            {"error": str(e)},
                            headers={"Retry-After":
                                     str(max(1, int(e.retry_after_s)))})
 
+            def _text(self, code: int, text: str) -> None:
+                route = self.path.split("?")[0]
+                if route not in self._ROUTES:
+                    route = "other"
+                _catalog.HTTP_REQUESTS.inc(route=route, code=str(code))
+                body = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 path = self.path.split("?")[0]
                 if path == "/metrics":
                     try:
-                        text = _catalog.scrape()
-                        code = 200
+                        self._text(200, _catalog.scrape())
                     except Exception as e:
-                        text = f"# scrape error: {e}\n"
-                        code = 500
-                    body = text.encode("utf-8")
-                    self.send_response(code)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4; "
-                                     "charset=utf-8")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                        self._text(500, f"# scrape error: {e}\n")
+                elif path == "/metrics/fleet":
+                    try:
+                        self._text(200, outer.fleet.scrape_fleet(
+                            _catalog.scrape))
+                    except Exception as e:
+                        self._text(500, f"# fleet scrape error: {e}\n")
+                elif path == "/trace/fleet":
+                    try:
+                        self._json(200, outer._fleet_trace())
+                    except Exception as e:
+                        self._json(500, {"error": str(e)})
                 elif path == "/health":
                     ups = outer.registry.up_replicas()
                     routable = outer.registry.routable_replicas()
@@ -196,8 +242,17 @@ class GatewayHTTPServer:
     def _proxy_generate(self, handler, raw: bytes, req: dict) -> None:
         tokens = self._routing_tokens(req)
         trace_id = new_trace_id()
+        tenant = req.get("tenant") or handler.headers.get("X-DWT-Tenant")
+        tenant = str(tenant) if tenant else None
+        get_flight_recorder().record(
+            "gateway_admit", trace_id=f"{trace_id:016x}",
+            tenant=tenant or "default")
         route_clock = SpanClock()
         decision = self.router.route(tokens)    # raises GatewayOverloaded
+        get_flight_recorder().record(
+            "gateway_route", replica=decision.rid,
+            policy=decision.policy, match_tokens=decision.match_tokens,
+            trace_id=f"{trace_id:016x}")
         route_span = self.tracer.record(
             "gateway.route", trace_id, clock=route_clock,
             replica=decision.rid, policy=decision.policy,
@@ -218,7 +273,8 @@ class GatewayHTTPServer:
             proxy_clock = SpanClock()
             try:
                 done = self._proxy_once(handler, rid, raw, trace_id,
-                                        ttft_clock, decision, attempt)
+                                        ttft_clock, decision, attempt,
+                                        tenant=tenant)
             except _ReplicaDied as e:
                 last_err = e
                 self.registry.record_failure(rid, reason=str(e))
@@ -239,7 +295,8 @@ class GatewayHTTPServer:
             retry_after_s=2.0)
 
     def _proxy_once(self, handler, rid: str, raw: bytes, trace_id: int,
-                    ttft_clock: SpanClock, decision, attempt: int) -> bool:
+                    ttft_clock: SpanClock, decision, attempt: int,
+                    tenant: Optional[str] = None) -> bool:
         """Proxy one attempt to ``rid``.  Returns True on a 2xx the
         client fully received; raises :class:`_ReplicaDied` when safe
         to retry (no body byte forwarded); propagates replica HTTP
@@ -247,11 +304,18 @@ class GatewayHTTPServer:
         host, port = self.registry.endpoint(rid)
         conn = HTTPConnection(host, port, timeout=self.proxy_timeout_s)
         try:
+            headers = {
+                "Content-Type": "application/json",
+                "X-DWT-Trace-Id": f"{trace_id:016x}",
+            }
+            if tenant:
+                # tenant rides the hop so the replica's SLO ledger
+                # books this request under the right tenant even when
+                # the body carried it as a header-only hint
+                headers["X-DWT-Tenant"] = tenant[:64]
             try:
-                conn.request("POST", "/generate", body=raw, headers={
-                    "Content-Type": "application/json",
-                    "X-DWT-Trace-Id": f"{trace_id:016x}",
-                })
+                conn.request("POST", "/generate", body=raw,
+                             headers=headers)
                 resp = conn.getresponse()
             except Exception as e:
                 raise _ReplicaDied(f"{rid}: {e}") from e
@@ -260,6 +324,9 @@ class GatewayHTTPServer:
                 # federated admission: the replica's shed is the
                 # answer — propagate its Retry-After verbatim
                 _catalog.GATEWAY_SHED.inc()
+                get_flight_recorder().record(
+                    "gateway_shed", reason=f"replica {rid} shed "
+                    f"({resp.status})", trace_id=f"{trace_id:016x}")
                 body = resp.read()
                 retry_after = resp.getheader("Retry-After") or "1"
                 handler._json(resp.status,
@@ -367,9 +434,54 @@ class GatewayHTTPServer:
             return 400, {"error": f"unknown replica {rid!r}",
                          "replicas": self.registry.replica_ids()}
         flag = bool(req.get("draining", True))
+        get_flight_recorder().record("gateway_drain", replica=rid,
+                                     draining=flag)
         self.registry.set_draining(rid, flag)
         return 200, {"replica": rid, "draining": flag,
                      "routable": self.registry.routable_replicas()}
+
+    # -- fleet observability -----------------------------------------------
+
+    def _fleet_trace(self) -> dict:
+        """``GET /trace/fleet``: drain the gateway's own spans, drain
+        every up replica's ``/trace`` export, and stitch them into one
+        Chrome trace (``merge_chrome_traces`` renumbers pids so each
+        process keeps its own track).  A replica that fails to export
+        just misses from this stitch — its spans survive locally until
+        its next ``/trace`` drain, so nothing is lost, only deferred."""
+        traces = [to_chrome_trace(self.tracer.drain())]
+        for rid in self.registry.up_replicas():
+            host, port = self.registry.endpoint(rid)
+            conn = HTTPConnection(host, port,
+                                  timeout=self.proxy_timeout_s or 5.0)
+            try:
+                conn.request("GET", "/trace")
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    continue
+                t = json.loads(body)
+                if isinstance(t, dict):
+                    traces.append(t)
+            except Exception:
+                continue
+            finally:
+                conn.close()
+        return merge_chrome_traces(traces)
+
+    def _fleet_slo(self) -> dict:
+        """Per-replica SLO summaries, as last reported over the health
+        probe (engine ``stats()`` includes its SLO ledger summary, and
+        the prober stores the whole stats dict)."""
+        out = {}
+        for rid in self.registry.replica_ids():
+            try:
+                slo = self.registry.get(rid).last_stats.get("slo")
+            except KeyError:
+                continue
+            if isinstance(slo, dict):
+                out[rid] = slo
+        return out
 
     # -- introspection -----------------------------------------------------
 
@@ -389,6 +501,8 @@ class GatewayHTTPServer:
             "registry": self.registry.debug_state(),
             "routing": self.router.routing_table(),
             "postmortem": postmortem.debug_state(),
+            "fleet_slo": self._fleet_slo(),
+            "federation": self.fleet.debug_state(),
         }
 
     # -- lifecycle ---------------------------------------------------------

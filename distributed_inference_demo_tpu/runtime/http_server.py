@@ -13,7 +13,10 @@ inference request with ``"Inference not implemented yet"``
   the same stage counters as /stats plus batching/speculative and
   monitor series, scrapeable by a stock Prometheus
 - ``GET  /trace``     — Chrome trace-event JSON of the spans recorded
-  since the last call (pipeline backends only; load in Perfetto)
+  since the last call (pipeline + batching backends; load in Perfetto)
+- ``GET  /timeline``  — recent per-request timeline records + the
+  per-tenant SLO/goodput summary (telemetry/slo; ``?n=`` bounds the
+  tail)
 - ``POST /generate``  — ``{"prompt_ids": [[...]], "max_new_tokens": N,
   "stream": false}`` → ``{"tokens": [[...]]}``; with ``"prompt": "text"``
   when a tokenizer is attached; ``"stream": true`` switches to chunked
@@ -416,7 +419,7 @@ class InferenceHTTPServer:
             # child (and one /metrics line) per junk URL forever
             _ROUTES = frozenset((
                 "/health", "/stats", "/stats/reset", "/metrics", "/trace",
-                "/debugz", "/generate", "/classify"))
+                "/timeline", "/debugz", "/generate", "/classify"))
 
             def _json(self, code: int, obj: dict,
                       headers: Optional[dict] = None) -> None:
@@ -438,6 +441,22 @@ class InferenceHTTPServer:
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _obs_kwargs(self, fn) -> dict:
+                """tenant/trace_id kwargs for backends that take them
+                (the continuous-batching engine) — duck-typed like
+                image/timeout, so pipeline backends stay untouched."""
+                out = {}
+                tenant = getattr(self, "_tenant", None)
+                if tenant and _accepts_kwarg(fn, "tenant"):
+                    out["tenant"] = str(tenant)
+                tid = getattr(self, "_trace_id", None)
+                if tid and _accepts_kwarg(fn, "trace_id"):
+                    try:
+                        out["trace_id"] = int(str(tid), 16)
+                    except ValueError:
+                        pass
+                return out
 
             def _shed(self, e: SchedulerOverloaded) -> None:
                 """503/429 + Retry-After: the admission queue is past
@@ -497,6 +516,21 @@ class InferenceHTTPServer:
                         self._json(200, outer.backend.stats())
                     else:
                         self._json(200, {"stages": []})
+                elif self.path.split("?")[0] == "/timeline":
+                    # recent closed request timelines + per-tenant SLO
+                    # summary (telemetry/slo) — the fleet plane's
+                    # where-did-the-milliseconds-go surface
+                    from urllib.parse import parse_qs, urlparse
+                    from ..telemetry import slo as _slo
+                    try:
+                        qs = parse_qs(urlparse(self.path).query)
+                        n = max(1, min(1024, int(qs.get("n", ["64"])[0])))
+                    except ValueError:
+                        n = 64
+                    try:
+                        self._json(200, _slo.debug_state(tail=n))
+                    except Exception as e:
+                        self._json(500, {"error": str(e)})
                 elif self.path.split("?")[0] == "/debugz":
                     try:
                         self._json(200, outer._debugz())
@@ -546,6 +580,11 @@ class InferenceHTTPServer:
                 except (ValueError, KeyError) as e:
                     self._json(400, {"error": str(e)})
                     return
+                # tenant identity (docs/DESIGN.md §7): body field wins
+                # over the gateway-forwarded header; either way it rides
+                # the batching rows into the per-tenant SLO ledger
+                self._tenant = (req.get("tenant")
+                                or self.headers.get("X-DWT-Tenant"))
                 if image is not None:
                     # honor-or-reject: only a multimodal backend takes
                     # an image, and images don't stream (the fused
@@ -637,6 +676,8 @@ class InferenceHTTPServer:
                             # cancels through Request.cancel() on expiry
                             # (slot freed), surfacing as TimeoutError
                             kwargs["timeout"] = outer.request_timeout
+                        kwargs.update(
+                            self._obs_kwargs(outer.backend.generate))
                         t_req = time.perf_counter()
                         res = outer.backend.generate(ids, max_new,
                                                      seed=seed, **kwargs)
@@ -711,6 +752,8 @@ class InferenceHTTPServer:
                     # the same per-request deadline as the plain branch:
                     # a wedged scheduler surfaces as 504, never a hang
                     kwargs["timeout"] = outer.request_timeout
+                kwargs.update(
+                    self._obs_kwargs(outer.backend.generate_stream))
                 gen = outer.backend.generate_stream(ids, max_new,
                                                     seed=seed, **kwargs)
                 ses = _StopSession(outer.tokenizer, stop, len(ids),
@@ -741,6 +784,8 @@ class InferenceHTTPServer:
                 ``logprobs=True`` — deltas can't carry them: a logprob
                 belongs to a token, and tokens aren't streamed here)."""
                 kwargs = {"logprobs": True} if logprobs else {}
+                kwargs.update(
+                    self._obs_kwargs(outer.backend.generate_stream))
                 gen = outer.backend.generate_stream(ids, max_new,
                                                     seed=seed, **kwargs)
 
@@ -836,6 +881,8 @@ class InferenceHTTPServer:
 
             def _stream(self, ids, max_new, seed, logprobs=False):
                 kwargs = {"logprobs": True} if logprobs else {}
+                kwargs.update(
+                    self._obs_kwargs(outer.backend.generate_stream))
                 gen = outer.backend.generate_stream(ids, max_new, seed=seed,
                                                     **kwargs)
 

@@ -117,7 +117,14 @@ def _state_meta(ckpt: dict, *, rid: str, attempt: int, n_frames: int,
             "last_tok": int(ckpt["last_tok"]),
             "kv_dtype": ckpt["kv_dtype"],
             "block_tokens": int(ckpt["block_tokens"]),
-            "source_id": source_id, "reply_to": reply_to}
+            "source_id": source_id, "reply_to": reply_to,
+            # observability identity (docs/DESIGN.md §7): the manifest is
+            # all the target sees, so tenant/trace must ride it or the
+            # adopted request would lose its attribution mid-fleet
+            "tenant": ckpt.get("tenant", "default"),
+            "trace_id": int(ckpt.get("trace_id") or 0),
+            "t_submit_wall": float(ckpt.get("t_submit_wall") or 0.0),
+            "migration_pause": float(ckpt.get("migration_pause") or 0.0)}
 
 
 def _ckpt_from_staged(stager: PageStager, st: dict, meta: dict) -> dict:
@@ -136,6 +143,10 @@ def _ckpt_from_staged(stager: PageStager, st: dict, meta: dict) -> dict:
             "last_tok": int(meta["last_tok"]),
             "kv_dtype": meta.get("kv_dtype", st["kv_dtype"]),
             "block_tokens": int(meta["block_tokens"]),
+            "tenant": meta.get("tenant", "default"),
+            "trace_id": int(meta.get("trace_id") or 0),
+            "t_submit_wall": float(meta.get("t_submit_wall") or 0.0),
+            "migration_pause": float(meta.get("migration_pause") or 0.0),
             "k": k_blocks, "v": v_blocks,
             "rng": (np.asarray(rng, np.uint32) if len(rng) else None)}
 
@@ -166,6 +177,12 @@ class MigrationWorker:
             DEFAULT_PAGE_FRAME_BLOCKS if page_frame_blocks is None
             else page_frame_blocks))
         self.tracer = TraceRecorder(f"migration:{self.device_id}")
+        # replica /trace drains the ENGINE's recorder: register ours so
+        # the export (and the gateway's /trace/fleet stitch) carries
+        # migration spans on the same page as prefill/decode spans
+        reg = getattr(engine, "register_aux_tracer", None)
+        if callable(reg):
+            reg(self.tracer)
         # target side: (rid, attempt) page staging (host-only; zero pool
         # pages) — pass the DecodeWorker's stager to co-serve one
         # transport with the §15 admission join
@@ -508,6 +525,16 @@ class MigrationWorker:
 
     # -- source: relay consumption ----------------------------------------
 
+    @staticmethod
+    def _end_pause(req) -> None:
+        """Close the freeze→resume gap the detaching export opened: the
+        accumulated pause is the timeline ledger's migration_pause field
+        (first relayed/healed token, or fin, whichever lands first)."""
+        t0 = getattr(req, "_pause_t0", None)
+        if t0 is not None:
+            req.migration_pause += time.perf_counter() - t0
+            req._pause_t0 = None
+
     def _on_tok(self, rid: str, idx: int, payload: bytes) -> None:
         ent = self._relays.get(rid)
         if ent is None:
@@ -531,6 +558,7 @@ class MigrationWorker:
         # one replayed boundary step appends nowhere, a skipped step is
         # structurally impossible (idx == len(tokens) or it drops)
         if idx == len(req.tokens):
+            self._end_pause(req)
             req.tokens.append(tok)
             req.stream.put(tok)
         elif idx < len(req.tokens):
@@ -554,26 +582,43 @@ class MigrationWorker:
                                  len(payload), e)
             req.error = MigrationError(
                 f"relay fin for {rid!r} was corrupt")
+            self._end_pause(req)
             req.stream.put(None)
             req.done.set()
+            try:
+                self.engine._close_timeline(req, error="MigrationError")
+            except Exception:        # pragma: no cover - defensive
+                pass
             return
         if meta.get("ok"):
             # the authoritative token list reconciles any relay frames
             # the wire lost (fin rides the reliable send-retry path)
             final = [int(t) for t in tensors[0]]
             for tok in final[len(req.tokens):]:
+                self._end_pause(req)
                 req.tokens.append(tok)
                 req.stream.put(tok)
         elif not meta.get("cancelled"):
             req.error = MigrationError(
                 meta.get("error") or f"migrated request {rid!r} failed "
                 "on the target replica")
+        self._end_pause(req)
         req.t_done = time.perf_counter()
         req.stream.put(None)
         req.done.set()
         self._flight.record("migration_relay_done", rid=rid,
                             ok=bool(meta.get("ok")),
                             tokens=len(req.tokens))
+        # the SOURCE closes the user-visible timeline: it held the
+        # client connection across the handoff, so its clocks cover the
+        # whole request (pause included) — the target never closes one
+        try:
+            self.engine._close_timeline(
+                req, error=(None if meta.get("ok") else
+                            ("cancelled" if meta.get("cancelled")
+                             else "MigrationError")))
+        except Exception:            # pragma: no cover - defensive
+            pass
 
     # -- source: migrate out ----------------------------------------------
 
@@ -598,8 +643,6 @@ class MigrationWorker:
         after SELF-HEALING: the detached checkpoint (if any) re-imports
         locally, so the request survives a dead target."""
         t_all = SpanClock()
-        if trace is None:
-            trace = (new_trace_id(), 0)
         # attempts start at 1: the adopted/aborted gates treat 0 as
         # "never seen", so attempt numbers must stay strictly positive
         attempt = self._attempts.get(rid, 0) + 1
@@ -607,6 +650,12 @@ class MigrationWorker:
         req = self.engine.get_request(rid)
         if req is None:
             raise KeyError(f"unknown request id {rid!r}")
+        if trace is None:
+            # join the request's own trace when it carries one (the
+            # gateway-propagated id), so /trace/fleet stitches the
+            # migration spans into the same request lane as the proxy
+            # and engine spans; a fresh id otherwise
+            trace = (getattr(req, "trace_id", 0) or new_trace_id(), 0)
         cat = _migration_metrics()
         if cat is not None:
             try:
@@ -854,8 +903,13 @@ class MigrationWorker:
             req.error = MigrationError(
                 f"handoff failed and local re-import failed too: "
                 f"{type(e).__name__}: {e}")
+            self._end_pause(req)
             req.stream.put(None)
             req.done.set()
+            try:
+                self.engine._close_timeline(req, error="MigrationError")
+            except Exception:        # pragma: no cover - defensive
+                pass
             return
         self.stats["healed_requests"] += 1
         self._flight.record("migration_healed", rid=rid,
@@ -866,12 +920,20 @@ class MigrationWorker:
                 item = healed.stream.get()
                 if item is None:
                     break
+                self._end_pause(req)
                 req.tokens.append(int(item))
                 req.stream.put(int(item))
             req.error = healed.error
+            self._end_pause(req)
             req.t_done = time.perf_counter()
             req.stream.put(None)
             req.done.set()
+            try:
+                self.engine._close_timeline(
+                    req, error=(None if healed.error is None else
+                                type(healed.error).__name__))
+            except Exception:        # pragma: no cover - defensive
+                pass
 
         threading.Thread(target=pump, daemon=True,
                          name=f"migration-heal-{rid}").start()

@@ -8,6 +8,9 @@ them *continuously* and names the moment something leaves its envelope:
   the ring median (a slow host / thermal-throttled chip / dying link);
 - **slo_ttft / slo_tpot** — the batching engine's time-to-first-token or
   per-output-token p95 breaches a configured SLO;
+- **slo_burn** — a tenant's error-budget burn rate (from the SLO
+  ledger, ``telemetry/slo.py``) exceeds ``DWT_ANOMALY_BURN_RATE`` on
+  every window at once (fast 5m AND slow 1h — multiwindow alerting);
 - **queue_saturation** — admitted-but-unslotted requests pile up past a
   threshold (the system is falling behind offered load);
 - **accept_collapse** — the speculative accept rate collapses (the draft
@@ -54,6 +57,7 @@ class Thresholds:
     accept_floor: float = 0.1         # speculative acceptance collapse
     accept_min_drafted: int = 256     # ... after this many drafted tokens
     stall_s: float = 30.0             # watchdog: no progress with work
+    burn_rate: float = 0.0            # 0 = SLO burn detector disabled
     sustain: int = 3                  # consecutive breaches before firing
     cooldown_s: float = 300.0         # per-kind re-fire suppression
 
@@ -71,6 +75,7 @@ class Thresholds:
             accept_min_drafted=_env_int(
                 "DWT_ANOMALY_ACCEPT_MIN_DRAFTED", 256),
             stall_s=_env_float("DWT_ANOMALY_STALL_S", 30.0),
+            burn_rate=_env_float("DWT_ANOMALY_BURN_RATE", 0.0),
             sustain=_env_int("DWT_ANOMALY_SUSTAIN", 3),
             cooldown_s=_env_float("DWT_ANOMALY_COOLDOWN_S", 300.0),
         )
@@ -219,6 +224,39 @@ class AnomalyDetector:
                     out.append(a)
             else:
                 self._clear(kind)
+
+        # multiwindow burn-rate: a tenant is burning error budget only
+        # when EVERY window (fast 5m AND slow 1h) sits over the
+        # threshold — the classic guard against paging on a short blip
+        # (5m alone) or on a long-recovered incident (1h alone).  Keyed
+        # per tenant so one noisy tenant can't mask another's streak.
+        burning = set()
+        slo_block = stats.get("slo")
+        if t.burn_rate > 0 and isinstance(slo_block, dict):
+            from .slo import isfinite
+            tenants = slo_block.get("tenants")
+            for tenant, ts_ in (tenants or {}).items():
+                burn = ts_.get("burn") if isinstance(ts_, dict) else None
+                if not isinstance(burn, dict) or not burn:
+                    continue
+                vals = list(burn.values())
+                if not all(isfinite(v) for v in vals):
+                    # NaN/inf: unusable sample — it can't fire, and the
+                    # streak restarts (sustain means CONSECUTIVE, the
+                    # same gap rule as the SLO p95 loop above)
+                    continue
+                key = f"slo_burn:{tenant}"
+                if all(v > t.burn_rate for v in vals):
+                    burning.add(key)
+                    a = self._breach(
+                        "slo_burn", "critical",
+                        {"tenant": tenant, "burn": burn,
+                         "threshold": t.burn_rate}, key=key)
+                    if a:
+                        out.append(a)
+        for key in [k for k in self._streak
+                    if k.startswith("slo_burn:") and k not in burning]:
+            self._clear(key)
 
         depth = stats.get("queue_depth")
         if isinstance(depth, int) and depth >= t.queue_depth:

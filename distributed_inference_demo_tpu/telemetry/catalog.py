@@ -17,6 +17,10 @@ from __future__ import annotations
 
 from .metrics import (LATENCY_BUCKETS_S, REGISTRY, counter, gauge,
                       histogram)
+# the per-tenant SLO/goodput series (dwt_slo_*) register on slo's import
+# — pulled in here so "import catalog" keeps meaning "the full standard
+# set is registered" (the metric-name lint and /metrics both rely on it)
+from . import slo  # noqa: E402  (registers dwt_slo_* series)
 
 # -- stage (pipeline role) series, bridged from StageStats snapshots -------
 
@@ -463,6 +467,23 @@ GATEWAY_PROXY_TTFT_SECONDS = histogram(
     "byte proxied back from the replica (includes routing, replica "
     "queueing, and prefill)",
     buckets=LATENCY_BUCKETS_S)
+GATEWAY_FLEET_SCRAPES = counter(
+    "dwt_gateway_fleet_scrapes_total",
+    "Successful per-replica /metrics pulls performed by the "
+    "GET /metrics/fleet federation endpoint (cache refreshes, not "
+    "client requests — a debounced request serves the cached text "
+    "without counting here)", ("replica",))
+GATEWAY_FLEET_SCRAPE_FAILURES = counter(
+    "dwt_gateway_fleet_failed_scrapes_total",
+    "Failed per-replica /metrics pulls during fleet federation; the "
+    "endpoint serves that replica's last good text until the bounded "
+    "staleness window expires, then drops its section with an "
+    "explanatory comment", ("replica",))
+GATEWAY_FLEET_SCRAPE_AGE = gauge(
+    "dwt_gateway_fleet_scrape_age_seconds",
+    "Age of each replica's federated /metrics section at the last "
+    "GET /metrics/fleet render — bounded by the staleness window; a "
+    "replica pinned at the bound is scraping dead", ("replica",))
 
 
 # -- live decode-to-decode migration series (docs/DESIGN.md §18) -----------
@@ -598,6 +619,7 @@ def scrape(backend=None) -> str:
     stall on a dead stage."""
     update_monitor_series()
     update_flight_series()
+    slo.update_slo_series()
     fn = getattr(backend, "scrape_stats", None) or getattr(
         backend, "stats", None)
     if fn is not None:

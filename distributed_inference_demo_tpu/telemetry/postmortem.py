@@ -11,7 +11,11 @@ anomaly, a ring stall, an unhandled crash — written by
   (caller-provided spans as duration events + flight events as instant
   events — loadable in Perfetto next to a ``/trace`` export);
 - ``config.json``     — whatever run configuration the caller holds;
-- ``runlog_tail.jsonl`` — the tail of the active structured run log.
+- ``runlog_tail.jsonl`` — the tail of the active structured run log;
+- ``timelines.jsonl`` — recent per-request SLO timeline records from
+  the SLO ledger (``telemetry/slo.py``): queue wait / TTFT / per-token
+  / migration-pause decomposition for the requests leading up to the
+  trigger.
 
 Writing is best-effort everywhere: a postmortem must never add a second
 failure to the one being recorded (a full disk degrades to a partial
@@ -104,6 +108,9 @@ class PostmortemWriter:
         tail = self._runlog_tail()
         if tail:
             self._write_text(path, "runlog_tail.jsonl", tail)
+        timelines = self._timelines()
+        if timelines:
+            self._write_text(path, "timelines.jsonl", timelines)
         self._count_bundle()
         self._prune()
         return path
@@ -137,6 +144,15 @@ class PostmortemWriter:
                          if k not in ("ts", "kind")},
             })
         return trace
+
+    @staticmethod
+    def _timelines() -> str:
+        try:
+            from .slo import timelines_jsonl
+            lines = timelines_jsonl()
+            return "\n".join(lines) + "\n" if lines else ""
+        except Exception:
+            return ""
 
     @staticmethod
     def _runlog_tail() -> str:

@@ -35,11 +35,20 @@ from typing import Dict, Iterable, List, Optional
 
 _MAX_SPANS = 8192          # bounded: long runs keep O(1) memory
 
+# Trace/span ids must stay unique across processes that FORKED from one
+# parent: the module-level ``random`` generator's state is copied by
+# fork, so two replicas forked after import would mint the *same* id
+# sequence and their traces would merge into one request at the gateway.
+# ``SystemRandom`` reads the kernel CSPRNG per call — no Python-level
+# state to inherit.
+_SYS_RANDOM = random.SystemRandom()
+
 
 def new_trace_id() -> int:
     """Random nonzero 64-bit trace id (collision odds are irrelevant at
-    any realistic request volume)."""
-    return random.getrandbits(64) | 1
+    any realistic request volume).  Drawn from ``os.urandom`` via
+    ``SystemRandom`` so ids stay distinct across forked replicas."""
+    return _SYS_RANDOM.getrandbits(64) | 1
 
 
 class SpanClock:
@@ -87,8 +96,9 @@ class TraceRecorder:
         self._spans: "deque[dict]" = deque(maxlen=max_spans)
         self._lock = threading.Lock()
         # span ids: process-unique base + counter, so two stages' ids
-        # cannot collide when merged at the header
-        self._base = (random.getrandbits(32) << 24) ^ (os.getpid() << 8)
+        # cannot collide when merged at the header.  SystemRandom for the
+        # same reason as new_trace_id(): a fork must not clone the base.
+        self._base = (_SYS_RANDOM.getrandbits(32) << 24) ^ (os.getpid() << 8)
         self._seq = itertools.count(1)
 
     def next_span_id(self) -> int:
@@ -168,6 +178,46 @@ def to_chrome_trace(spans: Iterable[dict]) -> dict:
             "ts": int(s.get("ts_us", 0)), "dur": int(s.get("dur_us", 0)),
             "args": args,
         })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def merge_chrome_traces(traces: Iterable[dict]) -> dict:
+    """Merge already-exported Chrome trace objects (``{"traceEvents":
+    [...]}``) into one.
+
+    Each input was built by :func:`to_chrome_trace` in a *different*
+    process (replica ``/trace`` exports plus the gateway's own), so their
+    small-integer pids collide.  Pids are renumbered per input object;
+    ``process_name`` metadata rows are deduplicated by name so the merged
+    view shows one row per distinct proc, and duration events whose proc
+    already has a row reuse it — a request's gateway-proxy, engine, and
+    migration spans land in one file, joined by the ``trace_id`` arg the
+    per-span export already carries.
+    """
+    name_pids: Dict[str, int] = {}
+    events: List[dict] = []
+    next_pid = 1
+    for trace in traces:
+        remap: Dict[int, int] = {}
+        pending: List[dict] = []   # events seen before their meta row
+        for ev in (trace or {}).get("traceEvents", []):
+            pid = int(ev.get("pid", 0))
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                proc = str((ev.get("args") or {}).get("name", "?"))
+                if proc in name_pids:
+                    remap[pid] = name_pids[proc]
+                else:
+                    name_pids[proc] = remap[pid] = next_pid
+                    next_pid += 1
+                    events.append(dict(ev, pid=remap[pid]))
+                continue
+            pending.append(ev)
+        for ev in pending:
+            pid = int(ev.get("pid", 0))
+            if pid not in remap:
+                remap[pid] = next_pid
+                next_pid += 1
+            events.append(dict(ev, pid=remap[pid]))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

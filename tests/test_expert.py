@@ -100,7 +100,13 @@ def test_ep_capacity_drop(devices):
     np.testing.assert_array_equal(y[:, C:], np.zeros_like(y[:, C:]))
 
 
-@pytest.mark.parametrize("quant", [False, "int8", "int4"])
+@pytest.mark.parametrize("quant", [
+    False,
+    # int8 twin — slow lane: int4 is the odd packed path and stays
+    # quick; int8 expert dequant shares its code shape with int4
+    pytest.param("int8", marks=pytest.mark.slow),
+    "int4",
+])
 def test_ep_stage_prefill_decode_parity(quant, devices):
     """Whole mixtral stage E-sliced over ep=2: prefill logits match the
     single-device forward; one decode step on the sharded cache works.

@@ -38,7 +38,12 @@ def test_forward_shapes(name):
     assert np.isfinite(np.asarray(logits, np.float32)).all()
 
 
-@pytest.mark.parametrize("name", FAMILIES)
+@pytest.mark.parametrize("name", [
+    "llama-test", "bloom-test",
+    # MoE twin — slow lane: the cache layout is llama's; the routed
+    # part is pinned quick by test_expert EP parity + hf_parity decode
+    pytest.param("mixtral-test", marks=pytest.mark.slow),
+])
 def test_kv_cache_decode_matches_full_prefill(name):
     """Prefill(N) then decode 1-by-1 must equal prefill(N+k) logits.
 

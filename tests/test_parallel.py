@@ -235,7 +235,10 @@ def test_grad_scaling_rule_at_4x4(pp, tp):
 
 
 @pytest.mark.parametrize("pp,tp", [
-    (2, 1), pytest.param(2, 2, marks=pytest.mark.slow), (4, 1)])
+    (2, 1), pytest.param(2, 2, marks=pytest.mark.slow),
+    # 4-stage twin — slow lane: deeper-pipeline middle stages stay
+    # quick via the 3-stage chaos/elastic loopbacks
+    pytest.param(4, 1, marks=pytest.mark.slow)])
 def test_pipeline_generate_matches_engine(pp, tp, devices):
     """SPMD circular-pipeline decode (ppermute ring + token lane) must
     reproduce the single-chip engine's greedy tokens for every microbatch

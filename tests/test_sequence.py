@@ -150,10 +150,13 @@ def test_sp_generate_rejects_bad_shapes(sp_mesh):
         gen(params, jnp.zeros((1, 32), jnp.int32), jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow
 def test_sp_generate_fp8_cache_matches_fp8_engine(sp_mesh):
     """Reduced-precision sequence-sharded cache: greedy output matches a
     single-device engine storing its cache in the same dtype (attention
-    reads what the cache stores, on both sides)."""
+    reads what the cache stores, on both sides).  Slow lane: the cross
+    of two quick-covered dimensions (sp greedy parity rep + fp8 cache
+    reps in test_kvcache/engine)."""
     from distributed_inference_demo_tpu.ops.sampling import SamplingParams
     from distributed_inference_demo_tpu.runtime import InferenceEngine
 

@@ -1,0 +1,316 @@
+"""Per-tenant SLO ledger, trace-id hygiene, and anomaly-layer edges.
+
+The ISSUE-16 unit layer (no engines, no sockets, no jax):
+
+- the timeline decomposition identity: for every closed record,
+  ``ttft + per_token*(tokens-1) + migration_pause == e2e`` exactly —
+  a timeline that doesn't add up is a measurement bug;
+- goodput judging: first token vs the TTFT SLO, decode tokens vs the
+  TPOT SLO, errored requests all-bad, thresholds unset == always good;
+- multi-window burn rates decaying under an injected clock;
+- the ``new_trace_id`` fork/seed regression (module-``random`` state
+  must not leak into trace ids);
+- ``TraceRecorder.drain()`` racing ``record()`` (satellite d): every
+  span exported exactly once, no crashes;
+- anomaly SLO detectors under missing/NaN samples, and the multiwindow
+  ``slo_burn`` detector;
+- postmortem bundles carrying ``timelines.jsonl``.
+"""
+
+import json
+import random
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_inference_demo_tpu.telemetry.anomaly import (
+    AnomalyDetector, Thresholds)
+from distributed_inference_demo_tpu.telemetry.postmortem import (
+    PostmortemWriter)
+from distributed_inference_demo_tpu.telemetry.slo import (
+    SloLedger, sanitize_tenant, set_slo_ledger)
+from distributed_inference_demo_tpu.telemetry.tracing import (
+    TraceRecorder, new_trace_id)
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture()
+def ledger():
+    """Fresh process-default ledger with known thresholds + clock;
+    restored after the test so engine tests see a clean default."""
+    clk = _Clock()
+    led = SloLedger(ttft_slo_ms=100.0, tpot_slo_ms=10.0, target=0.9,
+                    clock=clk)
+    led.clock = clk            # convenience handle for tests
+    set_slo_ledger(led)
+    yield led
+    set_slo_ledger(None)
+
+
+# ---------------------------------------------------------------------------
+# ledger math
+
+
+@pytest.mark.quick
+def test_timeline_decomposition_sums_exactly(ledger):
+    rec = ledger.close_request(
+        rid="r1", tenant="acme", trace_id=0xABCD, queue_wait_s=0.02,
+        ttft_s=0.08, e2e_s=1.30, tokens=12, migration_pause_s=0.25,
+        migrated=True, replica="engine:aa")
+    # the identity the module promises, exact by construction
+    lhs = (rec["ttft_s"] + rec["per_token_s"] * (rec["tokens"] - 1)
+           + rec["migration_pause_s"])
+    assert lhs == pytest.approx(rec["e2e_s"], abs=1e-12)
+    assert rec["prefill_s"] == pytest.approx(0.06)
+    assert rec["decode_s"] == pytest.approx(1.22)
+    assert rec["per_token_s"] == pytest.approx((1.30 - 0.08 - 0.25) / 11)
+    assert rec["tenant"] == "acme" and rec["migrated"] is True
+    assert rec["trace_id"] == f"{0xABCD:016x}"
+    # clamps: ttft >= queue_wait, e2e >= ttft + pause
+    rec2 = ledger.close_request(rid="r2", queue_wait_s=0.5, ttft_s=0.1,
+                                e2e_s=0.0, tokens=2,
+                                migration_pause_s=0.2)
+    assert rec2["ttft_s"] == 0.5
+    assert rec2["e2e_s"] == pytest.approx(0.7)
+    assert rec2["per_token_s"] == 0.0    # decode == pause: clamped to 0
+
+
+@pytest.mark.quick
+def test_goodput_judging_ttft_tpot_and_errors(ledger):
+    # fully good: ttft 50ms <= 100ms, per-token ~5ms <= 10ms
+    rec = ledger.close_request(rid="g", tenant="t", ttft_s=0.05,
+                               e2e_s=0.05 + 0.005 * 9, tokens=10)
+    assert rec["good_tokens"] == 10
+    # late first token: only the first token is bad
+    rec = ledger.close_request(rid="b1", tenant="t", ttft_s=0.5,
+                               e2e_s=0.5 + 0.005 * 9, tokens=10)
+    assert rec["good_tokens"] == 9
+    # slow decode: first token good, all decode tokens bad
+    rec = ledger.close_request(rid="b2", tenant="t", ttft_s=0.05,
+                               e2e_s=0.05 + 0.05 * 9, tokens=10)
+    assert rec["good_tokens"] == 1
+    # an errored request's tokens all count against the budget
+    rec = ledger.close_request(rid="err", tenant="t", ttft_s=0.05,
+                               e2e_s=0.1, tokens=10, error="Boom")
+    assert rec["good_tokens"] == 0 and rec["error"] == "Boom"
+    # migration pause is EXCLUDED from per-token judging: a 2s pause
+    # inside an otherwise-fast decode stays good
+    rec = ledger.close_request(rid="m", tenant="t", ttft_s=0.05,
+                               e2e_s=0.05 + 0.005 * 9 + 2.0, tokens=10,
+                               migration_pause_s=2.0, migrated=True)
+    assert rec["good_tokens"] == 10
+    # thresholds unset -> everything good
+    open_led = SloLedger(ttft_slo_ms=0, tpot_slo_ms=0, target=0.9)
+    rec = open_led.close_request(rid="x", ttft_s=9.0, e2e_s=99.0,
+                                 tokens=5)
+    assert rec["good_tokens"] == 5
+
+
+@pytest.mark.quick
+def test_burn_windows_decay_with_injected_clock(ledger):
+    clk = ledger.clock
+    # all-bad request: 10 tokens, every one violating (error)
+    ledger.close_request(rid="a", tenant="acme", ttft_s=0.05, e2e_s=0.1,
+                         tokens=10, error="X")
+    burn = ledger.burn_rates("acme")
+    # bad fraction 1.0 over budget (1 - 0.9) = burn 10.0 on both windows
+    assert burn["5m"] == pytest.approx(10.0)
+    assert burn["1h"] == pytest.approx(10.0)
+    # good traffic dilutes the fraction: 10 bad / 40 total = 0.25
+    for i in range(3):
+        ledger.close_request(rid=f"g{i}", tenant="acme", ttft_s=0.05,
+                             e2e_s=0.05 + 0.005 * 9, tokens=10)
+    burn = ledger.burn_rates("acme")
+    assert burn["5m"] == pytest.approx(2.5)
+    # past the 5m window the short burn clears, the 1h one remembers
+    clk.t += 301.0
+    burn = ledger.burn_rates("acme")
+    assert burn["5m"] == 0.0
+    assert burn["1h"] == pytest.approx(2.5)
+    # past the 1h window everything decays
+    clk.t += 3600.0
+    burn = ledger.burn_rates("acme")
+    assert burn == {"5m": 0.0, "1h": 0.0}
+    # summary carries the same numbers for /stats + the anomaly layer
+    s = ledger.summary()
+    assert s["tenants"]["acme"]["requests"] == 4
+    assert s["tenants"]["acme"]["goodput_ratio"] == pytest.approx(0.75)
+    assert s["tenants"]["acme"]["burn"] == {"5m": 0.0, "1h": 0.0}
+    assert s["slo"]["ttft_ms"] == 100.0
+
+
+@pytest.mark.quick
+def test_sanitize_tenant_clamps_untrusted_identities():
+    assert sanitize_tenant(None) == "default"
+    assert sanitize_tenant("") == "default"
+    assert sanitize_tenant("  ") == "default"
+    assert sanitize_tenant("acme-prod") == "acme-prod"
+    assert sanitize_tenant("team@org/svc:a.b") == "team@org/svc:a.b"
+    assert sanitize_tenant('ev"il\n{label}') == "ev_il__label_"
+    assert len(sanitize_tenant("x" * 500)) == 64
+
+
+# ---------------------------------------------------------------------------
+# trace-id hygiene (satellite a)
+
+
+@pytest.mark.quick
+def test_new_trace_id_ignores_module_random_seed():
+    """The unseeded-module-random-survives-fork regression: two
+    processes forked after import used to share ``random``'s state and
+    mint identical id sequences.  Seeding the module RNG to the same
+    state twice is the in-process equivalent — ids must still differ
+    (SystemRandom reads the kernel CSPRNG, not Python state)."""
+    random.seed(42)
+    a = new_trace_id()
+    random.seed(42)
+    b = new_trace_id()
+    assert a != b
+    assert a & 1 and b & 1                  # nonzero guarantee
+    # span-id bases are fork-safe for the same reason
+    random.seed(42)
+    r1 = TraceRecorder("p")
+    random.seed(42)
+    r2 = TraceRecorder("p")
+    assert r1.next_span_id() != r2.next_span_id()
+
+
+@pytest.mark.quick
+def test_trace_recorder_drain_races_record():
+    """Satellite d: concurrent ``record()`` while another thread
+    ``drain()``s — every span lands in exactly one drain, none lost,
+    none duplicated, no exception."""
+    rec = TraceRecorder("race", max_spans=100_000)
+    n_threads, per_thread = 4, 500
+    drained = []
+    stop = threading.Event()
+
+    def writer(base):
+        for i in range(per_thread):
+            rec.record("s", trace_id=1, idx=base + i)
+
+    def drainer():
+        while not stop.is_set():
+            drained.extend(rec.drain())
+        drained.extend(rec.drain())
+
+    threads = [threading.Thread(target=writer, args=(t * per_thread,))
+               for t in range(n_threads)]
+    dt = threading.Thread(target=drainer)
+    dt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    dt.join()
+    drained.extend(rec.drain())
+    seen = sorted(s["args"]["idx"] for s in drained)
+    assert seen == list(range(n_threads * per_thread))
+
+
+# ---------------------------------------------------------------------------
+# anomaly-layer edges (satellite d)
+
+
+def _lat_stats(**lat):
+    return {"latency": lat, "queue_depth": 0, "steps": 0}
+
+
+@pytest.mark.quick
+def test_slo_detector_missing_and_nan_samples_restart_the_streak():
+    """A missing or NaN p95 is 'no data': it can never FIRE the SLO
+    detector, and it restarts the sustain streak (sustain means
+    CONSECUTIVE breaches) — two old breaches plus a later noisy sample
+    must not add up to a firing."""
+    det = AnomalyDetector(Thresholds(ttft_slo_ms=100.0, sustain=3,
+                                     cooldown_s=0.0), clock=_Clock())
+    breach = _lat_stats(ttft_p95_ms=500.0)
+    assert det.observe(breach) == []
+    assert det.observe(breach) == []
+    # gap: the reservoir reset and the key vanished
+    assert det.observe(_lat_stats()) == []
+    assert det.observe(breach) == []          # streak restarted at 1
+    assert det.observe(breach) == []
+    out = det.observe(breach)                 # 3 consecutive: fires
+    assert [a.kind for a in out] == ["slo_ttft"]
+    # NaN behaves exactly like missing: never fires, restarts streak
+    det2 = AnomalyDetector(Thresholds(tpot_slo_ms=10.0, sustain=2,
+                                      cooldown_s=0.0), clock=_Clock())
+    nan = _lat_stats(per_token_p95_ms=float("nan"))
+    assert det2.observe(nan) == []
+    assert det2.observe(_lat_stats(per_token_p95_ms=50.0)) == []
+    assert det2.observe(nan) == []            # breach streak reset
+    assert det2.observe(_lat_stats(per_token_p95_ms=50.0)) == []
+    out = det2.observe(_lat_stats(per_token_p95_ms=50.0))
+    assert [a.kind for a in out] == ["slo_tpot"]
+
+
+@pytest.mark.quick
+def test_slo_burn_detector_needs_every_window_hot():
+    """Multiwindow burn alerting: the ``slo_burn`` detector fires only
+    when EVERY window breaches (5m blip alone or long-recovered 1h
+    alone stay quiet), keyed per tenant, NaN windows unusable."""
+    det = AnomalyDetector(Thresholds(burn_rate=2.0, sustain=2,
+                                     cooldown_s=0.0), clock=_Clock())
+
+    def stats(burns):
+        return {"latency": {}, "queue_depth": 0, "steps": 0,
+                "slo": {"tenants": {
+                    t: {"burn": b} for t, b in burns.items()}}}
+
+    hot = {"5m": 3.0, "1h": 2.5}
+    assert det.observe(stats({"acme": hot})) == []
+    out = det.observe(stats({"acme": hot}))
+    assert [a.kind for a in out] == ["slo_burn"]
+    assert out[0].detail["tenant"] == "acme"
+    # short-window blip alone: never fires, clears acme's streak
+    blip = {"5m": 9.0, "1h": 0.1}
+    assert det.observe(stats({"acme": blip})) == []
+    assert det.observe(stats({"acme": blip})) == []
+    # per-tenant keying: one hot tenant can't borrow another's streak
+    assert det.observe(stats({"acme": hot, "beta": hot})) == []
+    out = det.observe(stats({"beta": hot}))
+    assert [(a.kind, a.detail["tenant"]) for a in out] == [
+        ("slo_burn", "beta")]
+    # NaN window: unusable sample, no fire
+    det2 = AnomalyDetector(Thresholds(burn_rate=2.0, sustain=1,
+                                      cooldown_s=0.0), clock=_Clock())
+    assert det2.observe(stats(
+        {"acme": {"5m": float("nan"), "1h": 9.0}})) == []
+    # threshold 0 (default) disables the detector entirely
+    det3 = AnomalyDetector(Thresholds(sustain=1, cooldown_s=0.0),
+                           clock=_Clock())
+    assert det3.observe(stats({"acme": {"5m": 99.0, "1h": 99.0}})) == []
+
+
+# ---------------------------------------------------------------------------
+# postmortem bundles carry timelines (tentpole seam)
+
+
+@pytest.mark.quick
+def test_postmortem_bundle_includes_timelines_jsonl(tmp_path, ledger):
+    ledger.close_request(rid="pm1", tenant="acme", ttft_s=0.05,
+                         e2e_s=0.2, tokens=4, migration_pause_s=0.01,
+                         migrated=True)
+    w = PostmortemWriter(str(tmp_path), proc="test")
+    bundle = w.write_bundle("test_reason")
+    assert bundle is not None
+    lines = (Path(bundle) / "timelines.jsonl").read_text().splitlines()
+    recs = [json.loads(ln) for ln in lines]
+    assert any(r["rid"] == "pm1" and r["migrated"] for r in recs)
+    lhs = (recs[-1]["ttft_s"]
+           + recs[-1]["per_token_s"] * (recs[-1]["tokens"] - 1)
+           + recs[-1]["migration_pause_s"])
+    assert lhs == pytest.approx(recs[-1]["e2e_s"], abs=1e-9)

@@ -15,7 +15,13 @@ time):
    ``metrics.Metric`` — re-checked here so a future constructor bypass
    still fails the lint);
 4. counters end in ``_total``; non-counters must NOT (the Prometheus
-   convention scrapers and recording rules rely on).
+   convention scrapers and recording rules rely on);
+5. label sets are linted too: label names come from a known vocabulary
+   (a typo'd label forks a series family no dashboard joins), the
+   fleet-plane families' label sets are pinned exactly
+   (``tenant=``/``replica=`` must stay catalog-declared), and
+   ``replica`` is reserved for the ``/metrics/fleet`` relabeler on
+   non-gateway series.
 
 Run standalone (``python tools/check_metrics_names.py``, exit 1 on
 violations) or via the tier-1 suite (``tests/test_metrics_names.py``).
@@ -28,7 +34,8 @@ from typing import List
 
 SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
               "engine", "control", "anomaly", "flight", "kvcache",
-              "transport", "fault", "disagg", "gateway", "migration"}
+              "transport", "fault", "disagg", "gateway", "migration",
+              "slo"}
 
 # unit suffixes a metric name may end with (after stripping ``_total``).
 # Plain-count units (requests, tokens, ...) double as the unit for
@@ -38,7 +45,50 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
          "info", "events", "bundles", "blocks", "nodes",
          "retries", "reconnects", "frames", "faults", "dispatches",
-         "pages", "replicas"}
+         "pages", "replicas", "scrapes"}
+
+# label names any series may declare.  The label VOCABULARY is linted
+# like the name vocabulary: a typo'd label ("tenent", "repilca") would
+# silently fork a series family that no dashboard joins, which is worse
+# than a crash.  Extend deliberately, with the catalog.
+KNOWN_LABELS = {"role", "device", "route", "code", "kind", "engine",
+                "peer", "replica", "dtype", "tenant", "window"}
+
+# series whose label SET is pinned exactly — the fleet-plane families
+# whose labels dashboards and the federation relabeler join on.  A
+# tenant series silently losing its tenant label (or growing a stray
+# one) would still render, still scrape, and aggregate every tenant
+# into one line — this lint makes that drift a tier-1 failure.
+REQUIRED_LABELS = {
+    "dwt_slo_ttft_seconds": ("tenant",),
+    "dwt_slo_queue_wait_seconds": ("tenant",),
+    "dwt_slo_per_token_seconds": ("tenant",),
+    "dwt_slo_e2e_seconds": ("tenant",),
+    "dwt_slo_migration_pause_seconds": ("tenant",),
+    "dwt_slo_requests_total": ("tenant",),
+    "dwt_slo_failed_requests_total": ("tenant",),
+    "dwt_slo_tokens_total": ("tenant",),
+    "dwt_slo_good_tokens_total": ("tenant",),
+    "dwt_slo_good_ttft_requests_total": ("tenant",),
+    "dwt_slo_migrated_requests_total": ("tenant",),
+    "dwt_slo_burn_rate_ratio": ("tenant", "window"),
+    "dwt_gateway_fleet_scrapes_total": ("replica",),
+    "dwt_gateway_fleet_failed_scrapes_total": ("replica",),
+    "dwt_gateway_fleet_scrape_age_seconds": ("replica",),
+    "dwt_gateway_prefix_hit_ratio": ("replica",),
+    "dwt_gateway_index_entries": ("replica",),
+    "dwt_gateway_queue_depth_requests": ("replica",),
+    "dwt_anomaly_events_total": ("kind",),
+    "dwt_anomaly_last_seconds": ("kind",),
+}
+
+# label names reserved for the federation relabeler: GET /metrics/fleet
+# injects replica="<rid>" into every replica-exported sample, so a
+# REPLICA-side series already carrying the label would collide with the
+# injected one (Prometheus rejects duplicate label names in a sample).
+# Gateway-side series (dwt_gateway_*) legitimately declare it — they
+# are emitted by the gateway's own registry, never relabeled.
+FEDERATION_RESERVED_LABELS = {"replica"}
 
 # exact names exempted from the unit-suffix rule — each entry is a
 # deliberate, documented exception (NOT a new unit: adding a pseudo-unit
@@ -147,6 +197,22 @@ REQUIRED_SERIES = {
     "dwt_migration_moved_bytes_total",
     "dwt_migration_handoff_seconds",
     "dwt_migration_inflight_requests",
+    # the fleet observability plane (docs/DESIGN.md §7): per-tenant SLO
+    # accounting absent from a scrape is indistinguishable from "no
+    # tenant ever violated its SLO", and the federation counters absent
+    # would make a dead replica's section silently vanish from
+    # /metrics/fleet with nothing left to alert on
+    "dwt_slo_requests_total",
+    "dwt_slo_tokens_total",
+    "dwt_slo_good_tokens_total",
+    "dwt_slo_ttft_seconds",
+    "dwt_slo_per_token_seconds",
+    "dwt_slo_e2e_seconds",
+    "dwt_slo_migration_pause_seconds",
+    "dwt_slo_burn_rate_ratio",
+    "dwt_gateway_fleet_scrapes_total",
+    "dwt_gateway_fleet_failed_scrapes_total",
+    "dwt_gateway_fleet_scrape_age_seconds",
 }
 
 
@@ -181,6 +247,26 @@ def check_registry(registry) -> List[str]:
                 and name not in UNIT_SUFFIX_EXEMPT):
             problems.append(
                 f"{name}: missing unit suffix (allowed: {sorted(UNITS)})")
+        # label-set lint: vocabulary, pinned sets, federation reserve
+        labels = tuple(getattr(m, "label_names", ()) or ())
+        for lab in labels:
+            if lab not in KNOWN_LABELS:
+                problems.append(
+                    f"{name}: unknown label {lab!r} (known: "
+                    f"{sorted(KNOWN_LABELS)})")
+        want = REQUIRED_LABELS.get(name)
+        if want is not None and tuple(sorted(labels)) != tuple(
+                sorted(want)):
+            problems.append(
+                f"{name}: label set {sorted(labels)} must be exactly "
+                f"{sorted(want)}")
+        if (parts[1] != "gateway"
+                and FEDERATION_RESERVED_LABELS & set(labels)):
+            problems.append(
+                f"{name}: label(s) "
+                f"{sorted(FEDERATION_RESERVED_LABELS & set(labels))} are "
+                "reserved for the /metrics/fleet relabeler (replica-side "
+                "series must not pre-declare them)")
     return problems
 
 

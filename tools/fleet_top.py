@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Live fleet SLO view over the gateway's federated metrics page.
+
+``fleet_top`` is ``top(1)`` for the serving fleet: it polls the
+gateway's ``GET /metrics/fleet`` (every replica's ``/metrics``
+re-labeled with ``replica=`` and merged — see
+``runtime/gateway/federation.py``) and renders one row per
+tenant x replica:
+
+- request / token counts and the goodput ratio (tokens inside the
+  TTFT/TPOT SLO vs total, from ``dwt_slo_good_tokens_total`` /
+  ``dwt_slo_tokens_total``);
+- error-budget burn rates per window (``dwt_slo_burn_rate_ratio``,
+  5m and 1h — both > 1.0 means the budget is burning faster than it
+  refills);
+- TTFT p95 estimated from the ``dwt_slo_ttft_seconds`` histogram
+  buckets (upper-bound of the bucket crossing the 95th percentile);
+- migrated-request counts, plus each replica's scrape age so a stale
+  section is visible as staleness, not as a frozen tenant.
+
+Stdlib only (urllib + ANSI), same constraint as every ``tools/``
+script.  ``--once`` prints a single snapshot and exits — the mode the
+tests (and cron jobs) use; without it the screen redraws every
+``--interval`` seconds until Ctrl-C.
+
+Usage::
+
+    python tools/fleet_top.py --gateway 127.0.0.1:8100
+    python tools/fleet_top.py --gateway 127.0.0.1:8100 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Tuple
+
+_LABEL_RE = re.compile(r'(\w+)="((?:\\.|[^"\\])*)"')
+_UNESCAPE = {"\\\\": "\\", '\\"': '"', "\\n": "\n"}
+
+
+def _unescape(v: str) -> str:
+    return re.sub(r'\\[\\"n]', lambda m: _UNESCAPE[m.group(0)], v)
+
+
+def parse_metrics(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Exposition text → ``[(name, labels, value), ...]`` samples."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        brace = line.find("{")
+        if brace != -1:
+            close = line.rfind("}")
+            if close == -1:
+                continue
+            name = line[:brace]
+            labels = {k: _unescape(v) for k, v in
+                      _LABEL_RE.findall(line[brace + 1:close])}
+            rest = line[close + 1:].strip()
+        else:
+            parts = line.split(None, 1)
+            if len(parts) != 2:
+                continue
+            name, rest = parts[0], parts[1]
+            labels = {}
+        try:
+            value = float(rest.split()[0])
+        except (ValueError, IndexError):
+            continue
+        out.append((name, labels, value))
+    return out
+
+
+def _hist_p95(buckets: Dict[float, float]) -> float:
+    """p95 upper-bound from cumulative ``le`` buckets (NaN when empty)."""
+    if not buckets:
+        return float("nan")
+    les = sorted(buckets)
+    total = buckets[les[-1]]
+    if total <= 0:
+        return float("nan")
+    want = 0.95 * total
+    for le in les:
+        if buckets[le] >= want:
+            return le
+    return les[-1]
+
+
+def fleet_rows(samples) -> List[dict]:
+    """Samples → one row dict per (tenant, replica), sorted."""
+    rows: Dict[Tuple[str, str], dict] = {}
+    ttft_buckets: Dict[Tuple[str, str], Dict[float, float]] = {}
+
+    def row(labels: dict) -> dict:
+        key = (labels.get("tenant", "?"), labels.get("replica", "-"))
+        return rows.setdefault(key, {
+            "tenant": key[0], "replica": key[1], "requests": 0.0,
+            "failed": 0.0, "migrated": 0.0, "tokens": 0.0,
+            "good_tokens": 0.0, "burn": {}, "ttft_p95_s": float("nan")})
+
+    simple = {"dwt_slo_requests_total": "requests",
+              "dwt_slo_failed_requests_total": "failed",
+              "dwt_slo_migrated_requests_total": "migrated",
+              "dwt_slo_tokens_total": "tokens",
+              "dwt_slo_good_tokens_total": "good_tokens"}
+    for name, labels, value in samples:
+        if name in simple:
+            row(labels)[simple[name]] += value
+        elif name == "dwt_slo_burn_rate_ratio":
+            row(labels)["burn"][labels.get("window", "?")] = value
+        elif name == "dwt_slo_ttft_seconds_bucket":
+            key = (labels.get("tenant", "?"), labels.get("replica", "-"))
+            try:
+                le = float(labels.get("le", "inf").replace("+Inf", "inf"))
+            except ValueError:
+                continue
+            ttft_buckets.setdefault(key, {})[le] = value
+    for key, buckets in ttft_buckets.items():
+        if key in rows:
+            rows[key]["ttft_p95_s"] = _hist_p95(buckets)
+    for r in rows.values():
+        r["goodput"] = (r["good_tokens"] / r["tokens"]
+                        if r["tokens"] > 0 else float("nan"))
+    return [rows[k] for k in sorted(rows)]
+
+
+def scrape_ages(samples) -> Dict[str, float]:
+    return {labels.get("replica", "?"): value
+            for name, labels, value in samples
+            if name == "dwt_gateway_fleet_scrape_age_seconds"}
+
+
+def _fmt(v: float, pct: bool = False) -> str:
+    if v != v:                       # NaN
+        return "-"
+    return f"{100 * v:.1f}%" if pct else f"{v:.2f}"
+
+
+def render(rows: List[dict], ages: Dict[str, float]) -> str:
+    hdr = (f"{'TENANT':<16} {'REPLICA':<22} {'REQS':>6} {'FAIL':>5} "
+           f"{'MIGR':>5} {'TOKENS':>8} {'GOODPUT':>8} {'BURN5m':>7} "
+           f"{'BURN1h':>7} {'TTFTp95':>8}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in rows:
+        burn = r["burn"]
+        lines.append(
+            f"{r['tenant']:<16.16} {r['replica']:<22.22} "
+            f"{int(r['requests']):>6} {int(r['failed']):>5} "
+            f"{int(r['migrated']):>5} {int(r['tokens']):>8} "
+            f"{_fmt(r['goodput'], pct=True):>8} "
+            f"{_fmt(burn.get('5m', float('nan'))):>7} "
+            f"{_fmt(burn.get('1h', float('nan'))):>7} "
+            f"{_fmt(r['ttft_p95_s']):>7}s")
+    if ages:
+        lines.append("")
+        lines.append("scrape age: " + "  ".join(
+            f"{rid}={age:.1f}s" for rid, age in sorted(ages.items())))
+    return "\n".join(lines)
+
+
+def fetch(base: str, path: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(f"http://{base}{path}",
+                                timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--gateway", required=True,
+                    help="gateway host:port (e.g. 127.0.0.1:8100)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no ANSI)")
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            text = fetch(args.gateway, "/metrics/fleet")
+        except Exception as e:
+            print(f"fleet_top: cannot scrape {args.gateway}: {e}",
+                  file=sys.stderr)
+            return 1
+        samples = parse_metrics(text)
+        page = render(fleet_rows(samples), scrape_ages(samples))
+        if args.once:
+            print(page)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + page + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -309,6 +309,7 @@ def micro_prepass(artifact: dict, path: Path, legs, params) -> int:
               f"{json.dumps(result)[:160]}", flush=True)
         if not ok and "timed out" in str(result.get("error", "")):
             wedged = True
+            wedged_leg, wedged_result = leg, result
             break
     n = sum(micro_done(artifact, l) for l in legs)
     commit(path, f"Bench artifact: micro prepass "
@@ -316,8 +317,35 @@ def micro_prepass(artifact: dict, path: Path, legs, params) -> int:
     if wedged:
         print("measure_session: micro leg timeout -> assuming wedge; "
               "stopping", flush=True)
+        dump_wedge_bundle(wedged_leg, wedged_result, MICRO_BUDGET)
         return 3
     return 0
+
+
+def dump_wedge_bundle(leg: str, result: dict, budget: float) -> None:
+    """A bench-leg timeout IS an incident: dump a postmortem bundle
+    (flight ring, metrics snapshot, recent SLO timelines — see
+    telemetry/postmortem.py) so the wedge window is diagnosable after
+    the watcher moves on.  Best-effort: the bundle must never turn a
+    timeout exit into a crash exit.  ``DWT_POSTMORTEM_DIR`` wins when
+    set; otherwise bundles land under ``postmortems/`` in the repo."""
+    try:
+        from distributed_inference_demo_tpu.telemetry.postmortem import (
+            PostmortemWriter)
+        out_dir = os.environ.get("DWT_POSTMORTEM_DIR") or str(
+            REPO / "postmortems")
+        writer = PostmortemWriter(out_dir, proc="measure_session")
+        bundle = writer.write_bundle(
+            "bench_leg_timeout",
+            detail={"leg": leg, "budget_s": budget,
+                    "error": str(result.get("error", ""))[:512],
+                    "leg_seconds": result.get("leg_seconds")})
+        if bundle:
+            print(f"measure_session: wedge postmortem bundle at "
+                  f"{bundle}", flush=True)
+    except Exception as e:
+        print(f"measure_session: postmortem bundle failed: {e}",
+              flush=True)
 
 
 def commit(path: Path, msg: str) -> bool:
@@ -425,6 +453,7 @@ def main():
             # let the watcher re-probe rather than burning every budget
             print("measure_session: leg timeout -> assuming wedge; "
                   "stopping", flush=True)
+            dump_wedge_bundle(leg, result, budget)
             return 3
     artifact = load_artifact(path)
     remaining = [l for l in legs if not leg_done(artifact, l)
