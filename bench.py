@@ -2781,6 +2781,35 @@ def micro_shape(p: dict) -> dict:
                 new_tokens=min(p["new_tokens"], 8))
 
 
+# headline-order legs that stamp the §20 cost-observatory block into
+# their artifact (BENCH_SELF r06+): per-signature p50/p95 from the
+# sampled dispatch profiler plus the compile ledger.  Each leg runs in
+# a fresh subprocess (_spawn_leg), so the process-global observatory
+# snapshot IS that leg's own dispatches — no cross-leg bleed.
+_PROFILED_LEGS = {"headline", "headline_int8", "flagship_bf16",
+                  "flagship_int8", "decode_fused", "batching",
+                  "mixed_batching"}
+
+
+def _dispatch_profile_extras() -> dict:
+    """The ``dispatch_profile`` artifact block: per-signature p50/p95
+    (+ achieved GB/s where attributed) and compile counts, from this
+    process's cost observatory.  Empty dict when nothing was profiled
+    (DWT_PROFILE_SAMPLE_N=0, or a leg that never dispatched a tracked
+    program) — the block is then omitted rather than stamped hollow."""
+    try:
+        from distributed_inference_demo_tpu.telemetry import profiling
+        prof = profiling.get_profiler()
+        sigs = prof.snapshot()
+        comp = profiling.get_compile_tracker().snapshot()
+    except Exception:
+        return {}
+    if not sigs and not comp:
+        return {}
+    return {"sample_n": prof.sample_n, "signatures": sigs,
+            "compile": comp}
+
+
 def run_leg(name: str, p: dict, micro: bool = False) -> dict:
     if micro:
         p = micro_shape(p)
@@ -2917,6 +2946,10 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
             raise SystemExit(f"unknown leg {name!r}")
     except Exception as e:         # structured error, not a dead process
         out = {"error": f"{type(e).__name__}: {e}"}
+    if name in _PROFILED_LEGS and "error" not in out:
+        dp = _dispatch_profile_extras()
+        if dp:
+            out["dispatch_profile"] = dp
     if micro:
         # stamped so a micro number can never masquerade as a
         # full-budget measurement in the artifact

@@ -11,13 +11,18 @@ emitting TPU mesh axes per stage.
 """
 
 from .cost_model import LayerCost, ModelCostProfile, model_cost_profile
-from .planner import (DeviceProfile, PartitionPlan, PlanError,
-                      plan_partition, round_robin_plan, load_cached_plan,
-                      save_plan_cache)
+from .planner import (SKETCH_REQUIRED_KEYS, SKETCH_SCHEMA_VERSION,
+                      DeviceProfile, PartitionPlan, PlanError, SketchError,
+                      WorkloadSketch, load_cached_plan,
+                      load_workload_sketch, plan_from_sketch,
+                      plan_partition, round_robin_plan, save_plan_cache)
 
 __all__ = [
     "LayerCost", "ModelCostProfile", "model_cost_profile",
     "DeviceProfile", "PartitionPlan", "PlanError",
     "plan_partition", "round_robin_plan",
     "load_cached_plan", "save_plan_cache",
+    "SKETCH_SCHEMA_VERSION", "SKETCH_REQUIRED_KEYS",
+    "SketchError", "WorkloadSketch",
+    "load_workload_sketch", "plan_from_sketch",
 ]

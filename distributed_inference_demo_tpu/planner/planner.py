@@ -256,6 +256,131 @@ def round_robin_plan(cfg: ModelConfig, model_name: str,
                          est_bottleneck_sec=0.0, plan_version=plan_version)
 
 
+# -- workload sketch input (telemetry/profiling.py, docs/DESIGN.md §20) ------
+
+#: pinned with ``telemetry.profiling.SKETCH_SCHEMA_VERSION`` by
+#: ``tools/check_sketch_schema.py`` — bump BOTH together.  Deliberately a
+#: LITERAL copy, not an import: the planner parses committed sketch
+#: artifacts without loading the serving stack.
+SKETCH_SCHEMA_VERSION = 1
+
+#: top-level keys every consumable artifact carries (same lint pins the
+#: recorder's copy; ``load_workload_sketch`` enforces presence).
+SKETCH_REQUIRED_KEYS = ("schema_version", "window_s", "requests",
+                        "tenants", "prompt_tokens", "decode_tokens",
+                        "interarrival_s", "prefix_hit")
+
+
+class SketchError(ValueError):
+    """A workload-sketch artifact the planner refuses to consume."""
+
+
+def _hist_percentile(frag: dict, p: float) -> float:
+    """Planner-side mirror of the recorder's fixed-edge histogram read:
+    the upper edge of the bucket holding the p-quantile (conservative);
+    the overflow bin reports the max seen."""
+    edges = frag.get("edges") or []
+    counts = [int(c) for c in (frag.get("counts") or [])]
+    total = sum(counts)
+    if not total:
+        return 0.0
+    target = p * total
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= target:
+            return (float(edges[i]) if i < len(edges)
+                    else float(frag.get("max", 0.0)))
+    return float(frag.get("max", 0.0))
+
+
+@dataclass(frozen=True)
+class WorkloadSketch:
+    """Planner view of one measured workload (the §20 sketch artifact):
+    exactly the knobs ROADMAP item 3 names — ctx length, arrival rate,
+    prefix share — distilled from the recorder's histograms."""
+
+    requests: int
+    window_s: float
+    arrival_rate: float            # requests/sec over the window (0 = n/a)
+    prompt_p50: float
+    prompt_p95: float
+    decode_p50: float
+    decode_p95: float
+    prefix_share: float
+    tenants: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def ctx_tokens(self) -> int:
+        """Context budget a plan should assume: p95 prompt + p95 decode
+        (conservative bucket-edge reads, so a plan sized from this never
+        under-reserves KV for the sketched traffic)."""
+        return int(self.prompt_p95 + self.decode_p95)
+
+
+def load_workload_sketch(src) -> WorkloadSketch:
+    """Parse a sketch artifact into the planner's workload input.
+
+    ``src``: a dict (already-parsed artifact), a JSON string, or a path
+    to a JSON file (``tools/sketch.py`` writes both forms).  Raises
+    :class:`SketchError` on a schema-version mismatch or missing keys —
+    a mis-sized plan must fail loudly at planning time."""
+    obj = src
+    if isinstance(obj, str):
+        if obj.lstrip().startswith("{"):
+            obj = json.loads(obj)
+        else:
+            with open(obj) as f:
+                obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise SketchError(f"sketch artifact must be a JSON object, "
+                          f"got {type(obj).__name__}")
+    if obj.get("schema_version") != SKETCH_SCHEMA_VERSION:
+        raise SketchError(
+            f"sketch schema_version {obj.get('schema_version')!r} != "
+            f"planner's pinned {SKETCH_SCHEMA_VERSION} — regenerate the "
+            "artifact (or update BOTH pinned versions together)")
+    missing = [k for k in SKETCH_REQUIRED_KEYS if k not in obj]
+    if missing:
+        raise SketchError(f"sketch artifact missing keys: {missing}")
+    window = float(obj["window_s"])
+    requests = int(obj["requests"])
+    prefix = obj["prefix_hit"] or {}
+    return WorkloadSketch(
+        requests=requests,
+        window_s=window,
+        arrival_rate=(requests / window if window > 0 else 0.0),
+        prompt_p50=_hist_percentile(obj["prompt_tokens"], 0.50),
+        prompt_p95=_hist_percentile(obj["prompt_tokens"], 0.95),
+        decode_p50=_hist_percentile(obj["decode_tokens"], 0.50),
+        decode_p95=_hist_percentile(obj["decode_tokens"], 0.95),
+        prefix_share=float(prefix.get("share", 0.0)),
+        tenants={str(k): int(v)
+                 for k, v in (obj.get("tenants") or {}).items()})
+
+
+def plan_from_sketch(cfg: ModelConfig, model_name: str,
+                     devices: Sequence[DeviceProfile], sketch,
+                     batch: int = 1,
+                     profile: Optional[ModelCostProfile] = None,
+                     plan_version: int = 0) -> PartitionPlan:
+    """:func:`plan_partition` driven by a measured workload sketch
+    instead of a hand-picked ctx: the context budget is the sketch's
+    p95 prompt + p95 decode (clamped to the model's window), discounted
+    by the measured prefix-hit share — shared prefixes don't re-prefill,
+    so the KV feasibility constraint should not charge them twice."""
+    if not isinstance(sketch, WorkloadSketch):
+        sketch = load_workload_sketch(sketch)
+    ctx = sketch.ctx_tokens or min(cfg.max_seq_len, 1024)
+    # prefix-shared tokens are resident once per tree, not once per
+    # request: discount the per-request ctx the memory constraint sees
+    ctx = int(ctx - sketch.prompt_p95 * min(1.0, max(0.0,
+                                                     sketch.prefix_share)))
+    ctx = max(1, min(cfg.max_seq_len, ctx))
+    return plan_partition(cfg, model_name, devices, batch=batch, ctx=ctx,
+                          profile=profile, plan_version=plan_version)
+
+
 # -- plan caching (reference ip_module.json/session.json, server.py:805-820)
 
 def save_plan_cache(path: str, plan: PartitionPlan) -> None:

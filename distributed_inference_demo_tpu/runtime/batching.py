@@ -75,6 +75,7 @@ from ..models.base import (KVCache, ModelConfig, StageParams,
                            StageSpec, pad_cache_capacity)
 from ..ops.sampling import SamplingParams, filtered_logits, sample_logits
 from ..telemetry import postmortem
+from ..telemetry import profiling as _profiling
 from ..telemetry.anomaly import AnomalyMonitor
 from ..telemetry.flightrecorder import get_flight_recorder
 from ..telemetry.slo import get_slo_ledger, sanitize_tenant
@@ -593,9 +594,21 @@ class ContinuousBatchingEngine:
             lp = _emitted_logprob(last, tok)
             return cache.keys, cache.values, tok[0], lp[0]
 
-        self._paged_prefill = paged_prefill
-        self._paged_step = paged_step
-        self._paged_multi_step = paged_multi_step
+        # cost observatory (docs/DESIGN.md §20): every jitted program
+        # class is wrapped for compile accounting at its assignment
+        # site — cache growth across a call books one compile event.
+        # Variant budgets document the compiled-variant invariants
+        # (multi_step: the two round-count variants the warmup loop
+        # pre-compiles); unbudgeted programs legitimately fork per
+        # bucket/chunk shape and never feed recompile_storm.
+        _ct = _profiling.get_compile_tracker()
+        self._paged_chunk_mid = _ct.wrap("paged_chunk_mid",
+                                         self._paged_chunk_mid)
+        self._paged_prefill = _ct.wrap("paged_prefill", paged_prefill)
+        self._paged_step = _ct.wrap("paged_step", paged_step)
+        self._paged_multi_step = _ct.wrap("paged_multi_step",
+                                          paged_multi_step,
+                                          variant_budget=2)
         self._set_slot_state = set_slot_state
 
         # ------------------------------------------------------------------
@@ -675,7 +688,10 @@ class ContinuousBatchingEngine:
                 return (cache.keys, cache.values, lengths, tok,
                         final_toks, final_lps, toks, lps, steps)
 
-            self._mixed_step = mixed_step
+            # the §19 invariant the recompile_storm detector enforces:
+            # with_finals x one static num_steps = exactly two variants
+            self._mixed_step = _ct.wrap("mixed_step", mixed_step,
+                                        variant_budget=2)
 
         def verify_slots(params, cache, drafts, q_logits, lengths,
                          last_tok, active, rng):
@@ -1017,6 +1033,20 @@ class ContinuousBatchingEngine:
             "max_seq": self.max_seq, "decode_block": decode_block,
             "prefill_chunk": prefill_chunk,
             "mixed_token_budget": self.mixed_token_budget})
+        # cost observatory handles (docs/DESIGN.md §20): the sampled
+        # dispatch profiler (off-path free: an unsampled dispatch is
+        # one dict increment, zero added syncs), the HBM watermark
+        # ledger (this engine's owners reset on close()), and the
+        # workload sketch recorder feeding GET /sketch
+        self._prof = _profiling.get_profiler()
+        self._sketch = _profiling.get_sketch()
+        self._hbm = _profiling.get_hbm_watermarks()
+        self._hbm_owners: set = set()
+        # per-token KV byte attribution for achieved-GB/s: K+V over all
+        # layers incl. the quantized sidecar, via the pool's block
+        # accounting (the one-owner ops/quant.py math)
+        self._kv_bytes_per_token = max(
+            1, self.kv_cache.block_bytes // self.kv_cache.block_tokens)
         self._running = True
         # serializes submit() against close(): no request can be enqueued
         # after close() returns, so none can slip past the shutdown drain
@@ -1092,6 +1122,11 @@ class ContinuousBatchingEngine:
                 raise RuntimeError("engine is closed")
             self._by_rid[req.rid] = req
             self._queue.put(req)
+        # workload sketch: admitted arrivals only (shed requests above
+        # never became workload); t_submit doubles as the interarrival
+        # clock so the sketch is a pure fold over the request trace
+        self._sketch.record_request(len(prompt), tenant=req.tenant,
+                                    now=req.t_submit)
         return req
 
     def submit_premigrated(self, prompt_ids, max_new_tokens: int,
@@ -1167,11 +1202,17 @@ class ContinuousBatchingEngine:
             req._pkv_blocked = (mgr.epoch, mgr.free_blocks)
             raise _BlocksExhausted()
         from .kvcache.device import adopt_blocks_into_pages
+        bt = mgr.block_tokens
+        _sig = _profiling.dispatch_signature(
+            "disagg_adopt", batch=n, chunk=bt,
+            kv_dtype=self.kv_cache.kv_dtype)
+        _t0 = self._prof.begin(_sig)
         self._pk, self._pv = adopt_blocks_into_pages(
             self._pk, self._pv, jax.tree.map(jnp.asarray, st["k"]),
             jax.tree.map(jnp.asarray, st["v"]),
             jnp.asarray(np.asarray(ids, np.int32)))
-        bt = mgr.block_tokens
+        self._prof.end(_sig, _t0, out=(self._pk, self._pv),
+                       hbm_bytes=n * bt * self._kv_bytes_per_token)
         adopted, lease = mgr.store_shared(req.prompt[:n * bt], ids)
         adopted_set = set(adopted)
         leftovers = [b for b in ids if b not in adopted_set]
@@ -1643,6 +1684,12 @@ class ContinuousBatchingEngine:
             out["disagg"] = dict(self.disagg_stats)
         if any(self.migration_stats.values()):
             out["migration"] = dict(self.migration_stats)
+        # compile ledger (docs/DESIGN.md §20): the recompile_storm
+        # detector below reads this fragment, and /stats readers get
+        # the per-program compile picture for free
+        compile_snap = _profiling.get_compile_tracker().snapshot()
+        if compile_snap:
+            out["compile"] = compile_snap
         if self._spec_step is not None or self._pld_step is not None:
             s = self.spec_stats
             out["speculative"] = {
@@ -1672,7 +1719,8 @@ class ContinuousBatchingEngine:
         """Backend fragment of ``GET /debugz``: anomaly-detector state
         (thresholds, streaks, recent firings, bundles written) + the KV
         cache picture (occupancy, LRU leaves, leased nodes)."""
-        out = {"anomaly": self.anomaly.state()}
+        out = {"anomaly": self.anomaly.state(),
+               "observatory": _profiling.observatory_state()}
         if self.kv_cache is not None:
             out["kvcache"] = self.kv_cache.debug_state()
         if self.disagg_stats["premigrated_requests"]:
@@ -1708,6 +1756,12 @@ class ContinuousBatchingEngine:
         self._running = False
         self._queue.put(None)              # wake the scheduler
         self._thread.join(timeout=30)
+        # reset-on-close: this engine's pool owners leave the process
+        # watermark ledger (a successor engine's pools start a fresh
+        # high-water history; other engines' owners are untouched)
+        for owner in self._hbm_owners:
+            self._hbm.reset(owner)
+        self._hbm_owners.clear()
 
     def __enter__(self):
         return self
@@ -1798,6 +1852,10 @@ class ContinuousBatchingEngine:
                     "private": private, "adopted": (), "n_pref": n_pref,
                     "table": table, "dprivate": dprivate,
                     "dtable": dtable, "released": False}
+        # workload sketch: prefix-hit share = matched / prompt tokens,
+        # recorded once per SUCCESSFUL reservation (a _BlocksExhausted
+        # retry re-runs match and must not double-count)
+        self._sketch.record_prefix(m, plen)
         return m
 
     def _release_request_kv(self, req: Request) -> None:
@@ -1970,10 +2028,16 @@ class ContinuousBatchingEngine:
             try:
                 head = jnp.asarray(
                     np.asarray(a["suffix"][:C], np.int32)[None])
+                _sig = _profiling.dispatch_signature(
+                    "paged_chunk_mid", batch=1, chunk=C,
+                    kv_dtype=self.kv_cache.kv_dtype)
+                _t0 = self._prof.begin(_sig)
                 self._pk, self._pv = self._paged_chunk_mid(
                     self.params, self._pk, self._pv, head,
                     jnp.asarray(req._pkv["table"][None]),
                     jnp.int32(a["start"]))
+                self._prof.end(_sig, _t0, out=self._pk,
+                               hbm_bytes=C * self._kv_bytes_per_token)
             except BaseException as e:
                 # a per-request failure fails that request, never the
                 # engine — same contract as every other admission
@@ -2008,10 +2072,16 @@ class ContinuousBatchingEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(suffix)] = suffix
         self._rng, sub = jax.random.split(self._rng)
+        _sig = _profiling.dispatch_signature(
+            "paged_prefill", batch=1, chunk=bucket,
+            kv_dtype=self.kv_cache.kv_dtype)
+        _t0 = self._prof.begin(_sig)
         self._pk, self._pv, tok, lp0 = self._paged_prefill(
             self.params, self._pk, self._pv, jnp.asarray(padded),
             jnp.asarray(st["table"][None]), jnp.int32(start),
             jnp.int32(len(suffix)), sub)
+        self._prof.end(_sig, _t0, out=tok,
+                       hbm_bytes=len(suffix) * self._kv_bytes_per_token)
         # store at PREFILL time, by ADOPTION: the tree takes
         # ownership of the full-prompt pages it was missing — the
         # next shared-prefix request block-table-references the
@@ -2108,6 +2178,8 @@ class ContinuousBatchingEngine:
                     (req.t_done - req.t_first) / (len(req.tokens) - 1))
             req.stream.put(None)
             req.done.set()
+            # workload sketch: realized decode length at completion
+            self._sketch.record_decode(len(req.tokens))
             if req.rid is not None and self._by_rid.get(req.rid) is req:
                 del self._by_rid[req.rid]
             self._slots[slot] = None
@@ -2271,6 +2343,33 @@ class ContinuousBatchingEngine:
         self.loop_stats["device_loop_steps"] += steps
         count_device_loop(type(self).__name__, steps)
 
+    def _sample_hbm(self) -> None:
+        """Feed the HBM watermark ledger one scheduler-iteration sample
+        per pool owner.  Pool accounting is host-side integers (no
+        device sync); owners are remembered so close() can retire their
+        watermarks (reset-on-close)."""
+        snap = self.kv_cache.snapshot()
+        self._hbm.sample("kv_page_pool",
+                         snap.get("device_resident_bytes", 0)
+                         + snap.get("quant_scale_bytes", 0))
+        self._hbm_owners.add("kv_page_pool")
+        if self._dmgr is not None:
+            d = self._dmgr.snapshot()
+            self._hbm.sample("draft_scratch",
+                             d.get("device_resident_bytes", 0)
+                             + d.get("quant_scale_bytes", 0))
+            self._hbm_owners.add("draft_scratch")
+
+    def _decode_kv_bytes(self, active_mask, steps: int) -> int:
+        """KV bytes one fused decode dispatch touched (achieved-GB/s
+        attribution, SAMPLED dispatches only — the lengths readback
+        here is a host sync the unsampled path must never pay): every
+        active row re-reads its history each step and writes one
+        token per step, priced by the pool's per-token byte math."""
+        lens = np.asarray(self._lengths)[active_mask]
+        return int((int(lens.sum()) + active_mask.sum())
+                   * max(1, steps) * self._kv_bytes_per_token)
+
     def _step_active(self, rounds: int) -> None:
         """Run up to ``rounds`` lockstep decode steps (plain mode) or
         draft/verify rounds (speculative / prompt-lookup modes) over the
@@ -2305,6 +2404,10 @@ class ContinuousBatchingEngine:
             for r in range(rounds):
                 self._drain_spec_blocks(em_np[r], ns_np[r])
         elif rounds > 1:
+            _sig = _profiling.dispatch_signature(
+                "paged_multi_step", batch=int(active_mask.sum()),
+                chunk=rounds, kv_dtype=self.kv_cache.kv_dtype)
+            _t0 = self._prof.begin(_sig)
             (self._pk, self._pv, self._lengths, tok,
              blocks, lps, steps) = self._paged_multi_step(
                 self.params, self._pk, self._pv,
@@ -2313,18 +2416,33 @@ class ContinuousBatchingEngine:
                 self._eos_scalar(), self._budget_vec(), rounds)
             self._last_tok = tok
             steps = int(steps)       # the on-device active count
+            if _t0 is not None:
+                # sampled only (int(steps) above already synced): the
+                # dominant KV traffic is each active row re-reading its
+                # history every step, plus one written token/row/step
+                self._prof.end(_sig, _t0, out=tok,
+                               hbm_bytes=self._decode_kv_bytes(
+                                   active_mask, steps))
             self._count_loop(steps)
             self._step_count += steps
             self._record_row_blocks(
                 np.asarray(blocks), np.full(len(self._slots), steps),
                 np.asarray(lps))
         else:
+            _sig = _profiling.dispatch_signature(
+                "paged_step", batch=int(active_mask.sum()), chunk=1,
+                kv_dtype=self.kv_cache.kv_dtype)
+            _t0 = self._prof.begin(_sig)
             (self._pk, self._pv, self._lengths, tok,
              lp) = self._paged_step(
                 self.params, self._pk, self._pv,
                 jnp.asarray(self._tables), self._lengths,
                 self._last_tok, jnp.asarray(active_mask), sub)
             self._last_tok = tok
+            if _t0 is not None:
+                self._prof.end(_sig, _t0, out=tok,
+                               hbm_bytes=self._decode_kv_bytes(
+                                   active_mask, 1))
             self._count_loop(1)
             tok_np, lp_np = np.asarray(tok), np.asarray(lp)
             self._step_count += 1
@@ -2499,7 +2617,11 @@ class ContinuousBatchingEngine:
             self._rng, dec_sub = jax.random.split(self._rng)
         else:
             dec_sub = jax.random.PRNGKey(0)   # prefill-only: loop is
-        try:                                  # a 0-step no-op
+        _sig = _profiling.dispatch_signature(  # a 0-step no-op
+            "mixed_step", batch=int(active_mask.sum()),
+            chunk=self.decode_block, kv_dtype=self.kv_cache.kv_dtype)
+        _t0 = self._prof.begin(_sig)
+        try:
             (self._pk, self._pv, self._lengths, tok, final_toks,
              final_lps, toks, lps, steps) = self._mixed_step(
                 self.params, self._pk, self._pv, jnp.asarray(seg_ids),
@@ -2560,6 +2682,12 @@ class ContinuousBatchingEngine:
                 self._record_token(slot, req, int(final_toks_np[r0]),
                                    float(final_lps_np[r0]))
         steps = int(steps)           # the on-device active count
+        if _t0 is not None:
+            # sampled only (int(steps) above already synced): packed
+            # prefill writes + every active row's per-step history read
+            self._prof.end(_sig, _t0, out=tok, hbm_bytes=(
+                prefill_tokens * self._kv_bytes_per_token
+                + self._decode_kv_bytes(active_mask, steps)))
         cs["mixed_packed_tokens"] += (prefill_tokens
                                       + n_active * steps)
         if steps > 0:
@@ -2604,6 +2732,7 @@ class ContinuousBatchingEngine:
             # the bench baseline.
             while self._running:
                 self.anomaly.observe(self.stats)
+                self._sample_hbm()
                 self._mixed_iteration()
             self._drain_all(
                 RuntimeError("engine closed while request in flight"))
@@ -2612,6 +2741,7 @@ class ContinuousBatchingEngine:
             # anomaly watch rides the loop (throttled internally; the
             # stats() snapshot is only built when an observation is due)
             self.anomaly.observe(self.stats)
+            self._sample_hbm()
             free = [i for i, s in enumerate(self._slots) if s is None]
             # one dispatch of the in-progress chunked admission (if any)
             self._advance_admission(free)

@@ -51,6 +51,7 @@ from ..comm.transport import (BaseTransport, TransportTimeout,
 from ..models.base import KVCache, ModelConfig, StageParams, StageSpec
 from ..ops.sampling import SamplingParams, sample_logits
 from ..telemetry import postmortem
+from ..telemetry import profiling as _profiling
 from ..telemetry.flightrecorder import get_flight_recorder
 from ..telemetry.tracing import SpanClock, TraceRecorder, new_trace_id
 from .stats import StageStats
@@ -218,6 +219,14 @@ class StageRuntime:
         from ..telemetry._env import env_int
         self.fused_tail = (spec.is_last
                            and env_int("DWT_RING_FUSED_TAIL", 1) != 0)
+        # §20 observatory handles: the tail's fused dispatch is profiled
+        # under the "ring_chunk_sample" program class; the stage page
+        # pool feeds the HBM watermark ledger per chunk served.
+        self._prof = _profiling.get_profiler()
+        self._kv_token_bytes = _profiling.kv_dispatch_bytes(
+            1, spec.num_layers, cfg.num_kv_heads, cfg.head_dim,
+            self.kv_dtype if self.kv_layout == "paged" else None,
+            (self.kv_cache_dtype or cfg.dtype))
 
     def _cache_for(self, rid: int, batch: int) -> KVCache:
         cache = self.caches.get(rid)
@@ -263,6 +272,15 @@ class StageRuntime:
         self._rid_blocks[rid] = max(have, need)
         return tbl, cur
 
+    def _sample_stage_hbm(self) -> None:
+        """One HBM-watermark sample for this stage's page pool (§20) —
+        host-side integer math only, called per chunk served."""
+        if self.kv_layout != "paged":
+            return
+        used = self._sentinel - len(self._pool_free)
+        _profiling.get_hbm_watermarks().sample(
+            "stage_pool", used * self._bt * self._kv_token_bytes)
+
     def run_chunk(self, rid: int, inputs: np.ndarray) -> jax.Array:
         """Run this stage on a chunk; updates the request's cache in place.
         Returns hidden [b,s,H] (or last-position logits on the tail)."""
@@ -274,6 +292,7 @@ class StageRuntime:
                 self.params, x, self._pk, self._pv, jnp.asarray(tbl),
                 jnp.int32(cur))
             self._rid_len[rid] = cur + x.shape[1]
+            self._sample_stage_hbm()
             return out
         cache = self._cache_for(rid, x.shape[0])
         out, self.caches[rid] = self._forward(self.params, x, cache)
@@ -294,18 +313,35 @@ class StageRuntime:
         x = jnp.asarray(inputs)
         rng = jax.random.fold_in(jax.random.fold_in(self._rng_base, rid),
                                  step)
+        b, s = x.shape[0], x.shape[1]
+        _sig = _profiling.dispatch_signature(
+            "ring_chunk_sample", batch=b, chunk=s,
+            kv_dtype=(self.kv_dtype if self.kv_layout == "paged" else
+                      np.dtype(self.kv_cache_dtype or self.cfg.dtype).name))
+        _t0 = self._prof.begin(_sig)
         if self.kv_layout == "paged":
-            tbl, cur = self._paged_chunk_state(rid, x.shape[0],
-                                               x.shape[1])
+            tbl, cur = self._paged_chunk_state(rid, b, s)
             tok, self._pk, self._pv = self._forward_sample_p(
                 self.params, x, self._pk, self._pv, jnp.asarray(tbl),
                 jnp.int32(cur), rng)
-            self._rid_len[rid] = cur + x.shape[1]
-            return np.asarray(tok)
-        cache = self._cache_for(rid, x.shape[0])
+            self._rid_len[rid] = cur + s
+            tok = np.asarray(tok)
+            if _t0 is not None:
+                # the asarray above synced; the chunk attends the rid's
+                # whole KV prefix and writes s new tokens
+                self._prof.end(_sig, _t0, hbm_bytes=(
+                    b * (cur + s) * self._kv_token_bytes))
+            self._sample_stage_hbm()
+            return tok
+        cache = self._cache_for(rid, b)
         tok, self.caches[rid] = self._forward_sample(self.params, x,
                                                      cache, rng)
-        return np.asarray(tok)
+        tok = np.asarray(tok)
+        if _t0 is not None:
+            self._prof.end(_sig, _t0, hbm_bytes=(
+                b * int(np.asarray(self.caches[rid].length))
+                * self._kv_token_bytes))
+        return tok
 
     def free(self, rid: int) -> None:
         self.caches.pop(rid, None)

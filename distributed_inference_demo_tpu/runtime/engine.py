@@ -37,6 +37,7 @@ from ..models.decoder import stage_forward
 from ..ops.flash_attention import make_flash_attn_impl
 from ..ops.sampling import (SamplingParams, match_stop_ids, pad_stop_ids,
                             sample_logits)
+from ..telemetry import profiling as _profiling
 from ..telemetry.flightrecorder import get_flight_recorder
 from ..telemetry.runlog import get_run_log
 
@@ -575,9 +576,20 @@ class InferenceEngine:
             out, cache = fwd(params, tok[:, None], cache, pos, False)
             return tok, lp, out[:, 0], cache, rng, done
 
-        self._prefill = prefill
-        self._decode_loop = decode_loop
-        self._decode_one = decode_one
+        # observatory seams (docs/DESIGN.md §20): compile accounting on
+        # the jitted programs + the sampled dispatch profiler.
+        # decode_loop legitimately forks per static (num_steps,
+        # with_logprobs) pair, so it carries NO variant budget — only
+        # programs with a documented invariant feed recompile_storm.
+        _ct = _profiling.get_compile_tracker()
+        self._prefill = _ct.wrap("prefill", prefill)
+        self._decode_loop = _ct.wrap("decode_loop", decode_loop)
+        self._decode_one = _ct.wrap("decode_one", decode_one)
+        self._prof = _profiling.get_profiler()
+        # dense-cache attribution: KV bytes one (row, token) touches
+        self._kv_token_bytes = _profiling.kv_dispatch_bytes(
+            1, cfg.num_layers, cfg.num_kv_heads, cfg.head_dim,
+            None, self.kv_cache_dtype)
 
     # ------------------------------------------------------------------
 
@@ -665,7 +677,8 @@ class InferenceEngine:
         """``GET /debugz`` fragment: KV cache occupancy/LRU picture +
         the device-loop dispatch accounting (§13 runbook)."""
         out = {"device_loop": dict(self.loop_stats,
-                                   stream_block=self.stream_block)}
+                                   stream_block=self.stream_block),
+               "observatory": _profiling.observatory_state()}
         if self.kv_cache is not None:
             out["kvcache"] = self.kv_cache.debug_state()
         return out
@@ -691,12 +704,21 @@ class InferenceEngine:
         start, cache = self._kv_seed(ids, cache)
         last_logits, cache = self._run_prefill(ids, cache, start=start)
         self._kv_store(ids, cache)
+        _sig = _profiling.dispatch_signature(
+            "decode_loop", batch=b, chunk=max_new_tokens,
+            kv_dtype=np.dtype(self.kv_cache_dtype).name)
+        _t0 = self._prof.begin(_sig)
         toks, lps, _, _, _, _, steps = self._decode_loop(
             self.params, last_logits, cache, rng, self._eos_scalar(),
             self._stop_ids, jnp.zeros((b,), bool),
             jnp.int32(max_new_tokens), max_new_tokens, logprobs)
         toks = np.asarray(toks)
         steps = int(steps)
+        if _t0 is not None:
+            # the asarray above already synced; every device step reads
+            # each row's prompt history and writes one token
+            self._prof.end(_sig, _t0, hbm_bytes=(
+                b * (plen + 1) * steps * self._kv_token_bytes))
         self._count_loop(steps)
         lps_np = np.asarray(lps) if logprobs else None
         dt = time.perf_counter() - t0
@@ -771,13 +793,23 @@ class InferenceEngine:
         K = self.stream_block
         if K > 1:
             remaining = max_new_tokens
+            _sig = _profiling.dispatch_signature(
+                "decode_loop", batch=b, chunk=K,
+                kv_dtype=np.dtype(self.kv_cache_dtype).name)
             while remaining > 0:
+                _t0 = self._prof.begin(_sig)
                 toks, lps, logits, cache, rng, done, steps = \
                     self._decode_loop(
                         self.params, logits, cache, rng,
                         self._eos_scalar(), self._stop_ids, done,
                         jnp.int32(min(K, remaining)), K, logprobs)
                 steps = int(steps)
+                if _t0 is not None:
+                    # int(steps) above already synced the dispatch; rows
+                    # entered at length plen + tokens already streamed
+                    self._prof.end(_sig, _t0, hbm_bytes=(
+                        b * (plen + max_new_tokens - remaining + 1)
+                        * steps * self._kv_token_bytes))
                 self._count_loop(steps)
                 if steps == 0:      # all rows were already done on entry
                     return

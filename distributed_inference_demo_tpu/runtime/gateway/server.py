@@ -61,6 +61,7 @@ from typing import Optional
 
 from ...telemetry import catalog as _catalog
 from ...telemetry import metrics as _m
+from ...telemetry import profiling as _profiling
 from ...telemetry.flightrecorder import get_flight_recorder
 from ...telemetry.tracing import (SpanClock, TraceRecorder,
                                   merge_chrome_traces, new_trace_id,
@@ -85,18 +86,21 @@ class GatewayHTTPServer:
                  proxy_timeout_s: Optional[float] = None,
                  fleet_scrape_interval_s: float = 1.0,
                  fleet_max_stale_s: float = 30.0,
-                 metrics_fetcher=None):
+                 metrics_fetcher=None, sketch_fetcher=None):
         """``retry_limit``: additional replicas tried after the routed
         one dies before first token.  ``proxy_timeout_s``: per-socket
         timeout on replica connections (None = no deadline; streams
         with long decode gaps need None or a generous value).
         ``fleet_scrape_interval_s`` / ``fleet_max_stale_s`` /
         ``metrics_fetcher``: the ``/metrics/fleet`` federation knobs
-        (see :class:`~.federation.FleetScraper`)."""
+        (see :class:`~.federation.FleetScraper`).  ``sketch_fetcher``:
+        injectable ``(rid, host, port) -> dict`` for the federated
+        ``GET /sketch`` (tests run it socket-free; None = HTTP)."""
         self.registry = registry
         self.router = router
         self.retry_limit = max(0, int(retry_limit))
         self.proxy_timeout_s = proxy_timeout_s
+        self._sketch_fetcher = sketch_fetcher
         self.tracer = TraceRecorder("gateway")
         self.fleet = FleetScraper(
             registry, min_interval_s=fleet_scrape_interval_s,
@@ -112,8 +116,8 @@ class GatewayHTTPServer:
             # bounded route labels, same rule as the replica server
             _ROUTES = frozenset((
                 "/health", "/stats", "/metrics", "/metrics/fleet",
-                "/trace", "/trace/fleet", "/debugz", "/generate",
-                "/drain"))
+                "/trace", "/trace/fleet", "/debugz", "/sketch",
+                "/generate", "/drain"))
 
             def _json(self, code: int, obj: dict,
                       headers: Optional[dict] = None) -> None:
@@ -169,6 +173,24 @@ class GatewayHTTPServer:
                 elif path == "/trace/fleet":
                     try:
                         self._json(200, outer._fleet_trace())
+                    except Exception as e:
+                        self._json(500, {"error": str(e)})
+                elif path == "/sketch":
+                    # federated workload sketch (§20): merged across up
+                    # replicas, served as CANONICAL bytes (re-dumping
+                    # through _json would break byte-determinism)
+                    try:
+                        body = _profiling.render_sketch(
+                            outer._fleet_sketch()).encode("utf-8")
+                        _catalog.HTTP_REQUESTS.inc(route="/sketch",
+                                                   code="200")
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
                     except Exception as e:
                         self._json(500, {"error": str(e)})
                 elif path == "/health":
@@ -468,6 +490,36 @@ class GatewayHTTPServer:
             finally:
                 conn.close()
         return merge_chrome_traces(traces)
+
+    def _fleet_sketch(self) -> dict:
+        """``GET /sketch``: every up replica's workload-sketch artifact,
+        merged deterministically (``profiling.merge_sketches`` sorts by
+        replica id and sums fixed-edge histograms bin-wise).  A replica
+        that fails to serve — or serves a foreign schema version — is
+        listed in ``dropped_replicas`` instead of poisoning the merge."""
+        sections = []
+        for rid in self.registry.up_replicas():
+            try:
+                host, port = self.registry.endpoint(rid)
+                if self._sketch_fetcher is not None:
+                    obj = self._sketch_fetcher(rid, host, port)
+                else:
+                    conn = HTTPConnection(
+                        host, port, timeout=self.proxy_timeout_s or 5.0)
+                    try:
+                        conn.request("GET", "/sketch")
+                        resp = conn.getresponse()
+                        body = resp.read()
+                        if resp.status != 200:
+                            continue
+                        obj = json.loads(body)
+                    finally:
+                        conn.close()
+            except Exception:
+                continue
+            if isinstance(obj, dict):
+                sections.append((rid, obj))
+        return _profiling.merge_sketches(sections)
 
     def _fleet_slo(self) -> dict:
         """Per-replica SLO summaries, as last reported over the health

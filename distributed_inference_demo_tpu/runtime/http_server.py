@@ -419,7 +419,8 @@ class InferenceHTTPServer:
             # child (and one /metrics line) per junk URL forever
             _ROUTES = frozenset((
                 "/health", "/stats", "/stats/reset", "/metrics", "/trace",
-                "/timeline", "/debugz", "/generate", "/classify"))
+                "/timeline", "/debugz", "/sketch", "/generate",
+                "/classify"))
 
             def _json(self, code: int, obj: dict,
                       headers: Optional[dict] = None) -> None:
@@ -534,6 +535,25 @@ class InferenceHTTPServer:
                 elif self.path.split("?")[0] == "/debugz":
                     try:
                         self._json(200, outer._debugz())
+                    except Exception as e:
+                        self._json(500, {"error": str(e)})
+                elif self.path.split("?")[0] == "/sketch":
+                    # §20 workload-sketch artifact: serve the recorder's
+                    # CANONICAL bytes verbatim (re-dumping would break
+                    # the byte-identity determinism contract)
+                    from ..telemetry import profiling as _profiling
+                    try:
+                        body = _profiling.get_sketch().to_json() \
+                            .encode("utf-8")
+                        _metrics.HTTP_REQUESTS.inc(route="/sketch",
+                                                   code="200")
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
                     except Exception as e:
                         self._json(500, {"error": str(e)})
                 else:

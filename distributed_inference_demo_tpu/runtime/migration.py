@@ -73,6 +73,7 @@ import numpy as np
 from ..comm import wire
 from ..comm.transport import (TransportError, TransportTimeout,
                               record_corrupt_frame)
+from ..telemetry import profiling as _profiling
 from ..telemetry._env import env_float, env_int
 from ..telemetry.flightrecorder import get_flight_recorder
 from ..telemetry.tracing import SpanClock, TraceRecorder, new_trace_id
@@ -310,6 +311,10 @@ class MigrationWorker:
             self._drop(tag, "already_adopted")
             return
         status = self.stager.stage_page(rid, attempt, seq, payload, tag)
+        # staged frames are the migration path's only host-buffer growth:
+        # feed the §20 watermark ledger here (peaks are what it keeps)
+        _profiling.get_hbm_watermarks().sample(
+            "migration_staged", self.stager.staged_bytes)
         if status in ("stale_attempt", "dedup"):
             self._drop(tag, status)
 

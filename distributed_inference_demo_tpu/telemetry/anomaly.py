@@ -15,6 +15,10 @@ them *continuously* and names the moment something leaves its envelope:
   threshold (the system is falling behind offered load);
 - **accept_collapse** — the speculative accept rate collapses (the draft
   stopped predicting the target; every round is wasted work);
+- **recompile_storm** — a tracked jitted program compiled past its
+  documented variant budget (``stats()["compile"]`` fragment from
+  ``telemetry/profiling.py``; e.g. ``_mixed_step``'s two-variant
+  invariant) — a silent recompile latency cliff becomes a named event;
 - **pipeline_stall** — work is in flight but the step counter has not
   advanced for longer than the watchdog window (the explicit
   TransportTimeout path in ``runtime/distributed.py`` covers the ring;
@@ -58,6 +62,8 @@ class Thresholds:
     accept_min_drafted: int = 256     # ... after this many drafted tokens
     stall_s: float = 30.0             # watchdog: no progress with work
     burn_rate: float = 0.0            # 0 = SLO burn detector disabled
+    recompile_slack: int = 0          # extra compiles tolerated past a
+    # program's variant budget before recompile_storm (-1 disables)
     sustain: int = 3                  # consecutive breaches before firing
     cooldown_s: float = 300.0         # per-kind re-fire suppression
 
@@ -76,6 +82,7 @@ class Thresholds:
                 "DWT_ANOMALY_ACCEPT_MIN_DRAFTED", 256),
             stall_s=_env_float("DWT_ANOMALY_STALL_S", 30.0),
             burn_rate=_env_float("DWT_ANOMALY_BURN_RATE", 0.0),
+            recompile_slack=_env_int("DWT_ANOMALY_RECOMPILE_SLACK", 0),
             sustain=_env_int("DWT_ANOMALY_SUSTAIN", 3),
             cooldown_s=_env_float("DWT_ANOMALY_COOLDOWN_S", 300.0),
         )
@@ -256,6 +263,40 @@ class AnomalyDetector:
                         out.append(a)
         for key in [k for k in self._streak
                     if k.startswith("slo_burn:") and k not in burning]:
+            self._clear(key)
+
+        # recompile storm: a tracked program's compile count exceeds
+        # its documented variant budget (telemetry/profiling.py feeds
+        # the stats()["compile"] fragment; e.g. _mixed_step may compile
+        # exactly two variants, docs/DESIGN.md §19).  Keyed per program
+        # so one storming program can't mask another's streak; only
+        # budgeted programs are eligible (budget None = unbounded by
+        # design, e.g. per-chunk-length prefill variants).
+        storming = set()
+        compile_block = stats.get("compile")
+        if t.recompile_slack >= 0 and isinstance(compile_block, dict):
+            for prog, e in compile_block.items():
+                if not isinstance(e, dict):
+                    continue
+                budget = e.get("variant_budget")
+                compiles = e.get("compiles")
+                if not isinstance(budget, int) or \
+                        not isinstance(compiles, (int, float)):
+                    continue
+                key = f"recompile:{prog}"
+                if compiles > budget + t.recompile_slack:
+                    storming.add(key)
+                    a = self._breach(
+                        "recompile_storm", "critical",
+                        {"program": prog, "compiles": int(compiles),
+                         "variant_budget": budget,
+                         "slack": t.recompile_slack,
+                         "compile_seconds":
+                             e.get("compile_seconds", 0.0)}, key=key)
+                    if a:
+                        out.append(a)
+        for key in [k for k in self._streak
+                    if k.startswith("recompile:") and k not in storming]:
             self._clear(key)
 
         depth = stats.get("queue_depth")

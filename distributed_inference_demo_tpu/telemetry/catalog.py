@@ -547,7 +547,7 @@ ANOMALY_EVENTS = counter(
     "dwt_anomaly_events_total",
     "Anomalies flagged by the online detectors, by kind "
     "(straggler_hop, slo_ttft, slo_tpot, queue_saturation, "
-    "accept_collapse, pipeline_stall)", ("kind",))
+    "accept_collapse, pipeline_stall, recompile_storm)", ("kind",))
 ANOMALY_LAST = gauge(
     "dwt_anomaly_last_seconds",
     "Epoch seconds of the most recent anomaly of each kind", ("kind",))
@@ -564,6 +564,97 @@ def update_flight_series() -> None:
     fr = get_flight_recorder()
     FLIGHT_EVENTS.set_cumulative(fr.total)
     FLIGHT_BUFFER.set(len(fr))
+
+
+# -- cost observatory series (docs/DESIGN.md §20) --------------------------
+# fed by telemetry/profiling.py: the sampled dispatch timer observes
+# dwt_profile_dispatch_seconds directly at sample time (the slow path —
+# it just blocked on the device anyway); everything snapshot-shaped
+# (dispatch counts, compile ledger, HBM watermarks) bridges at scrape
+# via update_profiling_series so the hot path never touches the
+# registry.
+
+# dispatch wall times run far below the request-latency buckets: a
+# fused decode step is ~100 µs–10 ms, a prefill chunk tens of ms.
+PROFILE_BUCKETS_S = (0.0002, 0.0005, 0.001, 0.002, 0.004, 0.008,
+                     0.016, 0.032, 0.064, 0.125, 0.25, 0.5, 1.0, 4.0)
+
+PROFILE_DISPATCH_SECONDS = histogram(
+    "dwt_profile_dispatch_seconds",
+    "Sampled per-dispatch wall time (block_until_ready) of each jitted "
+    "program class, keyed by dispatch signature "
+    "program|b<batch-bucket>|c<chunk-or-K>|<kv_dtype> — every "
+    "DWT_PROFILE_SAMPLE_N-th dispatch per signature is timed",
+    ("signature",), buckets=PROFILE_BUCKETS_S)
+PROFILE_SAMPLES = counter(
+    "dwt_profile_samples_total",
+    "Dispatches the sampled profiler actually timed, per signature "
+    "(≈ dispatches / DWT_PROFILE_SAMPLE_N)", ("signature",))
+PROFILE_DISPATCHES = counter(
+    "dwt_profile_dispatches_total",
+    "Total dispatches seen per dispatch signature (counted whenever "
+    "sampling is enabled; exactly 0 with DWT_PROFILE_SAMPLE_N=0 — the "
+    "off-path touches nothing)", ("signature",))
+PROFILE_ACHIEVED_BPS = gauge(
+    "dwt_profile_achieved_bytes_per_second",
+    "Achieved HBM bandwidth attribution of the last sampled dispatch "
+    "per signature, from the KV byte math in ops/quant.py (a lower "
+    "bound: weights and activations ride on top)", ("signature",))
+PROFILE_ROOFLINE_FRAC = gauge(
+    "dwt_profile_roofline_ratio",
+    "Achieved-bandwidth attribution over the ROOFLINE_LEDGER.json "
+    "ceiling (DWT_ROOFLINE_GBS overrides), per signature",
+    ("signature",))
+
+COMPILE_EVENTS = counter(
+    "dwt_compile_events_total",
+    "XLA compiles observed per jitted program (jit-cache growth across "
+    "a tracked call); a program compiling past its variant budget is "
+    "the recompile_storm anomaly", ("program",))
+COMPILE_SECONDS = counter(
+    "dwt_compile_seconds_total",
+    "Wall seconds spent in calls that grew a program's jit cache "
+    "(trace + lower + compile dominate such calls)", ("program",))
+COMPILE_CACHE_ENTRIES = gauge(
+    "dwt_compile_cache_entries",
+    "Live jit-cache entries per tracked program at last compile",
+    ("program",))
+COMPILE_VARIANT_BUDGET = gauge(
+    "dwt_compile_variant_budget_entries",
+    "Documented compiled-variant budget per tracked program (e.g. "
+    "mixed_step's two-variant invariant, docs/DESIGN.md §19); only "
+    "budgeted programs feed the recompile_storm detector", ("program",))
+
+HBM_OWNER_BYTES = gauge(
+    "dwt_hbm_owner_bytes",
+    "Current resident bytes per pool owner (kv_page_pool, "
+    "kv_host_pool, draft_scratch, stage_pool, migration_staged), "
+    "sampled at scheduler iterations", ("owner",))
+HBM_WATERMARK_BYTES = gauge(
+    "dwt_hbm_watermark_bytes",
+    "High-water-mark resident bytes per pool owner since process start "
+    "or the owner's engine close — how big the pool could have been",
+    ("owner",))
+
+
+def update_profiling_series() -> None:
+    """Bridge the cost observatory's snapshot-shaped ledgers onto the
+    ``dwt_profile_*`` / ``dwt_compile_*`` / ``dwt_hbm_*`` series (cheap:
+    three locked dict copies; runs at scrape time only)."""
+    from . import profiling
+    for sig, n in profiling.get_profiler().dispatch_counts().items():
+        PROFILE_DISPATCHES.set_cumulative(n, signature=sig)
+    for prog, e in profiling.get_compile_tracker().snapshot().items():
+        COMPILE_EVENTS.set_cumulative(e["compiles"], program=prog)
+        COMPILE_SECONDS.set_cumulative(e["compile_seconds"],
+                                       program=prog)
+        COMPILE_CACHE_ENTRIES.set(e["cache_entries"], program=prog)
+        if e["variant_budget"] is not None:
+            COMPILE_VARIANT_BUDGET.set(e["variant_budget"],
+                                       program=prog)
+    for owner, w in profiling.get_hbm_watermarks().watermarks().items():
+        HBM_OWNER_BYTES.set(w["bytes"], owner=owner)
+        HBM_WATERMARK_BYTES.set(w["watermark_bytes"], owner=owner)
 
 
 # -- monitor series (probes.py measurements) -------------------------------
@@ -619,6 +710,7 @@ def scrape(backend=None) -> str:
     stall on a dead stage."""
     update_monitor_series()
     update_flight_series()
+    update_profiling_series()
     slo.update_slo_series()
     fn = getattr(backend, "scrape_stats", None) or getattr(
         backend, "stats", None)
@@ -641,6 +733,7 @@ def render_worker(stage_stats, device_id: str = "") -> str:
     StageStats and render (``worker_main --metrics-port``)."""
     update_monitor_series()
     update_flight_series()
+    update_profiling_series()
     snap = dict(stage_stats.snapshot(), device_id=device_id)
     update_stage_series([snap])
     return REGISTRY.render()

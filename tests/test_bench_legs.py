@@ -374,6 +374,43 @@ def test_leg_mixed_batching_gates_tiny():
     assert out["mixed_ttft_p95_le_baseline"] is True, (base, mixed)
 
 
+def test_run_leg_stamps_dispatch_profile_extras(monkeypatch):
+    """The §20 bench satellite's CPU dryrun: a headline-order leg run
+    through run_leg stamps the ``dispatch_profile`` extras block —
+    per-signature p50/p95 from the sampled dispatch profiler plus the
+    compile ledger — so BENCH_SELF r06+ artifacts carry the cost
+    observatory without a TPU session proving the plumbing first.
+    Sampling is forced to every dispatch so the tiny micro shape still
+    banks samples deterministically."""
+    from distributed_inference_demo_tpu.telemetry import profiling
+    monkeypatch.setenv("DWT_PROFILE_SAMPLE_N", "1")
+    profiling.reset_observatory()
+    try:
+        p = {"model": "llama-test", "batch": 8, "prompt_len": 64,
+             "new_tokens": 128, "flagship": "llama-test"}
+        out = bench.run_leg("decode_fused", p, micro=True)
+        assert "error" not in out
+        dp = out["dispatch_profile"]
+        assert dp["sample_n"] == 1
+        # the K=4 point runs the fused loop: its signature carries the
+        # program class, pow2 batch bucket, chunk K and kv dtype
+        sigs = dp["signatures"]
+        assert any(s.startswith("decode_loop|b1|c4|") for s in sigs), sigs
+        for entry in sigs.values():
+            assert entry["samples"] >= 1
+            assert entry["dispatches"] >= entry["samples"]
+            assert entry["p95_ms"] >= entry["p50_ms"] >= 0.0
+        # the compile ledger saw the engine's jitted programs compile
+        comp = dp["compile"]
+        assert comp["decode_loop"]["compiles"] >= 1
+        assert comp["decode_loop"]["compile_seconds"] > 0
+        # un-budgeted programs must not feed recompile_storm
+        assert comp["decode_loop"]["variant_budget"] is None
+    finally:
+        monkeypatch.delenv("DWT_PROFILE_SAMPLE_N", raising=False)
+        profiling.reset_observatory()
+
+
 def test_run_leg_micro_variants_stamp_and_shrink():
     """--micro runs the same leg structure at the smallest meaningful
     shape and stamps the result so a micro number can never masquerade

@@ -717,13 +717,13 @@ def test_bounce_migration_src_to_dst_and_back(pair, reference):
         orig = e._paged_multi_step
 
         def slow(*a, _orig=orig, **k):
-            time.sleep(0.003)
+            time.sleep(0.005)
             return _orig(*a, **k)
 
         throttled.append((e, orig))
         e._paged_multi_step = slow
     try:
-        for i in range(8):
+        for i in range(12):
             rid = f"mb{i}"
             req = pair.src_e.submit(PROMPT, MAX_NEW, request_id=rid)
             _wait_tokens(req, 2)
@@ -740,7 +740,7 @@ def test_bounce_migration_src_to_dst_and_back(pair, reference):
             if bounced:
                 break
         else:
-            pytest.fail("bounce never landed in 8 attempts")
+            pytest.fail("bounce never landed in 12 attempts")
     finally:
         for e, orig in throttled:
             e._paged_multi_step = orig

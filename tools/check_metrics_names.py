@@ -35,7 +35,7 @@ from typing import List
 SUBSYSTEMS = {"stage", "batching", "speculative", "http", "monitor",
               "engine", "control", "anomaly", "flight", "kvcache",
               "transport", "fault", "disagg", "gateway", "migration",
-              "slo"}
+              "slo", "profile", "compile", "hbm"}
 
 # unit suffixes a metric name may end with (after stripping ``_total``).
 # Plain-count units (requests, tokens, ...) double as the unit for
@@ -45,14 +45,15 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
          "info", "events", "bundles", "blocks", "nodes",
          "retries", "reconnects", "frames", "faults", "dispatches",
-         "pages", "replicas", "scrapes"}
+         "pages", "replicas", "scrapes", "samples"}
 
 # label names any series may declare.  The label VOCABULARY is linted
 # like the name vocabulary: a typo'd label ("tenent", "repilca") would
 # silently fork a series family that no dashboard joins, which is worse
 # than a crash.  Extend deliberately, with the catalog.
 KNOWN_LABELS = {"role", "device", "route", "code", "kind", "engine",
-                "peer", "replica", "dtype", "tenant", "window"}
+                "peer", "replica", "dtype", "tenant", "window",
+                "signature", "program", "owner"}
 
 # series whose label SET is pinned exactly — the fleet-plane families
 # whose labels dashboards and the federation relabeler join on.  A
@@ -80,6 +81,21 @@ REQUIRED_LABELS = {
     "dwt_gateway_queue_depth_requests": ("replica",),
     "dwt_anomaly_events_total": ("kind",),
     "dwt_anomaly_last_seconds": ("kind",),
+    # cost observatory (docs/DESIGN.md §20): the dispatch-signature /
+    # program / owner keys ARE the join keys the auto-planner and
+    # fleet_top --profile aggregate on — losing one collapses every
+    # program variant (or pool owner) into a single meaningless line
+    "dwt_profile_dispatch_seconds": ("signature",),
+    "dwt_profile_samples_total": ("signature",),
+    "dwt_profile_dispatches_total": ("signature",),
+    "dwt_profile_achieved_bytes_per_second": ("signature",),
+    "dwt_profile_roofline_ratio": ("signature",),
+    "dwt_compile_events_total": ("program",),
+    "dwt_compile_seconds_total": ("program",),
+    "dwt_compile_cache_entries": ("program",),
+    "dwt_compile_variant_budget_entries": ("program",),
+    "dwt_hbm_owner_bytes": ("owner",),
+    "dwt_hbm_watermark_bytes": ("owner",),
 }
 
 # label names reserved for the federation relabeler: GET /metrics/fleet
@@ -213,6 +229,23 @@ REQUIRED_SERIES = {
     "dwt_gateway_fleet_scrapes_total",
     "dwt_gateway_fleet_failed_scrapes_total",
     "dwt_gateway_fleet_scrape_age_seconds",
+    # the cost observatory (docs/DESIGN.md §20): dispatches_total
+    # registered-and-zero is how a scrape PROVES sampling is off (the
+    # free off-path), compile_events absent would let a recompile storm
+    # burn the fleet with nothing to alert on, and the HBM watermark
+    # vanishing reads as "pools never grew" — exactly the OOM-postmortem
+    # blindness the ledger exists to end
+    "dwt_profile_dispatch_seconds",
+    "dwt_profile_samples_total",
+    "dwt_profile_dispatches_total",
+    "dwt_profile_achieved_bytes_per_second",
+    "dwt_profile_roofline_ratio",
+    "dwt_compile_events_total",
+    "dwt_compile_seconds_total",
+    "dwt_compile_cache_entries",
+    "dwt_compile_variant_budget_entries",
+    "dwt_hbm_owner_bytes",
+    "dwt_hbm_watermark_bytes",
 }
 
 

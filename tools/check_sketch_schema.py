@@ -1,0 +1,76 @@
+"""Lint: the sketch recorder and the planner pin ONE schema version.
+
+``telemetry/profiling.py`` (the recorder) and ``planner/planner.py``
+(the consumer) each carry a LITERAL copy of ``SKETCH_SCHEMA_VERSION``
+and ``SKETCH_REQUIRED_KEYS`` — deliberately duplicated so the planner
+can parse committed artifacts without importing the serving stack.
+This lint (tier-1, via tests/test_profiling.py) reads both copies by
+AST — no imports, so it works on a box with neither jax nor the repo
+installed — and fails when they disagree.
+
+Run: ``python tools/check_sketch_schema.py`` (exit 0 = agree).
+"""
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PKG = REPO / "distributed_inference_demo_tpu"
+FILES = (PKG / "telemetry" / "profiling.py",
+         PKG / "planner" / "planner.py")
+NAMES = ("SKETCH_SCHEMA_VERSION", "SKETCH_REQUIRED_KEYS")
+
+
+def pinned_constants(path: pathlib.Path) -> dict:
+    """Module-level literal assignments for NAMES, by AST."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id in NAMES:
+                out[tgt.id] = ast.literal_eval(node.value)
+    return out
+
+
+def check() -> list:
+    """Return a list of error strings (empty = the copies agree)."""
+    errors = []
+    pins = {}
+    for path in FILES:
+        got = pinned_constants(path)
+        missing = [n for n in NAMES if n not in got]
+        if missing:
+            errors.append(f"{path.relative_to(REPO)}: missing pinned "
+                          f"constants {missing}")
+            continue
+        pins[path] = got
+    if len(pins) == len(FILES):
+        a, b = (pins[f] for f in FILES)
+        for name in NAMES:
+            va, vb = a[name], b[name]
+            if isinstance(va, (list, tuple)):
+                va, vb = tuple(va), tuple(vb)
+            if va != vb:
+                errors.append(
+                    f"{name} disagrees: "
+                    f"{FILES[0].relative_to(REPO)} pins {a[name]!r}, "
+                    f"{FILES[1].relative_to(REPO)} pins {b[name]!r} — "
+                    "bump BOTH copies together")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"check_sketch_schema: {e}", file=sys.stderr)
+    if not errors:
+        print("check_sketch_schema: recorder and planner agree "
+              f"(schema v{pinned_constants(FILES[0])['SKETCH_SCHEMA_VERSION']})")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

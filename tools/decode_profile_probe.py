@@ -33,6 +33,13 @@ from distributed_inference_demo_tpu.models import get_model_config
 from distributed_inference_demo_tpu.models.decoder import init_full_params
 from distributed_inference_demo_tpu.ops.sampling import SamplingParams
 from distributed_inference_demo_tpu.runtime import InferenceEngine
+from distributed_inference_demo_tpu.telemetry.profiling import \
+    dispatch_signature
+
+try:        # `python tools/decode_profile_probe.py` vs `-m tools....`
+    from probe_artifact import emit_signatures
+except ImportError:
+    from tools.probe_artifact import emit_signatures
 
 BATCHES = (1, 8, 32, 64)
 NEW = 128
@@ -84,6 +91,15 @@ def main():
     for b in BATCHES:
         tax = rows[("topk7", b)] - rows[("greedy", b)]
         print(f"b={b:3d} sampling tax {tax:+.2f} ms/step", flush=True)
+
+    # observatory artifact: the same numbers keyed by dispatch
+    # signature (mergeable with /debugz snapshots + bench extras)
+    emit_signatures(
+        [(dispatch_signature(f"probe_decode_{name}", batch=b, chunk=NEW),
+          {"mean_ms": ms,
+           "weights_gbs": weights_gb / (ms / 1000)})
+         for (name, b), ms in sorted(rows.items())],
+        extra={"probe": "decode_profile", "weights_gb": weights_gb})
 
     print("== kth-value microbench on [b, 32000] f32 ==", flush=True)
 

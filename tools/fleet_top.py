@@ -128,6 +128,65 @@ def fleet_rows(samples) -> List[dict]:
     return [rows[k] for k in sorted(rows)]
 
 
+def profile_rows(samples, top: int = 10) -> List[dict]:
+    """Top dispatch signatures by sampled p95, one row per
+    (signature, replica), from the federated ``dwt_profile_*`` series
+    (docs/DESIGN.md §20).  A replica exposing no profiling series (old
+    build, or DWT_PROFILE_SAMPLE_N=0) simply contributes no rows —
+    never a crash."""
+    buckets: Dict[Tuple[str, str], Dict[float, float]] = {}
+    sums: Dict[Tuple[str, str], float] = {}
+    counts: Dict[Tuple[str, str], float] = {}
+    dispatches: Dict[Tuple[str, str], float] = {}
+    for name, labels, value in samples:
+        key = (labels.get("signature", "?"), labels.get("replica", "-"))
+        if name == "dwt_profile_dispatch_seconds_bucket":
+            try:
+                le = float(labels.get("le", "inf").replace("+Inf", "inf"))
+            except ValueError:
+                continue
+            buckets.setdefault(key, {})[le] = value
+        elif name == "dwt_profile_dispatch_seconds_sum":
+            sums[key] = value
+        elif name == "dwt_profile_dispatch_seconds_count":
+            counts[key] = value
+        elif name == "dwt_profile_dispatches_total":
+            dispatches[key] = value
+    rows = []
+    for key, b in buckets.items():
+        n = counts.get(key, 0.0)
+        rows.append({
+            "signature": key[0], "replica": key[1],
+            "samples": int(n),
+            "dispatches": int(dispatches.get(key, 0.0)),
+            "p95_s": _hist_p95(b),
+            "mean_s": (sums.get(key, 0.0) / n) if n > 0
+                      else float("nan")})
+    rows.sort(key=lambda r: (-(r["p95_s"] if r["p95_s"] == r["p95_s"]
+                               else -1.0), r["signature"], r["replica"]))
+    return rows[:top]
+
+
+def render_profile(rows: List[dict]) -> str:
+    hdr = (f"{'SIGNATURE':<34} {'REPLICA':<22} {'DISP':>8} "
+           f"{'SAMP':>6} {'MEANms':>8} {'P95ms':>8}")
+    lines = ["", "top dispatch signatures by p95 (sampled):",
+             hdr, "-" * len(hdr)]
+    if not rows:
+        lines.append("(no dwt_profile_* series exported — profiling "
+                     "disabled or pre-§20 replicas)")
+    for r in rows:
+        mean = (f"{r['mean_s'] * 1e3:.2f}"
+                if r["mean_s"] == r["mean_s"] else "-")
+        p95 = (f"{r['p95_s'] * 1e3:.2f}"
+               if r["p95_s"] == r["p95_s"] else "-")
+        lines.append(
+            f"{r['signature']:<34.34} {r['replica']:<22.22} "
+            f"{r['dispatches']:>8} {r['samples']:>6} "
+            f"{mean:>8} {p95:>8}")
+    return "\n".join(lines)
+
+
 def scrape_ages(samples) -> Dict[str, float]:
     return {labels.get("replica", "?"): value
             for name, labels, value in samples
@@ -176,6 +235,11 @@ def main(argv=None) -> int:
                     help="refresh period in seconds (default 2)")
     ap.add_argument("--once", action="store_true",
                     help="print one snapshot and exit (no ANSI)")
+    ap.add_argument("--profile", action="store_true",
+                    help="append the top dispatch signatures by sampled "
+                         "p95 (dwt_profile_* series, docs/DESIGN.md §20)")
+    ap.add_argument("--profile-top", type=int, default=10,
+                    help="rows in the --profile section (default 10)")
     args = ap.parse_args(argv)
     while True:
         try:
@@ -186,6 +250,9 @@ def main(argv=None) -> int:
             return 1
         samples = parse_metrics(text)
         page = render(fleet_rows(samples), scrape_ages(samples))
+        if args.profile:
+            page += "\n" + render_profile(
+                profile_rows(samples, top=args.profile_top))
         if args.once:
             print(page)
             return 0

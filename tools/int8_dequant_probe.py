@@ -16,6 +16,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_inference_demo_tpu.telemetry.profiling import \
+    dispatch_signature
+
+try:        # `python tools/int8_dequant_probe.py` vs `-m tools....`
+    from probe_artifact import emit_signatures
+except ImportError:
+    from tools.probe_artifact import emit_signatures
+
 L, H, I = 8, 2048, 5632
 B = 8
 STEPS = 24
@@ -57,10 +65,20 @@ def main():
         h = jnp.maximum(x @ wu, 0)
         return (h @ wd).astype(jnp.bfloat16)
 
+    rows = []
+
+    def note(variant, kv_dtype, dt, nbytes):
+        rows.append((dispatch_signature(f"probe_dequant_{variant}",
+                                        batch=B, chunk=STEPS,
+                                        kv_dtype=kv_dtype),
+                     {"mean_ms": dt * 1e3 / STEPS,
+                      "achieved_gbs": nbytes * STEPS / dt / 1e9}))
+
     dt = bench(tok_scan(lay_bf16, (w_up, w_dn)), x0)
     nbytes = (w_up.nbytes + w_dn.nbytes)
     print(f"bf16:        {dt*1e3/STEPS:7.2f} ms/step  "
           f"{nbytes*STEPS/dt/1e9:7.1f} GB/s")
+    note("bf16", "bf16", dt, nbytes)
 
     # int8 quantize
     def q(w):
@@ -84,6 +102,7 @@ def main():
     dt = bench(tok_scan(lay_f32, (qu, su, qd, sd)), x0)
     print(f"int8 f32-deq:{dt*1e3/STEPS:7.2f} ms/step  "
           f"{q_bytes*STEPS/dt/1e9:7.1f} GB/s")
+    note("f32_deq", "int8", dt, q_bytes)
 
     def lay_bf(x, ws):
         qu, su, qd, sd = ws
@@ -95,6 +114,7 @@ def main():
     dt = bench(tok_scan(lay_bf, (qu, su, qd, sd)), x0)
     print(f"int8 bf-deq: {dt*1e3/STEPS:7.2f} ms/step  "
           f"{q_bytes*STEPS/dt/1e9:7.1f} GB/s")
+    note("bf_deq", "int8", dt, q_bytes)
 
     # int8 with dot_general on raw int8 then scale the [B, I] result
     # (per-output-channel scale commutes past the contraction)
@@ -111,6 +131,10 @@ def main():
     dt = bench(tok_scan(lay_post, (qu, su, qd, sd)), x0)
     print(f"int8 post-sc:{dt*1e3/STEPS:7.2f} ms/step  "
           f"{q_bytes*STEPS/dt/1e9:7.1f} GB/s")
+    note("post_scale", "int8", dt, q_bytes)
+
+    # observatory artifact: signature-keyed, mergeable (§20)
+    emit_signatures(rows, extra={"probe": "int8_dequant"})
 
 
 if __name__ == "__main__":

@@ -1,0 +1,40 @@
+"""Shared emitter for the ad-hoc probe scripts (docs/DESIGN.md §20).
+
+Every probe keeps its human-readable prints, then emits ONE trailing
+JSON artifact keyed by the observatory's dispatch-signature schema
+(``telemetry/profiling.dispatch_signature``) so probe outputs merge
+with ``/debugz`` observatory snapshots and bench ``dispatch_profile``
+extras blocks: the join key is the signature string, the values are
+per-signature summaries (``*_ms``, ``*_gbs``, counts).
+
+Canonical rendering (sorted keys, minimal separators) matches the
+sketch artifact contract — piping a probe's last line into a file
+yields a committable, diffable artifact.
+"""
+
+import json
+
+
+def signature_entries(rows):
+    """``[(signature, {metric: value})] -> {signature: {...}}`` with
+    floats rounded (determinism) and later duplicates merged into
+    earlier ones (a probe timing one signature twice updates it)."""
+    out = {}
+    for sig, metrics in rows:
+        e = out.setdefault(sig, {})
+        for k, v in metrics.items():
+            e[k] = round(v, 6) if isinstance(v, float) else v
+    return out
+
+
+def emit_signatures(rows, extra=None):
+    """Print the trailing observatory artifact for ``rows`` =
+    ``[(signature, metrics_dict)]``; ``extra`` merges into the top
+    level (probe-specific context like weights_gb)."""
+    obj = {"schema": "dispatch_signature",
+           "signatures": signature_entries(rows)}
+    if extra:
+        obj.update(extra)
+    print("== observatory artifact ==", flush=True)
+    print(json.dumps(obj, sort_keys=True, separators=(",", ":")),
+          flush=True)

@@ -18,6 +18,13 @@ from distributed_inference_demo_tpu.models import get_model_config
 from distributed_inference_demo_tpu.models.decoder import init_full_params
 from distributed_inference_demo_tpu.ops.sampling import SamplingParams
 from distributed_inference_demo_tpu.runtime import InferenceEngine
+from distributed_inference_demo_tpu.telemetry.profiling import \
+    dispatch_signature
+
+try:        # `python tools/sampling_cost_probe.py` vs `-m tools....`
+    from probe_artifact import emit_signatures
+except ImportError:
+    from tools.probe_artifact import emit_signatures
 
 
 def main():
@@ -28,6 +35,7 @@ def main():
         ("topk7", SamplingParams(temperature=0.7, top_k=7)),
         ("topp95", SamplingParams(temperature=0.7, top_k=0, top_p=0.95)),
     ]
+    rows = []
     for batch in (8, 64):
         for name, samp in variants:
             eng = InferenceEngine(cfg, params, max_seq=192, sampling=samp)
@@ -39,6 +47,12 @@ def main():
             ms = r.seconds / steps * 1000
             print(f"b={batch:3d} {name:7s} {r.tokens_per_second:9.1f} tok/s"
                   f"  {ms:6.2f} ms/step", flush=True)
+            rows.append((dispatch_signature(f"probe_sampling_{name}",
+                                            batch=batch, chunk=steps),
+                         {"mean_ms": ms,
+                          "tokens_per_sec": r.tokens_per_second}))
+    # observatory artifact: signature-keyed, mergeable (§20)
+    emit_signatures(rows, extra={"probe": "sampling_cost"})
 
 
 if __name__ == "__main__":

@@ -1,0 +1,114 @@
+"""Fetch / inspect §20 workload-sketch artifacts.
+
+The measurement half of the auto-planner loop (ROADMAP item 3): a
+replica or gateway serves its workload sketch at ``GET /sketch``
+(canonical JSON — byte-deterministic for an identical request trace);
+this tool fetches or reads one, re-validates it against the planner's
+pinned schema, and writes/prints it as a committable artifact.
+
+Usage::
+
+    python tools/sketch.py --url http://127.0.0.1:8000        # GET /sketch
+    python tools/sketch.py --url 127.0.0.1:8000 -o sketch.json
+    python tools/sketch.py --file sketch.json --planner-input
+    cat sketch.json | python tools/sketch.py --stdin
+
+``--planner-input`` prints the distilled WorkloadSketch the planner
+consumes (ctx tokens, arrival rate, prefix share) — the exact values
+``planner.plan_from_sketch`` feeds into ``plan_partition``.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import urllib.request
+
+# repo root on sys.path when run as a script from anywhere
+_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from distributed_inference_demo_tpu.planner import (SketchError,
+                                                    load_workload_sketch)
+from distributed_inference_demo_tpu.telemetry.profiling import \
+    render_sketch
+
+
+def fetch_sketch(url: str, timeout: float = 10.0) -> str:
+    """GET /sketch from a replica or gateway; ``url`` may be a bare
+    ``host:port``.  Returns the body VERBATIM (the canonical bytes —
+    re-dumping here would break byte-determinism)."""
+    if "://" not in url:
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/sketch"):
+        url = url.rstrip("/") + "/sketch"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fetch/inspect a workload-sketch artifact")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="replica or gateway base URL "
+                                   "(host:port accepted)")
+    src.add_argument("--file", help="read an artifact from a JSON file")
+    src.add_argument("--stdin", action="store_true",
+                     help="read an artifact from stdin")
+    ap.add_argument("-o", "--out", help="write the canonical artifact "
+                                        "to this path (atomic-ish)")
+    ap.add_argument("--planner-input", action="store_true",
+                    help="print the distilled planner workload input "
+                         "instead of the raw artifact")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    if args.url:
+        raw = fetch_sketch(args.url, timeout=args.timeout)
+    elif args.stdin:
+        raw = sys.stdin.read()
+    else:
+        with open(args.file) as f:
+            raw = f.read()
+
+    try:
+        obj = json.loads(raw)
+    except ValueError as e:
+        print(f"error: artifact is not JSON: {e}", file=sys.stderr)
+        return 2
+    # validate against the planner's pinned schema BEFORE writing: a
+    # committed artifact the planner later rejects helps nobody
+    try:
+        ws = load_workload_sketch(obj)
+    except SketchError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    canonical = render_sketch(obj)
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(canonical)
+        import os
+        os.replace(tmp, args.out)
+        print(f"wrote {args.out} ({len(canonical)} bytes, "
+              f"{ws.requests} requests)", file=sys.stderr)
+
+    if args.planner_input:
+        print(json.dumps({
+            "ctx_tokens": ws.ctx_tokens,
+            "arrival_rate_per_s": round(ws.arrival_rate, 6),
+            "prefix_share": round(ws.prefix_share, 6),
+            "prompt_p50": ws.prompt_p50, "prompt_p95": ws.prompt_p95,
+            "decode_p50": ws.decode_p50, "decode_p95": ws.decode_p95,
+            "requests": ws.requests, "window_s": ws.window_s,
+            "tenants": ws.tenants,
+        }, sort_keys=True, separators=(",", ":")))
+    elif not args.out:
+        print(canonical)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
