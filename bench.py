@@ -33,6 +33,10 @@ Extra legs (each reported inside the same JSON object):
 - ``prefix_reuse``: the block KV cache (runtime/kvcache) on a
   repeated-shared-prefix workload — hit rate, reused tokens, and
   measured prefill-seconds saved (cache-off vs cache-on wall delta);
+- ``tiered_prefix``: the §21 host-RAM/disk KV tier vs re-prefill when
+  the shared-prefix working set exceeds the device pool — revisit TTFT
+  p95, promotion h2d bytes, per-tier hit rates, greedy bit-identity,
+  and the three-tier zero-leak check;
 - ``paged_decode``: paged vs dense KV layout on the batching engine —
   decode tok/s ratio, reserved-vs-actually-allocated cache HBM at a
   serving-realistic max_seq, and the primed phase's h2d_bytes == 0
@@ -1363,6 +1367,171 @@ def _leg_prefix_reuse(model: str, new_tokens: int, slots: int = 8,
         "blocks_resident": snap["blocks_used"],
         "evicted_blocks": snap["evicted_blocks"],
     }
+
+
+def _leg_tiered_prefix(model: str, new_tokens: int, slots: int = 2,
+                       groups: int = 6, revisits: int = 3,
+                       shared_len: int = 96, tail_len: int = 16,
+                       block_tokens: int = 16, kv_blocks: int = 24,
+                       host_groups: int = 3) -> dict:
+    """Tiered KV (docs/DESIGN.md §21) vs re-prefill on a
+    working-set-over-HBM workload: ``groups`` distinct shared prefixes
+    whose trees cannot all stay resident in a ``kv_blocks``-block device
+    pool, revisited after eviction.
+
+    Phase A (tiering OFF) pays a full re-prefill on every revisit of an
+    evicted prefix.  Phase B (tiering ON, host ring sized to hold
+    ``host_groups`` of the ``groups`` prefixes so the REST spill to the
+    disk segment) promotes the demoted pages back through the staged
+    adopt seam instead.  Same prompts, same greedy sampling, same pool:
+    the gates are
+
+    - ``tiered_wins_ttft_p95``: revisit TTFT p95 with tiering beats
+      re-prefill;
+    - ``promote_h2d_bytes`` > 0: the promotion path actually moved
+      bytes (phase A's h2d stays 0 — nothing else may touch the host
+      bounce);
+    - ``bit_identical``: greedy revisit tokens match across phases —
+      a promoted prefix is the SAME cache state, not an approximation;
+    - ``three_tier_zero_leak``: at leg end the device pool's used
+      blocks equal tree-owned blocks and the host/disk ledgers pass
+      :meth:`TieredKVStore.check` (host XOR disk, exact byte sums,
+      consistent disk free list).
+    """
+    import tempfile
+
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+    from distributed_inference_demo_tpu.runtime.stats import _percentile
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    greedy = SamplingParams(greedy=True)
+    new_tokens = min(new_tokens, 16)
+    max_seq = shared_len + tail_len + new_tokens + block_tokens
+    rng = np.random.default_rng(7)
+    shared = [rng.integers(2, cfg.vocab_size - 1, size=(shared_len,))
+              .astype(np.int32) for _ in range(groups)]
+    # revisit tails fixed up front so BOTH phases replay the identical
+    # prompt sequence (the bit-identity gate compares token-for-token)
+    tails = [[rng.integers(2, cfg.vocab_size - 1, size=(tail_len,))
+              .astype(np.int32) for _ in range(revisits)]
+             for _ in range(groups)]
+    # the warm prompt has the SAME shape as a group prompt so the
+    # promote-path warmup below compiles the same adopt-scatter block
+    # count the measured revisits dispatch
+    warm = rng.integers(2, cfg.vocab_size - 1,
+                        size=(shared_len + tail_len,)).astype(np.int32)
+    blocks_per_group = -(-(shared_len + tail_len + new_tokens)
+                         // block_tokens)
+
+    def run(tier_kwargs):
+        with ContinuousBatchingEngine(
+                cfg, params, max_seq=max_seq, max_batch=slots,
+                sampling=greedy, kv_cache_blocks=kv_blocks,
+                kv_block_tokens=block_tokens, **tier_kwargs) as eng:
+            # compile the admission/prefill/decode programs before
+            # timing; the warm blocks sit in-tree identically in both
+            # phases (oldest, so they evict first either way)
+            eng.submit(warm, new_tokens).wait(timeout=600)
+            eng.kv_cache.reset_stats()
+            # round 1: touch every group once; the small pool evicts
+            # older groups as later ones admit (demoting in phase B)
+            for g in range(groups):
+                eng.submit(np.concatenate([shared[g], tails[g][0]]),
+                           new_tokens).wait(timeout=900)
+            # promote-path warmup, symmetric across phases: the warm
+            # prefix was evicted by round 1, so resubmitting it here
+            # compiles the adopt-scatter programs (phase B) / replays a
+            # re-prefill (phase A) OUTSIDE the measured wave — same
+            # discipline as warming prefill before timing it
+            eng.submit(warm, new_tokens).wait(timeout=900)
+            # round 2: revisit every group — evicted prefixes re-prefill
+            # (phase A) or promote from the tier (phase B).  Revisit
+            # round 0 is the steady-state round: it flushes out the
+            # remaining demote/promote compile variants (the export and
+            # adopt scatters bucket to powers of two, but a leaf size
+            # class first seen mid-wave would still stall one TTFT on a
+            # compile); rounds >= 1 are the measured ones.  Tokens from
+            # EVERY round feed the bit-identity gate.
+            ttfts, toks = [], []
+            for rv in range(revisits):
+                for g in range(groups):
+                    r = eng.submit(
+                        np.concatenate([shared[g], tails[g][rv]]),
+                        new_tokens)
+                    r.wait(timeout=900)
+                    if rv >= 1:
+                        ttfts.append(r.t_first - r.t_submit)
+                    toks.append(list(r.tokens))
+            snap = eng.kv_cache.snapshot()
+            leaked = snap["blocks_used"] - snap["tree_blocks"]
+            tier_ok = True
+            if eng.kv_cache.tier is not None:
+                try:
+                    eng.kv_cache.tier.check()
+                except AssertionError:
+                    tier_ok = False
+            return {"ttfts": ttfts, "tokens": toks, "snap": snap,
+                    "leaked_blocks": leaked, "tier_ledger_ok": tier_ok}
+
+    cold = run({})
+    # size the host ring off the REAL pool geometry (quantized pools
+    # carry scale sidecars; 1.25x covers them at int4's worst ratio)
+    per_block = cold["snap"]["capacity_bytes"] // max(kv_blocks, 1)
+    host_bytes = int(per_block * blocks_per_group * host_groups * 1.25)
+    disk_bytes = int(per_block * blocks_per_group * groups * 1.5)
+    with tempfile.TemporaryDirectory(prefix="dwt-tier-") as td:
+        tiered = run({"kv_host_tier_bytes": host_bytes,
+                      "kv_disk_tier_path": os.path.join(td, "kv.seg"),
+                      "kv_disk_tier_bytes": disk_bytes})
+
+    def pcts(xs):
+        xs = sorted(xs)
+        return {"requests": len(xs),
+                "ttft_p50_ms": round(_percentile(xs, 50) * 1e3, 2),
+                "ttft_p95_ms": round(_percentile(xs, 95) * 1e3, 2)}
+
+    a, b = pcts(cold["ttfts"]), pcts(tiered["ttfts"])
+    frag = tiered["snap"].get("tier") or {}
+    hits = frag.get("host_hits", 0) + frag.get("disk_hits", 0)
+    out = {
+        "model": model, "slots": slots, "groups": groups,
+        "revisits": revisits, "shared_prefix_tokens": shared_len,
+        "tail_tokens": tail_len, "new_tokens": new_tokens,
+        "block_tokens": block_tokens, "kv_blocks": kv_blocks,
+        "host_tier_bytes": host_bytes, "disk_tier_bytes": disk_bytes,
+        "reprefill": a, "tiered": b,
+        "tiered_wins_ttft_p95": b["ttft_p95_ms"] < a["ttft_p95_ms"],
+        "ttft_p95_speedup": round(a["ttft_p95_ms"] / b["ttft_p95_ms"], 3)
+        if b["ttft_p95_ms"] else None,
+        "promote_h2d_bytes": tiered["snap"]["h2d_bytes"],
+        "reprefill_h2d_bytes": cold["snap"]["h2d_bytes"],
+        "demoted_blocks": frag.get("demoted_blocks", 0),
+        "promoted_blocks": frag.get("promoted_blocks", 0),
+        "spilled_blocks": frag.get("spilled_blocks", 0),
+        "dropped_blocks": frag.get("dropped_blocks", 0),
+        "tier_hits": {"host": frag.get("host_hits", 0),
+                      "disk": frag.get("disk_hits", 0)},
+        # which tier the promoted blocks came from (host ring vs the
+        # disk segment below it)
+        "tier_hit_share": ({
+            "host": round(frag.get("host_hits", 0) / hits, 3),
+            "disk": round(frag.get("disk_hits", 0) / hits, 3)}
+            if hits else None),
+        "bit_identical": cold["tokens"] == tiered["tokens"],
+        "three_tier_zero_leak": (cold["leaked_blocks"] == 0
+                                 and tiered["leaked_blocks"] == 0
+                                 and tiered["tier_ledger_ok"]),
+        "leaked_blocks": {"reprefill": cold["leaked_blocks"],
+                          "tiered": tiered["leaked_blocks"]},
+    }
+    return out
 
 
 def _leg_paged_decode(model: str, new_tokens: int, slots: int = 8,
@@ -2788,7 +2957,7 @@ def micro_shape(p: dict) -> dict:
 # snapshot IS that leg's own dispatches — no cross-leg bleed.
 _PROFILED_LEGS = {"headline", "headline_int8", "flagship_bf16",
                   "flagship_int8", "decode_fused", "batching",
-                  "mixed_batching"}
+                  "mixed_batching", "tiered_prefix"}
 
 
 def _dispatch_profile_extras() -> dict:
@@ -2861,6 +3030,17 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
                    if micro else _leg_mixed_batching(model))
         elif name == "prefix_reuse":
             out = _leg_prefix_reuse(model, min(new_tokens, 64))
+        elif name == "tiered_prefix":
+            # the micro shape keeps the §21 gate structural on CPU: a
+            # 14-block pool under a 4-group working set (8 blocks per
+            # group) thrashes every revisit, the 2-group host ring
+            # forces the rest through the disk segment
+            out = (_leg_tiered_prefix(model, min(new_tokens, 8),
+                                      groups=4, revisits=2,
+                                      shared_len=48, tail_len=8,
+                                      block_tokens=8, kv_blocks=14,
+                                      host_groups=2) if micro
+                   else _leg_tiered_prefix(model, new_tokens))
         elif name == "paged_decode":
             out = _leg_paged_decode(model, new_tokens)
         elif name == "serving_relative":
@@ -3164,7 +3344,7 @@ def main() -> None:
             "prompt_lookup", "planner_pipeline", "long_context",
             "long_context_sp", "disagg", "gateway_routing",
             "flagship_int8", "batching", "mixed_batching",
-            "prefix_reuse", "paged_decode",
+            "prefix_reuse", "tiered_prefix", "paged_decode",
             "serving_relative", "sweep", "flagship_bf16", "pipeline",
             "fault_recovery", "prefill_long", "moe", "multimodal",
             "int4"]
@@ -3175,7 +3355,8 @@ def main() -> None:
             ("BENCH_SKIP_SWEEP", ["sweep"]),
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
                                     "batching", "mixed_batching",
-                                    "prefix_reuse", "paged_decode",
+                                    "prefix_reuse", "tiered_prefix",
+                                    "paged_decode",
                                     "serving_relative", "disagg",
                                     "gateway_routing"]),
             ("BENCH_SKIP_LONGCTX", ["long_context", "long_context_sp"]),
@@ -3239,8 +3420,10 @@ def main() -> None:
     # builds two engines + three waves — budget it like batching
     # gateway_routing runs three replica engines through three phases
     # (two routed soaks + the drain) — multi-engine, budget it likewise
+    # tiered_prefix builds two engines (re-prefill reference + tiered)
+    # and runs two routed rounds each — budget it like prefix_reuse
     leg_timeouts = {"batching": 1500, "mixed_batching": 1500,
-                    "prefix_reuse": 1200,
+                    "prefix_reuse": 1200, "tiered_prefix": 1200,
                     "paged_decode": 1500, "serving_relative": 1500,
                     "gateway_routing": 1500}
     runlog.event("bench_start", params=params, legs=legs)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Optional
 
@@ -100,6 +101,35 @@ def _kvcache_from_args(args):
     serve modes."""
     return {"kv_cache_blocks": getattr(args, "kv_cache_blocks", None),
             "kv_block_tokens": getattr(args, "kv_block_tokens", None)}
+
+
+def _kv_tier_from_args(args):
+    """The §21 tiered-KV kwargs for the one engine that plumbs them
+    explicitly (ContinuousBatchingEngine).  Every OTHER engine reaches
+    the tier through ``make_kv_backend``'s env fallback, which is why
+    :func:`_export_kv_tier_env` pushes the flags into the ``DWT_KV_*``
+    knobs instead of threading three kwargs through every ctor."""
+    return {"kv_host_tier_bytes": getattr(args, "kv_host_tier_bytes",
+                                          None),
+            "kv_disk_tier_path": getattr(args, "kv_disk_tier_path",
+                                         None) or None,
+            "kv_disk_tier_bytes": getattr(args, "kv_disk_tier_bytes",
+                                          None)}
+
+
+def _export_kv_tier_env(args) -> None:
+    """Arg-over-env, via env: the tier flags overwrite their own env
+    knobs so ``resolve_tier_config`` (called inside ``make_kv_backend``
+    at every pool-creation site) sees the CLI's values — the §17
+    kv_dtype funnel pattern, flag wins, zero per-engine plumbing."""
+    if getattr(args, "kv_host_tier_bytes", None) is not None:
+        os.environ["DWT_KV_HOST_TIER_BYTES"] = str(
+            args.kv_host_tier_bytes)
+    if getattr(args, "kv_disk_tier_path", None):
+        os.environ["DWT_KV_DISK_TIER_PATH"] = args.kv_disk_tier_path
+    if getattr(args, "kv_disk_tier_bytes", None) is not None:
+        os.environ["DWT_KV_DISK_TIER_BYTES"] = str(
+            args.kv_disk_tier_bytes)
 
 
 def _kvcache_flags_set(args) -> bool:
@@ -208,6 +238,7 @@ def cmd_serve(args) -> int:
     chain (start the workers first with the ``worker`` subcommand)."""
     from .runtime.http_server import HeaderBackend, InferenceHTTPServer
 
+    _export_kv_tier_env(args)
     if getattr(args, "run_log", ""):
         from .telemetry.runlog import RunLog, set_run_log
         rl = RunLog(args.run_log)
@@ -485,7 +516,7 @@ def cmd_serve(args) -> int:
             kv_layout=getattr(args, "kv_layout", None),
             kv_dtype=getattr(args, "kv_dtype", None),
             max_queue_depth=getattr(args, "admission_queue_depth", 0),
-            **_kvcache_from_args(args))
+            **_kvcache_from_args(args), **_kv_tier_from_args(args))
         kvc = backend.kv_cache
         kv_desc = "off" if kvc is None else (
             f"{getattr(kvc, 'num_blocks', None) or kvc.pool.num_blocks}"
@@ -939,6 +970,7 @@ def cmd_generate(args) -> int:
     """One-shot local generation (ids in, ids/text out)."""
     import numpy as np
 
+    _export_kv_tier_env(args)
     tokenizer = _load_tokenizer(args.tokenizer)
     if args.prompt_ids:
         ids = np.asarray([[int(t) for t in args.prompt_ids.split(",")]],
@@ -1206,6 +1238,24 @@ def _add_engine_args(ap):
                     help="tokens per KV cache block (match granularity "
                          "AND minimum reusable prefix; default "
                          "DWT_KVCACHE_BLOCK_TOKENS, else 16)")
+    ap.add_argument("--kv-host-tier-bytes", type=int, default=None,
+                    help="tiered KV (docs/DESIGN.md §21): byte budget "
+                         "of the host-RAM ring that catches KV blocks "
+                         "LRU-evicted from the device page pool; a "
+                         "radix miss whose prefix sits demoted promotes "
+                         "it back for one h2d adopt instead of "
+                         "re-prefilling.  Default DWT_KV_HOST_TIER_"
+                         "BYTES, else 0 (off)")
+    ap.add_argument("--kv-disk-tier-path", default=None,
+                    help="optional mmap'd disk segment BELOW the host "
+                         "ring: host-budget overflow spills here "
+                         "(oldest first) instead of dropping; requires "
+                         "--kv-host-tier-bytes > 0 and "
+                         "--kv-disk-tier-bytes.  Default "
+                         "DWT_KV_DISK_TIER_PATH")
+    ap.add_argument("--kv-disk-tier-bytes", type=int, default=None,
+                    help="byte budget of the disk segment (0 = no disk "
+                         "tier; default DWT_KV_DISK_TIER_BYTES)")
     ap.add_argument("--kv-dtype", default=None,
                     choices=["bf16", "int8", "int4"],
                     help="KV page WIDTH for the paged pool "

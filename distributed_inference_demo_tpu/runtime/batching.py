@@ -189,7 +189,10 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  kv_layout: Optional[str] = None,
                  max_queue_depth: Optional[int] = None,
-                 mixed_token_budget: Optional[int] = None):
+                 mixed_token_budget: Optional[int] = None,
+                 kv_host_tier_bytes: Optional[int] = None,
+                 kv_disk_tier_path: Optional[str] = None,
+                 kv_disk_tier_bytes: Optional[int] = None):
         """``kv_cache_blocks`` / ``kv_block_tokens``: the block-level KV
         cache (``runtime/kvcache``, docs/DESIGN.md §10) — automatic
         prefix reuse at ``kv_block_tokens`` granularity.  A new prompt
@@ -297,7 +300,19 @@ class ContinuousBatchingEngine:
         speculative modes (draft/prompt-lookup ride the serialized
         path).  ``None`` defers to ``DWT_MIXED_TOKEN_BUDGET``; 0 (the
         default) keeps the serialized interleave, which is the
-        bit-identity reference the mixed path is pinned against."""
+        bit-identity reference the mixed path is pinned against.
+
+        ``kv_host_tier_bytes`` / ``kv_disk_tier_path`` /
+        ``kv_disk_tier_bytes``: the TIERED KV capacity layer below the
+        page pool (docs/DESIGN.md §21) — LRU-evicted radix leaves
+        demote into a byte-budgeted host-RAM ring (plus an optional
+        mmap'd disk segment below it) instead of vanishing, and a
+        later prompt sharing the demoted prefix promotes the blocks
+        back through the §15 adopt seam instead of re-prefilling.
+        ``None`` defers to ``DWT_KV_HOST_TIER_BYTES`` /
+        ``DWT_KV_DISK_TIER_PATH`` / ``DWT_KV_DISK_TIER_BYTES``; 0
+        (the default) disables the tier — eviction discards, exactly
+        as before."""
         if max_queue_depth is None:
             from ..telemetry._env import env_int
             max_queue_depth = env_int("DWT_MAX_QUEUE_DEPTH", 0)
@@ -445,6 +460,23 @@ class ContinuousBatchingEngine:
         # construction) and scatters it into the scratch pool; the
         # TARGET's temp-row path is deleted — prefill pages directly
         self._write_row = write_row_to_pages
+
+        # tiered KV (docs/DESIGN.md §21): the host-RAM/disk capacity
+        # layer below the pool.  The demote hook closes over the LIVE
+        # pool references (they rotate on every donating dispatch);
+        # promotion runs in _reserve_pages, before the match.
+        from .kvcache import (TieredKVStore, make_demote_hook,
+                              resolve_tier_config)
+        tier_host, tier_path, tier_disk = resolve_tier_config(
+            kv_host_tier_bytes, kv_disk_tier_path, kv_disk_tier_bytes)
+        self._kv_tier = None
+        if tier_host > 0:
+            self._kv_tier = TieredKVStore(
+                tier_host, bt, disk_path=tier_path,
+                disk_bytes=tier_disk)
+            self.kv_cache.tier = self._kv_tier
+            self.kv_cache.demote_hook = make_demote_hook(
+                self._kv_tier, lambda: (self._pk, self._pv))
 
         def _emitted_logprob(logits, tok):
             """Raw log-softmax of the emitted token (the engines'
@@ -1756,6 +1788,11 @@ class ContinuousBatchingEngine:
         self._running = False
         self._queue.put(None)              # wake the scheduler
         self._thread.join(timeout=30)
+        # the tier dies with its pool: demoted entries reference a page
+        # layout the successor engine may not share, and the host ring /
+        # mmap'd segment must not outlive the engine that budgeted them
+        if self._kv_tier is not None:
+            self._kv_tier.close()
         # reset-on-close: this engine's pool owners leave the process
         # watermark ledger (a successor engine's pools start a fresh
         # high-water history; other engines' owners are untouched)
@@ -1816,6 +1853,17 @@ class ContinuousBatchingEngine:
         # disaggregated join: land migrated blocks + adopt BEFORE the
         # match below, which then finds them as an ordinary prefix hit
         self._import_staged(req)
+        # tier promotion (docs/DESIGN.md §21) rides the same seam: a
+        # demoted continuation of the prompt's device-covered prefix
+        # adopts back into the pool here, so the match below finds it
+        # as an ordinary hit.  Best-effort: pool pressure skips it and
+        # the suffix prefills (never _BlocksExhausted — a cold prefill
+        # beats waiting on a warm one).
+        if self._kv_tier is not None:
+            from .kvcache import promote_prefix
+            self._pk, self._pv, _ = promote_prefix(
+                mgr, self._kv_tier, self._pk, self._pv, req.prompt,
+                profiler=self._prof)
         lease = mgr.match(req.prompt)
         m = lease.tokens if lease is not None else 0
         n_pref = m // bt
@@ -2359,6 +2407,13 @@ class ContinuousBatchingEngine:
                              d.get("device_resident_bytes", 0)
                              + d.get("quant_scale_bytes", 0))
             self._hbm_owners.add("draft_scratch")
+        if self._kv_tier is not None:
+            # host RAM, not HBM — but the same ledger answers the same
+            # postmortem question ("how big did this pool get"), and
+            # reset-on-close retires it with the engine's other owners
+            self._hbm.sample("host_tier",
+                             self._kv_tier.host_resident_bytes)
+            self._hbm_owners.add("host_tier")
 
     def _decode_kv_bytes(self, active_mask, steps: int) -> int:
         """KV bytes one fused decode dispatch touched (achieved-GB/s

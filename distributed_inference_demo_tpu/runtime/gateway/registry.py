@@ -91,6 +91,14 @@ class Replica:
     def pending_prefill_tokens(self) -> int:
         return int(self.last_stats.get("pending_prefill_tokens", 0))
 
+    @property
+    def kv_tier(self) -> dict:
+        """The replica's last-reported §21 tier fragment (empty dict
+        when the replica runs no host tier) — occupancy for /debugz,
+        demoted-prefix digest for the router's second chance."""
+        kv = self.last_stats.get("kvcache") or {}
+        return kv.get("tier") or {}
+
 
 class ReplicaRegistry:
     """Debounced replica membership (see module docstring)."""
@@ -298,6 +306,14 @@ class ReplicaRegistry:
                             "probes": r.probes, "failures": r.failures,
                             "queue_depth": r.queue_depth,
                             "down_for_s": (round(self._clock() - r.down_at, 3)
-                                           if r.down_at is not None else None)}
+                                           if r.down_at is not None else None),
+                            # §21 tier occupancy (bounded: counts and
+                            # bytes, never the digest list itself)
+                            "kv_tier": ({k: r.kv_tier.get(k, 0)
+                                         for k in ("host_blocks",
+                                                   "host_resident_bytes",
+                                                   "disk_blocks",
+                                                   "disk_resident_bytes")}
+                                        if r.kv_tier else None)}
                     for r in self._replicas.values()},
             }

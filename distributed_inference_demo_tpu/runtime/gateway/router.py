@@ -25,6 +25,15 @@ only gateway-side state (docs/DESIGN.md §16):
   whose radix tree emptied (restart, eviction storm) gets its index
   flushed instead of attracting traffic for prefixes it no longer
   holds.  A readmitted replica is flushed the same way.
+- **host-tier second chance** (docs/DESIGN.md §21): when no replica's
+  device-tier index matches enough prefix, the router consults the
+  demoted-prefix digests replicas publish in ``/stats`` (the tiered-KV
+  host ring's newest chain digests, 64-bit-truncated).  A replica
+  whose HOST tier holds the prefix promotes it back for one h2d adopt
+  instead of re-prefilling — cheaper than the hash fallback's cold
+  replica.  Unlike the routing-history index this is replica-REPORTED
+  state (probe-fresh, capped), so it sits strictly between the prefix
+  policy and the hash fallback, never above the device-tier estimate.
 
 Everything is in-process state under one lock; the router never opens
 a socket (the registry probes, the server proxies).
@@ -54,7 +63,7 @@ class RouteDecision:
     def __init__(self, rid: str, policy: str, match_tokens: int,
                  candidates: List[str]):
         self.rid = rid
-        self.policy = policy            # "prefix" | "hash"
+        self.policy = policy            # "prefix" | "host_tier" | "hash"
         self.match_tokens = match_tokens
         # alternates for retry-before-first-token, preference order
         self.candidates = candidates
@@ -93,6 +102,11 @@ class PrefixAwareRouter:
         self._prefix_hits: Dict[str, int] = {}
         # last replica-reported radix occupancy, for reconciliation
         self._replica_nodes: Dict[str, int] = {}
+        # last replica-reported demoted-prefix digest (§21 host tier):
+        # rid -> {"block_tokens": int, "digests": frozenset of 64-bit
+        # hex strings} — replica-owned truth, replaced wholesale on
+        # every /stats probe, so it never needs LRU bookkeeping here
+        self._tier_index: Dict[str, dict] = {}
         registry.on_readmit = self.flush_replica
         registry.on_stats = self.reconcile
 
@@ -149,12 +163,42 @@ class PrefixAwareRouter:
                     return n
         return 0
 
+    def tier_match_tokens(self, rid: str, tokens: Sequence[int]) -> int:
+        """Longest prefix of ``tokens`` whose chain digest appears in
+        ``rid``'s reported demoted-prefix digest, in tokens.
+
+        Recomputed at the REPLICA's block granularity (its pool may run
+        a different ``block_tokens`` than the router default) with the
+        replica's 64-bit truncation — byte-compatible with
+        ``kvcache.tiered.chain_digests`` / ``TieredKVStore.digest()``.
+        Deepest boundary wins; the run needn't be contiguous here (the
+        replica's promote walks contiguity itself; a gap just means a
+        shorter actual promote — a hint being optimistic is fine)."""
+        with self._lock:
+            info = self._tier_index.get(rid)
+        if not info:
+            return 0
+        bt = info["block_tokens"]
+        digests = info["digests"]
+        toks = [int(t) for t in tokens[:self.max_key_tokens]]
+        best = 0
+        h = hashlib.sha1()
+        pos = 0
+        for end in range(bt, (len(toks) // bt) * bt + 1, bt):
+            for t in toks[pos:end]:
+                h.update(t.to_bytes(8, "big", signed=True))
+            pos = end
+            if h.hexdigest()[:16] in digests:
+                best = end
+        return best
+
     def flush_replica(self, rid: str) -> None:
         """Drop the routing history for ``rid`` (readmission after an
         outage: its cache state is unknown — re-learn from scratch)."""
         with self._lock:
             self._index.pop(rid, None)
             self._replica_nodes.pop(rid, None)
+            self._tier_index.pop(rid, None)
         _catalog.GATEWAY_INDEX_ENTRIES.set(0, replica=rid)
 
     def reconcile(self, rid: str, stats: dict) -> None:
@@ -164,6 +208,19 @@ class PrefixAwareRouter:
         routing on prefixes the replica evicted would send traffic to
         a cold cache on purpose."""
         kv = stats.get("kvcache") or {}
+        tier = kv.get("tier") or {}
+        digests = tier.get("digest")
+        if digests is not None:
+            bt = int(tier.get("block_tokens", self.block_tokens))
+            with self._lock:
+                if digests:
+                    self._tier_index[rid] = {
+                        "block_tokens": max(1, bt),
+                        "digests": frozenset(str(d) for d in digests)}
+                else:
+                    # empty digest = nothing demoted (or tier closed):
+                    # stop second-chancing onto this replica
+                    self._tier_index.pop(rid, None)
         nodes = kv.get("nodes", kv.get("tree_nodes"))
         if nodes is None:
             return
@@ -230,9 +287,23 @@ class PrefixAwareRouter:
         ranked = sorted(
             ups, key=lambda rid: _digest(key + rid.encode()), reverse=True)
 
+        # host-tier second chance: no device-tier estimate is good
+        # enough, but some replica REPORTS the prefix demoted in its
+        # host ring — promotion beats the hash pick's re-prefill
+        tier_rid, tier_len = None, 0
+        if best_len < self.min_prefix_tokens and toks:
+            for rid in ups:
+                n = self.tier_match_tokens(rid, toks)
+                if n > tier_len or (n == tier_len and n > 0 and tier_rid
+                                    and loads[rid] < loads[tier_rid]):
+                    tier_rid, tier_len = rid, n
+
         if best_rid is not None and best_len >= self.min_prefix_tokens:
             chosen, policy, match = best_rid, "prefix", best_len
             _catalog.GATEWAY_PREFIX_ROUTED.inc()
+        elif tier_rid is not None and tier_len >= self.min_prefix_tokens:
+            chosen, policy, match = tier_rid, "host_tier", tier_len
+            _catalog.GATEWAY_TIER_ROUTED.inc()
         else:
             chosen, policy, match = ranked[0], "hash", 0
             # bounded load: a hashed pick may be busy while the fleet
@@ -285,5 +356,8 @@ class PrefixAwareRouter:
                             self.registry.pending_prefill_tokens(rid),
                         "replica_tree_nodes":
                             self._replica_nodes.get(rid),
+                        "tier_digest_entries": len(
+                            (self._tier_index.get(rid) or {})
+                            .get("digests", ())),
                     } for rid in sorted(rids)},
             }

@@ -306,9 +306,13 @@ class GatewayHTTPServer:
                 self.tracer.record(
                     "gateway.proxy", trace_id, parent_id=route_span,
                     clock=proxy_clock, replica=rid, attempt=attempt)
-            if done and tokens and decision.policy in ("prefix", "hash"):
+            if done and tokens and decision.policy in (
+                    "prefix", "host_tier", "hash"):
                 # the replica now holds this prompt's blocks: teach the
                 # index so the NEXT request sharing the prefix sticks
+                # (a host_tier route lands here too — the promote puts
+                # the prefix back in the replica's DEVICE tree, so the
+                # next hit is an ordinary prefix route)
                 self.router.record(rid, tokens)
             return
         raise GatewayOverloaded(

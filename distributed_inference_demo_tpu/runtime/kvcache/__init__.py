@@ -40,6 +40,8 @@ from .manager import (DEFAULT_BLOCK_TOKENS, KVCacheManager, KVLease,
 from .paged import PagedBlockLease, PagedKVCacheManager
 from .pool import KVBlockPool
 from .radix import RadixTree
+from .tiered import (TieredKVStore, make_demote_hook, promote_prefix,
+                     resolve_tier_config)
 
 KV_LAYOUTS = ("paged",)
 
@@ -74,4 +76,6 @@ __all__ = ["KVBlockPool", "KVCacheManager", "KVLease",
            "PagedBlockLease", "PagedKVCacheManager", "RadixTree",
            "resolve_kvcache_config", "resolve_kv_layout",
            "resolve_kv_dtype", "DEFAULT_BLOCK_TOKENS",
-           "KV_LAYOUTS", "KV_DTYPES"]
+           "KV_LAYOUTS", "KV_DTYPES",
+           "TieredKVStore", "make_demote_hook", "promote_prefix",
+           "resolve_tier_config"]

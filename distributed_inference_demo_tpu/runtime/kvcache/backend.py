@@ -57,7 +57,10 @@ class PagedKVBackend:
     layout = "paged"
 
     def __init__(self, cfg, num_blocks: int, block_tokens: int,
-                 dtype=None, kv_dtype=None):
+                 dtype=None, kv_dtype=None,
+                 kv_host_tier_bytes: Optional[int] = None,
+                 kv_disk_tier_path: Optional[str] = None,
+                 kv_disk_tier_bytes: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -73,20 +76,44 @@ class PagedKVBackend:
              self.mgr.block_tokens, cfg.head_dim), self.kv_dtype,
             page_dtype)
         self._pv = jax.tree.map(jnp.zeros_like, self._pk)
+        # tiered KV (docs/DESIGN.md §21): evicted tree leaves demote
+        # into the host ring; seed() promotes a demoted continuation
+        # back before its match.  Arg over env (resolve_tier_config),
+        # so single-request engines inherit DWT_KV_HOST_TIER_BYTES with
+        # zero per-engine plumbing — the §17 kv_dtype pattern.
+        from .tiered import (TieredKVStore, make_demote_hook,
+                             resolve_tier_config)
+        tier_host, tier_path, tier_disk = resolve_tier_config(
+            kv_host_tier_bytes, kv_disk_tier_path, kv_disk_tier_bytes)
+        self.tier = None
+        if tier_host > 0:
+            self.tier = TieredKVStore(tier_host, self.block_tokens,
+                                      disk_path=tier_path,
+                                      disk_bytes=tier_disk)
+            self.mgr.tier = self.tier
+            self.mgr.demote_hook = make_demote_hook(
+                self.tier, lambda: (self._pk, self._pv))
 
     def seed(self, ids, cache):
         """Match + device gather out of the pool into the fresh cache —
-        zero H2D (``dwt_kvcache_h2d_bytes_total`` stays 0 structurally:
-        this class never moves bytes through the host).  The pin is
-        released right after the gather dispatch: device ops execute in
-        dispatch order, so a later store/evict can never overwrite the
-        pages before the gather reads them."""
+        zero H2D on the device-tier path (this class never moves bytes
+        through the host; ``dwt_kvcache_h2d_bytes_total`` counts only
+        §21 tier promotions, re-staged here before the match so a
+        demoted prefix still seeds).  The pin is released right after
+        the gather dispatch: device ops execute in dispatch order, so a
+        later store/evict can never overwrite the pages before the
+        gather reads them."""
         import jax.numpy as jnp
 
         from ...models.base import KVCache
         from .device import seed_cache_from_pages
         if ids.shape[0] != 1:
             return 0, cache
+        if self.tier is not None:
+            from .tiered import promote_prefix
+            self._pk, self._pv, _ = promote_prefix(
+                self.mgr, self.tier, self._pk, self._pv,
+                np.asarray(ids[0]))
         lease = self.mgr.match(np.asarray(ids[0]))
         if lease is None:
             return 0, cache
@@ -149,10 +176,23 @@ class PagedKVBackend:
     def reset_stats(self) -> None:
         self.mgr.reset_stats()
 
+    def close(self) -> None:
+        """Drop the host/disk tier with the pool it shadows — demoted
+        entries reference a page layout a successor backend may not
+        share, so they die here rather than resurrect wrong."""
+        if self.tier is not None:
+            self.mgr.demote_hook = None
+            self.mgr.tier = None
+            self.tier.close()
+            self.tier = None
+
 
 def make_kv_backend(cfg, kv_cache_blocks: Optional[int],
                     kv_block_tokens: Optional[int], *, layout: str,
-                    dtype=None, kv_dtype=None, default_blocks: int = 0):
+                    dtype=None, kv_dtype=None, default_blocks: int = 0,
+                    kv_host_tier_bytes: Optional[int] = None,
+                    kv_disk_tier_path: Optional[str] = None,
+                    kv_disk_tier_bytes: Optional[int] = None):
     """The one constructor every engine calls: resolve the block-count /
     block-tokens knobs (CLI over env over ``default_blocks``) and build
     the layout's backend — or None when the pool is off (0 blocks, or a
@@ -189,4 +229,7 @@ def make_kv_backend(cfg, kv_cache_blocks: Optional[int],
     if apply_byte_budget(n_blocks, block_bytes) < 1:
         return None
     return PagedKVBackend(cfg, n_blocks, block_tokens, dtype=dtype,
-                          kv_dtype=kv_dtype)
+                          kv_dtype=kv_dtype,
+                          kv_host_tier_bytes=kv_host_tier_bytes,
+                          kv_disk_tier_path=kv_disk_tier_path,
+                          kv_disk_tier_bytes=kv_disk_tier_bytes)

@@ -95,8 +95,15 @@ class PagedKVCacheManager:
         self.epoch = 0
         self.stats = {"hits": 0, "misses": 0, "partial_hit_tokens": 0,
                       "stores": 0, "stored_blocks": 0,
-                      "evicted_blocks": 0}
+                      "evicted_blocks": 0, "promote_h2d_bytes": 0}
         self._flight = get_flight_recorder()
+        # capacity tier below the pool (docs/DESIGN.md §21), installed
+        # by the pool OWNER (only it can gather page bytes): the hook
+        # receives each eviction victim's (full key path, freed ids)
+        # BEFORE those ids are handed back out; ``tier`` makes the
+        # tier's occupancy ride this manager's snapshot()/stats surface
+        self.demote_hook = None
+        self.tier = None
 
     @classmethod
     def for_model(cls, cfg, num_blocks: int, block_tokens: int,
@@ -188,18 +195,29 @@ class PagedKVCacheManager:
         pending admission that cannot be satisfied does not flush the
         prefix cache on every retry."""
         evicted = 0
+        demote = []
         with self._lock:
             if len(self._free) + self._reclaimable_locked() < n:
                 return None
             while len(self._free) < n:
-                freed = self.tree.evict_lru_leaf()
+                path, freed = self.tree.evict_lru_leaf_entry()
                 assert freed, "feasibility check promised evictable blocks"
                 self._free.extend(freed)
                 evicted += len(freed)
+                if self.demote_hook is not None:
+                    demote.append((path, freed))
             out = [self._free.pop() for _ in range(n)]
             if evicted:
                 self.stats["evicted_blocks"] += evicted
                 self.epoch += 1
+        # demote OUTSIDE the lock (the hook d2h-gathers page bytes and
+        # may block) but BEFORE returning the allocation: the caller
+        # has not seen the ids yet, so none of the freed pages — some
+        # of which are being handed right back out — can be rewritten
+        # before the gather dispatch reads them.  The hook never raises
+        # (a failed demotion costs cache capacity, not admission).
+        for path, freed in demote:
+            self.demote_hook(path, freed)
         if evicted:
             self._flight.record("kvcache_evict", blocks=evicted,
                                 layout="paged")
@@ -284,32 +302,53 @@ class PagedKVCacheManager:
 
     # ------------------------------------------------------------------
 
+    def note_promote_h2d(self, nbytes: int) -> None:
+        """Count a tier promotion's adopt-scatter bytes: the ONE honest
+        exception to the paged layout's h2d_bytes == 0 claim (docs/
+        DESIGN.md §21) — the bytes really do cross host -> device."""
+        with self._lock:
+            self.stats["promote_h2d_bytes"] += int(nbytes)
+
     def reset_stats(self) -> None:
         with self._lock:
             for k in self.stats:
                 self.stats[k] = 0
+        if self.tier is not None:
+            self.tier.reset_stats()
 
     def snapshot(self) -> dict:
         """Counters + occupancy for ``/stats`` and the ``dwt_kvcache_*``
-        bridge.  ``h2d_bytes`` is structurally 0 here (nothing in this
-        class can move bytes); ``resident_bytes`` (host) likewise —
-        the pool is device HBM, reported as
-        ``device_resident_bytes``/``capacity_bytes``."""
+        bridge.  ``h2d_bytes`` is 0 by construction on every lookup /
+        store path (hits are block-table references, stores ownership
+        adoptions); the ONE thing that can move bytes host -> device is
+        a §21 tier promotion, counted honestly here.  ``resident_bytes``
+        (host) stays 0 — the pool is device HBM, reported as
+        ``device_resident_bytes``/``capacity_bytes``; the HOST tier
+        reports its own bytes under the ``tier`` sub-dict."""
         with self._lock:
             used = self.num_blocks - len(self._free)
-            return dict(self.stats,
-                        layout="paged",
-                        h2d_bytes=0,
-                        block_tokens=self.block_tokens,
-                        blocks_total=self.num_blocks,
-                        blocks_used=used,
-                        resident_bytes=0,
-                        device_resident_bytes=used * self.block_bytes,
-                        capacity_bytes=self.num_blocks * self.block_bytes,
-                        page_dtype=self.kv_dtype,
-                        quant_scale_bytes=used * self.scale_block_bytes,
-                        tree_blocks=self.tree.block_count,
-                        nodes=self.tree.node_count - 1)
+            out = dict(self.stats,
+                       layout="paged",
+                       h2d_bytes=self.stats["promote_h2d_bytes"],
+                       block_tokens=self.block_tokens,
+                       blocks_total=self.num_blocks,
+                       blocks_used=used,
+                       resident_bytes=0,
+                       device_resident_bytes=used * self.block_bytes,
+                       capacity_bytes=self.num_blocks * self.block_bytes,
+                       page_dtype=self.kv_dtype,
+                       quant_scale_bytes=used * self.scale_block_bytes,
+                       tree_blocks=self.tree.block_count,
+                       nodes=self.tree.node_count - 1)
+        if self.tier is not None:
+            # outside self._lock (lock order: manager -> tier, never
+            # nested the other way).  The digest list is the gateway's
+            # second-chance routing hint; it rides /stats so the
+            # registry prober carries it for free.
+            frag = self.tier.snapshot()
+            frag["digest"] = self.tier.digest()["digests"]
+            out["tier"] = frag
+        return out
 
     def debug_state(self) -> dict:
         snap = self.snapshot()

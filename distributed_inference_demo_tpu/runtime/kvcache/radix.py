@@ -195,11 +195,25 @@ class RadixTree:
         """Remove the least-recently-used evictable leaf; returns its
         pool block ids (for the caller to free), or [] when nothing is
         evictable (every leaf is leased)."""
+        return self.evict_lru_leaf_entry()[1]
+
+    def evict_lru_leaf_entry(self) -> Tuple[List[BlockKey], List[int]]:
+        """Like :meth:`evict_lru_leaf`, but also returns the victim's
+        FULL root-to-leaf key path (ancestor edge keys + its own) — the
+        tier-demotion hook keys the freed blocks by their chain digest,
+        which covers every preceding block, not just the leaf's edge.
+        The path's last ``len(blocks)`` keys label the returned blocks.
+        Returns ``([], [])`` when nothing is evictable."""
         leaves = self.evictable_leaves()
         if not leaves:
-            return []
+            return [], []
         victim = min(leaves, key=lambda n: n.last_use)
         parent = victim.parent
+        path: List[BlockKey] = list(victim.keys)
+        node = parent
+        while node is not None:
+            path = list(node.keys) + path
+            node = node.parent
         del parent.children[victim.keys[0]]
         self.node_count -= 1
         self.block_count -= len(victim.blocks)
@@ -214,7 +228,7 @@ class RadixTree:
             parent.parent.children[only.keys[0]] = only
             only.last_use = max(only.last_use, parent.last_use)
             self.node_count -= 1
-        return victim.blocks
+        return path, victim.blocks
 
     # ------------------------------------------------------------------
     # invariants (test hook)

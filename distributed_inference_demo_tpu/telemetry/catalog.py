@@ -196,6 +196,98 @@ KVCACHE_QUANT_SCALE_BYTES = gauge(
     "sidecars of in-use pages — the accounting overhead the narrow "
     "page width pays; 0 on the bf16 layout")
 
+# -- capacity tier below the device pool (docs/DESIGN.md §21) --------------
+# demotions gather evicted radix leaves to a host-RAM ring (optionally
+# spilling to an mmap'd disk segment); a radix miss whose prefix sits
+# demoted promotes back through the staged-adopt seam.  Gauges carry a
+# tier label (host / disk); promote H2D bytes ALSO count into
+# dwt_kvcache_h2d_bytes_total — the honest-bytes invariant.
+
+KVCACHE_TIER_RESIDENT_BYTES = gauge(
+    "dwt_kvcache_tier_resident_bytes",
+    "Bytes of demoted KV blocks resident per capacity tier (host ring "
+    "/ disk segment); 0 when tiering is off (--kv-host-tier-bytes "
+    "unset)", ("tier",))
+KVCACHE_TIER_RESIDENT_BLOCKS = gauge(
+    "dwt_kvcache_tier_resident_blocks",
+    "Demoted KV blocks resident per capacity tier", ("tier",))
+KVCACHE_TIER_CAPACITY_BYTES = gauge(
+    "dwt_kvcache_tier_capacity_bytes",
+    "Configured byte budget per capacity tier (--kv-host-tier-bytes / "
+    "--kv-disk-tier-bytes)", ("tier",))
+KVCACHE_TIER_DEMOTED_BLOCKS = counter(
+    "dwt_kvcache_tier_demoted_blocks_total",
+    "KV blocks demoted out of the device pool into the host ring by "
+    "LRU leaf eviction (admitted after in-tier dedup)")
+KVCACHE_TIER_DEMOTED_BYTES = counter(
+    "dwt_kvcache_tier_demoted_bytes_total",
+    "Bytes demoted into the host ring (quantized payload + sidecars, "
+    "at page width — NOT dequantized)")
+KVCACHE_TIER_PROMOTED_BLOCKS = counter(
+    "dwt_kvcache_tier_promoted_blocks_total",
+    "Demoted KV blocks promoted back into device pages on a tier hit "
+    "(move semantics: the tier copy is consumed)")
+KVCACHE_TIER_PROMOTED_BYTES = counter(
+    "dwt_kvcache_tier_promoted_bytes_total",
+    "Bytes promoted back to the device (also counted into "
+    "dwt_kvcache_h2d_bytes_total: promotion is the one H2D path the "
+    "paged layout has)")
+KVCACHE_TIER_DROPPED_BLOCKS = counter(
+    "dwt_kvcache_tier_dropped_blocks_total",
+    "Demoted blocks dropped at the bottom of the hierarchy (host "
+    "overflow with no disk tier, or disk overflow) — the tier is a "
+    "cache, dropping is correct, but a high rate means the budgets "
+    "are undersized for the prefix working set")
+KVCACHE_TIER_SPILLED_BLOCKS = counter(
+    "dwt_kvcache_tier_spilled_blocks_total",
+    "Blocks spilled host ring -> disk segment under host-budget "
+    "pressure (LRU position preserved; payload leaves RAM)")
+KVCACHE_TIER_HITS = counter(
+    "dwt_kvcache_tier_hits_total",
+    "Tier lookups that promoted at least one block, per tier the "
+    "payload was read from", ("tier",))
+
+# demote is a device gather + host copy (sub-ms to ms); promote adds
+# the staged-adopt scatter dispatch.  Both sit well below the request
+# buckets, so they share the dispatch-scale profile buckets.
+_TIER_BUCKETS_S = (0.0002, 0.0005, 0.001, 0.002, 0.004, 0.008,
+                   0.016, 0.032, 0.064, 0.125, 0.25, 0.5, 1.0, 4.0)
+KVCACHE_TIER_DEMOTE_SECONDS = histogram(
+    "dwt_kvcache_tier_demote_seconds",
+    "Wall time of one demotion (device gather of the evicted leaf + "
+    "host-ring insert + budget eviction)", buckets=_TIER_BUCKETS_S)
+KVCACHE_TIER_PROMOTE_SECONDS = histogram(
+    "dwt_kvcache_tier_promote_seconds",
+    "Wall time of one promotion (tier read + staged adopt scatter + "
+    "radix re-insert)", buckets=_TIER_BUCKETS_S)
+
+
+def update_kvcache_tier_series(tier: dict) -> None:
+    """Bridge a ``TieredKVStore.snapshot()`` fragment (attached under
+    ``snapshot()["tier"]`` by the pool owner) onto the
+    ``dwt_kvcache_tier_*`` series."""
+    for t in ("host", "disk"):
+        KVCACHE_TIER_RESIDENT_BYTES.set(
+            tier.get(f"{t}_resident_bytes", 0), tier=t)
+        KVCACHE_TIER_RESIDENT_BLOCKS.set(
+            tier.get(f"{t}_blocks", 0), tier=t)
+        KVCACHE_TIER_CAPACITY_BYTES.set(
+            tier.get(f"{t}_capacity_bytes", 0), tier=t)
+        KVCACHE_TIER_HITS.set_cumulative(
+            tier.get(f"{t}_hits", 0), tier=t)
+    KVCACHE_TIER_DEMOTED_BLOCKS.set_cumulative(
+        tier.get("demoted_blocks", 0))
+    KVCACHE_TIER_DEMOTED_BYTES.set_cumulative(
+        tier.get("demoted_bytes", 0))
+    KVCACHE_TIER_PROMOTED_BLOCKS.set_cumulative(
+        tier.get("promoted_blocks", 0))
+    KVCACHE_TIER_PROMOTED_BYTES.set_cumulative(
+        tier.get("promoted_bytes", 0))
+    KVCACHE_TIER_DROPPED_BLOCKS.set_cumulative(
+        tier.get("dropped_blocks", 0))
+    KVCACHE_TIER_SPILLED_BLOCKS.set_cumulative(
+        tier.get("spilled_blocks", 0))
+
 
 def update_kvcache_series(kv: dict) -> None:
     """Bridge a ``KVCacheManager.snapshot()`` dict onto the
@@ -226,6 +318,9 @@ def update_kvcache_series(kv: dict) -> None:
         for d in KV_DTYPES:
             KVCACHE_PAGE_DTYPE.set(1 if d == page_dtype else 0, dtype=d)
         KVCACHE_QUANT_SCALE_BYTES.set(kv.get("quant_scale_bytes", 0))
+    tier = kv.get("tier")
+    if tier:
+        update_kvcache_tier_series(tier)
 
 
 SPEC_ROUNDS = counter(
@@ -416,6 +511,12 @@ GATEWAY_HASHED = counter(
     "Requests routed by the consistent-hash-with-bounded-load "
     "fallback (no replica's index matched enough prefix, or routing "
     "keys were unavailable)")
+GATEWAY_TIER_ROUTED = counter(
+    "dwt_gateway_tier_routed_requests_total",
+    "Requests routed by the host-tier second chance: no replica's "
+    "device-tier index matched enough prefix, but a replica's "
+    "reported demoted-prefix digest (docs/DESIGN.md §21) did — the "
+    "replica promotes from its host ring instead of re-prefilling")
 GATEWAY_RETRIED = counter(
     "dwt_gateway_retried_requests_total",
     "Requests re-proxied to an alternate replica after the first "
@@ -628,8 +729,10 @@ COMPILE_VARIANT_BUDGET = gauge(
 HBM_OWNER_BYTES = gauge(
     "dwt_hbm_owner_bytes",
     "Current resident bytes per pool owner (kv_page_pool, "
-    "kv_host_pool, draft_scratch, stage_pool, migration_staged), "
-    "sampled at scheduler iterations", ("owner",))
+    "kv_host_pool, draft_scratch, stage_pool, migration_staged, "
+    "host_tier — the §21 demoted-prefix ring rides the same ledger "
+    "even though its bytes live in host RAM), sampled at scheduler "
+    "iterations", ("owner",))
 HBM_WATERMARK_BYTES = gauge(
     "dwt_hbm_watermark_bytes",
     "High-water-mark resident bytes per pool owner since process start "

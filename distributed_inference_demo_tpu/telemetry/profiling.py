@@ -402,7 +402,12 @@ class HbmWatermarks:
     scheduler iterations with the owner's CURRENT resident bytes; the
     watermark only ever grows until :meth:`reset` (monotone — pinned by
     tests), so a pool's worst case survives the quiet period after the
-    burst that caused it."""
+    burst that caused it.
+
+    Owners: ``kv_page_pool``, ``kv_host_pool``, ``draft_scratch``,
+    ``stage_pool``, ``migration_staged``, and (despite the ledger's
+    name) ``host_tier`` — the §21 demoted-prefix ring's host-RAM bytes
+    ride the same postmortem surface and the same reset-on-close."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()

@@ -208,6 +208,9 @@ def test_kvcache_shared_prefix_divergent_tail(params, oracle):
         assert st["partial_hit_tokens"] == len(shared)
 
 
+# slow lane: partial-hit twin; exact_repeat, shared_prefix_divergent_tail
+# and below_block_and_pool_bound keep the prefix-cache seam quick
+@pytest.mark.slow
 def test_kvcache_mid_prompt_partial_hit_observable(params, oracle):
     """ISSUE 3 generality: a MID-prompt partial hit — shared prefix
     strictly shorter than the cached prompt AND the new prompt — reuses
@@ -245,6 +248,9 @@ def test_kvcache_mid_prompt_partial_hit_observable(params, oracle):
         assert hits and hits[-1]["tokens"] == reused
 
 
+# slow lane: primed-vs-cold twin of test_kvcache_exact_repeat +
+# test_kvcache_shared_prefix_divergent_tail, which stay quick
+@pytest.mark.slow
 def test_kvcache_primed_vs_cold_scheduler_exactness(params, oracle):
     """ISSUE 3 exactness (scheduler path): the same suffix-after-shared-
     prefix prompt decodes token-identically on a COLD engine and on an
@@ -1082,6 +1088,9 @@ def test_logprobs_rejected_with_speculation(params, draft_params):
             eng.generate(np.asarray([[1, 2, 3]]), 4, logprobs=True)
 
 
+# slow lane: HTTP twin — engine-level logprobs parity and the plain HTTP
+# batching surface each stay quick
+@pytest.mark.slow
 def test_http_logprobs_over_batching_backend(params, oracle):
     """POST /generate {"logprobs": true} against the batching backend
     returns per-token logprobs (501 before this surface existed)."""
@@ -1116,6 +1125,9 @@ def test_http_logprobs_over_batching_backend(params, oracle):
             server.shutdown()
 
 
+# slow lane: spec × logprobs interaction refinement; the logprobs seam
+# and the spec modes each keep quick pins of their own
+@pytest.mark.slow
 def test_logprobs_empty_in_spec_mode(params, draft_params):
     """Speculative requests keep lps EMPTY (no stale admission entry):
     tokens and lps can never silently misalign if the guard is relaxed."""
@@ -1202,6 +1214,9 @@ def test_abandoned_stream_frees_slots(params):
         assert eng._step_count < 60
 
 
+# slow lane: HTTP twin — stream-cancel budget release is pinned quick by
+# test_abandoned_stream_frees_slots and the stop seam by test_text_e2e
+@pytest.mark.slow
 def test_http_stop_over_batching_frees_budget(params):
     """POST /generate with stop over the BATCHING backend: the early
     exit closes the stream, which cancels the in-flight request — the

@@ -315,6 +315,53 @@ def test_leg_prefix_reuse_structure_tiny():
 
 
 @pytest.mark.slow
+def test_leg_tiered_prefix_structure_tiny():
+    """The tiered_prefix leg's CPU dryrun (the §21 acceptance shape):
+    both phases report TTFT percentiles over the measured revisit
+    rounds, promotion h2d bytes move (and the re-prefill phase's stay
+    0), blocks demote/spill/promote through all three tiers, the
+    greedy revisit tokens are bit-identical across phases, and the
+    three-tier zero-leak gate holds at leg end.  The micro shape is
+    the run_leg --micro one: a 14-block pool under a 4-group working
+    set with a 2-group host ring, so the rest round-trips through the
+    disk segment.  The TTFT-p95 WIN is asserted by the full-shape leg
+    on device (at this toy scale a 56-token re-prefill costs less than
+    the promote dispatch), not here — structure only."""
+    out = bench.run_leg("tiered_prefix",
+                        {"model": "llama-test", "batch": 2,
+                         "prompt_len": 32, "new_tokens": 8,
+                         "flagship": "llama-test"}, micro=True)
+    assert "error" not in out
+    assert out["micro"] is True
+    a, b = out["reprefill"], out["tiered"]
+    # measured wave = (revisits - 1) rounds x groups
+    assert a["requests"] == b["requests"] == 4
+    assert a["ttft_p95_ms"] >= a["ttft_p50_ms"] > 0
+    assert b["ttft_p95_ms"] >= b["ttft_p50_ms"] > 0
+    assert out["tiered_wins_ttft_p95"] in (True, False)
+    # the promotion path moved real bytes; nothing else may touch the
+    # host bounce (the re-prefill phase pins the counter at 0)
+    assert out["promote_h2d_bytes"] > 0
+    assert out["reprefill_h2d_bytes"] == 0
+    # all three tiers exercised: demotions filled the host ring, the
+    # overflow spilled to the disk segment, and revisits promoted back
+    # from BOTH
+    assert out["demoted_blocks"] > 0
+    assert out["spilled_blocks"] > 0
+    assert out["promoted_blocks"] > 0
+    assert out["tier_hits"]["host"] > 0
+    assert out["tier_hits"]["disk"] > 0
+    share = out["tier_hit_share"]
+    assert abs(share["host"] + share["disk"] - 1.0) < 0.01
+    # pinned greedy bit-identity: a promoted prefix is the same cache
+    # state, token for token
+    assert out["bit_identical"] is True
+    # and nothing leaked in any tier
+    assert out["three_tier_zero_leak"] is True
+    assert out["leaked_blocks"] == {"reprefill": 0, "tiered": 0}
+
+
+@pytest.mark.slow
 def test_leg_decode_fused_structure_tiny():
     """The decode_fused leg's full structure (per-point engines across
     batch x stream_block K, measured dispatches/token) at CPU-viable

@@ -208,6 +208,9 @@ def test_cli_generate_greedy():
     assert body["tokens"] == want
 
 
+# slow lane: CLI twin of the engine-level self-draft pins in
+# test_speculative; the generate surface stays quick via the greedy test
+@pytest.mark.slow
 def test_cli_generate_speculative_self_draft():
     """generate --draft-model with draft == target (same seed-init) must
     reproduce plain greedy output exactly with 100% acceptance."""
@@ -459,6 +462,9 @@ def test_cli_generate_sp_matches_plain():
     assert rc == 1
 
 
+# slow lane: HTTP twin of the engine-level pld parity pins in
+# test_batching; the HTTP batching surface stays quick elsewhere
+@pytest.mark.slow
 def test_http_batching_with_prompt_lookup(http_server):
     """Continuous batching x draft-free speculation over HTTP: greedy
     output matches the plain engine, /stats names the proposer."""

@@ -73,6 +73,9 @@ def test_stream_block_logprobs_bit_identical(params):
         np.testing.assert_array_equal(rl, gl)
 
 
+# slow lane: sampled-stream twin — the rng-stream claim is pinned quick by
+# test_mixed_sampled_stream_bit_identical_to_serialized (mixed dispatch)
+@pytest.mark.slow
 def test_stream_block_sampled_bit_identical(params):
     """K-fusion must not perturb the rng stream: the loop body splits
     the carried rng per step in decode_one's exact order, so SAMPLED
@@ -263,6 +266,10 @@ def test_paged_fused_block_reports_actual_steps(params):
     assert stats["device_loop_steps"] >= 4
 
 
+# slow lane: eos-mid-block twin; test_fused_generate_early_exits_on_eos,
+# test_stop_token_ids_early_exit_accounting and the batching-level
+# test_decode_block_eos_mid_block keep the seam quick
+@pytest.mark.slow
 def test_batching_eos_mid_block_early_exit(params):
     """An all-rows-EOS inside the fused block ends it on device: parity
     plus the step count proves the remaining rounds never ran."""

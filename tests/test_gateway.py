@@ -197,6 +197,84 @@ def test_prefix_tie_breaks_toward_the_lighter_replica():
 
 
 @pytest.mark.quick
+def test_host_tier_second_chance_decision_table():
+    """The §21 tier-aware route, as a decision table:
+
+    1. device-tier miss everywhere + no tier digests -> hash fallback;
+    2. device-tier miss + replica B's REPORTED host tier holds the
+       prefix -> route to B with policy host_tier (NOT the rendezvous
+       pick);
+    3. device-tier history, once learned, wins over the tier hint;
+    4. a match below min_prefix_tokens never second-chances;
+    5. an empty digest report (tier drained/closed) withdraws B.
+    """
+    from distributed_inference_demo_tpu.runtime.kvcache.tiered import (
+        chain_digests)
+    reg = _registry(3)
+    router = PrefixAwareRouter(reg, min_prefix_tokens=16, block_tokens=8)
+    rids = reg.replica_ids()
+    b = rids[1]
+    toks = list(range(200, 232))                 # 4 blocks of 8
+    keys = [tuple(toks[i * 8:(i + 1) * 8]) for i in range(4)]
+    digests = [d.hex()[:16] for d in chain_digests(keys)]
+
+    # row 1: nothing anywhere -> hash
+    assert router.route(toks).policy == "hash"
+
+    # row 2: B reports the prefix demoted (the /stats fragment the
+    # registry prober carries) -> second chance routes to B
+    router.reconcile(b, {"kvcache": {
+        "tier": {"block_tokens": 8, "digest": digests}}})
+    d = router.route(toks)
+    assert d.policy == "host_tier"
+    assert d.rid == b
+    assert d.match_tokens == 32
+    assert router.routing_table()["replicas"][b]["tier_digest_entries"] == 4
+
+    # row 3: once replica A holds it in its DEVICE tree (gateway
+    # history), the prefix policy outranks the tier hint
+    a = rids[0]
+    router.record(a, toks)
+    d = router.route(toks)
+    assert d.policy == "prefix" and d.rid == a
+
+    # row 4: a one-block tier match (8 < min_prefix_tokens 16) is not
+    # good enough — hash, not host_tier
+    short = list(range(500, 516))
+    short_digest = [chain_digests([tuple(short[:8])])[0].hex()[:16]]
+    router.reconcile(b, {"kvcache": {
+        "tier": {"block_tokens": 8, "digest": short_digest}}})
+    assert router.route(short).policy == "hash"
+
+    # row 5: an empty report withdraws the replica from second chances
+    router.reconcile(b, {"kvcache": {
+        "tier": {"block_tokens": 8, "digest": []}}})
+    other = list(range(600, 632))
+    router.reconcile(b, {"kvcache": {"tier": {"block_tokens": 8,
+                                              "digest": []}}})
+    assert router.route(other).policy == "hash"
+    assert router.routing_table()["replicas"][b]["tier_digest_entries"] == 0
+
+
+@pytest.mark.quick
+def test_host_tier_flush_on_readmission_drops_digests():
+    reg = _registry(2)
+    router = PrefixAwareRouter(reg, min_prefix_tokens=8, block_tokens=8)
+    from distributed_inference_demo_tpu.runtime.kvcache.tiered import (
+        chain_digests)
+    rid = reg.replica_ids()[0]
+    toks = list(range(2, 18))
+    dgs = [d.hex()[:16] for d in chain_digests(
+        [tuple(toks[:8]), tuple(toks[8:])])]
+    router.reconcile(rid, {"kvcache": {
+        "tier": {"block_tokens": 8, "digest": dgs}}})
+    assert router.tier_match_tokens(rid, toks) == 16
+    # readmission flush: the replica restarted — its host ring is gone
+    router.flush_replica(rid)
+    assert router.tier_match_tokens(rid, toks) == 0
+
+
+@pytest.mark.quick
 def test_lru_trim_keeps_the_most_specific_prefix_keys():
     router = PrefixAwareRouter(_registry(), min_prefix_tokens=4,
                                block_tokens=4, max_index_entries=2)

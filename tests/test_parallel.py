@@ -24,7 +24,10 @@ def _full_spec(cfg):
 
 
 @pytest.mark.parametrize("name", [
-    "llama-test", "bloom-test",
+    "llama-test",
+    # bloom twin — slow lane like the flash/sequence bloom twins; ALiBi
+    # under TP shares its shape with the quick llama path
+    pytest.param("bloom-test", marks=pytest.mark.slow),
     pytest.param("mixtral-test", marks=pytest.mark.slow)])
 def test_manual_tp_matches_single_device(name, devices):
     """shard_map TP forward (tp=2) must reproduce single-device logits."""
@@ -81,6 +84,9 @@ def test_pipeline_train_step_dp_pp_tp(devices):
     assert losses[-1] < losses[0], losses
 
 
+# slow lane: subsumed by test_pipeline_sgd_update_matches_single_device,
+# which needs the same loss (and its grads) to match to pass
+@pytest.mark.slow
 def test_pipeline_loss_matches_single_device(devices):
     """Pipeline-parallel loss at step 0 == plain single-device loss."""
     cfg = get_model_config("llama-test")

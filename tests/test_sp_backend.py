@@ -265,6 +265,9 @@ def test_sp_stream_fns_greedy_parity_and_partial_block(strategy):
         np.testing.assert_array_equal(got, want)
 
 
+# slow lane: stream twin of test_sp_backend_fp8_cache_matches_fp8_engine;
+# stream parity itself stays quick via test_sp_stream_fns_greedy_parity
+@pytest.mark.slow
 def test_sp_stream_fp8_cache_matches_fp8_engine():
     """Streaming composes with the reduced-precision sp cache."""
     cfg = get_model_config("llama-test")

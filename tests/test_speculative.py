@@ -144,6 +144,9 @@ def test_self_draft_accepts_everything(params):
     assert stats.tokens_per_round > 4.0   # 21 emitted / 4 rounds = 5.25
 
 
+# slow lane: sampled twin of test_self_draft_accepts_everything (greedy),
+# which stays quick; stochastic verify is also hit by sampled_tokens_in_range
+@pytest.mark.slow
 def test_self_draft_accepts_everything_sampled(params):
     """Draft == target under temperature sampling: p == q so the accept
     rule (u < p/q) accepts every token — exercises the stochastic verify
@@ -358,6 +361,9 @@ def test_eos_early_stop_skips_rounds(params, draft_params):
                                   np.full((1, 12), eos, np.int32))
 
 
+# slow lane: eos × stream twin; test_eos_early_stop_skips_rounds and
+# test_stream_matches_generate keep each seam quick on its own
+@pytest.mark.slow
 def test_eos_stream_matches_engine_stream(params, draft_params):
     """Streamed spec decode with eos stops at the same step and yields the
     same (eos-padded) tokens as InferenceEngine.generate_stream."""

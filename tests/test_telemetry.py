@@ -320,6 +320,9 @@ def test_runlog_no_rollover_when_unset(tmp_path):
     assert len(path.read_text().splitlines()) == 50
 
 
+# slow lane: on-header endpoint twin of test_http_metrics_and_trace_on_header
+# (same gating plumbing); /debugz content is pinned in the fleet tests
+@pytest.mark.slow
 def test_http_debugz_on_header():
     """GET /debugz returns flight-ring state, backend in-flight info,
     and postmortem status without touching the pipeline."""

@@ -243,6 +243,9 @@ def test_stop_sequences():
         server.shutdown()
 
 
+# slow lane: stop-family refinement twin; test_stop_sequences and the
+# chat-repl stop test keep the seam quick
+@pytest.mark.slow
 def test_stop_with_logprobs_truncates_rows_identically():
     """stop × logprobs, both paths (the 501 wall this combination used
     to hit is lifted): logprob rows truncate at EXACTLY the token index
@@ -351,6 +354,9 @@ def test_stop_needs_tokenizer():
         server.shutdown()
 
 
+# slow lane: stop-family refinement twin; test_stop_sequences keeps the
+# stop seam quick and eos accounting is pinned in test_device_loop
+@pytest.mark.slow
 def test_stop_reports_eos_reason():
     """A row that terminates on the backend's eos before any stop match
     reports stop_reason "eos" (not "length") and keeps only its real

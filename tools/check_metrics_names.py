@@ -53,7 +53,7 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
 # than a crash.  Extend deliberately, with the catalog.
 KNOWN_LABELS = {"role", "device", "route", "code", "kind", "engine",
                 "peer", "replica", "dtype", "tenant", "window",
-                "signature", "program", "owner"}
+                "signature", "program", "owner", "tier"}
 
 # series whose label SET is pinned exactly — the fleet-plane families
 # whose labels dashboards and the federation relabeler join on.  A
@@ -96,6 +96,14 @@ REQUIRED_LABELS = {
     "dwt_compile_variant_budget_entries": ("program",),
     "dwt_hbm_owner_bytes": ("owner",),
     "dwt_hbm_watermark_bytes": ("owner",),
+    # tiered KV (docs/DESIGN.md §21): the tier label (host / disk) is
+    # what separates "RAM is full" from "disk is full" on a dashboard —
+    # an unlabeled residency gauge would sum the two budgets into one
+    # meaningless number
+    "dwt_kvcache_tier_resident_bytes": ("tier",),
+    "dwt_kvcache_tier_resident_blocks": ("tier",),
+    "dwt_kvcache_tier_capacity_bytes": ("tier",),
+    "dwt_kvcache_tier_hits_total": ("tier",),
 }
 
 # label names reserved for the federation relabeler: GET /metrics/fleet
@@ -151,6 +159,12 @@ REQUIRED_SERIES = {
     "dwt_kvcache_h2d_bytes_total",
     "dwt_kvcache_page_dtype_info",
     "dwt_kvcache_quant_scale_bytes",
+    # the §21 tier triple: residency plus the demote/promote flow
+    # counters — a tier silently absent from /metrics reads as
+    # "tiering disabled", indistinguishable from "demotions regressed"
+    "dwt_kvcache_tier_resident_bytes",
+    "dwt_kvcache_tier_promoted_blocks_total",
+    "dwt_kvcache_tier_demoted_blocks_total",
     # the transport-reliability / chaos quartet (docs/DESIGN.md §12): a
     # corrupt frame that is silently absent from /metrics is exactly the
     # "decoded garbage into a wrong token" failure this layer exists to

@@ -16,7 +16,11 @@ tenant x replica:
 - TTFT p95 estimated from the ``dwt_slo_ttft_seconds`` histogram
   buckets (upper-bound of the bucket crossing the 95th percentile);
 - migrated-request counts, plus each replica's scrape age so a stale
-  section is visible as staleness, not as a frozen tenant.
+  section is visible as staleness, not as a frozen tenant;
+- with ``--kv``, a per-replica KV tier-occupancy section (host ring /
+  disk segment resident vs capacity, hit and demote/promote counters,
+  from the ``dwt_kvcache_tier_*`` series — docs/DESIGN.md §21); crash-
+  safe when a fleet exports no tier series at all.
 
 Stdlib only (urllib + ANSI), same constraint as every ``tools/``
 script.  ``--once`` prints a single snapshot and exits — the mode the
@@ -187,6 +191,66 @@ def render_profile(rows: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def kv_tier_rows(samples) -> List[dict]:
+    """Per-replica KV tier occupancy from the federated
+    ``dwt_kvcache_tier_*`` series (docs/DESIGN.md §21): resident
+    blocks/bytes vs capacity for the host ring and disk segment, plus
+    the cumulative demote/promote counters.  A replica exposing no
+    tier series (tiering off, or a pre-§21 build) contributes no rows
+    — never a crash."""
+    per: Dict[str, dict] = {}
+
+    def rep(labels: dict) -> dict:
+        return per.setdefault(labels.get("replica", "-"), {
+            "replica": labels.get("replica", "-"),
+            "tiers": {}, "demoted": 0.0, "promoted": 0.0,
+            "spilled": 0.0, "dropped": 0.0})
+
+    def tier(labels: dict) -> dict:
+        return rep(labels)["tiers"].setdefault(
+            labels.get("tier", "?"),
+            {"blocks": 0.0, "bytes": 0.0, "cap": 0.0, "hits": 0.0})
+
+    gauges = {"dwt_kvcache_tier_resident_blocks": "blocks",
+              "dwt_kvcache_tier_resident_bytes": "bytes",
+              "dwt_kvcache_tier_capacity_bytes": "cap",
+              "dwt_kvcache_tier_hits_total": "hits"}
+    counters = {"dwt_kvcache_tier_demoted_blocks_total": "demoted",
+                "dwt_kvcache_tier_promoted_blocks_total": "promoted",
+                "dwt_kvcache_tier_spilled_blocks_total": "spilled",
+                "dwt_kvcache_tier_dropped_blocks_total": "dropped"}
+    for name, labels, value in samples:
+        if name in gauges:
+            tier(labels)[gauges[name]] = value
+        elif name in counters:
+            rep(labels)[counters[name]] += value
+    return [per[k] for k in sorted(per)]
+
+
+def render_kv(rows: List[dict]) -> str:
+    hdr = (f"{'REPLICA':<22} {'TIER':<5} {'BLOCKS':>7} {'RES_MB':>8} "
+           f"{'CAP_MB':>8} {'USE%':>6} {'HITS':>7} {'DEM':>7} "
+           f"{'PRO':>7} {'SPILL':>6} {'DROP':>6}")
+    lines = ["", "kv tier occupancy (host ring / disk segment):",
+             hdr, "-" * len(hdr)]
+    if not rows:
+        lines.append("(no dwt_kvcache_tier_* series exported — tiering "
+                     "off or pre-§21 replicas)")
+    for r in rows:
+        for tname in sorted(r["tiers"]):
+            t = r["tiers"][tname]
+            use = (100 * t["bytes"] / t["cap"]) if t["cap"] > 0 else None
+            lines.append(
+                f"{r['replica']:<22.22} {tname:<5.5} "
+                f"{int(t['blocks']):>7} {t['bytes'] / 2**20:>8.2f} "
+                f"{t['cap'] / 2**20:>8.2f} "
+                f"{(f'{use:.1f}%' if use is not None else '-'):>6} "
+                f"{int(t['hits']):>7} {int(r['demoted']):>7} "
+                f"{int(r['promoted']):>7} {int(r['spilled']):>6} "
+                f"{int(r['dropped']):>6}")
+    return "\n".join(lines)
+
+
 def scrape_ages(samples) -> Dict[str, float]:
     return {labels.get("replica", "?"): value
             for name, labels, value in samples
@@ -240,6 +304,9 @@ def main(argv=None) -> int:
                          "p95 (dwt_profile_* series, docs/DESIGN.md §20)")
     ap.add_argument("--profile-top", type=int, default=10,
                     help="rows in the --profile section (default 10)")
+    ap.add_argument("--kv", action="store_true",
+                    help="append per-replica KV tier occupancy "
+                         "(dwt_kvcache_tier_* series, docs/DESIGN.md §21)")
     args = ap.parse_args(argv)
     while True:
         try:
@@ -253,6 +320,8 @@ def main(argv=None) -> int:
         if args.profile:
             page += "\n" + render_profile(
                 profile_rows(samples, top=args.profile_top))
+        if args.kv:
+            page += "\n" + render_kv(kv_tier_rows(samples))
         if args.once:
             print(page)
             return 0

@@ -63,6 +63,9 @@ LEG_BUDGETS = {
     # batching
     "mixed_batching": 2400,
     "prefix_reuse": 1800,
+    # two engine builds (re-prefill reference + tiered) over two routed
+    # rounds each — budget like prefix_reuse
+    "tiered_prefix": 1800,
     "paged_decode": 1800,
     "serving_relative": 1800,
     # the full-budget sweep now runs the promoted b8/32/64 x
