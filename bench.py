@@ -1285,6 +1285,195 @@ def _leg_mixed_batching(model: str, prompt_len: int = 256,
     }
 
 
+def _leg_spec_mixed(model: str, prompt_len: int = 192,
+                    new_tokens: int = 32, slots: int = 8,
+                    n_req: int = 16, prefill_chunk: int = 32,
+                    decode_block: int = 4, num_draft: int = 4,
+                    token_budget: int = 0,
+                    arrival_s: float = 0.02,
+                    block_tokens: int = 16,
+                    bg_prompt_len: int = 32) -> dict:
+    """Speculation INSIDE the mixed dispatch (docs/DESIGN.md §22) vs the
+    two single-feature configurations it fuses.
+
+    One schedule, three engines: ``slots - 1`` long-decode background
+    rows pin the batch while ``n_req`` chunk-heavy motif-tiled prompts
+    arrive at a fixed interval.  All prompts are tiled 16-token motifs —
+    the n-gram shape prompt-lookup speculation exists for — so the
+    proposer has real lookup structure; measured acceptance on
+    seed-init weights stays a weights property (adversarial for
+    agreement), so the leg's value is the MECHANICS: what fusing
+    draft/verify into the packed dispatch does to aggregate tok/s,
+    TTFT p95, and dispatches/step on the same arrival load.
+
+    - ``spec_only``: prompt-lookup speculation with serialized chunked
+      prefill (the pre-§22 shipping configuration — every arriving
+      chunk is its own dispatch between speculative rounds).
+    - ``mixed_only``: §19 token-budget packing, no speculation.
+    - ``spec_mixed``: ONE program carries prefill segments + decode +
+      draft/verify, adaptive per-row K (§22).
+
+    Gates: ``spec_mixed_wins_tokens_per_sec`` (beats BOTH baselines)
+    and ``ttft_p95_le_mixed_only`` (fusing speculation must not buy
+    throughput with arrival latency).  The spec arms also report the
+    §22 shrink observables (``k_row_buckets``, acceptance)."""
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    sampling = SamplingParams(greedy=True)
+    # the default §19 budget (slots * decode_block + 2 chunks) prices a
+    # DECODE row at decode_block tokens; §22 prices a spec row at
+    # (K_row + 1) * decode_block, so a budget sized for plain decode
+    # leaves no prefill room once the batch speculates — size it for
+    # the spec pricing and give every arm the same knob
+    budget = token_budget or (slots * (num_draft + 1) * decode_block
+                              + 2 * prefill_chunk)
+    bg_rows = max(1, slots - 1)
+    # background rows must OUTLIVE the arrival stream in every mode: a
+    # speculating row emits up to K+1 tokens per round, and a row that
+    # finishes mid-window both zeroes its arm's background tokens and
+    # dumps its pre-window TTFT (submit-to-first-token spans the warmup
+    # compiles) into the measured latency reservoir.  Budget from the
+    # worst case: the window is bounded by the per-request dispatch
+    # count (decode blocks + prefill chunks + admission slack) and a
+    # spec row emits at most (K+1) * decode_block tokens per dispatch.
+    per_req_dispatches = ((new_tokens + decode_block - 1) // decode_block
+                          + (prompt_len + prefill_chunk - 1)
+                          // prefill_chunk + 8)
+    bg_new = ((num_draft + 1) * decode_block
+              * n_req * per_req_dispatches)
+    max_seq = max(prompt_len + new_tokens, bg_prompt_len + bg_new)
+    rng = np.random.default_rng(0)
+
+    def motif_prompt(length):
+        # per-request DISTINCT motif (identical prompts would let the
+        # block cache collapse the prefill work the leg measures)
+        motif = rng.integers(0, 1000, size=(16,))
+        return np.tile(motif, max(1, length // 16))[:length].astype(
+            np.int32)
+
+    prompts = [motif_prompt(prompt_len) for _ in range(n_req)]
+    # background prompts are RANDOM (no n-gram structure): their
+    # near-zero lookup acceptance is the §22 shrink workload — the
+    # adaptive controller walks their K_row toward bucket 1, which is
+    # exactly the ``k_row_buckets`` observable the spec arms report
+    bg_prompts = [rng.integers(0, 1000, size=(bg_prompt_len,)).astype(
+        np.int32) for _ in range(bg_rows)]
+    warm = [motif_prompt(prompt_len) for _ in range(2)]
+
+    def run(mode: str) -> dict:
+        kw = {}
+        if mode != "spec_only":
+            kw["mixed_token_budget"] = budget
+        if mode != "mixed_only":
+            kw.update(prompt_lookup=True, num_draft=num_draft)
+        with ContinuousBatchingEngine(
+                cfg, params, max_seq=max_seq, max_batch=slots,
+                sampling=sampling, prefill_chunk=prefill_chunk,
+                decode_block=decode_block, kv_block_tokens=block_tokens,
+                **kw) as eng:
+            # compile pass 1: a full-shape admission on an idle engine
+            eng.submit(warm[0], 2).wait(timeout=600)
+            bg = [eng.submit(p, bg_new) for p in bg_prompts]
+            deadline = time.monotonic() + 600
+            for r in bg:               # every background row decoding
+                while not r.tokens:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("background rows never "
+                                           "admitted")
+                    time.sleep(0.002)
+            # compile pass 2: an admission UNDER decode/spec load — the
+            # packed-with-rounds and no-finals program variants both
+            # compile here, not inside the measured window
+            eng.submit(warm[1], 2).wait(timeout=600)
+            eng.reset_stats()
+            bg_before = sum(len(r.tokens) for r in bg)
+            t0 = time.perf_counter()
+            reqs = []
+            for p in prompts:
+                reqs.append(eng.submit(p, new_tokens))
+                if arrival_s:
+                    time.sleep(arrival_s)
+            for r in reqs:
+                r.wait(timeout=900)
+            dt = time.perf_counter() - t0
+            bg_tokens = sum(len(r.tokens) for r in bg) - bg_before
+            st = eng.stats()
+            ls = dict(eng.loop_stats)
+            for r in bg:
+                r.cancel()
+            for r in bg:
+                try:
+                    r.wait(timeout=600)
+                except Exception:
+                    pass
+            out = {
+                "tokens_per_sec": round(
+                    (n_req * new_tokens + bg_tokens) / dt, 2),
+                "stream_tokens_per_sec": round(
+                    n_req * new_tokens / dt, 2),
+                "background_tokens": bg_tokens,
+                "ttft_p95_ms": st["latency"].get("ttft_p95_ms"),
+                "host_dispatches": ls["host_dispatches"],
+                "device_loop_steps": ls["device_loop_steps"],
+                "dispatches_per_step": round(
+                    ls["host_dispatches"]
+                    / max(1, ls["device_loop_steps"]), 4),
+            }
+            if mode != "spec_only":
+                out["mixed_dispatches"] = st["mixed"]["dispatches"]
+                out["prefill_tokens"] = st["mixed"]["prefill_tokens"]
+                out["budget_utilization"] = st["mixed"][
+                    "budget_utilization"]
+            if mode != "mixed_only":
+                sp = st["speculative"]
+                # the §22 shrink observables: per-bucket occupancy of
+                # the active rows' K_row + measured acceptance
+                out["spec"] = {
+                    "drafted": sp["drafted"],
+                    "accepted": sp["accepted"],
+                    "acceptance_rate": sp["acceptance_rate"],
+                    "adaptive": sp["adaptive"],
+                    "k_row_buckets": sp["k_row_buckets"],
+                }
+            mgr = eng.kv_cache
+            out["leaked_blocks"] = (mgr.used_blocks
+                                    - mgr.tree.block_count)
+            return out
+
+    spec_only = run("spec_only")
+    mixed_only = run("mixed_only")
+    spec_mixed = run("spec_mixed")
+    return {
+        "model": model, "slots": slots, "requests": n_req,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "prefill_chunk": prefill_chunk, "decode_block": decode_block,
+        "num_draft": num_draft, "token_budget": budget,
+        "arrival_s": arrival_s, "background_rows": bg_rows,
+        "prompt_shape": "16-token motif tiled, distinct per request",
+        "spec_only": spec_only, "mixed_only": mixed_only,
+        "spec_mixed": spec_mixed,
+        # the §22 acceptance gates: the fused program must beat BOTH
+        # single-feature configurations on aggregate throughput without
+        # regressing arrival TTFT vs the mixed-only packer
+        "spec_mixed_wins_tokens_per_sec": (
+            spec_mixed["tokens_per_sec"] > spec_only["tokens_per_sec"]
+            and spec_mixed["tokens_per_sec"]
+            > mixed_only["tokens_per_sec"]),
+        "ttft_p95_le_mixed_only": (
+            spec_mixed["ttft_p95_ms"] is not None
+            and mixed_only["ttft_p95_ms"] is not None
+            and spec_mixed["ttft_p95_ms"] <= mixed_only["ttft_p95_ms"]),
+    }
+
+
 def _leg_prefix_reuse(model: str, new_tokens: int, slots: int = 8,
                       n_req: int = 16, shared_len: int = 96,
                       tail_len: int = 32, block_tokens: int = 16,
@@ -2957,7 +3146,7 @@ def micro_shape(p: dict) -> dict:
 # snapshot IS that leg's own dispatches — no cross-leg bleed.
 _PROFILED_LEGS = {"headline", "headline_int8", "flagship_bf16",
                   "flagship_int8", "decode_fused", "batching",
-                  "mixed_batching", "tiered_prefix"}
+                  "mixed_batching", "spec_mixed", "tiered_prefix"}
 
 
 def _dispatch_profile_extras() -> dict:
@@ -3028,6 +3217,17 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
                                        prefill_chunk=8, decode_block=4,
                                        arrival_s=0.0, block_tokens=8)
                    if micro else _leg_mixed_batching(model))
+        elif name == "spec_mixed":
+            # the micro shape keeps the §22 comparison structural on
+            # CPU: motif-tiled chunky prompts over 4 slots with 3
+            # pinned background rows, all arrivals at once, K=2 — the
+            # three engine builds and the packed-with-rounds program
+            # variants all exercise at the smallest meaningful scale
+            out = (_leg_spec_mixed(model, prompt_len=96, new_tokens=8,
+                                   slots=4, n_req=6, prefill_chunk=8,
+                                   decode_block=4, num_draft=2,
+                                   arrival_s=0.0, block_tokens=8)
+                   if micro else _leg_spec_mixed(model))
         elif name == "prefix_reuse":
             out = _leg_prefix_reuse(model, min(new_tokens, 64))
         elif name == "tiered_prefix":
@@ -3344,7 +3544,7 @@ def main() -> None:
             "prompt_lookup", "planner_pipeline", "long_context",
             "long_context_sp", "disagg", "gateway_routing",
             "flagship_int8", "batching", "mixed_batching",
-            "prefix_reuse", "tiered_prefix", "paged_decode",
+            "spec_mixed", "prefix_reuse", "tiered_prefix", "paged_decode",
             "serving_relative", "sweep", "flagship_bf16", "pipeline",
             "fault_recovery", "prefill_long", "moe", "multimodal",
             "int4"]
@@ -3355,6 +3555,7 @@ def main() -> None:
             ("BENCH_SKIP_SWEEP", ["sweep"]),
             ("BENCH_SKIP_SERVING", ["speculative", "prompt_lookup",
                                     "batching", "mixed_batching",
+                                    "spec_mixed",
                                     "prefix_reuse", "tiered_prefix",
                                     "paged_decode",
                                     "serving_relative", "disagg",
@@ -3422,7 +3623,10 @@ def main() -> None:
     # (two routed soaks + the drain) — multi-engine, budget it likewise
     # tiered_prefix builds two engines (re-prefill reference + tiered)
     # and runs two routed rounds each — budget it like prefix_reuse
+    # spec_mixed builds THREE engines (spec-only, mixed-only, fused)
+    # over the same arrival stream — budget it like batching
     leg_timeouts = {"batching": 1500, "mixed_batching": 1500,
+                    "spec_mixed": 1500,
                     "prefix_reuse": 1200, "tiered_prefix": 1200,
                     "paged_decode": 1500, "serving_relative": 1500,
                     "gateway_routing": 1500}

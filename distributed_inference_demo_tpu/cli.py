@@ -280,6 +280,14 @@ def cmd_serve(args) -> int:
         print(f"choose one serve mode, got {' + '.join(modes)}",
               file=sys.stderr)
         return 1
+    if getattr(args, "no_spec_adaptive", False) and not (
+            getattr(args, "batch_slots", 0)
+            and ("--draft-model" in modes or "--prompt-lookup" in modes)):
+        # adaptive K_row lives in the mixed slot loop; anywhere else the
+        # flag would silently do nothing
+        print("--no-spec-adaptive requires --batch-slots with "
+              "--draft-model or --prompt-lookup", file=sys.stderr)
+        return 1
     if getattr(args, "tp", 1) > 1 and "--chain" in modes:
         print("--tp is not supported with --chain (stages are whole-model "
               "slices per worker)", file=sys.stderr)
@@ -509,6 +517,7 @@ def cmd_serve(args) -> int:
             eos_id=getattr(args, "eos_id", None),
             draft_cfg=draft_cfg, draft_params=draft_params,
             num_draft=args.num_draft, prompt_lookup=pld,
+            spec_adaptive=not getattr(args, "no_spec_adaptive", False),
             decode_block=args.decode_block,
             prefill_chunk=getattr(args, "prefill_chunk", 0) or None,
             mixed_token_budget=getattr(args, "mixed_token_budget", 0)
@@ -526,7 +535,9 @@ def cmd_serve(args) -> int:
               f"tp={getattr(args, 'tp', 1)}"
               + (f" draft={args.draft_model} k={args.num_draft}"
                  if draft_cfg is not None else "")
-              + (f" prompt_lookup k={args.num_draft}" if pld else ""),
+              + (f" prompt_lookup k={args.num_draft}" if pld else "")
+              + (" k_adaptive" if (draft_cfg is not None or pld)
+                 and not getattr(args, "no_spec_adaptive", False) else ""),
               flush=True)
     elif getattr(args, "draft_model", ""):
         from .runtime.speculative import SpeculativeBackend
@@ -971,6 +982,10 @@ def cmd_generate(args) -> int:
     import numpy as np
 
     _export_kv_tier_env(args)
+    if getattr(args, "no_spec_adaptive", False):
+        print("--no-spec-adaptive requires serve --batch-slots with "
+              "--draft-model or --prompt-lookup", file=sys.stderr)
+        return 1
     tokenizer = _load_tokenizer(args.tokenizer)
     if args.prompt_ids:
         ids = np.asarray([[int(t) for t in args.prompt_ids.split(",")]],
@@ -1335,6 +1350,10 @@ def _add_draft_args(p) -> None:
     p.add_argument("--prompt-lookup", action="store_true",
                    help="draft-FREE speculation: n-gram lookup over the "
                         "context proposes, the target verifies")
+    p.add_argument("--no-spec-adaptive", action="store_true",
+                   help="pin K_row = --num-draft in the mixed dispatch "
+                        "instead of adapting per-row draft length to "
+                        "measured acceptance (serve --batch-slots only)")
 
 
 def main(argv=None) -> int:

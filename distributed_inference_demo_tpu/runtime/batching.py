@@ -190,6 +190,7 @@ class ContinuousBatchingEngine:
                  kv_layout: Optional[str] = None,
                  max_queue_depth: Optional[int] = None,
                  mixed_token_budget: Optional[int] = None,
+                 spec_adaptive: bool = True,
                  kv_host_tier_bytes: Optional[int] = None,
                  kv_disk_tier_path: Optional[str] = None,
                  kv_disk_tier_bytes: Optional[int] = None):
@@ -296,11 +297,25 @@ class ContinuousBatchingEngine:
         admitting prompts, up to this many tokens per dispatch.  Decode
         fusion survives admission (the serialized mode's fuse
         suppression is gone) and several prompts stream chunks
-        concurrently.  Requires ``prefill_chunk``; exclusive with the
-        speculative modes (draft/prompt-lookup ride the serialized
-        path).  ``None`` defers to ``DWT_MIXED_TOKEN_BUDGET``; 0 (the
-        default) keeps the serialized interleave, which is the
-        bit-identity reference the mixed path is pinned against.
+        concurrently.  Requires ``prefill_chunk``.  With a speculative
+        proposer armed (draft model or prompt lookup) the dispatch's
+        decode half runs ``decode_block`` draft/verify ROUNDS instead
+        of plain steps (docs/DESIGN.md §22): a spec row is priced at
+        ``(K_row + 1) * decode_block`` budget tokens and the remainder
+        still packs prefill segments.  ``None`` defers to
+        ``DWT_MIXED_TOKEN_BUDGET``; 0 (the default) keeps the
+        serialized interleave, which is the bit-identity reference the
+        mixed path is pinned against.
+
+        ``spec_adaptive``: adaptive per-row draft length in the mixed
+        dispatch (docs/DESIGN.md §22) — an EWMA of each row's
+        acceptance rate shrinks/widens its ``K_row`` between iterations
+        within a small static bucket set ({1, K/2, K}), so a collapsing
+        acceptor degrades to near-plain decode instead of burning
+        budget on rejected drafts.  False pins ``K_row = num_draft``
+        (the serialized schedule's width — required for SAMPLED
+        bit-identity against the serialized spec reference; greedy
+        streams are K-invariant and stay bit-identical either way).
 
         ``kv_host_tier_bytes`` / ``kv_disk_tier_path`` /
         ``kv_disk_tier_bytes``: the TIERED KV capacity layer below the
@@ -341,11 +356,6 @@ class ContinuousBatchingEngine:
                 raise ValueError(
                     "mixed_token_budget needs prefill_chunk: the budget "
                     "is packed with C-token prefill segments")
-            if prompt_lookup or draft_cfg is not None:
-                raise ValueError(
-                    "mixed_token_budget composes with plain decode only; "
-                    "the speculative modes ride the serialized "
-                    "chunked-admission path")
             if self.mixed_token_budget < self.prefill_chunk:
                 raise ValueError(
                     f"mixed_token_budget ({self.mixed_token_budget}) must "
@@ -412,6 +422,20 @@ class ContinuousBatchingEngine:
         spec_mode = prompt_lookup or draft_cfg is not None
         self._slack_tokens = (decode_block * (num_draft + 1)
                               if spec_mode else 0)
+        # adaptive per-row draft length (docs/DESIGN.md §22): the mixed
+        # dispatch prices a spec row at (K_row + 1) tokens per round and
+        # an EWMA of its acceptance rate moves K_row between iterations
+        # within this SMALL STATIC bucket set — the dispatch-wide draft
+        # width is the max active bucket, so compiled variants stay
+        # O(buckets) (re-pinned in the §20 CompileTracker budget below).
+        # spec_adaptive=False pins K_row = num_draft (the serialized
+        # schedule's width).
+        K0 = max(1, int(num_draft))
+        self._spec_buckets = tuple(sorted({1, max(1, K0 // 2), K0}))
+        self.spec_adaptive = bool(spec_adaptive) and spec_mode
+        self._spec_krow = np.full((B,), K0, np.int32)
+        self._spec_ewma = np.ones((B,), np.float64)
+        self._spec_ewma_alpha = 0.5
         g = math.lcm(8, block_tokens)
         S = -(-(pad_cache_capacity(self.max_seq)
                 + self._slack_tokens) // g) * g
@@ -653,11 +677,30 @@ class ContinuousBatchingEngine:
         # so every install drops), giving exactly two compiled variants
         # (with_finals x num_steps is static per decode_block).
         self._mixed_step = None
+        self._mixed_pld_step = None
+        self._mixed_spec_step = None
         self._mixed_seg_cap = 0
         if self.mixed_token_budget > 0:
             C_mixed = self.prefill_chunk
             n_seg = max(1, self.mixed_token_budget // C_mixed)
             self._mixed_seg_cap = n_seg
+
+        def slab_finals(logits, seg_lens, seg_keys):
+            """Per-row batch-1 sampling of the packed finals' token #1 —
+            shared by the plain and speculative mixed programs (each row
+            its own key: the serialized final prefill's exact spend)."""
+            f_toks, f_lps = [], []
+            for r in range(self._mixed_seg_cap):
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[r], seg_lens[r] - 1, axis=0,
+                    keepdims=True)                         # [1, V]
+                tok_r = sample_logits(last, seg_keys[r], samp_)
+                f_toks.append(tok_r[0])
+                f_lps.append(_emitted_logprob(last, tok_r)[0])
+            return (jnp.stack(f_toks).astype(jnp.int32),
+                    jnp.stack(f_lps))
+
+        if self.mixed_token_budget > 0 and not spec_mode:
 
             @partial(jax.jit, donate_argnums=(1, 2),
                      static_argnums=(17, 18))
@@ -684,18 +727,8 @@ class ContinuousBatchingEngine:
                 logits, cache = slab_body(params, cache, seg_ids,
                                           seg_tables, seg_starts)
                 if with_finals:
-                    f_toks, f_lps = [], []
-                    for r in range(n_seg):
-                        # batch-1 sampling per final row, its own key:
-                        # bit-identical to the serialized final prefill
-                        last = jax.lax.dynamic_index_in_dim(
-                            logits[r], seg_lens[r] - 1, axis=0,
-                            keepdims=True)                     # [1, V]
-                        tok_r = sample_logits(last, seg_keys[r], samp_)
-                        f_toks.append(tok_r[0])
-                        f_lps.append(_emitted_logprob(last, tok_r)[0])
-                    final_toks = jnp.stack(f_toks).astype(jnp.int32)
-                    final_lps = jnp.stack(f_lps)
+                    final_toks, final_lps = slab_finals(
+                        logits, seg_lens, seg_keys)
                     lengths = lengths.at[seg_slot].set(
                         seg_plen, mode="drop")
                     last_tok = last_tok.at[seg_slot].set(
@@ -726,7 +759,7 @@ class ContinuousBatchingEngine:
                                         variant_budget=2)
 
         def verify_slots(params, cache, drafts, q_logits, lengths,
-                         last_tok, active, rng):
+                         last_tok, active, rng, k_cap=None):
             """Target-verify all slots' proposals in ONE [B, K+1]
             forward over the PAGE POOL (the [B, K+1] chunk rides the
             paged impl's XLA-gather path; writes scatter through the
@@ -734,7 +767,9 @@ class ContinuousBatchingEngine:
             verify half shared by the draft-model and prompt-lookup step
             jits (their host-side twin is _drain_spec_blocks).  Inactive
             rows' chunk writes route through their slots' sentineled
-            tables and drop."""
+            tables and drop.  ``k_cap`` ([B] or None): per-row
+            draft-length cap, the mixed dispatch's adaptive-K seam
+            (speculative.accept_and_extra)."""
             K = drafts.shape[1]
             verify_in = jnp.concatenate([last_tok[:, None], drafts],
                                         axis=1)
@@ -742,7 +777,8 @@ class ContinuousBatchingEngine:
             t_logits, cache = fwd_p(params, verify_in, cache, pos, False)
             rng, sub_u, sub_x = jax.random.split(rng, 3)
             emitted, n, new_last = verify_emit_per_row(
-                t_logits, drafts, q_logits, samp_, sub_u, sub_x)
+                t_logits, drafts, q_logits, samp_, sub_u, sub_x,
+                k_cap=k_cap)
             n = jnp.where(active, n, 0)
             new_last = jnp.where(active, new_last, last_tok)
             return cache, emitted, n, new_last, lengths + n
@@ -813,6 +849,83 @@ class ContinuousBatchingEngine:
 
             self._pld_step, self._admit_h = pld_step, admit_h
             self._history = jnp.zeros((B, hcap), jnp.int32)
+
+            if self.mixed_token_budget > 0:
+
+                @partial(jax.jit, donate_argnums=(1, 2, 3),
+                         static_argnums=(17, 18, 19))
+                def mixed_pld_step(params, pk, pv, history, seg_ids,
+                                   seg_tables, seg_starts, seg_lens,
+                                   seg_slot, seg_plen, seg_keys,
+                                   dec_tables, lengths, last_tok, active,
+                                   dec_rng, k_row, k_disp, num_rounds,
+                                   with_finals):
+                    """One mixed SPECULATIVE dispatch, prompt-lookup
+                    proposer (docs/DESIGN.md §22): the §19 prefill slab
+                    (finals sample token #1 from their own per-row keys,
+                    in pack order) followed by ``num_rounds``
+                    draft/verify rounds over the PRE-EXISTING active
+                    rows.  Freshly installed finals set only
+                    lengths/last_tok in-program and stay OUT of the
+                    rounds' active mask — their history row seeds
+                    host-side after the dispatch (the serialized
+                    admission's exact timing), and their sentinel decode
+                    table drops any garbage verify write.  ``k_disp``
+                    (static, a bucket) is the dispatch-wide draft width;
+                    ``k_row`` [B] caps each row's acceptance below it
+                    (adaptive K via verify_slots' k_cap) without
+                    changing the rng spend."""
+                    b = last_tok.shape[0]
+                    cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
+                    logits, cache = slab_body(params, cache, seg_ids,
+                                              seg_tables, seg_starts)
+                    if with_finals:
+                        final_toks, final_lps = slab_finals(
+                            logits, seg_lens, seg_keys)
+                        lengths = lengths.at[seg_slot].set(
+                            seg_plen, mode="drop")
+                        last_tok = last_tok.at[seg_slot].set(
+                            final_toks, mode="drop")
+                    else:
+                        final_toks = jnp.zeros((n_seg,), jnp.int32)
+                        final_lps = jnp.zeros((n_seg,), jnp.float32)
+                    bind_tables(dec_tables)
+
+                    def one_round(carry, sub):
+                        cache, history, lengths, last_tok = carry
+                        hist_len = lengths + 1
+                        drafts = ngram_propose(history, hist_len, k_disp)
+                        cache, emitted, n, new_last, new_lengths = \
+                            verify_slots(params, cache, drafts, None,
+                                         lengths, last_tok, active, sub,
+                                         k_cap=k_row)
+                        rows = jnp.arange(b)[:, None]
+                        cols = jnp.where(
+                            active[:, None],
+                            hist_len[:, None] + jnp.arange(k_disp + 1),
+                            hcap)
+                        history = history.at[rows, cols].set(emitted)
+                        return (cache, history, new_lengths, new_last), \
+                            (emitted, n)
+
+                    if num_rounds > 0:
+                        (cache, history, lengths, last_tok), (em, ns) = \
+                            jax.lax.scan(
+                                one_round,
+                                (cache, history, lengths, last_tok),
+                                jax.random.split(dec_rng, num_rounds))
+                    else:
+                        em = jnp.zeros((0, b, k_disp + 1), jnp.int32)
+                        ns = jnp.zeros((0, b), jnp.int32)
+                    return (cache.keys, cache.values, history, lengths,
+                            last_tok, final_toks, final_lps, em, ns)
+
+                # §20/§22 variant invariant: with_finals x (each bucket's
+                # k_disp with num_rounds=decode_block, plus the
+                # rounds-free shape at k_disp=max bucket)
+                self._mixed_pld_step = _ct.wrap(
+                    "mixed_pld_step", mixed_pld_step,
+                    variant_budget=2 * (len(self._spec_buckets) + 1))
 
         # ------------------------------------------------------------------
         # speculative slot decoding (draft model inside the slot loop)
@@ -952,6 +1065,100 @@ class ContinuousBatchingEngine:
 
             self._spec_step = spec_step
             self._dprefill, self._zero_row_d = dprefill, zero_row_d
+
+            if self.mixed_token_budget > 0:
+
+                @partial(jax.jit, donate_argnums=(2, 3, 4, 5),
+                         static_argnums=(20, 21, 22))
+                def mixed_spec_step(params, dparams, pk, pv, dpk, dpv,
+                                    seg_ids, seg_tables, seg_starts,
+                                    seg_lens, seg_slot, seg_plen,
+                                    seg_keys, dec_tables, dec_dtables,
+                                    lengths, last_tok, active, dec_rng,
+                                    k_row, k_disp, num_rounds,
+                                    with_finals):
+                    """One mixed SPECULATIVE dispatch, draft-model
+                    proposer (docs/DESIGN.md §22): the §19 prefill slab
+                    + finals, then ``num_rounds`` draft/verify rounds
+                    over the PRE-EXISTING active rows through the draft
+                    scratch pool.  Fresh finals set only
+                    lengths/last_tok — their draft cache prefills
+                    host-side after the dispatch (their dtable row is
+                    still all-sentinel here, so draft-side writes drop).
+                    ``k_disp`` is the static dispatch-wide draft width
+                    (drafting always runs the full sub-scan so the rng
+                    spend matches the serialized spec_step); ``k_row``
+                    caps per-row acceptance (adaptive K)."""
+                    b = last_tok.shape[0]
+                    cache = KVCache(pk, pv, jnp.zeros((), jnp.int32))
+                    logits, cache = slab_body(params, cache, seg_ids,
+                                              seg_tables, seg_starts)
+                    if with_finals:
+                        final_toks, final_lps = slab_finals(
+                            logits, seg_lens, seg_keys)
+                        lengths = lengths.at[seg_slot].set(
+                            seg_plen, mode="drop")
+                        last_tok = last_tok.at[seg_slot].set(
+                            final_toks, mode="drop")
+                    else:
+                        final_toks = jnp.zeros((n_seg,), jnp.int32)
+                        final_lps = jnp.zeros((n_seg,), jnp.float32)
+                    bind_tables(dec_tables)
+                    bind_dtables(dec_dtables)
+                    dcache = KVCache(dpk, dpv, jnp.zeros((), jnp.int32))
+
+                    def one_round(carry, sub):
+                        cache, dcache, lengths, last_tok = carry
+
+                        def dstep(c, j):
+                            tok, dc, r = c
+                            pos = (lengths + j)[:, None]
+                            dlogits, dc = fwd_dp(dparams, tok[:, None],
+                                                 dc, pos, True)
+                            dlogits = dlogits[:, 0]
+                            r, s = jax.random.split(r)
+                            if samp_.greedy:
+                                d = jnp.argmax(dlogits, axis=-1).astype(
+                                    jnp.int32)
+                                q = dlogits
+                            else:
+                                q = filtered_logits(dlogits, samp_)
+                                d = jax.random.categorical(s, q, axis=-1)
+                                d = d.astype(jnp.int32)
+                            return (d, dc, r), (d, q)
+
+                        sub, sub_d = jax.random.split(sub)
+                        (_, dcache, _), (drafts, q_logits) = jax.lax.scan(
+                            dstep, (last_tok, dcache, sub_d),
+                            jnp.arange(k_disp + 1))
+                        drafts = drafts[:k_disp].T
+                        q_logits = jnp.swapaxes(q_logits[:k_disp], 0, 1)
+
+                        cache, emitted, n, new_last, lengths = \
+                            verify_slots(
+                                params, cache, drafts,
+                                None if samp_.greedy else q_logits,
+                                lengths, last_tok, active, sub,
+                                k_cap=k_row)
+                        return (cache, dcache, lengths, new_last), \
+                            (emitted, n)
+
+                    if num_rounds > 0:
+                        (cache, dcache, lengths, last_tok), (em, ns) = \
+                            jax.lax.scan(
+                                one_round,
+                                (cache, dcache, lengths, last_tok),
+                                jax.random.split(dec_rng, num_rounds))
+                    else:
+                        em = jnp.zeros((0, b, k_disp + 1), jnp.int32)
+                        ns = jnp.zeros((0, b), jnp.int32)
+                    return (cache.keys, cache.values, dcache.keys,
+                            dcache.values, lengths, last_tok,
+                            final_toks, final_lps, em, ns)
+
+                self._mixed_spec_step = _ct.wrap(
+                    "mixed_spec_step", mixed_spec_step,
+                    variant_budget=2 * (len(self._spec_buckets) + 1))
         self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
         # disaggregated-join counters (docs/DESIGN.md §15): requests
         # admitted with premigrated KV + pages adopted on their behalf
@@ -1291,8 +1498,12 @@ class ContinuousBatchingEngine:
         it, so the target resuming AT the snapshot replays at most the
         in-flight step — never skips one.
 
-        Plain decode slots only: the speculative proposers' draft-pool /
-        n-gram history state is not checkpointed."""
+        Speculative rows export at a VERIFY BOUNDARY (exports are
+        serviced between dispatches, where no draft is in flight): the
+        checkpoint carries per-row adaptive-K state (``spec_k`` +
+        acceptance EWMA) but NOT the draft scratch pages or n-gram
+        history — the importer rebuilds proposer state from
+        prompt+tokens, which is cheap and exact (docs/DESIGN.md §22)."""
         req = rid if isinstance(rid, Request) else self._by_rid.get(rid)
         if req is None:
             raise KeyError(f"unknown request id {rid!r}")
@@ -1348,11 +1559,6 @@ class ContinuousBatchingEngine:
             raise ValueError(f"request {req.rid!r} already finished")
         if req.cancelled:
             raise ValueError(f"request {req.rid!r} was cancelled")
-        if self._spec_step is not None or self._pld_step is not None:
-            raise ValueError(
-                "export_request supports plain decode slots only (the "
-                "speculative proposers' draft/history state is not "
-                "checkpointed)")
         slot = next((i for i, r in enumerate(self._slots) if r is req),
                     None)
         mid_adm = ((self._adm is not None and self._adm["req"] is req)
@@ -1398,6 +1604,11 @@ class ContinuousBatchingEngine:
                         k=jax.tree.map(np.asarray, k_run),
                         v=jax.tree.map(np.asarray, v_run),
                         rng=np.asarray(self._rng).copy())
+            if self._spec_step is not None or self._pld_step is not None:
+                # verify-boundary freeze (§22): adaptive-K state ships;
+                # draft scratch / history do not (importer rebuilds)
+                ckpt["spec_k"] = int(self._spec_krow[slot])
+                ckpt["spec_ewma"] = float(self._spec_ewma[slot])
         self.migration_stats["exported_requests"] += 1
         if detach:
             if slot is not None:
@@ -1445,9 +1656,6 @@ class ContinuousBatchingEngine:
                               trace_id=int(ckpt.get("trace_id") or 0))
             req.adopted = True
             return req
-        if self._spec_step is not None or self._pld_step is not None:
-            raise ValueError(
-                "import_request supports plain decode slots only")
         if ckpt.get("kv_dtype", "bf16") != self.kv_dtype:
             raise ValueError(
                 f"checkpoint kv_dtype {ckpt.get('kv_dtype')!r} does not "
@@ -1493,7 +1701,9 @@ class ContinuousBatchingEngine:
         req.t_first = time.perf_counter()
         req._resume = {"k": ckpt["k"], "v": ckpt["v"], "length": length,
                        "last_tok": int(ckpt["last_tok"]),
-                       "rng": ckpt.get("rng")}
+                       "rng": ckpt.get("rng"),
+                       "spec_k": int(ckpt.get("spec_k") or 0),
+                       "spec_ewma": float(ckpt.get("spec_ewma") or 0.0)}
         with self._submit_lock:
             if not self._running:
                 raise RuntimeError("engine is closed")
@@ -1663,6 +1873,23 @@ class ContinuousBatchingEngine:
             total += max(0, len(a["req"].prompt) - a["start"])
         return total
 
+    def _spec_backlog_tokens(self) -> int:
+        """Per-iteration speculative token cost of the ACTIVE rows —
+        Σ (K_row + 1) · decode_block — the spec twin of the prefill
+        backlog above: the gateway's bounded-load router weighs it so a
+        replica mid-speculation (whose budget the spec rows are eating)
+        stops looking as idle as a plain-decode one (§22).  Racy
+        snapshot of scheduler-owned state: a gauge, not an invariant."""
+        if self._spec_step is None and self._pld_step is None:
+            return 0
+        total = 0
+        for i, r in enumerate(self._slots):
+            if r is not None:
+                k = (int(self._spec_krow[i]) if self.spec_adaptive
+                     else int(self._spec_buckets[-1]))
+                total += (k + 1) * self.decode_block
+        return total
+
     def stats(self) -> dict:
         """Scheduler counters for the HTTP ``/stats`` surface."""
         import copy as _copy
@@ -1724,12 +1951,24 @@ class ContinuousBatchingEngine:
             out["compile"] = compile_snap
         if self._spec_step is not None or self._pld_step is not None:
             s = self.spec_stats
+            # per-bucket occupancy of the ACTIVE rows' adaptive K_row —
+            # the observable shrink signal (§22): a low-acceptance
+            # workload walks mass toward bucket 1
+            k_buckets = {
+                str(b): int(sum(
+                    1 for i, r in enumerate(self._slots)
+                    if r is not None and int(self._spec_krow[i]) == b))
+                for b in self._spec_buckets}
             out["speculative"] = {
                 "proposer": ("prompt_lookup" if self._pld_step is not None
                              else "draft"),
                 "num_draft": self.num_draft, "rounds": s["rounds"],
+                "drafted": s["drafted"], "accepted": s["accepted"],
+                "adaptive": bool(self.spec_adaptive),
+                "k_row_buckets": k_buckets,
                 "acceptance_rate": (round(s["accepted"] / s["drafted"], 4)
                                     if s["drafted"] else None)}
+            out["spec_backlog_tokens"] = self._spec_backlog_tokens()
         # per-tenant SLO rollup (goodput + burn rates) rides the same
         # stats surface: the gateway's health prober stores it per
         # replica (the /debugz fleet summary) and the anomaly layer's
@@ -2003,6 +2242,16 @@ class ContinuousBatchingEngine:
         if ids is None:
             req._pkv_blocked = state
             raise _BlocksExhausted()
+        dids = None
+        if self._dmgr is not None:
+            # draft scratch, atomically with the target's pages (same
+            # rule as _reserve_pages): the checkpoint does NOT ship
+            # draft KV — it is rebuilt below from prompt + tokens
+            dids = self._dmgr.alloc(n_total)
+            if dids is None:
+                mgr.free(ids)
+                req._pkv_blocked = state
+                raise _BlocksExhausted()
         req._pkv_blocked = None
         length = rs["length"]
         n_used = -(-length // bt)
@@ -2018,16 +2267,53 @@ class ContinuousBatchingEngine:
         table = np.full((self._table_width,), self._page_sentinel,
                         np.int32)
         table[:n_total] = ids
+        dtable = None
+        if dids is not None:
+            dtable = np.full((self._table_width,), self._dpage_sentinel,
+                             np.int32)
+            dtable[:n_total] = dids
         req._pkv = {"lease": None, "store_lease": store_lease,
                     "private": ids, "adopted": tuple(adopted),
-                    "n_pref": 0, "table": table, "dprivate": None,
-                    "dtable": None, "released": False}
+                    "n_pref": 0, "table": table, "dprivate": dids,
+                    "dtable": dtable, "released": False}
         self._tables[slot] = table
         self._lengths, self._last_tok = self._set_slot_state(
             self._lengths, self._last_tok, jnp.int32(slot),
             jnp.int32(length), jnp.int32(rs["last_tok"]))
         if rs.get("rng") is not None:
             self._rng = jnp.asarray(np.asarray(rs["rng"]))
+        if self._spec_step is not None or self._pld_step is not None:
+            # §22 verify-boundary resume: the proposers' state is NOT in
+            # the checkpoint — rebuild it exactly from prompt + emitted
+            # tokens (KV [0, length) = prompt + tokens[:-1]; tokens[-1]
+            # is last_tok, whose KV the next round's verify writes)
+            hist = np.concatenate(
+                [np.asarray(req.prompt, np.int32),
+                 np.asarray(req.tokens[:-1], np.int32)])
+            if self._spec_step is not None:
+                dbucket = self._bucket(length)
+                dpad = np.zeros((1, dbucket), np.int32)
+                dpad[0, :length] = hist
+                drow_k, drow_v = self._dprefill(
+                    self.draft_params, jnp.asarray(dpad),
+                    *self._zero_row_d())
+                self._dpk, self._dpv = self._write_row(
+                    self._dpk, self._dpv, drow_k, drow_v,
+                    jnp.asarray(dtable))
+                self._dtables[slot] = dtable
+            if self._pld_step is not None:
+                hpad = np.zeros((1, self._bucket(length)), np.int32)
+                hpad[0, :length] = hist
+                self._history = self._admit_h(
+                    self._history, jnp.asarray(hpad), jnp.int32(slot),
+                    jnp.int32(length), jnp.int32(rs["last_tok"]))
+            k = int(rs.get("spec_k") or 0)
+            self._spec_krow[slot] = next(
+                (b for b in self._spec_buckets if b >= k),
+                self._spec_buckets[-1]) if k > 0 \
+                else self._spec_buckets[-1]
+            self._spec_ewma[slot] = (float(rs.get("spec_ewma") or 0.0)
+                                     or 1.0)
         self._slots[slot] = req
         req._resume = None          # staged host buffers are done
         self.migration_stats["imported_requests"] += 1
@@ -2165,6 +2451,10 @@ class ContinuousBatchingEngine:
             self._history = self._admit_h(
                 self._history, jnp.asarray(hpad), jnp.int32(slot),
                 jnp.int32(plen), tok.astype(jnp.int32))
+        # fresh acceptor starts at the widest bucket (adaptive K re-learns
+        # from this row's own acceptance; §22)
+        self._spec_krow[slot] = self._spec_buckets[-1]
+        self._spec_ewma[slot] = 1.0
         self._slots[slot] = req
         self._flight.record("batch_admit", slot=slot, prompt_len=plen,
                             max_new=req.max_new,
@@ -2193,16 +2483,20 @@ class ContinuousBatchingEngine:
                     i, req, int(em_np[i, j]),
                     None if lps_np is None else float(lps_np[i, j]))
 
-    def _drain_spec_blocks(self, em_np, ns_np) -> None:
+    def _drain_spec_blocks(self, em_np, ns_np, k_vec=None) -> None:
         """Record one speculative round's per-row emitted blocks +
         acceptance stats — shared by the draft-model and prompt-lookup
         step branches.  Both counters come from the slots still OCCUPIED
         at drain time, so rounds after a row finished mid-block (fused
-        decode_block) inflate neither drafted nor accepted."""
+        decode_block) inflate neither drafted nor accepted.  ``k_vec``
+        ([B] or None): the mixed dispatch's per-row draft widths —
+        adaptive K prices drafted by what each row actually offered."""
         self._step_count += 1
         self.spec_stats["rounds"] += 1
         live = [i for i, r in enumerate(self._slots) if r is not None]
-        self.spec_stats["drafted"] += self.num_draft * len(live)
+        self.spec_stats["drafted"] += (
+            self.num_draft * len(live) if k_vec is None
+            else int(sum(int(k_vec[i]) for i in live)))
         self.spec_stats["accepted"] += int(
             sum(int(ns_np[i]) - 1 for i in live))
         self._record_row_blocks(em_np, ns_np)
@@ -2603,8 +2897,24 @@ class ContinuousBatchingEngine:
         W = self._table_width
         n_seg = self._mixed_seg_cap
         n_active = sum(1 for s in self._slots if s is not None)
-        room = max(0, self.mixed_token_budget
-                   - n_active * self.decode_block)
+        live0 = [i for i, s in enumerate(self._slots) if s is not None]
+        spec_mixed = (self._mixed_pld_step is not None
+                      or self._mixed_spec_step is not None)
+        if spec_mixed:
+            # §22 pricing: a speculative row costs (K_row + 1) tokens
+            # per round — K_row drafts + the verify/bonus token — times
+            # the decode_block fused rounds.  Adaptive K shrinks a
+            # collapsing acceptor toward K_row = 1 (≈ plain decode)
+            # so it stops burning budget the prefill slab could use.
+            k_vec = (self._spec_krow.copy() if self.spec_adaptive
+                     else np.full((B,), self._spec_buckets[-1],
+                                  np.int32))
+            room = max(0, self.mixed_token_budget - sum(
+                (int(k_vec[i]) + 1) * self.decode_block for i in live0))
+        else:
+            k_vec = None
+            room = max(0, self.mixed_token_budget
+                       - n_active * self.decode_block)
         want = min(n_seg, max(1, room // C)) if self._adms else 0
         seg_ids = np.zeros((n_seg, C), np.int32)
         seg_tables = np.full((n_seg, W), self._page_sentinel, np.int32)
@@ -2665,28 +2975,77 @@ class ContinuousBatchingEngine:
         for (_, a, is_final, slot) in packed:
             if is_final:
                 budget_vec[slot] = a["req"].max_new - 1
-        if n_active > 0 or with_finals:
+        if spec_mixed:
+            # §22 rng rule: the decode split is spent iff spec rounds
+            # run, i.e. iff a row was ALREADY active — a freshly
+            # installed final spends only its pack-order batch-1 key
+            # this dispatch (its proposer state seeds host-side after),
+            # exactly the serialized final-split-then-step-split order.
+            num_rounds = self.decode_block if n_active > 0 else 0
+            k_disp = (max(int(k_vec[i]) for i in live0) if live0
+                      else int(self._spec_buckets[-1]))
+            if num_rounds > 0:
+                self._rng, dec_sub = jax.random.split(self._rng)
+            else:
+                dec_sub = jax.random.PRNGKey(0)
+        elif n_active > 0 or with_finals:
             # ONE decode split per dispatch that decodes — the
             # serialized loop's spend (it skips the split when no slot
             # is active)
+            num_rounds, k_disp = 0, 0
             self._rng, dec_sub = jax.random.split(self._rng)
         else:
+            num_rounds, k_disp = 0, 0
             dec_sub = jax.random.PRNGKey(0)   # prefill-only: loop is
-        _sig = _profiling.dispatch_signature(  # a 0-step no-op
-            "mixed_step", batch=int(active_mask.sum()),
+                                              # a 0-step no-op
+        prog = ("mixed_step" if not spec_mixed else
+                "mixed_spec_step" if self._mixed_spec_step is not None
+                else "mixed_pld_step")
+        _sig = _profiling.dispatch_signature(
+            prog, batch=int(active_mask.sum()),
             chunk=self.decode_block, kv_dtype=self.kv_cache.kv_dtype)
         _t0 = self._prof.begin(_sig)
         try:
-            (self._pk, self._pv, self._lengths, tok, final_toks,
-             final_lps, toks, lps, steps) = self._mixed_step(
-                self.params, self._pk, self._pv, jnp.asarray(seg_ids),
-                jnp.asarray(seg_tables), jnp.asarray(seg_starts),
-                jnp.asarray(seg_lens), jnp.asarray(seg_slot),
-                jnp.asarray(seg_plen), jnp.asarray(seg_keys),
-                jnp.asarray(self._tables), self._lengths,
-                self._last_tok, jnp.asarray(active_mask), dec_sub,
-                self._eos_scalar(), jnp.asarray(budget_vec),
-                self.decode_block, with_finals)
+            if not spec_mixed:
+                (self._pk, self._pv, self._lengths, tok, final_toks,
+                 final_lps, toks, lps, steps) = self._mixed_step(
+                    self.params, self._pk, self._pv,
+                    jnp.asarray(seg_ids), jnp.asarray(seg_tables),
+                    jnp.asarray(seg_starts), jnp.asarray(seg_lens),
+                    jnp.asarray(seg_slot), jnp.asarray(seg_plen),
+                    jnp.asarray(seg_keys), jnp.asarray(self._tables),
+                    self._lengths, self._last_tok,
+                    jnp.asarray(active_mask), dec_sub,
+                    self._eos_scalar(), jnp.asarray(budget_vec),
+                    self.decode_block, with_finals)
+                self._last_tok = tok
+            elif self._mixed_spec_step is not None:
+                (self._pk, self._pv, self._dpk, self._dpv,
+                 self._lengths, self._last_tok, final_toks, final_lps,
+                 em, ns) = self._mixed_spec_step(
+                    self.params, self.draft_params, self._pk, self._pv,
+                    self._dpk, self._dpv, jnp.asarray(seg_ids),
+                    jnp.asarray(seg_tables), jnp.asarray(seg_starts),
+                    jnp.asarray(seg_lens), jnp.asarray(seg_slot),
+                    jnp.asarray(seg_plen), jnp.asarray(seg_keys),
+                    jnp.asarray(self._tables),
+                    jnp.asarray(self._dtables), self._lengths,
+                    self._last_tok, jnp.asarray(active_mask), dec_sub,
+                    jnp.asarray(k_vec), k_disp, num_rounds,
+                    with_finals)
+            else:
+                (self._pk, self._pv, self._history, self._lengths,
+                 self._last_tok, final_toks, final_lps, em,
+                 ns) = self._mixed_pld_step(
+                    self.params, self._pk, self._pv, self._history,
+                    jnp.asarray(seg_ids), jnp.asarray(seg_tables),
+                    jnp.asarray(seg_starts), jnp.asarray(seg_lens),
+                    jnp.asarray(seg_slot), jnp.asarray(seg_plen),
+                    jnp.asarray(seg_keys), jnp.asarray(self._tables),
+                    self._lengths, self._last_tok,
+                    jnp.asarray(active_mask), dec_sub,
+                    jnp.asarray(k_vec), k_disp, num_rounds,
+                    with_finals)
         except BaseException as e:
             # a per-request failure fails the packed requests, never
             # the engine — same contract as the serialized admission
@@ -2705,7 +3064,6 @@ class ContinuousBatchingEngine:
             for req in failed:
                 self._fail_request(req, e)
             return
-        self._last_tok = tok
         cs = self.chunk_stats
         cs["mixed_dispatches"] += 1
         cs["mixed_prefill_tokens"] += prefill_tokens
@@ -2729,13 +3087,63 @@ class ContinuousBatchingEngine:
                         req.prompt, st["table"][:plen // bt])
                     st["adopted"] = adopted
                     st["store_lease"] = store_lease
+                if spec_mixed:
+                    # the fresh row's proposer state seeds HOST-SIDE,
+                    # exactly as _finish_admission does — during the
+                    # dispatch its draft table row was all-sentinel (or
+                    # its history row untouched: inactive rows scatter
+                    # out of bounds), so nothing stale survives
+                    if self._spec_step is not None:
+                        dbucket = self._bucket(plen)
+                        dpad = np.zeros((1, dbucket), np.int32)
+                        dpad[0, :plen] = req.prompt
+                        drow_k, drow_v = self._dprefill(
+                            self.draft_params, jnp.asarray(dpad),
+                            *self._zero_row_d())
+                        self._dpk, self._dpv = self._write_row(
+                            self._dpk, self._dpv, drow_k, drow_v,
+                            jnp.asarray(st["dtable"]))
+                        self._dtables[slot] = st["dtable"]
+                    if self._pld_step is not None:
+                        hpad = np.zeros((1, self._bucket(plen)),
+                                        np.int32)
+                        hpad[0, :plen] = req.prompt
+                        self._history = self._admit_h(
+                            self._history, jnp.asarray(hpad),
+                            jnp.int32(slot), jnp.int32(plen),
+                            jnp.int32(int(final_toks_np[r0])))
+                    # fresh acceptor: start wide, re-learn
+                    self._spec_krow[slot] = self._spec_buckets[-1]
+                    self._spec_ewma[slot] = 1.0
                 self._slots[slot] = req
                 self._flight.record("batch_admit", slot=slot,
                                     prompt_len=plen,
                                     max_new=req.max_new,
                                     prefix_reused=a["m"])
-                self._record_token(slot, req, int(final_toks_np[r0]),
-                                   float(final_lps_np[r0]))
+                self._record_token(
+                    slot, req, int(final_toks_np[r0]),
+                    None if spec_mixed else float(final_lps_np[r0]))
+        if spec_mixed:
+            em_np, ns_np = np.asarray(em), np.asarray(ns)
+            if _t0 is not None:
+                self._prof.end(_sig, _t0, out=self._last_tok,
+                               hbm_bytes=(
+                    prefill_tokens * self._kv_bytes_per_token
+                    + self._decode_kv_bytes(
+                        active_mask, num_rounds * (k_disp + 1))))
+            emitted = int(ns_np[:, live0].sum()) if live0 else 0
+            cs["mixed_packed_tokens"] += prefill_tokens + emitted
+            if num_rounds > 0:
+                self._count_loop(num_rounds)
+                for r0 in range(num_rounds):
+                    self._drain_spec_blocks(em_np[r0], ns_np[r0],
+                                            k_vec=k_vec)
+                if self.spec_adaptive:
+                    self._update_spec_krow(live0, k_vec, ns_np,
+                                           num_rounds)
+            if num_rounds > 0 and self._adms:
+                cs["interleaved_steps"] += 1
+            return
         steps = int(steps)           # the on-device active count
         if _t0 is not None:
             # sampled only (int(steps) above already synced): packed
@@ -2753,6 +3161,26 @@ class ContinuousBatchingEngine:
                 np.asarray(lps))
         if steps > 0 and self._adms:
             cs["interleaved_steps"] += 1
+
+    def _update_spec_krow(self, live0, k_vec, ns_np, num_rounds: int
+                          ) -> None:
+        """EWMA acceptance feedback (docs/DESIGN.md §22): fold one
+        dispatch's realized acceptance rate — per row live at dispatch
+        START, extra tokens kept over drafts offered — into the row's
+        EWMA, then re-bucket K_row to the smallest bucket covering
+        ``ewma * num_draft``.  A collapsing acceptor walks down to
+        K_row = 1 (plain decode's price); recovery walks it back up."""
+        buckets = self._spec_buckets
+        alpha = self._spec_ewma_alpha
+        for i in live0:
+            offered = num_rounds * max(1, int(k_vec[i]))
+            kept = int(ns_np[:, i].sum()) - num_rounds
+            rate = min(1.0, max(0.0, kept / offered))
+            self._spec_ewma[i] = ((1.0 - alpha) * self._spec_ewma[i]
+                                  + alpha * rate)
+            want = self._spec_ewma[i] * self.num_draft
+            self._spec_krow[i] = next(
+                (b for b in buckets if b >= want), buckets[-1])
 
     def _loop(self):
         try:

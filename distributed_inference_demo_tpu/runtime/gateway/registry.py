@@ -92,6 +92,13 @@ class Replica:
         return int(self.last_stats.get("pending_prefill_tokens", 0))
 
     @property
+    def spec_backlog_tokens(self) -> int:
+        """Per-iteration speculative token cost of the replica's active
+        rows — Σ (K_row + 1) · decode_block (docs/DESIGN.md §22); 0 on
+        replicas with no speculative proposer armed."""
+        return int(self.last_stats.get("spec_backlog_tokens", 0))
+
+    @property
     def kv_tier(self) -> dict:
         """The replica's last-reported §21 tier fragment (empty dict
         when the replica runs no host tier) — occupancy for /debugz,
@@ -202,6 +209,11 @@ class ReplicaRegistry:
         with self._lock:
             r = self._replicas.get(rid)
             return r.pending_prefill_tokens if r is not None else 0
+
+    def spec_backlog_tokens(self, rid: str) -> int:
+        with self._lock:
+            r = self._replicas.get(rid)
+            return r.spec_backlog_tokens if r is not None else 0
 
     # -- the debounce ------------------------------------------------------
 

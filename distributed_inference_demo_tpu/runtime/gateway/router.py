@@ -76,13 +76,16 @@ class PrefixAwareRouter:
     def __init__(self, registry, *, min_prefix_tokens: int = 16,
                  block_tokens: int = 16, max_index_entries: int = 4096,
                  max_key_tokens: int = 512, load_factor: float = 2.0,
-                 prefill_token_weight: int = 256):
+                 prefill_token_weight: int = 256,
+                 spec_token_weight: int = 256):
         if min_prefix_tokens < 1:
             raise ValueError("min_prefix_tokens must be >= 1")
         if block_tokens < 1:
             raise ValueError("block_tokens must be >= 1")
         if prefill_token_weight < 0:
             raise ValueError("prefill_token_weight must be >= 0")
+        if spec_token_weight < 0:
+            raise ValueError("spec_token_weight must be >= 0")
         self.registry = registry
         self.min_prefix_tokens = min_prefix_tokens
         self.block_tokens = block_tokens
@@ -93,6 +96,11 @@ class PrefixAwareRouter:
         # one queued request in the bounded-load check (0 = ignore the
         # backlog, depth-only load as before ISSUE-15)
         self.prefill_token_weight = prefill_token_weight
+        # speculative-backlog weighting (docs/DESIGN.md §22): the same
+        # scale for the replica-reported Σ (K_row + 1) · decode_block
+        # per-iteration spec spend — a replica mid-speculation has less
+        # budget headroom than its queue depth shows (0 = ignore)
+        self.spec_token_weight = spec_token_weight
         self._lock = threading.Lock()
         # rid -> OrderedDict[prefix-key-bytes, n_tokens] (LRU: move on
         # touch, evict oldest past the cap)
@@ -256,6 +264,12 @@ class PrefixAwareRouter:
         if self.prefill_token_weight:
             load += (self.registry.pending_prefill_tokens(rid)
                      / float(self.prefill_token_weight))
+        if self.spec_token_weight:
+            # spec backlog (§22): speculating rows eat the replica's
+            # per-iteration token budget the same way a prefill backlog
+            # does — fold it in at its own scale
+            load += (self.registry.spec_backlog_tokens(rid)
+                     / float(self.spec_token_weight))
         return load
 
     # -- the decision ------------------------------------------------------
@@ -344,6 +358,7 @@ class PrefixAwareRouter:
                 "block_tokens": self.block_tokens,
                 "load_factor": self.load_factor,
                 "prefill_token_weight": self.prefill_token_weight,
+                "spec_token_weight": self.spec_token_weight,
                 "replicas": {
                     rid: {
                         "up": self.registry.is_up(rid),
@@ -354,6 +369,8 @@ class PrefixAwareRouter:
                         "inflight": self._inflight.get(rid, 0),
                         "pending_prefill_tokens":
                             self.registry.pending_prefill_tokens(rid),
+                        "spec_backlog_tokens":
+                            self.registry.spec_backlog_tokens(rid),
                         "replica_tree_nodes":
                             self._replica_nodes.get(rid),
                         "tier_digest_entries": len(

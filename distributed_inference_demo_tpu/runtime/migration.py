@@ -125,7 +125,12 @@ def _state_meta(ckpt: dict, *, rid: str, attempt: int, n_frames: int,
             "tenant": ckpt.get("tenant", "default"),
             "trace_id": int(ckpt.get("trace_id") or 0),
             "t_submit_wall": float(ckpt.get("t_submit_wall") or 0.0),
-            "migration_pause": float(ckpt.get("migration_pause") or 0.0)}
+            "migration_pause": float(ckpt.get("migration_pause") or 0.0),
+            # §22 verify-boundary freeze: adaptive-K state rides the
+            # manifest (scalars); draft scratch / n-gram history do NOT
+            # ship — the importer rebuilds them from prompt + tokens
+            "spec_k": int(ckpt.get("spec_k") or 0),
+            "spec_ewma": float(ckpt.get("spec_ewma") or 0.0)}
 
 
 def _ckpt_from_staged(stager: PageStager, st: dict, meta: dict) -> dict:
@@ -148,6 +153,8 @@ def _ckpt_from_staged(stager: PageStager, st: dict, meta: dict) -> dict:
             "trace_id": int(meta.get("trace_id") or 0),
             "t_submit_wall": float(meta.get("t_submit_wall") or 0.0),
             "migration_pause": float(meta.get("migration_pause") or 0.0),
+            "spec_k": int(meta.get("spec_k") or 0),
+            "spec_ewma": float(meta.get("spec_ewma") or 0.0),
             "k": k_blocks, "v": v_blocks,
             "rng": (np.asarray(rng, np.uint32) if len(rng) else None)}
 

@@ -73,7 +73,7 @@ class SpecStats:
 
 
 def accept_and_extra(t_logits, drafts, q_logits, samp: SamplingParams,
-                     sub_u, sub_x):
+                     sub_u, sub_x, k_cap=None):
     """The speculative accept/resample rule, shared by every proposer
     (draft model, prompt lookup) and every advance policy (lockstep,
     per-row).
@@ -83,6 +83,16 @@ def accept_and_extra(t_logits, drafts, q_logits, samp: SamplingParams,
     q_logits: [b, K, V] proposer's filtered logits, or None for a
               DETERMINISTIC proposer (one-hot q: accept d with prob p(d),
               resample from p with d masked out).
+    k_cap:    [b] int32 per-row draft-length cap in [1, K], or None for
+              the full width.  Proposals at positions >= k_cap[i] are
+              never accepted — the adaptive-draft-length seam
+              (docs/DESIGN.md §22): a capped row behaves exactly as if
+              only its first k_cap proposals existed.  A TRUNCATION at
+              k_cap (< K, with the capped proposal otherwise live) is
+              not a rejection: the follow-up token samples from the
+              target's own distribution at that position, not the
+              residual.  Rng spend is identical either way, so capped
+              and uncapped schedules stay split-for-split comparable.
 
     Returns (a [b] accepted-draft counts in [0, K], extra [b]: the
     rejection-point resample, or the bonus token after K accepts).
@@ -91,9 +101,12 @@ def accept_and_extra(t_logits, drafts, q_logits, samp: SamplingParams,
     if samp.greedy:
         t_arg = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
         accept = drafts == t_arg[:, :K]                # [b, K] bool
+        if k_cap is not None:
+            accept = accept & (jnp.arange(K)[None, :] < k_cap[:, None])
         a = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)   # [b] in [0, K]
         # rejected at a -> the target's own argmax; all accepted -> bonus
-        # argmax after d_K.  Both are t_arg[:, a].
+        # argmax after d_K.  Both are t_arg[:, a].  A k_cap truncation is
+        # also t_arg[:, a] — the token greedy decode would emit there.
         extra = jnp.take_along_axis(t_arg, a[:, None], axis=1)[:, 0]
     else:
         p_logits = filtered_logits(t_logits, samp)     # [b, K+1, V]
@@ -108,6 +121,8 @@ def accept_and_extra(t_logits, drafts, q_logits, samp: SamplingParams,
             q_d = jnp.take_along_axis(
                 q, drafts[..., None], axis=-1)[..., 0]
             accept = u * jnp.maximum(q_d, 1e-20) < p_d
+        if k_cap is not None:
+            accept = accept & (jnp.arange(K)[None, :] < k_cap[:, None])
         a = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
         # resample dist at the rejection point: norm(max(p - q, 0)); for a
         # one-hot q that is p with the draft token masked out
@@ -124,6 +139,12 @@ def accept_and_extra(t_logits, drafts, q_logits, samp: SamplingParams,
         # to p_a — accept/resample then reduces to plain sampling from p
         resid_sum = jnp.sum(resid_a, axis=-1, keepdims=True)
         resid_a = jnp.where(resid_sum > 0, resid_a, p_a)
+        if k_cap is not None:
+            # truncated at k_cap < K with every eligible proposal
+            # accepted: the position-a proposal was never offered, so
+            # the correct follow-up is a plain sample from p there
+            trunc = (a == k_cap) & (k_cap < K)
+            resid_a = jnp.where(trunc[:, None], p_a, resid_a)
         bonus = jax.nn.softmax(p_logits[:, K], axis=-1)
         extra_probs = jnp.where((a == K)[:, None], bonus, resid_a)
         extra = jax.random.categorical(
@@ -163,17 +184,18 @@ def verify_emit(t_logits, drafts, q_logits, samp: SamplingParams,
 
 
 def verify_emit_per_row(t_logits, drafts, q_logits, samp: SamplingParams,
-                        sub_u, sub_x):
+                        sub_u, sub_x, k_cap=None):
     """Accept/resample + assembly with PER-ROW advance: row i moves by
     ``n_i = a_i + 1`` — no lockstep minimum, no wasted acceptances.  The
     policy for engines whose cache positions are already per-row (the
     continuous-batching slot cache); the follow-up token is always the
-    row's ``extra``.
+    row's ``extra``.  ``k_cap`` ([b] or None): per-row draft-length cap
+    (see :func:`accept_and_extra`).
 
     Returns (emitted [b, K+1], n [b] in [1, K+1], new_last [b]).
     """
     a, extra = accept_and_extra(t_logits, drafts, q_logits, samp,
-                                sub_u, sub_x)
+                                sub_u, sub_x, k_cap=k_cap)
     return assemble_emitted(drafts, a, extra), a + 1, extra
 
 

@@ -137,6 +137,27 @@ BATCH_TOKEN_BUDGET_UTILIZATION = gauge(
     "Packed tokens (prefill segments + decode-loop steps x active "
     "rows) over budgeted tokens across mixed dispatches; NaN until "
     "the first mixed dispatch")
+# spec-in-the-batch series (docs/DESIGN.md §22): the scheduler-side view
+# of speculation — drafted/accepted feed the acceptance ratio, and the
+# per-bucket K_row occupancy gauge is the observable adaptive-K signal
+# (a low-acceptance workload walks active rows toward bucket "1")
+BATCH_DRAFT_TOKENS = counter(
+    "dwt_batching_draft_tokens_total",
+    "Draft tokens the slot scheduler offered to the verifier "
+    "(speculative rows, serialized or mixed dispatch; adaptive K "
+    "prices each row by what it actually offered)")
+BATCH_ACCEPTED_TOKENS = counter(
+    "dwt_batching_accepted_tokens_total",
+    "Draft tokens the verifier accepted on scheduler rows (excl. the "
+    "bonus/resample token)")
+BATCH_DRAFT_LEN = gauge(
+    "dwt_batching_draft_len",
+    "Active decode rows currently assigned this adaptive draft-length "
+    "bucket (K_row; docs/DESIGN.md §22)", ("bucket",))
+BATCH_SPEC_ACCEPT_RATIO = gauge(
+    "dwt_batching_spec_acceptance_ratio",
+    "accepted/drafted over the scheduler's speculative rows (NaN until "
+    "the first draft)")
 
 # -- block KV cache (runtime/kvcache), bridged from manager snapshots ------
 
@@ -375,10 +396,17 @@ def update_batching_series(stats: dict) -> None:
         SPEC_ROUNDS.set_cumulative(sp.get("rounds", 0))
         if "drafted" in sp:
             SPEC_DRAFTED.set_cumulative(sp["drafted"])
+            BATCH_DRAFT_TOKENS.set_cumulative(sp["drafted"])
         if "accepted" in sp:
             SPEC_ACCEPTED.set_cumulative(sp["accepted"])
-        if sp.get("acceptance_rate") is not None:
-            SPEC_ACCEPT_RATIO.set(sp["acceptance_rate"])
+            BATCH_ACCEPTED_TOKENS.set_cumulative(sp["accepted"])
+        ar = sp.get("acceptance_rate")
+        if ar is not None:
+            SPEC_ACCEPT_RATIO.set(ar)
+        BATCH_SPEC_ACCEPT_RATIO.set(
+            ar if ar is not None else float("nan"))
+        for b, nrows in (sp.get("k_row_buckets") or {}).items():
+            BATCH_DRAFT_LEN.set(nrows, bucket=str(b))
 
 
 # -- engine device-loop series (event-driven, docs/DESIGN.md §13) ----------

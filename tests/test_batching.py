@@ -65,6 +65,9 @@ def test_concurrent_requests_all_match(params, oracle):
                                           expected(oracle, p, n))
 
 
+# tier-1 budget: test_decode_block_parity_and_late_joiner is the
+# quick-lane late-joiner rep (same seam through the fused loop)
+@pytest.mark.slow
 def test_late_joiner_matches(params, oracle):
     """A request admitted while another is mid-decode must still be
     bit-exact — the continuous part of continuous batching."""
@@ -526,6 +529,10 @@ def test_spec_single_request_matches_engine(params, draft_params, oracle):
         assert eng.stats()["speculative"]["rounds"] >= 1
 
 
+# tier-1 budget: test_spec_single_request_matches_engine keeps the
+# quick-lane draft rep; concurrency twins ride the slow lane with
+# the §22 mixed-spec suite pinning concurrent spec rows in tier-1
+@pytest.mark.slow
 def test_spec_concurrent_requests_all_match(params, draft_params, oracle):
     prompts = [[3, 14, 15], [9, 2, 6, 5, 3, 5], [1], [7, 7, 7, 7]]
     ns = [10, 14, 8, 12]
@@ -647,6 +654,7 @@ def test_pld_single_request_matches_engine(params, oracle):
         assert st["proposer"] == "prompt_lookup" and st["rounds"] >= 1
 
 
+@pytest.mark.slow
 def test_pld_concurrent_and_late_joiner_match(params, oracle):
     with pld_engine(params) as eng:
         first = eng.submit([5, 4, 3, 2], 40)
@@ -665,6 +673,9 @@ def test_pld_concurrent_and_late_joiner_match(params, oracle):
                                       expected(oracle, [5, 4, 3, 2], 40))
 
 
+# tier-1 budget: acceptance telemetry keeps a quick-lane rep in the
+# §22 adaptive-K test (tests/test_mixed_batching.py)
+@pytest.mark.slow
 def test_pld_repetitive_prompt_accepts(params):
     """A prompt whose greedy continuation re-uses its own spans gets
     acceptance > 0 through the slot loop (the PLD payoff).  greedy decode
@@ -703,7 +714,9 @@ def test_pld_exclusive_with_draft(params):
 
 
 @pytest.mark.parametrize("mode", [
-    "plain",
+    # tier-1 budget: the whole soak family rides the slow lane; the
+    # late-joiner/decode-block parity tests are the quick-lane reps
+    pytest.param("plain", marks=pytest.mark.slow),
     pytest.param("draft", marks=pytest.mark.slow),
     pytest.param("pld", marks=pytest.mark.slow),
     pytest.param("chunked", marks=pytest.mark.slow),
@@ -880,7 +893,12 @@ def test_chunked_admission_composes_with_prefix_cache(params, oracle):
 
 
 @pytest.mark.parametrize("mode", [
-    pytest.param("draft", marks=pytest.mark.slow), "pld"])
+    pytest.param("draft", marks=pytest.mark.slow),
+    # tier-1 budget: test_decode_block_composes_with_speculation[pld]
+    # keeps the quick-lane spec-composition rep; the §22 mixed tests
+    # pin spec x chunked admission in tier-1
+    pytest.param("pld", marks=pytest.mark.slow),
+])
 def test_chunked_admission_composes_with_speculation(params, draft_params,
                                                      oracle, mode):
     """Chunked target-side admission under both speculative proposers:

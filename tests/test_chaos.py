@@ -992,6 +992,94 @@ def test_chaos_live_migration_faults_bit_identical(mig_pair):
     _mig_no_pool_leaks(mig_pair.src_e, mig_pair.dst_e)
 
 
+@pytest.mark.slow
+def test_chaos_mid_speculation_migration_rs_faults_bit_identical():
+    """§22 chaos acceptance: a SPECULATING row (prompt-lookup proposer,
+    adaptive K live) hands off mid-decode while seeded faults hammer
+    the rs: resume-state frame — the frame that now carries the §22
+    spec_k/spec_ewma scalars.  Drops stall into ack-timeout retries,
+    corrupt frames are detected and retransmitted; the handoff still
+    completes (or legally resolves locally), the stream is
+    bit-identical to the never-migrated spec run, staging drains to
+    zero bytes, and no pool page leaks on either replica."""
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+    from distributed_inference_demo_tpu.runtime.migration import (
+        MigrationWorker)
+    cfg = get_model_config(MODEL)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+
+    def mk():
+        return ContinuousBatchingEngine(
+            cfg, params, max_seq=512, max_batch=2, sampling=GREEDY,
+            kv_cache_blocks=80, kv_block_tokens=8,
+            prompt_lookup=True, num_draft=3)
+
+    net = LoopbackNetwork()
+    src_e, dst_e = mk(), mk()
+    dst_w = MigrationWorker(dst_e, LoopbackTransport("smdst", net),
+                            ack_timeout=10.0)
+    th = threading.Thread(target=dst_w.serve_forever, daemon=True)
+    th.start()
+    try:
+        ref = [int(t) for t in src_e.submit(MIG_PROMPT,
+                                            MIG_MAX_NEW).wait(180)]
+        # a spec row emits several tokens per round, so the faulted
+        # handoff races a faster decode than the plain chaos test —
+        # same retry idiom, fresh rid + seed per attempt
+        moved = False
+        for i in range(4):
+            rid = f"sm{i}"
+            plan = FaultPlan(seed=7 + i, rules=[
+                FaultRule(kind="drop", tag_prefix="rs:", max_count=1),
+                FaultRule(kind="corrupt", tag_prefix="rs:", after=1,
+                          max_count=1),
+                FaultRule(kind="duplicate", tag_prefix="rs:", prob=0.5),
+                FaultRule(kind="duplicate", tag_prefix="pg:", prob=0.3),
+                FaultRule(kind="reorder", tag_prefix="pg:", prob=0.3)])
+            # tight ack timeout: each fault still costs a real
+            # stall-and-retry, but the handoff can beat a spec row
+            # that emits K+1 tokens per dispatch
+            src_w = MigrationWorker(
+                src_e,
+                FaultyTransport(LoopbackTransport(f"smsrc{i}", net),
+                                plan),
+                ack_timeout=0.05, retries=10)
+            sth = threading.Thread(target=src_w.serve_forever,
+                                   daemon=True)
+            sth.start()
+            req = src_e.submit(MIG_PROMPT, MIG_MAX_NEW, request_id=rid)
+            deadline = time.monotonic() + 30
+            while len(req.tokens) < 2 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            moved = src_w.migrate_out(rid, "smdst")
+            got = [int(t) for t in req.wait(120)]
+            src_w.stop()
+            sth.join(timeout=2)
+            assert got == ref
+            assert req.error is None and req.done.is_set()
+            if moved:
+                break
+        else:
+            pytest.fail("spec handoff never outran the decode in 4 "
+                        "attempts")
+        assert plan.events, "no fault fired — the plan never engaged"
+        assert src_w.stats["migrated_out"] == 1
+        assert dst_w.stats["migrated_in"] >= 1
+        deadline = time.monotonic() + 5.0
+        while (rid in dst_w.stager._staged
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert rid not in dst_w.stager._staged
+        assert dst_w.staged_bytes == 0
+        _mig_no_pool_leaks(src_e, dst_e)
+    finally:
+        dst_w.stop()
+        th.join(timeout=2)
+        src_e.close()
+        dst_e.close()
+
+
 def test_chaos_source_crash_mid_migration_promotes_or_survives(
         mig_pair):
     """crash_after on the source transport mid-protocol.  Wherever the

@@ -395,6 +395,13 @@ def test_serve_mode_pairing_rules(capsys):
                             "--prompt-lookup"]) == 1
     assert cli.main(base + ["--chain", "w@127.0.0.1:1",
                             "--prompt-lookup"]) == 1
+    # --no-spec-adaptive pins K_row in the mixed slot loop; outside
+    # serve --batch-slots + a proposer it would silently do nothing
+    assert cli.main(base + ["--no-spec-adaptive"]) == 1
+    assert cli.main(base + ["--batch-slots", "2",
+                            "--no-spec-adaptive"]) == 1
+    assert cli.main(["generate", "--model", "llama-test",
+                     "--prompt-ids", "1,2", "--no-spec-adaptive"]) == 1
     capsys.readouterr()
 
 

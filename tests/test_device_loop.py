@@ -302,6 +302,9 @@ def _run_ring(model, fused: bool, monkeypatch):
     return toks
 
 
+# tier-1 budget: dispatches-per-token + stream-block parity keep the
+# quick-lane fused-loop reps; the ring-mesh tail twin rides slow
+@pytest.mark.slow
 def test_ring_fused_tail_parity(params, monkeypatch):
     """The tail's fused forward+sample program must emit bit-identical
     tokens to the split forward-then-sample pair it replaces (same rng

@@ -97,6 +97,9 @@ def test_pipeline_matches_single_engine(model, num_stages):
     np.testing.assert_array_equal(got, want)
 
 
+# tier-1 budget: pipeline_eos_early_stop and the quick
+# pipeline_matches_single_engine params are the quick-lane reps
+@pytest.mark.slow
 def test_pipeline_interleaved_requests_match():
     """pool_size=2: two requests share the pipeline; results must equal the
     sequential single-engine output for each prompt."""

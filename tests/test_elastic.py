@@ -133,7 +133,9 @@ def test_live_migration_scale_down_park_and_rejoin():
         t.join(timeout=30)
 
 
-@pytest.mark.quick
+# tier-1 budget: heartbeat-reshard plus the test_migration live tests
+# are the quick-lane reps; the scale-up soak rides the slow lane
+@pytest.mark.slow
 def test_live_migration_scale_up():
     """Scale-up: a spare worker joins the chain via reshard."""
     want = reference_tokens(PROMPT, 10)

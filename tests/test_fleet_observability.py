@@ -232,6 +232,9 @@ def _idle_no_leaks(*engines):
         time.sleep(0.05)
 
 
+# tier-1 budget: the scraper/relabel/merge quick tests pin the fleet
+# plane; the two-tenant live-migration soak rides the slow lane
+@pytest.mark.slow
 def test_two_tenant_fleet_with_live_migration_end_to_end(params):
     """ISSUE-16 acceptance: see module docstring."""
     set_slo_ledger(SloLedger(ttft_slo_ms=0, tpot_slo_ms=0, target=0.99))

@@ -184,6 +184,62 @@ def test_bounded_load_weighs_prefill_backlog_decision_table():
 
 
 @pytest.mark.quick
+def test_bounded_load_weighs_spec_backlog_decision_table():
+    """ISSUE-19 satellite: the bounded-load walk folds a replica's
+    reported speculative backlog (``spec_backlog_tokens``, the active
+    rows' Σ (K_row+1)·decode_block per-iteration spend, scaled by
+    ``spec_token_weight``) into the same load it weighs prefill backlog
+    with — a replica mid-speculation sheds hashed traffic, weight=0
+    ignores it, uniform spec load causes no churn, and spec + prefill
+    backlogs ADD."""
+    toks = list(range(2, 34))
+
+    def scenario(weight, depths, specs, prefills=(0, 0, 0)):
+        reg = _registry()
+        router = PrefixAwareRouter(reg, min_prefix_tokens=64,
+                                   block_tokens=8, load_factor=1.0,
+                                   prefill_token_weight=256,
+                                   spec_token_weight=weight)
+        d0 = router.route(toks)
+        order = [d0.rid] + d0.candidates     # rendezvous order for toks
+        for rid, dep, sp, pf in zip(order, depths, specs, prefills):
+            reg.record_success(rid, {"queue_depth": dep,
+                                     "spec_backlog_tokens": sp,
+                                     "pending_prefill_tokens": pf})
+        return order, router.route(toks).rid, router
+
+    # nothing reported: rendezvous-first serves
+    order, got, _ = scenario(256, (0, 0, 0), (0, 0, 0))
+    assert got == order[0]
+
+    # deep spec backlog at zero depth sheds the pick: 4096/256 = 16
+    # request-equivalents > bound 1.0 * (1 + 16/3)
+    order, got, router = scenario(256, (0, 0, 0), (4096, 0, 0))
+    assert got == order[1]
+    assert router._load(order[0]) == 16.0
+
+    # the same backlog with weight=0 is invisible
+    order, got, _ = scenario(0, (0, 0, 0), (4096, 0, 0))
+    assert got == order[0]
+
+    # uniform spec backlog raises the mean with the load: no churn
+    order, got, _ = scenario(256, (0, 0, 0), (4096, 4096, 4096))
+    assert got == order[0]
+
+    # spec and prefill backlogs ADD: 512/256 + 1024/256 = 6 request-
+    # equivalents > bound 1.0 * (1 + 2); the walk moves on
+    order, got, router = scenario(256, (0, 0, 0), (512, 0, 0),
+                                  (1024, 0, 0))
+    assert got == order[1]
+    assert router._load(order[0]) == 6.0
+
+    # knob + per-replica gauge surface on /debugz
+    tab = router.routing_table()
+    assert tab["spec_token_weight"] == 256
+    assert tab["replicas"][order[0]]["spec_backlog_tokens"] == 512
+
+
+@pytest.mark.quick
 def test_prefix_tie_breaks_toward_the_lighter_replica():
     reg = _registry()
     router = PrefixAwareRouter(reg, min_prefix_tokens=8, block_tokens=8)
@@ -748,7 +804,9 @@ def _engine(params, **kw):
     return ContinuousBatchingEngine(CFG, params, **kw)
 
 
-@pytest.mark.quick
+# tier-1 budget: the routing decision tables + proxy tests keep the
+# quick-lane reps; the three-replica soak rides the slow lane
+@pytest.mark.slow
 def test_loopback_soak_three_replicas_cache_aware(params):
     """The -m quick representative of the gateway soak: three real
     replicas, grouped shared-prefix workload, every answer bit-identical

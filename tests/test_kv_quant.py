@@ -334,6 +334,7 @@ def test_batching_decodes_against_quantized_pages(int8_batching, oracle):
     assert mgr.snapshot()["page_dtype"] == "int8"
 
 
+@pytest.mark.slow
 def test_speculative_decodes_against_quantized_pages(params, oracle):
     """The speculative path inherits the quantized pool through the
     same make_kv_backend seam: a COLD greedy run never reads the pool

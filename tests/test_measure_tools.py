@@ -233,6 +233,59 @@ def test_micro_prepass_timeout_stops_and_commits_partial(tmp_path,
     assert len(committed) == 1
 
 
+@pytest.mark.quick
+def test_timed_out_leg_retries_once_at_reduced_budget(monkeypatch):
+    """A timed-out leg re-runs ONCE with halved new_tokens before its
+    failure is recorded; the retried result is stamped
+    ``retried_reduced`` so consumers can see the reduced shape."""
+    ms = _ms()
+    calls = []
+
+    def fake_spawn(leg, params, timeout, micro=False):
+        calls.append((leg, dict(params), timeout))
+        if len(calls) == 1:
+            return {"error": f"leg timed out after {timeout}s"}
+        return {"tok_s": 10.0}
+
+    monkeypatch.setattr(ms.bench, "_spawn_leg", fake_spawn)
+    result = ms.run_leg_with_retry("spec_mixed", dict(PARAMS), 2400)
+    assert len(calls) == 2
+    # the retry runs the SAME leg at the SAME time budget but half the
+    # measured work per round
+    assert calls[1][0] == "spec_mixed" and calls[1][2] == 2400
+    assert calls[1][1]["new_tokens"] == PARAMS["new_tokens"] // 2
+    assert result["retried_reduced"] is True and result["tok_s"] == 10.0
+    # the original params dict is not mutated by the reduced retry
+    assert PARAMS["new_tokens"] == 128
+
+
+def test_timed_out_retry_failure_records_error_no_third_attempt(
+        monkeypatch):
+    ms = _ms()
+    calls = []
+    monkeypatch.setattr(
+        ms.bench, "_spawn_leg",
+        lambda leg, params, timeout, micro=False: (
+            calls.append(leg) or {"error": f"leg timed out after {timeout}s"}))
+    result = ms.run_leg_with_retry("sweep", dict(PARAMS), 1200)
+    # exactly one retry — the reduced re-run must not recurse
+    assert calls == ["sweep", "sweep"]
+    assert "timed out" in result["error"]
+    assert result["retried_reduced"] is True
+
+
+def test_non_timeout_failure_does_not_retry(monkeypatch):
+    ms = _ms()
+    calls = []
+    monkeypatch.setattr(
+        ms.bench, "_spawn_leg",
+        lambda leg, params, timeout, micro=False: (
+            calls.append(leg) or {"error": "leg exited rc=1"}))
+    result = ms.run_leg_with_retry("sweep", dict(PARAMS), 1200)
+    assert calls == ["sweep"]
+    assert "retried_reduced" not in result
+
+
 def test_multichip_render_matches_driver_bytes():
     """The driver rewrites MULTICHIP artifacts from parsed JSON in its
     own format; tools/record_multichip.render_artifact must reproduce a

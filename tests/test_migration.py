@@ -362,6 +362,139 @@ def test_export_import_roundtrip_bit_identical(pair, reference):
 
 
 # ---------------------------------------------------------------------------
+# §22 mid-speculation migration: the verify-boundary freeze
+
+
+@pytest.fixture(scope="module")
+def draft_cfg_params(cfg_params):
+    """A cheap 2-layer draft of the same family (the speculative-engine
+    test idiom) for the draft-model proposer variants."""
+    import dataclasses
+    cfg, _ = cfg_params
+    dcfg = dataclasses.replace(cfg, num_layers=2)
+    return dcfg, init_full_params(jax.random.PRNGKey(1), dcfg)
+
+
+def _mk_spec_engine(cfg, params, proposer, draft, max_seq=160,
+                    kv_blocks=32):
+    kw = (dict(prompt_lookup=True, num_draft=3) if proposer == "pld"
+          else dict(draft_cfg=draft[0], draft_params=draft[1],
+                    num_draft=3))
+    return ContinuousBatchingEngine(
+        cfg, params, max_seq=max_seq, max_batch=2, sampling=GREEDY,
+        kv_cache_blocks=kv_blocks, kv_block_tokens=8, **kw)
+
+
+def _draft_pool_idle(*engines):
+    """§22 zero-leak extension: the draft scratch pool holds NO pages
+    while an engine is idle (scratch is per-active-row only; drafts
+    are never shipped, so an import must not strand importer-side
+    scratch either)."""
+    for e in engines:
+        if e._dmgr is not None:
+            assert e._dmgr.used_blocks == 0, (
+                f"draft scratch leak: {e._dmgr.used_blocks} pages")
+
+
+@pytest.mark.parametrize("proposer", [
+    "pld",
+    # tier-1 budget: the pld seam is the quick-lane rep; the draft
+    # variant (an extra pair of two-model engine builds) rides the
+    # slow lane with the live-migration test
+    pytest.param("draft", marks=pytest.mark.slow),
+])
+def test_mid_speculation_seam_bit_identical_zero_leak(
+        cfg_params, draft_cfg_params, proposer):
+    """§22 freeze rule: exports land between dispatches — a verify
+    boundary — so the checkpoint carries the adaptive controller's
+    scalars (``spec_k``/``spec_ewma``) and NO in-flight drafts; the
+    importer rebuilds proposer state (draft scratch prefill / lookup
+    history) from prompt + emitted tokens, the stitched greedy stream
+    is bit-identical to the unmigrated spec run, and both engines end
+    with zero leaks in the target pool AND the draft scratch pool."""
+    cfg, params = cfg_params
+    src = _mk_spec_engine(cfg, params, proposer, draft_cfg_params)
+    dst = _mk_spec_engine(cfg, params, proposer, draft_cfg_params)
+    try:
+        ref = [int(t) for t in src.submit(PROMPT, 40).wait(120)]
+        req = src.submit(PROMPT, 40, request_id="sp1")
+        _wait_tokens(req, 8)
+        ckpt = src.export_request("sp1", detach=True)
+        # §22 checkpoint schema additions ride the §18 schema
+        assert {"rid", "prompt", "tokens", "length", "last_tok", "k",
+                "v", "rng", "spec_k", "spec_ewma"} <= set(ckpt)
+        assert 1 <= ckpt["spec_k"] <= 3
+        assert 0.0 <= ckpt["spec_ewma"] <= 1.0
+        # drafts are dropped at the freeze, never serialized
+        assert "drafts" not in ckpt and "dk" not in ckpt
+        assert ckpt["tokens"] == ref[:len(ckpt["tokens"])]
+        assert src.get_request("sp1") is None
+        resumed = dst.import_request(ckpt)
+        assert [int(t) for t in resumed.wait(120)] == ref
+        _idle_no_leaks(src, dst)
+        _draft_pool_idle(src, dst)
+    finally:
+        src.close()
+        dst.close()
+
+
+@pytest.mark.slow
+def test_mid_speculation_live_migration_drains_staging(
+        cfg_params, draft_cfg_params):
+    """A speculating row handed off LIVE over the pg:/rs: wire (draft
+    proposer): the scratch drafts never ship, target staging drains to
+    zero bytes, the client stream stays bit-identical, and target pool
+    + draft scratch pool end clean on both replicas."""
+    cfg, params = cfg_params
+    net = LoopbackNetwork()
+    src = _mk_spec_engine(cfg, params, "draft", draft_cfg_params,
+                          max_seq=512, kv_blocks=80)
+    dst = _mk_spec_engine(cfg, params, "draft", draft_cfg_params,
+                          max_seq=512, kv_blocks=80)
+    src_w = MigrationWorker(src, LoopbackTransport("spsrc", net),
+                            ack_timeout=10.0)
+    dst_w = MigrationWorker(dst, LoopbackTransport("spdst", net),
+                            ack_timeout=10.0)
+    threads = [threading.Thread(target=w.serve_forever, daemon=True)
+               for w in (src_w, dst_w)]
+    for t in threads:
+        t.start()
+    try:
+        max_new = 480
+        ref = [int(t) for t in src.submit(PROMPT, max_new).wait(180)]
+        # a speculating row emits multiple tokens per round, so the
+        # handoff can lose the race to completion — legal locally;
+        # retry with a fresh rid (the chaos-test idiom)
+        for i in range(4):
+            rid = f"spl{i}"
+            req = src.submit(PROMPT, max_new, request_id=rid)
+            _wait_tokens(req, 2)
+            moved = src_w.migrate_out(rid, "spdst")
+            got = [int(t) for t in req.wait(180)]
+            assert got == ref
+            assert req.error is None and req.done.is_set()
+            if moved:
+                break
+        else:
+            pytest.fail("handoff never outran the spec decode in 4 "
+                        "attempts")
+        assert src_w.stats["migrated_out"] >= 1
+        assert dst_w.stats["migrated_in"] >= 1
+        # staging fully drained: zero held bytes, nothing parked
+        assert dst_w.stager._staged == {}
+        assert dst_w.staged_bytes == 0
+        _idle_no_leaks(src, dst)
+        _draft_pool_idle(src, dst)
+    finally:
+        src_w.stop()
+        dst_w.stop()
+        for t in threads:
+            t.join(timeout=2)
+        src.close()
+        dst.close()
+
+
+# ---------------------------------------------------------------------------
 # the loopback e2e (the -m quick live-migration rep)
 
 

@@ -23,6 +23,7 @@ Runs on CPU through the XLA-gather fallback — the same control flow
 the TPU prefill kernel's auto-dispatch falls back to.
 """
 
+import dataclasses
 import sys
 import time
 from pathlib import Path
@@ -42,12 +43,19 @@ from distributed_inference_demo_tpu.runtime.batching import (
     ContinuousBatchingEngine)
 
 CFG = get_model_config("llama-test")
+DRAFT_CFG = dataclasses.replace(CFG, num_layers=2)
 GREEDY = SamplingParams(greedy=True)
 
 
 @pytest.fixture(scope="module")
 def params():
     return init_full_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    # different seed AND depth: a genuinely different (bad) proposer
+    return init_full_params(jax.random.PRNGKey(1), DRAFT_CFG)
 
 
 @pytest.fixture(scope="module")
@@ -214,5 +222,203 @@ def test_mixed_matches_serialized_property_sweep(params, kv_dtype,
     assert run(None, mixed=True) == base
     # an eos taken from a real stream ends one request mid-decode while
     # the others still admit/decode — truncation points must coincide
+    eos = int(base[0][4])
+    assert run(eos, mixed=True) == run(eos, mixed=False)
+
+
+# ---------------------------------------------------------------------------
+# §22: speculation inside the mixed dispatch (docs/DESIGN.md §22)
+# ---------------------------------------------------------------------------
+
+
+def spec_kw(proposer, draft_params=None, num_draft=3, **extra):
+    if proposer == "pld":
+        kw = dict(prompt_lookup=True, num_draft=num_draft)
+    else:
+        kw = dict(draft_cfg=DRAFT_CFG, draft_params=draft_params,
+                  num_draft=num_draft)
+    kw.update(extra)
+    return kw
+
+
+def assert_spec_idle(eng):
+    """§22 zero-leak extension: the draft scratch pool holds no pages
+    when no request is in flight."""
+    if eng._dmgr is not None:
+        assert eng._dmgr.used_blocks == 0, eng._dmgr.used_blocks
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("proposer", [
+    "pld",
+    # tier-1 budget: the draft proposer keeps quick-lane coverage via
+    # the sampled and adaptive-shrink tests; this greedy twin rides
+    # the slow lane with the property sweep
+    pytest.param("draft", marks=pytest.mark.slow),
+])
+def test_spec_mixed_greedy_parity_and_zero_leak(params, draft_params,
+                                                oracle, proposer):
+    """§22 headline at greedy: speculative rows packed into the SAME
+    mixed dispatch as prefill chunks and plain decode, adaptive K live,
+    concurrent submissions — and the streams are still bit-identical to
+    the one-shot oracle.  Both proposers; draft scratch pool returns to
+    zero pages at idle."""
+    prompts = [[3, 14, 15], list(range(2, 24)), [9, 2, 6, 5, 3, 5]]
+    ns = [10, 12, 8]
+    with mixed_engine(params, **spec_kw(proposer, draft_params)) as eng:
+        reqs = [eng.submit(p, n) for p, n in zip(prompts, ns)]
+        for p, n, r in zip(prompts, ns, reqs):
+            np.testing.assert_array_equal(r.wait(timeout=300),
+                                          expected(oracle, p, n))
+        sp = eng.stats()["speculative"]
+        assert sp["drafted"] > 0
+        assert sp["adaptive"] is True
+        assert eng.stats()["mixed"]["dispatches"] > 0
+        assert_no_leak(eng)
+        assert_spec_idle(eng)
+
+
+@pytest.mark.quick
+def test_spec_mixed_sampled_bit_identical_to_serialized(params,
+                                                        draft_params):
+    """§22 rng contract: the fused draft/verify dispatch spends rng
+    exactly like the serialized spec schedule, so SAMPLED streams
+    (tokens and logprobs) match bit-for-bit.  K_row is pinned — the
+    adaptive controller feeds back measured wall-clock acceptance, which
+    is not part of the schedule being compared."""
+    samp = SamplingParams(greedy=False, temperature=0.9, top_k=40)
+
+    def run(**kw):
+        with ContinuousBatchingEngine(
+                CFG, params, max_seq=96, max_batch=4, sampling=samp,
+                seed=7, prompt_buckets=(16, 48), kv_block_tokens=8,
+                prefill_chunk=8, decode_block=4, draft_cfg=DRAFT_CFG,
+                draft_params=draft_params, num_draft=3,
+                spec_adaptive=False, **kw) as eng:
+            outs = []
+            for p, n in ((list(range(3, 30)), 8), ([9, 8, 7, 6], 6)):
+                r = eng.submit(p, n)
+                outs.append((list(r.wait(timeout=300)), list(r.lps)))
+            return outs
+
+    assert run() == run(mixed_token_budget=24)
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("kv_dtype", [
+    "int8",
+    # tier-1 budget: int8 is the quick-lane quantized rep; int4 rides
+    # the slow lane here and in the property sweep
+    pytest.param("int4", marks=pytest.mark.slow),
+])
+def test_spec_mixed_quantized_greedy_matches_serialized(params, kv_dtype):
+    """Quick quantized rep (the full cross product runs in the slow
+    sweep): greedy spec x mixed over int8/int4 pages matches the
+    serialized spec schedule on the SAME page dtype — verify reads and
+    draft proposals see identically-quantized history in both modes."""
+
+    def run(mixed):
+        kw = {"mixed_token_budget": 24} if mixed else {}
+        with ContinuousBatchingEngine(
+                CFG, params, max_seq=96, max_batch=4, sampling=GREEDY,
+                prompt_buckets=(16, 48), kv_block_tokens=8,
+                prefill_chunk=8, decode_block=4, kv_dtype=kv_dtype,
+                prompt_lookup=True, num_draft=3, **kw) as eng:
+            reqs = [eng.submit(p, n)
+                    for p, n in ((list(range(3, 24)), 8), ([9, 8, 7], 6))]
+            outs = [list(r.wait(timeout=300)) for r in reqs]
+            assert_no_leak(eng)
+            return outs
+
+    assert run(mixed=True) == run(mixed=False)
+
+
+@pytest.mark.quick
+def test_spec_dispatch_ratio_survives_admission(params, oracle):
+    """§22 acceptance: dispatches/step stays ≈ 1/K with speculation
+    armed WHILE a chunked prompt admits — the spec row keeps its fused
+    cadence inside the packed program instead of being suppressed."""
+    K = 4
+    with mixed_engine(params, max_batch=2, prompt_lookup=True,
+                      num_draft=3, mixed_token_budget=40) as eng:
+        a = eng.submit([5, 4, 3, 2], 36)
+        deadline = time.monotonic() + 60
+        while len(a.tokens) < 2:
+            assert time.monotonic() < deadline, "row A never started"
+            time.sleep(0.002)
+        b = eng.submit(list(range(1, 36)), 8)
+        np.testing.assert_array_equal(a.wait(timeout=300),
+                                      expected(oracle, [5, 4, 3, 2], 36))
+        np.testing.assert_array_equal(
+            b.wait(timeout=300), expected(oracle, list(range(1, 36)), 8))
+        assert eng.chunk_stats["interleaved_steps"] >= 1
+        sp = eng.stats()["speculative"]
+        assert sp["drafted"] > 0
+        ls = eng.loop_stats
+        assert ls["device_loop_steps"] > 0
+        ratio = ls["host_dispatches"] / ls["device_loop_steps"]
+        # accepted drafts only push the ratio further BELOW the plain
+        # fused bound; the suppressed path would measure ≈ 1.0
+        assert ratio <= 1 / K + 0.12, ls
+        assert_no_leak(eng)
+
+
+@pytest.mark.quick
+def test_spec_adaptive_k_shrinks_on_low_acceptance(params, draft_params,
+                                                   oracle):
+    """Adaptive K_row feedback: a draft model that disagrees with the
+    target drives EWMA acceptance down, the controller walks the row to
+    the smallest bucket (observable in k_row_buckets while the row is
+    live), and the stream still equals plain greedy decode exactly —
+    collapse degrades speculation, never correctness."""
+    prompt, n = [7, 3, 11], 60
+    with mixed_engine(params, max_batch=2,
+                      **spec_kw("draft", draft_params)) as eng:
+        r = eng.submit(prompt, n)
+        saw_small = False
+        deadline = time.monotonic() + 120
+        while not r.done.is_set() and time.monotonic() < deadline:
+            sp = eng.stats().get("speculative") or {}
+            if (sp.get("k_row_buckets") or {}).get("1", 0) >= 1:
+                saw_small = True
+                break
+            time.sleep(0.003)
+        np.testing.assert_array_equal(r.wait(timeout=300),
+                                      expected(oracle, prompt, n))
+        sp = eng.stats()["speculative"]
+        assert saw_small, sp
+        assert sp["acceptance_rate"] is None or sp["acceptance_rate"] < 0.5
+        assert_no_leak(eng)
+        assert_spec_idle(eng)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", [None, "int8", "int4"])
+@pytest.mark.parametrize("proposer", ["pld", "draft"])
+def test_spec_mixed_matches_serialized_property_sweep(params, draft_params,
+                                                      kv_dtype, proposer):
+    """§22 property sweep (proposer x page dtype x eos-mid-decode):
+    concurrent greedy spec streams out of the mixed loop are
+    bit-identical to the serialized spec schedule, and every run ends
+    with both pools leak-free."""
+    prompts = [(list(range(3, 30)), 10), ([9, 8, 7, 6], 8),
+               (list(range(50, 85)), 6)]
+
+    def run(eos_id, mixed):
+        kw = {"mixed_token_budget": 24} if mixed else {}
+        kw.update(spec_kw(proposer, draft_params))
+        with ContinuousBatchingEngine(
+                CFG, params, max_seq=96, max_batch=4, sampling=GREEDY,
+                seed=3, prompt_buckets=(16, 48), kv_block_tokens=8,
+                prefill_chunk=8, decode_block=4, eos_id=eos_id,
+                kv_dtype=kv_dtype, **kw) as eng:
+            reqs = [eng.submit(p, n) for p, n in prompts]
+            outs = [list(r.wait(timeout=300)) for r in reqs]
+            assert_no_leak(eng)
+            assert_spec_idle(eng)
+            return outs
+
+    base = run(None, mixed=False)
+    assert run(None, mixed=True) == base
     eos = int(base[0][4])
     assert run(eos, mixed=True) == run(eos, mixed=False)

@@ -70,7 +70,7 @@ def assert_no_leak(eng):
     assert mgr.debug_state()["leased_nodes"] == 0
 
 
-@pytest.mark.quick
+@pytest.mark.slow
 def test_cold_parity_concurrent_requests(params, oracle):
     prompts = [[3, 14, 15], [9, 2, 6, 5, 3, 5], [1], [7, 7, 7, 7]]
     ns = [10, 14, 8, 12]
@@ -158,7 +158,7 @@ def test_submit_rejects_request_larger_than_pool(params):
             eng.submit(list(range(1, 30)), 30)
 
 
-@pytest.mark.quick
+@pytest.mark.slow
 def test_paged_speculative_slot_modes_and_leak(params, oracle):
     """The §11 rejection matrix is DISSOLVED (docs/DESIGN.md §14): the
     speculative slot proposers run on the page pool — prompt-lookup
@@ -217,6 +217,7 @@ def test_decode_block_fused_parity(params, oracle):
         assert_no_leak(eng)
 
 
+@pytest.mark.slow
 def test_chunked_admission_parity(params, oracle):
     """prefill_chunk composes with paged: chunks stream into the dense
     temp row, the finished row scatters into this request's own pages."""

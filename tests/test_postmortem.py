@@ -250,6 +250,9 @@ def test_analyzer_compute_stall_diagnosis(tmp_path):
 # the acceptance scenario: killed worker mid-ring -> bundle -> correct hop
 
 
+# tier-1 budget: the golden-bundle analyzer + crash-handler tests are
+# the quick-lane reps; the real killed-worker run rides the slow lane
+@pytest.mark.slow
 def test_killed_worker_produces_bundle_with_correct_hop(tmp_path):
     """ISSUE 2 acceptance: a 3-stage loopback ring loses its tail
     mid-run; the header's step timeout captures a postmortem bundle and

@@ -181,6 +181,10 @@ def test_tp_mesh_parity(params, oracle):
     np.testing.assert_array_equal(want, got.tokens)
 
 
+# tier-1 budget: quantized-weights x engine keeps quick reps in
+# test_parallel (pipeline_quantized_params) and the checkpoint
+# int8 roundtrips; this pld twin rides the slow lane
+@pytest.mark.slow
 def test_int8_weights(params):
     """Quantized target params work through the lookup engine (greedy
     parity vs the int8 plain engine)."""

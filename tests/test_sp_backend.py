@@ -284,6 +284,8 @@ def test_sp_stream_fp8_cache_matches_fp8_engine():
     np.testing.assert_array_equal(got, want)
 
 
+# tier-1 budget: stream_fns greedy parity [ring] keeps the quick rep
+@pytest.mark.slow
 def test_sp_stream_is_incremental():
     """One compiled pair serves every max_new_tokens, and the first
     token arrives after ONE prefill dispatch (the generator yields

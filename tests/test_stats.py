@@ -64,6 +64,9 @@ def test_percentile_helper():
     assert _percentile([7.0], 99) == 7.0
 
 
+# tier-1 budget: http_stats_endpoint + stats_reset are the quick-lane
+# reps for the recording plumbing; the full pipeline run rides slow
+@pytest.mark.slow
 def test_pipeline_records_stats():
     header, workers, threads = _build(num_stages=3)
     new = 6

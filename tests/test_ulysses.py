@@ -18,7 +18,12 @@ from distributed_inference_demo_tpu.runtime import InferenceEngine
 GREEDY = SamplingParams(greedy=True)
 
 
-@pytest.mark.parametrize("model", ["llama-test", "bloom-test"])
+@pytest.mark.parametrize("model", [
+    "llama-test",
+    # tier-1 budget: llama-test is the quick-lane rep; the bloom
+    # (alibi) twin rides the slow lane
+    pytest.param("bloom-test", marks=pytest.mark.slow),
+])
 def test_ulysses_matches_engine(model, devices):
     cfg = get_model_config(model)
     params = init_full_params(jax.random.PRNGKey(0), cfg)
@@ -69,6 +74,9 @@ def test_ulysses_rejects_bad_configs(devices):
         gen(params, np.zeros((1, 14), np.int32), jax.random.PRNGKey(0))
 
 
+# tier-1 budget: the ring fp8 twin (tests/test_sp_backend.py) is the
+# quick-lane rep for fp8-cache x sequence-parallel
+@pytest.mark.slow
 def test_ulysses_fp8_cache_matches_fp8_engine(devices):
     """Reduced-precision head-sharded cache: greedy parity vs the fp8
     single-device engine (Ulysses attention already reads from the cache,

@@ -53,7 +53,7 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
 # than a crash.  Extend deliberately, with the catalog.
 KNOWN_LABELS = {"role", "device", "route", "code", "kind", "engine",
                 "peer", "replica", "dtype", "tenant", "window",
-                "signature", "program", "owner", "tier"}
+                "signature", "program", "owner", "tier", "bucket"}
 
 # series whose label SET is pinned exactly — the fleet-plane families
 # whose labels dashboards and the federation relabeler join on.  A
@@ -130,7 +130,12 @@ UNIT_SUFFIX_EXEMPT = {"dwt_kvcache_blocks_in_use",
                       # packed/budgeted fraction (a _ratio in spirit;
                       # "utilization" is the roofline-adjacent term the
                       # §19 runbook and bench leg both use)
-                      "dwt_batching_token_budget_utilization"}
+                      "dwt_batching_token_budget_utilization",
+                      # ISSUE-19 pins this exact name: the per-bucket
+                      # adaptive-K occupancy gauge — "len" is the
+                      # quantity itself (a draft LENGTH bucket), the
+                      # value's unit is rows via the bucket label
+                      "dwt_batching_draft_len"}
 
 # series the catalog must always register (regressions here would blind
 # the flight-recorder/anomaly layer silently — a scrape with the series
@@ -181,6 +186,14 @@ REQUIRED_SERIES = {
     "dwt_batching_mixed_dispatches_total",
     "dwt_batching_mixed_prefill_tokens_total",
     "dwt_batching_token_budget_utilization",
+    # the spec-in-the-batch quartet (docs/DESIGN.md §22): drafted /
+    # accepted absent would make the acceptance collapse the adaptive-K
+    # loop reacts to unobservable, and the draft_len bucket gauge
+    # registered-and-zero is how a scrape PROVES no row is speculating
+    "dwt_batching_draft_tokens_total",
+    "dwt_batching_accepted_tokens_total",
+    "dwt_batching_draft_len",
+    "dwt_batching_spec_acceptance_ratio",
     # the device-loop pair (docs/DESIGN.md §13): dispatches/token ≈ 1/K
     # is the dispatch-floor claim — with either series absent, a fused
     # loop that silently fell back to per-token dispatch would scrape
