@@ -3126,6 +3126,336 @@ def _leg_gateway_routing(model: str, n_replicas: int = 3, groups: int = 6,
     }
 
 
+def _leg_stream_failover(model: str, n_req: int = 8, prompt_len: int = 96,
+                         new_tokens: int = 24, slots: int = 4,
+                         max_seq: int = 512, block_tokens: int = 8,
+                         crash_after: int = 6,
+                         seed_victim: int = 3) -> dict:
+    """Zero-loss streams (docs/DESIGN.md §23): kill a replica mid-soak
+    and measure the resume path end to end — real HTTP client →
+    gateway → replica, greedy so bit-identity is checkable.
+
+    Three phases over the SAME two-replica fleet:
+
+    - *reference*: the unfailed run.  Every prompt streams to
+      completion with both replicas healthy; the recorded streams are
+      the bit-identity oracle for everything after.
+    - *failover*: the victim replica is armed to die ``crash_after``
+      tokens into every stream it serves (the §23 mid-stream error
+      seam), ``seed_victim`` prompts are pinned to it via the routing
+      index, and the soak re-runs with ``resume_limit=1``.  Gates:
+      100% completion, zero error lines, every stream bit-identical to
+      the reference with contiguous steps, resume attempts == resume
+      successes, the SLO ledger books each replay as a resume pause
+      with the timeline decomposition still summing exactly, and the
+      registry strikes the victim out.  Reported: TTF-resumed-token
+      p95 (detect → route → re-POST → replay) interpolated from the
+      gateway's own ``dwt_gateway_resume_ttf_seconds`` histogram.
+    - *documented_loss*: one pinned prompt through a fresh gateway
+      with ``resume_limit=0``: the pre-§23 contract — delivered
+      prefix + error line, never a hang — stays reachable and
+      documented.
+
+    Zero-leak gates close the leg on BOTH paths: the survivor (served
+    every resume) and the victim (its crashed streams must return
+    their pages, as a restarted process would want them)."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+    from http.client import HTTPConnection
+
+    import jax
+    import numpy as np
+    from distributed_inference_demo_tpu.models import get_model_config
+    from distributed_inference_demo_tpu.models.decoder import init_full_params
+    from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+    from distributed_inference_demo_tpu.runtime.batching import (
+        ContinuousBatchingEngine)
+    from distributed_inference_demo_tpu.runtime.gateway import (
+        GatewayHTTPServer, PrefixAwareRouter, ReplicaRegistry)
+    from distributed_inference_demo_tpu.runtime.http_server import (
+        InferenceHTTPServer)
+    from distributed_inference_demo_tpu.runtime.stats import _percentile
+    from distributed_inference_demo_tpu.telemetry.slo import (
+        SloLedger, set_slo_ledger)
+
+    cfg = get_model_config(model)
+    params = init_full_params(jax.random.PRNGKey(0), cfg)
+    greedy = SamplingParams(greedy=True)
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(2, cfg.vocab_size - 1, prompt_len)
+               .astype(np.int32) for _ in range(n_req)]
+
+    class _DyingBackend:
+        """The victim: while armed, every stream dies ``crash_after``
+        tokens in — the engine generator is closed eagerly so the dead
+        path's pages come back the way a crashed process's restart
+        would reclaim them."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.armed = False
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def generate_stream(self, *a, **kw):
+            gen = self._inner.generate_stream(*a, **kw)
+            try:
+                for i, item in enumerate(gen):
+                    if self.armed and i >= crash_after:
+                        raise RuntimeError(
+                            f"injected replica death after {i} tokens")
+                    yield item
+            finally:
+                gen.close()
+
+    def send(host, port, prompt):
+        """One streaming /generate; returns (status, token list or
+        None if an error line arrived, delivered-before-error count,
+        step list)."""
+        conn = HTTPConnection(host, port, timeout=600)
+        try:
+            conn.request("POST", "/generate", body=json.dumps(
+                {"prompt_ids": [prompt.tolist()],
+                 "max_new_tokens": new_tokens, "stream": True}),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                resp.read()
+                return resp.status, None, 0, []
+            toks, steps, errored = [], [], False
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                if "error" in d:
+                    errored = True
+                    break
+                tl = d.get("tokens")
+                if tl:
+                    toks.append(tl[0])
+                    steps.append(d.get("step"))
+            return (resp.status, None if errored else toks, len(toks),
+                    steps)
+        except Exception:
+            return -1, None, 0, []
+        finally:
+            conn.close()
+
+    def scrape(gw):
+        conn = HTTPConnection(gw.host, gw.port, timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            return conn.getresponse().read().decode()
+        finally:
+            conn.close()
+
+    def counter_val(text, name):
+        for ln in text.splitlines():
+            if ln.startswith(name + " ") or ln.startswith(name + "{"):
+                return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+
+    def hist_p95(text, name):
+        """PromQL-style histogram_quantile over the text exposition:
+        cumulative le buckets, linear interpolation inside the bucket
+        the 95th observation lands in."""
+        pts = []
+        for ln in text.splitlines():
+            if ln.startswith(name + "_bucket{"):
+                le = ln.split('le="', 1)[1].split('"', 1)[0]
+                pts.append((float("inf") if le == "+Inf" else float(le),
+                            float(ln.rsplit(" ", 1)[1])))
+        pts.sort()
+        total = pts[-1][1] if pts else 0.0
+        if total <= 0:
+            return None
+        rank = 0.95 * total
+        lo_b, lo_c = 0.0, 0.0
+        for b, c in pts:
+            if c >= rank:
+                if b == float("inf"):
+                    return round(lo_b * 1e3, 2)
+                frac = (rank - lo_c) / max(c - lo_c, 1e-12)
+                return round((lo_b + (b - lo_b) * frac) * 1e3, 2)
+            lo_b, lo_c = b, c
+        return None
+
+    def settle_idle(timeout=30.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            if not any(e.active_requests() for e in engines):
+                return
+            time.sleep(0.02)
+
+    def no_leak(eng):
+        mgr = eng.kv_cache
+        return (mgr.used_blocks == mgr.tree.block_count
+                and mgr.debug_state()["leased_nodes"] == 0)
+
+    engines = [ContinuousBatchingEngine(
+        cfg, params, max_seq=max_seq, max_batch=slots, sampling=greedy,
+        kv_cache_blocks=0, kv_block_tokens=block_tokens)
+        for _ in range(2)]
+    victim_backend = _DyingBackend(engines[0])
+    servers = []
+    for backend in (victim_backend, engines[1]):
+        srv = InferenceHTTPServer(backend, port=0, model_name=model)
+        srv.start()
+        servers.append(srv)
+    victim_rid = f"{servers[0].host}:{servers[0].port}"
+
+    # warm both replicas' compile caches off-workload, including the
+    # resume admission shape (prompt + delivered prefix re-prefill)
+    warm = rng.integers(2, cfg.vocab_size - 1, prompt_len) \
+        .astype(np.int32)
+    for srv in servers:
+        st, _, _, _ = send(srv.host, srv.port, warm)
+        if st != 200:
+            raise RuntimeError(f"warmup failed on {srv.host}:{srv.port} "
+                               f"(status {st})")
+
+    def fresh_gateway(resume_limit):
+        registry = ReplicaRegistry(
+            [(s.host, s.port) for s in servers], sustain=2,
+            readmit_cooldown_s=60.0, probe_interval_s=0.3)
+        router = PrefixAwareRouter(registry,
+                                   min_prefix_tokens=block_tokens,
+                                   block_tokens=block_tokens)
+        gw = GatewayHTTPServer(registry, router, port=0,
+                               resume_limit=resume_limit)
+        gw.start()
+        return gw, registry, router
+
+    results = {}
+
+    # -- phase 1: reference (unfailed) --------------------------------------
+    gw, registry, router = fresh_gateway(resume_limit=1)
+    ref = [send(gw.host, gw.port, p) for p in prompts]
+    gw.shutdown()
+    settle_idle()
+    if any(st != 200 or toks is None or len(toks) != new_tokens
+           for st, toks, _, _ in ref):
+        raise RuntimeError("reference phase did not complete cleanly")
+    ref_streams = [toks for _, toks, _, _ in ref]
+    results["reference"] = {"requests": n_req, "completed": n_req}
+
+    # -- phase 2: failover soak (resume_limit=1, victim dies) ---------------
+    led = SloLedger(ttft_slo_ms=60_000, tpot_slo_ms=60_000)
+    set_slo_ledger(led)
+    try:
+        gw, registry, router = fresh_gateway(resume_limit=1)
+        # pin a slice of the soak to the victim so streams are
+        # guaranteed to be mid-flight on it when it starts dying
+        for p in prompts[:seed_victim]:
+            router.record(victim_rid, p.tolist())
+        before = scrape(gw)
+        victim_backend.armed = True
+        out = [None] * n_req
+
+        def one(i):
+            out[i] = send(gw.host, gw.port, prompts[i])
+
+        with ThreadPoolExecutor(max_workers=3) as ex:
+            list(ex.map(one, range(n_req)))
+        after = scrape(gw)
+        settle_idle()
+        victim_backend.armed = False
+
+        completed = sum(1 for st, toks, _, _ in out
+                        if st == 200 and toks is not None)
+        identical = all(
+            st == 200 and toks == ref_streams[i]
+            for i, (st, toks, _, _) in enumerate(out))
+        steps_contiguous = all(
+            steps == list(range(len(toks or [])))
+            for _, toks, _, steps in out)
+        d = {name: counter_val(after, name) - counter_val(before, name)
+             for name in ("dwt_gateway_resume_attempts_total",
+                          "dwt_gateway_resume_succeeded_total",
+                          "dwt_gateway_resume_exhausted_requests_total")}
+        resumed_recs = [r for r in led.recent(4 * n_req)
+                        if r.get("resumed")]
+        decomposed = all(
+            abs(r["ttft_s"] + r["per_token_s"] * (r["tokens"] - 1)
+                + r["migration_pause_s"] + r["resume_pause_s"]
+                - r["e2e_s"]) <= 1e-6 * max(r["e2e_s"], 1.0)
+            for r in resumed_recs)
+        results["failover"] = {
+            "requests": n_req,
+            "completed": completed,
+            "bit_identical": bool(identical),
+            "steps_contiguous": bool(steps_contiguous),
+            "resume_attempts": int(d["dwt_gateway_resume_attempts_total"]),
+            "resume_succeeded": int(
+                d["dwt_gateway_resume_succeeded_total"]),
+            "resume_exhausted": int(
+                d["dwt_gateway_resume_exhausted_requests_total"]),
+            "resume_ttf_p95_ms": hist_p95(
+                after, "dwt_gateway_resume_ttf_seconds"),
+            "slo_resumed_requests": len(resumed_recs),
+            "slo_resume_pause_p95_ms": round(_percentile(
+                sorted(r["resume_pause_s"] for r in resumed_recs), 95)
+                * 1e3, 2) if resumed_recs else None,
+            "slo_decomposition_exact": bool(decomposed),
+            "victim_struck": not registry.is_up(victim_rid),
+        }
+        gw.shutdown()
+    finally:
+        set_slo_ledger(None)
+        victim_backend.armed = False
+
+    # -- phase 3: documented loss at resume_limit=0 -------------------------
+    gw, registry, router = fresh_gateway(resume_limit=0)
+    router.record(victim_rid, prompts[0].tolist())
+    victim_backend.armed = True
+    st, toks, delivered, _ = send(gw.host, gw.port, prompts[0])
+    victim_backend.armed = False
+    gw.shutdown()
+    settle_idle()
+    results["documented_loss"] = {
+        "status": st,
+        "error_line": toks is None,
+        "delivered_before_error": delivered,
+    }
+
+    for srv in servers:
+        srv.shutdown()
+    leak_free = {"survivor": no_leak(engines[1]),
+                 "victim": no_leak(engines[0])}
+    for eng in engines:
+        eng.close()
+
+    fo, dl = results["failover"], results["documented_loss"]
+    return {
+        "model": model, "requests": n_req, "prompt_len": prompt_len,
+        "new_tokens": new_tokens, "crash_after": crash_after,
+        **results,
+        # the §23 acceptance gates
+        "failover_completed_100pct": fo["completed"] == n_req,
+        "failover_bit_identical": (fo["bit_identical"]
+                                   and fo["steps_contiguous"]),
+        "resume_all_succeeded": (fo["resume_attempts"] >= 1
+                                 and fo["resume_succeeded"]
+                                 == fo["resume_attempts"]
+                                 and fo["resume_exhausted"] == 0),
+        "slo_books_resume": (fo["slo_resumed_requests"]
+                             == fo["resume_succeeded"]
+                             and fo["slo_decomposition_exact"]),
+        "loss_documented_at_limit_0": (dl["status"] == 200
+                                       and dl["error_line"]
+                                       and 1 <= dl[
+                                           "delivered_before_error"]
+                                       < new_tokens),
+        "zero_leak_survivor": leak_free["survivor"],
+        "zero_leak_victim": leak_free["victim"],
+    }
+
+
 # ---------------------------------------------------------------------------
 
 def micro_shape(p: dict) -> dict:
@@ -3280,6 +3610,16 @@ def run_leg(name: str, p: dict, micro: bool = False) -> dict:
                                         max_seq=512, block_tokens=16,
                                         kill_requests=4) if micro
                    else _leg_gateway_routing(model))
+        elif name == "stream_failover":
+            # the micro shape keeps the §23 gates structural on CPU:
+            # two replicas, a 4-stream soak with 2 streams pinned to
+            # the dying victim, death 2 tokens in — enough to cover
+            # detect → re-route → replay → bit-identical suffix
+            out = (_leg_stream_failover(model, n_req=4, prompt_len=32,
+                                        new_tokens=8, slots=2,
+                                        max_seq=256, block_tokens=8,
+                                        crash_after=2, seed_victim=2)
+                   if micro else _leg_stream_failover(model))
         elif name == "planner_pipeline":
             out = _leg_planner_pipeline(model, batch, prompt_len,
                                         min(new_tokens, 8))
@@ -3543,6 +3883,7 @@ def main() -> None:
             "headline_int8", "decode_fused", "speculative",
             "prompt_lookup", "planner_pipeline", "long_context",
             "long_context_sp", "disagg", "gateway_routing",
+            "stream_failover",
             "flagship_int8", "batching", "mixed_batching",
             "spec_mixed", "prefix_reuse", "tiered_prefix", "paged_decode",
             "serving_relative", "sweep", "flagship_bf16", "pipeline",
@@ -3559,7 +3900,8 @@ def main() -> None:
                                     "prefix_reuse", "tiered_prefix",
                                     "paged_decode",
                                     "serving_relative", "disagg",
-                                    "gateway_routing"]),
+                                    "gateway_routing",
+                                    "stream_failover"]),
             ("BENCH_SKIP_LONGCTX", ["long_context", "long_context_sp"]),
             ("BENCH_SKIP_PREFILL", ["prefill_long"]),
             ("BENCH_SKIP_MOE_MM", ["moe", "multimodal"]),
@@ -3625,11 +3967,14 @@ def main() -> None:
     # and runs two routed rounds each — budget it like prefix_reuse
     # spec_mixed builds THREE engines (spec-only, mixed-only, fused)
     # over the same arrival stream — budget it like batching
+    # stream_failover runs two replica engines through three routed
+    # phases (reference soak, failover soak, documented loss) — budget
+    # it like gateway_routing
     leg_timeouts = {"batching": 1500, "mixed_batching": 1500,
                     "spec_mixed": 1500,
                     "prefix_reuse": 1200, "tiered_prefix": 1200,
                     "paged_decode": 1500, "serving_relative": 1500,
-                    "gateway_routing": 1500}
+                    "gateway_routing": 1500, "stream_failover": 1500}
     runlog.event("bench_start", params=params, legs=legs)
     results = {}
     for leg in legs:

@@ -651,6 +651,7 @@ def cmd_gateway(args) -> int:
     server = GatewayHTTPServer(
         registry, router, host=args.http_host, port=args.http_port,
         retry_limit=args.retry_limit,
+        resume_limit=args.resume_limit,
         proxy_timeout_s=args.proxy_timeout or None)
     print(f"GATEWAY_READY http://{server.host}:{server.port} "
           f"replicas={','.join(registry.replica_ids())}", flush=True)
@@ -1490,6 +1491,11 @@ def main(argv=None) -> int:
     gw.add_argument("--retry-limit", type=int, default=1,
                     help="alternate replicas tried when the routed one "
                          "dies before first token")
+    gw.add_argument("--resume-limit", type=int, default=1,
+                    help="mid-stream failover attempts: a replica dying "
+                         "AFTER first token is resumed bit-identically "
+                         "on a survivor this many times before the "
+                         "error-line fallback (0 = disable)")
     gw.add_argument("--proxy-timeout", type=float, default=0.0,
                     help="per-socket replica timeout in seconds "
                          "(0 = none)")

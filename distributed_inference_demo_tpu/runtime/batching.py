@@ -155,6 +155,12 @@ class Request:
     # user-visible one — the adopting engine closing too would double-
     # count the tenant's tokens across the fleet
     adopted: bool = False
+    # gateway-failover resume (docs/DESIGN.md §23): admitted via
+    # submit_resumed on a survivor replica; resume_pause accumulates the
+    # replay window (first recorded token to first VISIBLE token) so the
+    # SLO timeline decomposes like a migration pause
+    resumed: bool = False
+    resume_pause: float = 0.0      # seconds spent re-deriving delivered
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self.done.wait(timeout):
@@ -1164,10 +1170,18 @@ class ContinuousBatchingEngine:
         # admitted with premigrated KV + pages adopted on their behalf
         self.disagg_stats = {"premigrated_requests": 0,
                              "adopted_pages": 0}
+        # gateway-failover resume counters (docs/DESIGN.md §23):
+        # surfaced under stats()["resumed"], bridged onto
+        # dwt_batching_resumed_requests_total by the catalog
+        self.resume_stats = {"requests": 0, "replayed_tokens": 0,
+                             "diverged": 0}
 
         self._lengths = jnp.zeros((B,), jnp.int32)
         self._last_tok = jnp.zeros((B,), jnp.int32)
         self._rng = jax.random.PRNGKey(seed)
+        # the resume replay (§23) rewinds the engine stream to this key
+        # so a survivor re-derives a sampled prefix bit-exactly
+        self._seed = int(seed)
         self._step_count = 0
         # device-loop dispatch accounting (docs/DESIGN.md §13): one
         # host dispatch per fused block, device_loop_steps counts the
@@ -1298,6 +1312,7 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt_ids, max_new_tokens: int,
                _staged: Optional[dict] = None,
+               _replay: Optional[dict] = None,
                request_id: Optional[str] = None,
                tenant: Optional[str] = None,
                trace_id: int = 0) -> Request:
@@ -1356,6 +1371,14 @@ class ContinuousBatchingEngine:
         # cold-prefill the full prompt instead of importing
         if _staged is not None:
             req._staged = _staged
+        if _replay is not None:
+            # resume replay state (submit_resumed) attaches before the
+            # queue put for the same reason as _staged: the scheduler
+            # may pop the request instantly, and a late attach would
+            # stream the replayed prefix to the client a second time
+            req.resumed = True
+            req._suppress = _replay["suppress"]
+            req._rng_rewind = _replay["rewind"]
         with self._submit_lock:
             if not self._running:
                 raise RuntimeError("engine is closed")
@@ -1424,6 +1447,73 @@ class ContinuousBatchingEngine:
         return self.submit(prompt, max_new_tokens,
                            _staged={"k": k_blocks, "v": v_blocks,
                                     "imported": False})
+
+    def submit_resumed(self, prompt_ids, max_new_tokens: int,
+                       delivered_tokens, *,
+                       request_id: Optional[str] = None,
+                       tenant: Optional[str] = None,
+                       trace_id: int = 0) -> Request:
+        """Admit a stream that already delivered tokens on a dead
+        replica (docs/DESIGN.md §23): re-derive the delivered prefix
+        through the NORMAL paged admission — mixed dispatch, prefix
+        reuse, speculation all included — verify it token-by-token
+        against the journal, and stream only the suffix.  The caller
+        passes the ORIGINAL ``prompt_ids`` / ``max_new_tokens`` plus
+        the delivered token ids, so the resumed stream is bit-identical
+        to the unfailed run:
+
+        - **greedy** engines extend the prompt with ``delivered[:-1]``
+          and prefill it like any other prompt (a delivered token's KV
+          is exact regardless of whether prefill or decode produced
+          it); admission's argmax re-derives ``delivered[-1]`` and the
+          suppress queue verifies it.  Exact on ANY survivor, warm or
+          busy.
+        - **sampled** engines re-submit the original prompt and rewind
+          the engine rng to the constructor seed immediately before
+          this request's token-#1 split, replaying the exact per-step
+          split schedule (admission split, then one decode split per
+          dispatch) that produced the delivered tokens.  Exact when the
+          survivor replays the original run's dispatch pattern — same
+          engine config and seed, request decoding alone from slot 0
+          (the §18/§19 single-stream pinning scope); any deviation is
+          caught by the verify queue and fails the request instead of
+          streaming a silently-wrong suffix.
+
+        Replayed tokens append to ``tokens`` (budget/page math stays
+        exact) but never re-enter the stream queue; the replay window
+        is recorded as ``resume_pause`` (the migration-pause analog) so
+        the SLO decomposition still sums."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        delivered = [int(t) for t in
+                     np.asarray(delivered_tokens, np.int64).reshape(-1)]
+        k = len(delivered)
+        if k == 0:
+            raise ValueError("resume needs at least one delivered token")
+        if k >= max_new_tokens:
+            raise ValueError(
+                f"{k} delivered tokens leave nothing to resume "
+                f"(max_new_tokens={max_new_tokens})")
+        if self.eos_id is not None and self.eos_id in delivered:
+            raise ValueError(
+                "delivered tokens contain eos — the stream already "
+                "completed and has nothing to resume")
+        if self.sampling.greedy:
+            ext = np.concatenate(
+                [prompt, np.asarray(delivered[:-1], np.int32)])
+            replay = {"suppress": deque([delivered[-1]]),
+                      "rewind": False}
+            req = self.submit(ext, max_new_tokens - (k - 1),
+                              _replay=replay, request_id=request_id,
+                              tenant=tenant, trace_id=trace_id)
+        else:
+            replay = {"suppress": deque(delivered), "rewind": True}
+            req = self.submit(prompt, max_new_tokens, _replay=replay,
+                              request_id=request_id, tenant=tenant,
+                              trace_id=trace_id)
+        self.resume_stats["requests"] += 1
+        self._flight.record("resume_admit", rid=req.rid, delivered=k,
+                            greedy=bool(self.sampling.greedy))
+        return req
 
     def _import_staged(self, req: Request) -> None:
         """Land a premigrated request's staged blocks in the pool and
@@ -1795,7 +1885,8 @@ class ContinuousBatchingEngine:
 
     def generate_stream(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         seed: int = 0, timeout: Optional[float] = None,
-                        tenant: Optional[str] = None, trace_id: int = 0):
+                        tenant: Optional[str] = None, trace_id: int = 0,
+                        resume: Optional[dict] = None):
         """Yield [batch] token arrays per step (HTTP streaming surface).
         Single-row streaming only batches trivially; multi-row prompts
         stream in lockstep of the slowest admitted row.  An ABANDONED
@@ -1805,13 +1896,36 @@ class ContinuousBatchingEngine:
         ``timeout``: overall wall-clock deadline — on expiry the
         requests are cancelled (slots freed) and TimeoutError raised,
         so a consumer with a deadline never blocks on a wedged
-        scheduler (the --request-timeout contract)."""
+        scheduler (the --request-timeout contract).
+
+        ``resume``: ``{"delivered_tokens": [...], "rng_step_offset":
+        N}`` — gateway-failover resumption (docs/DESIGN.md §23,
+        single-row only): the stream yields only the tokens AFTER the
+        delivered prefix, which :meth:`submit_resumed` re-derives and
+        verifies bit-exactly."""
         ids = np.asarray(prompt_ids)
         if ids.ndim == 1:
             ids = ids[None, :]
         deadline = None if not timeout else time.monotonic() + timeout
-        reqs = self._submit_rows(ids, max_new_tokens, tenant=tenant,
-                                 trace_id=trace_id)
+        if resume is not None:
+            if ids.shape[0] != 1:
+                raise ValueError("resume supports a single prompt row")
+            delivered = resume.get("delivered_tokens")
+            if not isinstance(delivered, (list, tuple)) or not delivered:
+                raise ValueError(
+                    "resume.delivered_tokens must be a non-empty list")
+            off = resume.get("rng_step_offset", len(delivered))
+            if int(off) != len(delivered):
+                raise ValueError(
+                    f"resume.rng_step_offset ({off}) must equal "
+                    f"len(delivered_tokens) ({len(delivered)}) — the "
+                    "rng schedule is derived from the delivered count")
+            reqs = [self.submit_resumed(ids[0], max_new_tokens,
+                                        delivered, tenant=tenant,
+                                        trace_id=trace_id)]
+        else:
+            reqs = self._submit_rows(ids, max_new_tokens, tenant=tenant,
+                                     trace_id=trace_id)
         fetched = [[] for _ in reqs]
         finished = [False] * len(reqs)   # row's None sentinel was consumed
         try:
@@ -1941,6 +2055,8 @@ class ContinuousBatchingEngine:
                     if cs["mixed_budget_tokens"] else None)}
         if self.disagg_stats["premigrated_requests"]:
             out["disagg"] = dict(self.disagg_stats)
+        if self.resume_stats["requests"]:
+            out["resumed"] = dict(self.resume_stats)
         if any(self.migration_stats.values()):
             out["migration"] = dict(self.migration_stats)
         # compile ledger (docs/DESIGN.md §20): the recompile_storm
@@ -2405,6 +2521,14 @@ class ContinuousBatchingEngine:
         bucket = self._bucket(len(suffix))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(suffix)] = suffix
+        if getattr(req, "_rng_rewind", False):
+            # §23 sampled resume: rewind the engine stream to the seed
+            # key immediately before this request's token-#1 split, so
+            # the replayed split schedule (this admission split, then
+            # one decode split per dispatch) re-derives the delivered
+            # tokens bit-exactly; the suppress queue verifies each one
+            self._rng = jax.random.PRNGKey(self._seed)
+            req._rng_rewind = False
         self._rng, sub = jax.random.split(self._rng)
         _sig = _profiling.dispatch_signature(
             "paged_prefill", batch=1, chunk=bucket,
@@ -2503,6 +2627,41 @@ class ContinuousBatchingEngine:
 
     def _record_token(self, slot: int, req: Request, tok: int,
                       lp: Optional[float] = None):
+        sup = getattr(req, "_suppress", None)
+        if sup:
+            # §23 resume replay: the regenerated token must match the
+            # journal exactly — append it (budget/page math counts it)
+            # but never re-stream it.  A mismatch means the survivor's
+            # replay diverged (foreign config, or a concurrent stream
+            # reordered the rng spend): fail loudly, never emit a
+            # silently-wrong suffix.
+            expect = sup.popleft()
+            if tok != expect:
+                self.resume_stats["diverged"] += 1
+                self._flight.record("resume_diverged", slot=slot,
+                                    expect=expect, got=tok,
+                                    replayed=len(req.tokens))
+                self._slots[slot] = None
+                self._fail_request(req, RuntimeError(
+                    f"resume replay diverged at replayed token "
+                    f"{len(req.tokens) + 1}: journal says {expect}, "
+                    f"survivor regenerated {tok} (engine config/seed or "
+                    "rng schedule differs from the original replica)"))
+                self._sentinel_slot(slot)
+                return
+            req.tokens.append(tok)
+            if lp is not None:
+                req.lps.append(lp)
+            if len(req.tokens) == 1:
+                req.t_first = time.perf_counter()
+            self.resume_stats["replayed_tokens"] += 1
+            return
+        if req.resumed and req.resume_pause == 0.0 and req.t_first:
+            # first VISIBLE token of a resumed stream: the replay
+            # window ends here, recorded like a migration pause so the
+            # SLO timeline decomposition still sums exactly
+            req.resume_pause = max(
+                1e-9, time.perf_counter() - req.t_first)
         req.tokens.append(tok)
         if lp is not None:
             req.lps.append(lp)
@@ -2580,7 +2739,9 @@ class ContinuousBatchingEngine:
                 e2e_s=max(0.0, t_done - req.t_submit),
                 tokens=len(req.tokens),
                 migration_pause_s=req.migration_pause,
-                migrated=req.migrated, replica=self.tracer.proc,
+                migrated=req.migrated,
+                resume_pause_s=req.resume_pause,
+                resumed=req.resumed, replica=self.tracer.proc,
                 error=error)
             if req.trace_id:
                 # engine spans for the fleet trace stitch: wall-clock
@@ -2955,6 +3116,11 @@ class ContinuousBatchingEngine:
             seg_plen[r] = len(req.prompt)
             # the final's batch-1 sampling key: the serialized
             # prefill's exact split, spent in pack order
+            if getattr(req, "_rng_rewind", False):
+                # §23 sampled resume rewind — same hook as the
+                # serialized _finish_admission, mixed-dispatch shape
+                self._rng = jax.random.PRNGKey(self._seed)
+                req._rng_rewind = False
             self._rng, sub = jax.random.split(self._rng)
             seg_keys[r] = np.asarray(sub)
             # decode inside this dispatch pages through the installed

@@ -42,6 +42,29 @@ from typing import Callable, Dict, List, Optional
 from ...telemetry import catalog as _catalog
 from ...telemetry.flightrecorder import get_flight_recorder
 
+#: bounded failure-reason vocabulary for
+#: ``dwt_gateway_replica_failures_total{reason=...}`` — free-text
+#: reasons (exception strings) collapse onto these so the label set
+#: cannot grow with error-message cardinality
+FAILURE_REASONS = ("probe", "proxy", "mid-stream", "resume", "other")
+
+
+def classify_failure_reason(reason: str) -> str:
+    """Collapse a free-text failure reason onto the bounded vocabulary:
+    the prober passes ``probe: ...``, the resume loop ``resume``/
+    ``resume: ...``, the mid-stream seam ``mid-stream``; any other
+    non-empty text is a pre-first-token proxy failure."""
+    r = (reason or "").lower()
+    if r.startswith("probe"):
+        return "probe"
+    if "mid-stream" in r:
+        return "mid-stream"
+    if r.startswith("resume"):
+        return "resume"
+    if r:
+        return "proxy"
+    return "other"
+
 
 def http_stats_prober(timeout_s: float = 2.0):
     """Default prober: ``GET /stats`` on the replica, parsed JSON.
@@ -141,6 +164,11 @@ class ReplicaRegistry:
         # (rid, stats) — the router hooks this for load + kvcache
         # reconciliation
         self.on_stats: Optional[Callable[[str, dict], None]] = None
+        # registry-wide failure counts by bounded reason (satellite of
+        # docs/DESIGN.md §23): the /debugz twin of
+        # dwt_gateway_replica_failures_total
+        self.failure_reasons: Dict[str, int] = {
+            k: 0 for k in FAILURE_REASONS}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         _catalog.GATEWAY_UP_REPLICAS.set(len(self._replicas))
@@ -221,17 +249,20 @@ class ReplicaRegistry:
         """One failure strike (probe or proxy).  At ``sustain``
         consecutive strikes an up replica is evicted."""
         evicted = False
+        label = classify_failure_reason(reason)
         with self._lock:
             r = self._replicas.get(rid)
             if r is None:
                 return
             r.failures += 1
             r.fail_streak += 1
+            self.failure_reasons[label] += 1
             if r.up and r.fail_streak >= self.sustain:
                 r.up = False
                 r.down_at = self._clock()
                 evicted = True
                 n_up = sum(1 for x in self._replicas.values() if x.up)
+        _catalog.GATEWAY_REPLICA_FAILURES.inc(reason=label)
         if evicted:
             _catalog.GATEWAY_REPLICA_DOWN.inc()
             _catalog.GATEWAY_UP_REPLICAS.set(n_up)
@@ -268,6 +299,23 @@ class ReplicaRegistry:
                 int(stats.get("queue_depth", 0)), replica=rid)
             if self.on_stats is not None:
                 self.on_stats(rid, stats)
+
+    def retry_after_hint(self, default_s: float = 2.0,
+                         floor_s: float = 1.0) -> float:
+        """How long a shed client should back off: the smallest
+        readmit-cooldown remainder over the DOWN replicas (floored at
+        ``floor_s`` — a sub-second hint rounds to an instant hammer),
+        or ``default_s`` when nothing is down (the shed was load, not
+        membership, and no cooldown clock says otherwise)."""
+        now = self._clock()
+        with self._lock:
+            remains = [
+                max(0.0, self.readmit_cooldown_s - (now - r.down_at))
+                for r in self._replicas.values()
+                if not r.up and r.down_at is not None]
+        if not remains:
+            return default_s
+        return max(floor_s, min(remains))
 
     def probe_all(self) -> None:
         """One probe round over every replica (up AND down — a down
@@ -312,6 +360,7 @@ class ReplicaRegistry:
             return {
                 "sustain": self.sustain,
                 "readmit_cooldown_s": self.readmit_cooldown_s,
+                "failure_reasons": dict(self.failure_reasons),
                 "replicas": {
                     r.rid: {"up": r.up, "draining": r.draining,
                             "fail_streak": r.fail_streak,

@@ -29,10 +29,24 @@ Proxy contract (the hard-won parts):
   gateway has forwarded ANY body byte is struck in the registry and
   the request is replayed on the next candidate — bounded by
   ``retry_limit``.  The instant one byte has been forwarded the
-  gateway never retries: the client has seen output, and a replay
-  could diverge.  A mid-stream death becomes an ``{"error": ...}``
-  JSONL line + clean termination (the exact contract engines use for
-  their own mid-stream failures), never a hang.
+  gateway never REPLAYS: the client has seen output, and a verbatim
+  replay could duplicate it.
+- **resume after first token**: a mid-stream death (severed chunked
+  stream, transport error, or the replica's own ``{"error": ...}``
+  line) is instead RESUMED on a survivor (docs/DESIGN.md §23): the
+  gateway journals every *complete* delivered JSONL line, re-routes
+  through the prefix-aware router, and re-POSTs the original body
+  plus ``{"resume": {"delivered_tokens": [...], "rng_step_offset":
+  N}}`` so the survivor replays the delivered prefix silently and
+  streams the suffix bit-identically — bounded by ``resume_limit``
+  (0 disables).  Only when resume is exhausted (or the request shape
+  is ineligible: multi-row, logprobs, stop, image, resume disabled)
+  does the client get the ``{"error": ...}`` JSONL line + clean
+  termination (the exact contract engines use for their own
+  mid-stream failures) — the documented post-resume fallback, never
+  a hang.  A torn trailing fragment (line without ``\n``) is never
+  forwarded: the client and the journal both end at the last
+  complete line.
 - **federated admission**: a replica's own ``503/429 + Retry-After``
   (runtime/overload.py) propagates to the client verbatim — the
   replica already said precisely what the client should do.  Every
@@ -83,12 +97,17 @@ class GatewayHTTPServer:
 
     def __init__(self, registry, router, host: str = "127.0.0.1",
                  port: int = 0, *, retry_limit: int = 1,
+                 resume_limit: int = 1,
                  proxy_timeout_s: Optional[float] = None,
                  fleet_scrape_interval_s: float = 1.0,
                  fleet_max_stale_s: float = 30.0,
                  metrics_fetcher=None, sketch_fetcher=None):
         """``retry_limit``: additional replicas tried after the routed
-        one dies before first token.  ``proxy_timeout_s``: per-socket
+        one dies before first token.  ``resume_limit``: mid-stream
+        failover attempts after the first token (each re-routes the
+        journaled request to a survivor with a ``resume`` payload;
+        0 disables and restores the error-line-only contract).
+        ``proxy_timeout_s``: per-socket
         timeout on replica connections (None = no deadline; streams
         with long decode gaps need None or a generous value).
         ``fleet_scrape_interval_s`` / ``fleet_max_stale_s`` /
@@ -99,6 +118,7 @@ class GatewayHTTPServer:
         self.registry = registry
         self.router = router
         self.retry_limit = max(0, int(retry_limit))
+        self.resume_limit = max(0, int(resume_limit))
         self.proxy_timeout_s = proxy_timeout_s
         self._sketch_fetcher = sketch_fetcher
         self.tracer = TraceRecorder("gateway")
@@ -261,11 +281,33 @@ class GatewayHTTPServer:
         except (TypeError, ValueError):
             return None
 
+    @staticmethod
+    def _make_journal(req: dict, tokens, tenant) -> Optional[dict]:
+        """Arm a resume journal iff the request shape supports
+        bit-identical resumption: a streaming single-row request with
+        no logprobs/stop/image sidecars (those change the line schema
+        or the replica-side replay contract).  Ineligible shapes keep
+        today's error-line-only mid-stream semantics."""
+        if not req.get("stream"):
+            return None
+        if req.get("logprobs") or req.get("stop") or \
+                req.get("image") is not None:
+            return None
+        ids = req.get("prompt_ids")
+        if isinstance(ids, list) and ids and isinstance(ids[0], list) \
+                and len(ids) > 1:
+            return None     # multi-row batch: one journal can't split it
+        return {"body": dict(req), "tokens": [],
+                "routing_tokens": tokens, "tenant": tenant,
+                "dead": set(), "eligible": True}
+
     def _proxy_generate(self, handler, raw: bytes, req: dict) -> None:
         tokens = self._routing_tokens(req)
         trace_id = new_trace_id()
         tenant = req.get("tenant") or handler.headers.get("X-DWT-Tenant")
         tenant = str(tenant) if tenant else None
+        journal = (self._make_journal(req, tokens, tenant)
+                   if self.resume_limit > 0 else None)
         get_flight_recorder().record(
             "gateway_admit", trace_id=f"{trace_id:016x}",
             tenant=tenant or "default")
@@ -296,7 +338,7 @@ class GatewayHTTPServer:
             try:
                 done = self._proxy_once(handler, rid, raw, trace_id,
                                         ttft_clock, decision, attempt,
-                                        tenant=tenant)
+                                        tenant=tenant, journal=journal)
             except _ReplicaDied as e:
                 last_err = e
                 self.registry.record_failure(rid, reason=str(e))
@@ -318,15 +360,86 @@ class GatewayHTTPServer:
         raise GatewayOverloaded(
             "request failed on every candidate replica before first "
             f"token (tried {len(candidates)}; last error: {last_err})",
-            retry_after_s=2.0)
+            retry_after_s=self.registry.retry_after_hint())
+
+    @staticmethod
+    def _journal_line(journal: dict, line: bytes) -> bool:
+        """Fold one complete forwarded JSONL line into the resume
+        journal.  Returns False when the line is the replica's own
+        ``{"error": ...}`` report — the caller treats that as a
+        mid-stream death (resume seam #3) instead of forwarding it.
+        A line the journal cannot account for (unparseable, batched
+        multi-token) permanently disarms resume for this request."""
+        try:
+            obj = json.loads(line)
+        except Exception:
+            journal["eligible"] = False
+            return True
+        if not isinstance(obj, dict):
+            journal["eligible"] = False
+            return True
+        if "error" in obj:
+            return False
+        toks = obj.get("tokens")
+        if isinstance(toks, list):
+            if len(toks) == 1:
+                try:
+                    journal["tokens"].append(int(toks[0]))
+                except (TypeError, ValueError):
+                    journal["eligible"] = False
+            elif len(toks) > 1:
+                journal["eligible"] = False
+        return True
+
+    def _forward_stream(self, resp, chunkfn, journal, rid: str):
+        """Forward JSONL lines from ``resp`` through ``chunkfn`` until
+        the stream ends.  Returns ``(status, detail)``: ``"done"``
+        (clean terminating chunk), ``"client_gone"`` (OUR client
+        closed — nothing left to do), or ``"died"`` (severed stream,
+        transport error, torn trailing fragment, or — when a journal
+        is armed and eligible — the replica's own error line, which
+        is intercepted so a resume can replace it)."""
+        while True:
+            try:
+                line = resp.readline()
+            except Exception as e:
+                return "died", f"stream error: {e}"
+            if not line:
+                # readline() reports a SEVERED chunked stream as a
+                # clean EOF: http.client's peek swallows the
+                # IncompleteRead AND closes the response, so read()
+                # cannot re-raise either.  The one surviving signal is
+                # chunk_left — a clean termination walks through the
+                # 0-chunk and leaves it None; a replica that died
+                # without it leaves 0 (or the unread remainder)
+                if resp.chunk_left is not None:
+                    return "died", ("chunked stream severed before "
+                                    "the terminating chunk")
+                return "done", None
+            if not line.endswith(b"\n"):
+                # torn fragment: never forward a partial JSONL line —
+                # the client and the journal both end at the last
+                # COMPLETE line (resume's correctness precondition)
+                return "died", "stream severed mid-line"
+            if journal is not None and journal["eligible"] and \
+                    not self._journal_line(journal, line):
+                return "died", f"replica {rid} reported mid-stream error"
+            try:
+                chunkfn(line)
+            except OSError:
+                return "client_gone", None
 
     def _proxy_once(self, handler, rid: str, raw: bytes, trace_id: int,
                     ttft_clock: SpanClock, decision, attempt: int,
-                    tenant: Optional[str] = None) -> bool:
+                    tenant: Optional[str] = None,
+                    journal: Optional[dict] = None) -> bool:
         """Proxy one attempt to ``rid``.  Returns True on a 2xx the
         client fully received; raises :class:`_ReplicaDied` when safe
         to retry (no body byte forwarded); propagates replica HTTP
-        errors (including 503/429 shedding) as final answers."""
+        errors (including 503/429 shedding) as final answers.  A
+        mid-stream death with ``journal`` armed hands off to
+        :meth:`_resume_stream` before falling back to the error
+        line."""
         host, port = self.registry.endpoint(rid)
         conn = HTTPConnection(host, port, timeout=self.proxy_timeout_s)
         try:
@@ -391,6 +504,17 @@ class GatewayHTTPServer:
             if not first:
                 raise _ReplicaDied(f"{rid}: empty stream before first "
                                    "token")
+            if not first.endswith(b"\n"):
+                # torn before the first complete line: nothing has
+                # been forwarded, so this stays an ordinary retry
+                raise _ReplicaDied(f"{rid}: stream severed mid-line "
+                                   "before first token")
+            if journal is not None and not self._journal_line(journal,
+                                                              first):
+                # the replica's FIRST line is already an error report:
+                # zero tokens delivered, nothing to resume — forward
+                # it verbatim like any other line
+                journal["eligible"] = False
             _catalog.GATEWAY_PROXY_TTFT_SECONDS.observe(ttft_clock.seconds)
             _catalog.HTTP_REQUESTS.inc(route="/generate", code="200")
             handler.send_response(200)
@@ -403,47 +527,151 @@ class GatewayHTTPServer:
                 handler.wfile.write(f"{len(data):x}\r\n".encode())
                 handler.wfile.write(data + b"\r\n")
 
-            sent_any = False
             try:
                 chunk(first)
-                sent_any = True
-                while True:
-                    line = resp.readline()
-                    if not line:
-                        # readline() reports a SEVERED chunked stream
-                        # as a clean EOF: http.client's peek swallows
-                        # the IncompleteRead AND closes the response,
-                        # so read() cannot re-raise either.  The one
-                        # surviving signal is chunk_left — a clean
-                        # termination walks through the 0-chunk and
-                        # leaves it None; a replica that died without
-                        # it leaves 0 (or the unread remainder)
-                        if resp.chunk_left is not None:
-                            raise RuntimeError(
-                                "chunked stream severed before the "
-                                "terminating chunk")
-                        break
-                    chunk(line)
             except OSError:
                 return True      # our client went away; nothing to do
-            except Exception as e:
-                # replica died MID-stream, after first token: no retry
-                # (the client saw output) — an error line + clean
-                # termination, the engines' own mid-stream contract
-                if sent_any:
+            status, detail = self._forward_stream(resp, chunk, journal,
+                                                  rid)
+            if status == "died":
+                # replica died MID-stream, after first token: never
+                # replayed verbatim (the client saw output).  Resume
+                # on a survivor when the journal allows it
+                # (docs/DESIGN.md §23); the error line is the
+                # post-resume fallback
+                self.registry.record_failure(rid, reason="mid-stream")
+                resumed = False
+                if journal is not None and journal["eligible"] and \
+                        journal["tokens"]:
+                    journal["dead"].add(rid)
+                    resumed = self._resume_stream(chunk, journal,
+                                                  trace_id)
+                    if not resumed:
+                        _catalog.GATEWAY_RESUME_EXHAUSTED.inc()
+                if not resumed:
                     try:
                         chunk((json.dumps(
                             {"error": f"replica {rid} died mid-stream: "
-                                      f"{e}"}) + "\n").encode())
+                                      f"{detail}"}) + "\n").encode())
                     except OSError:
                         return True
-                self.registry.record_failure(rid, reason="mid-stream")
+            elif status == "client_gone":
+                return True
             try:
                 chunk(b"")
                 handler.wfile.flush()
             except OSError:
                 pass
             return True
+        finally:
+            conn.close()
+
+    # -- mid-stream failover (docs/DESIGN.md §23) --------------------------
+
+    def _resume_stream(self, chunkfn, journal: dict,
+                       trace_id: int) -> bool:
+        """Bounded mid-stream failover: re-route the journaled request
+        and re-POST it with a ``resume`` payload so a survivor replays
+        the delivered prefix silently and streams the suffix
+        bit-identically.  Returns True when a survivor finished the
+        stream (the client saw delivered prefix + resumed suffix, no
+        repeats, gaps, or torn lines); False when attempts are
+        exhausted and the caller falls back to the error line."""
+        flight = get_flight_recorder()
+        for attempt in range(1, self.resume_limit + 1):
+            if not (journal["eligible"] and journal["tokens"]):
+                return False
+            _catalog.GATEWAY_RESUME_ATTEMPTS.inc()
+            ttf_clock = SpanClock()
+            try:
+                decision = self.router.route(journal["routing_tokens"])
+            except Exception:
+                return False    # nothing routable: fall back now
+            cands = [r for r in [decision.rid] + decision.candidates
+                     if r not in journal["dead"]
+                     and self.registry.is_up(r)]
+            if not cands:
+                return False
+            rid = cands[0]
+            flight.record(
+                "gateway_resume", replica=rid, attempt=attempt,
+                delivered=len(journal["tokens"]),
+                trace_id=f"{trace_id:016x}")
+            body = dict(journal["body"])
+            body["resume"] = {
+                "delivered_tokens": [int(t) for t in journal["tokens"]],
+                "rng_step_offset": len(journal["tokens"]),
+            }
+            raw = json.dumps(body).encode("utf-8")
+            self.router.acquire(rid)
+            span_clock = SpanClock()
+            try:
+                ok = self._resume_once(rid, raw, chunkfn, journal,
+                                       trace_id, ttf_clock)
+            finally:
+                self.router.release(rid)
+                self.tracer.record(
+                    "gateway.resume", trace_id, clock=span_clock,
+                    replica=rid, attempt=attempt)
+            if ok:
+                _catalog.GATEWAY_RESUME_SUCCEEDED.inc()
+                if journal["routing_tokens"]:
+                    # the survivor now holds prompt + stream blocks
+                    self.router.record(rid, journal["routing_tokens"])
+                flight.record("gateway_resume_done", replica=rid,
+                              trace_id=f"{trace_id:016x}")
+                return True
+            journal["dead"].add(rid)
+            self.registry.record_failure(
+                rid, reason=f"resume attempt {attempt} failed")
+        return False
+
+    def _resume_once(self, rid: str, raw: bytes, chunkfn,
+                     journal: dict, trace_id: int,
+                     ttf_clock: SpanClock) -> bool:
+        """One resume attempt against ``rid``.  The client's 200 +
+        chunked framing is already committed, so every failure mode
+        here returns False (try the next survivor / fall back) rather
+        than raising — nothing may reach the client except complete
+        resumed JSONL lines."""
+        host, port = self.registry.endpoint(rid)
+        conn = HTTPConnection(host, port, timeout=self.proxy_timeout_s)
+        try:
+            headers = {
+                "Content-Type": "application/json",
+                "X-DWT-Trace-Id": f"{trace_id:016x}",
+            }
+            if journal["tenant"]:
+                headers["X-DWT-Tenant"] = journal["tenant"][:64]
+            try:
+                conn.request("POST", "/generate", body=raw,
+                             headers=headers)
+                resp = conn.getresponse()
+            except Exception:
+                return False
+            if resp.status != 200:
+                resp.read()
+                return False
+            if (resp.getheader("Transfer-Encoding", "")
+                    .lower() != "chunked"):
+                return False    # resume is a streaming-only contract
+            self.registry.record_success(rid)
+            try:
+                first = resp.readline()
+            except Exception:
+                return False
+            if not first or not first.endswith(b"\n"):
+                return False
+            _catalog.GATEWAY_RESUME_TTF_SECONDS.observe(ttf_clock.seconds)
+            if not self._journal_line(journal, first):
+                return False    # survivor's replay failed loudly
+            try:
+                chunkfn(first)
+            except OSError:
+                return True     # our client went away; nothing to do
+            status, _detail = self._forward_stream(resp, chunkfn,
+                                                   journal, rid)
+            return status in ("done", "client_gone")
         finally:
             conn.close()
 

@@ -617,6 +617,49 @@ class InferenceHTTPServer:
                         self._json(501, {"error": "backend does not "
                                                   "support image input"})
                         return
+                resume = req.get("resume")
+                if resume is not None:
+                    # mid-stream failover resumption (docs/DESIGN.md
+                    # §23): the gateway re-POSTs the journaled request
+                    # with the delivered prefix; the engine replays it
+                    # silently and streams the suffix bit-identically.
+                    # Honor-or-reject: only the batching engine carries
+                    # the submit_resumed path
+                    err_code, err = None, None
+                    if not req.get("stream"):
+                        err_code, err = 400, "resume requires stream"
+                    elif (image is not None or req.get("stop") is not None
+                          or req.get("logprobs")):
+                        err_code, err = 501, ("resume does not support "
+                                              "image, stop, or logprobs")
+                    elif not _accepts_kwarg(outer.backend.generate_stream,
+                                            "resume"):
+                        err_code, err = 501, ("backend does not support "
+                                              "resume")
+                    elif not isinstance(resume, dict):
+                        err_code, err = 400, "resume must be an object"
+                    if err is None:
+                        delivered = resume.get("delivered_tokens")
+                        if (not isinstance(delivered, (list, tuple))
+                                or not delivered
+                                or not all(isinstance(t, int)
+                                           for t in delivered)):
+                            err_code, err = 400, (
+                                "resume.delivered_tokens must be a "
+                                "non-empty list of token ids")
+                        elif int(resume.get("rng_step_offset",
+                                            len(delivered))) \
+                                != len(delivered):
+                            # the rng fast-forward replays one sampler
+                            # split per delivered token — an offset
+                            # that disagrees with the prefix length
+                            # cannot be bit-identical
+                            err_code, err = 400, (
+                                "resume.rng_step_offset must equal "
+                                "len(delivered_tokens)")
+                    if err is not None:
+                        self._json(err_code, {"error": err})
+                        return
                 stop = req.get("stop")
                 if stop is not None:
                     if isinstance(stop, str):
@@ -676,7 +719,8 @@ class InferenceHTTPServer:
                                 "error": "backend does not support "
                                          "logprobs with stream"})
                             return
-                        self._stream(ids, max_new, seed, logprobs=want_lp)
+                        self._stream(ids, max_new, seed, logprobs=want_lp,
+                                     resume=resume)
                     else:
                         kwargs = {}
                         if image is not None:
@@ -899,12 +943,19 @@ class InferenceHTTPServer:
                 except OSError:
                     pass
 
-            def _stream(self, ids, max_new, seed, logprobs=False):
+            def _stream(self, ids, max_new, seed, logprobs=False,
+                        resume=None):
                 kwargs = {"logprobs": True} if logprobs else {}
+                if resume is not None:
+                    kwargs["resume"] = resume
                 kwargs.update(
                     self._obs_kwargs(outer.backend.generate_stream))
                 gen = outer.backend.generate_stream(ids, max_new, seed=seed,
                                                     **kwargs)
+                # a resumed stream continues the dead replica's step
+                # numbering so the client's concatenated stream reads
+                # seamlessly (delivered prefix ends at step k-1)
+                step0 = len(resume["delivered_tokens"]) if resume else 0
 
                 def lines(items, gen):
                     # incremental detokenization, per row: the "text"
@@ -922,7 +973,7 @@ class InferenceHTTPServer:
                     n_steps = 0
                     for i, item in enumerate(items):
                         toks, lps = item if logprobs else (item, None)
-                        line = {"step": i,
+                        line = {"step": step0 + i,
                                 "tokens": np.asarray(toks).tolist()}
                         if lps is not None:
                             line["logprobs"] = _round_lps(np.asarray(lps))
@@ -940,7 +991,7 @@ class InferenceHTTPServer:
                         rem = [detoks[r].flush() if r in detoks else ""
                                for r in range(max(detoks) + 1)]
                         if any(rem):
-                            yield {"step": n_steps, "tokens": [],
+                            yield {"step": step0 + n_steps, "tokens": [],
                                    "text": rem}
 
                 self._stream_lines(gen, lines)

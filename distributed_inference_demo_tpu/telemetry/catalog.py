@@ -158,6 +158,22 @@ BATCH_SPEC_ACCEPT_RATIO = gauge(
     "dwt_batching_spec_acceptance_ratio",
     "accepted/drafted over the scheduler's speculative rows (NaN until "
     "the first draft)")
+BATCH_RESUMED = counter(
+    "dwt_batching_resumed_requests_total",
+    "Requests admitted through the gateway-failover resume path "
+    "(docs/DESIGN.md §23): the delivered prefix re-derived through "
+    "normal paged admission on a survivor replica, verified "
+    "token-by-token, then streamed from the cut point")
+BATCH_RESUME_REPLAYED = counter(
+    "dwt_batching_resume_replayed_tokens_total",
+    "Delivered tokens re-derived and verify-swallowed (never "
+    "re-streamed) during resume replays")
+BATCH_RESUME_DIVERGED = counter(
+    "dwt_batching_resume_diverged_requests_total",
+    "Resume replays that regenerated a token differing from the "
+    "journal (foreign engine config/seed, or concurrent streams "
+    "reordering the rng spend) — failed loudly instead of streaming "
+    "a wrong suffix")
 
 # -- block KV cache (runtime/kvcache), bridged from manager snapshots ------
 
@@ -388,6 +404,12 @@ def update_batching_series(stats: dict) -> None:
         u = mx.get("budget_utilization")
         BATCH_TOKEN_BUDGET_UTILIZATION.set(
             u if u is not None else float("nan"))
+    rs = stats.get("resumed") or {}
+    if rs:
+        BATCH_RESUMED.set_cumulative(rs.get("requests", 0))
+        BATCH_RESUME_REPLAYED.set_cumulative(
+            rs.get("replayed_tokens", 0))
+        BATCH_RESUME_DIVERGED.set_cumulative(rs.get("diverged", 0))
     kv = stats.get("kvcache") or {}
     if kv:
         update_kvcache_series(kv)
@@ -555,6 +577,38 @@ GATEWAY_SHED = counter(
     "Requests the gateway answered 503/429: every replica down, every "
     "candidate overloaded, or a replica's Retry-After propagated "
     "through federated admission")
+# §23 zero-loss streams: a replica dying MID-stream no longer ends the
+# request — the gateway journals delivered lines and re-POSTs the
+# stream to a survivor with a resume payload (attempts bounded by
+# --resume-limit; exhaustion falls back to the error-line contract)
+GATEWAY_RESUME_ATTEMPTS = counter(
+    "dwt_gateway_resume_attempts_total",
+    "Mid-stream failover resume attempts: a journaled stream's replica "
+    "died after first token and the gateway re-POSTed the request to "
+    "a survivor with the delivered-token journal (docs/DESIGN.md §23)")
+GATEWAY_RESUME_SUCCEEDED = counter(
+    "dwt_gateway_resume_succeeded_total",
+    "Resume attempts that streamed the remainder to completion on a "
+    "survivor (the client saw delivered prefix + resumed suffix with "
+    "no repeats, gaps, or torn lines)")
+GATEWAY_RESUME_EXHAUSTED = counter(
+    "dwt_gateway_resume_exhausted_requests_total",
+    "Mid-stream deaths whose resume attempts were exhausted (or no "
+    "eligible survivor existed): degraded to the documented error-line "
+    "fallback")
+GATEWAY_RESUME_TTF_SECONDS = histogram(
+    "dwt_gateway_resume_ttf_seconds",
+    "Time from detecting a mid-stream replica death to the first "
+    "resumed token forwarded from the survivor (routing + re-POST + "
+    "replay window)",
+    buckets=LATENCY_BUCKETS_S)
+GATEWAY_REPLICA_FAILURES = counter(
+    "dwt_gateway_replica_failures_total",
+    "Replica failures recorded by the registry, by bounded failure "
+    "reason: probe (health prober), proxy (pre-first-token proxy "
+    "death), mid-stream (died after first streamed token), resume "
+    "(failed while serving a failover resume), other",
+    ("reason",))
 GATEWAY_REPLICA_DOWN = counter(
     "dwt_gateway_replica_down_total",
     "Replica up->down transitions: health probes (or proxy failures) "

@@ -11,13 +11,16 @@ went:
     queue_wait  — admission to first scheduler pickup
     prefill     — pickup to first emitted token (chunked prefill time)
     ttft        — admission to first token (= queue_wait + prefill)
-    per_token   — steady-state decode seconds/token, migration excluded
+    per_token   — steady-state decode seconds/token, pauses excluded
     migration_pause — freeze→first-relayed-token gap, live migrations
+    resume_pause — replay window on a survivor after gateway failover
+                   (docs/DESIGN.md §23), recorded like migration_pause
     e2e         — admission to final token
 
-By construction ``ttft + per_token*(tokens-1) + migration_pause == e2e``
-for every closed record, so the decomposition always sums — a timeline
-that doesn't add up is a measurement bug, not a rounding artifact.
+By construction ``ttft + per_token*(tokens-1) + migration_pause +
+resume_pause == e2e`` for every closed record, so the decomposition
+always sums — a timeline that doesn't add up is a measurement bug, not
+a rounding artifact.
 
 Each close rolls into per-tenant labeled Prometheus series
 (``dwt_slo_*``): latency histograms, goodput counters (tokens served
@@ -103,6 +106,17 @@ SLO_MIGRATED_REQUESTS = counter(
     "dwt_slo_migrated_requests_total",
     "Per-tenant closed requests that were live-migrated at least once",
     labels=("tenant",))
+SLO_RESUME_PAUSE = histogram(
+    "dwt_slo_resume_pause_seconds",
+    "Per-tenant gateway-failover resume pause (replay window on the "
+    "survivor: first replayed token to first visible token, docs/"
+    "DESIGN.md §23), observed only for resumed requests",
+    labels=("tenant",), buckets=_TTFT_BUCKETS_S)
+SLO_RESUMED_REQUESTS = counter(
+    "dwt_slo_resumed_requests_total",
+    "Per-tenant closed requests admitted through the gateway-failover "
+    "resume path (delivered prefix re-derived on a survivor replica)",
+    labels=("tenant",))
 SLO_BURN_RATE = gauge(
     "dwt_slo_burn_rate_ratio",
     "Per-tenant SLO burn rate over a trailing window: fraction of "
@@ -182,7 +196,9 @@ class SloLedger:
                       queue_wait_s: float = 0.0, ttft_s: float = 0.0,
                       e2e_s: float = 0.0, tokens: int = 0,
                       migration_pause_s: float = 0.0,
-                      migrated: bool = False, replica: str = "",
+                      migrated: bool = False,
+                      resume_pause_s: float = 0.0,
+                      resumed: bool = False, replica: str = "",
                       error: Optional[str] = None) -> dict:
         """Close one request into a timeline record and roll it into the
         per-tenant series.  Returns the record (also kept in the recent
@@ -192,11 +208,13 @@ class SloLedger:
         queue_wait_s = max(0.0, float(queue_wait_s))
         ttft_s = max(queue_wait_s, float(ttft_s))
         migration_pause_s = max(0.0, float(migration_pause_s))
-        e2e_s = max(ttft_s + migration_pause_s, float(e2e_s))
+        resume_pause_s = max(0.0, float(resume_pause_s))
+        pause_s = migration_pause_s + resume_pause_s
+        e2e_s = max(ttft_s + pause_s, float(e2e_s))
         decode_s = e2e_s - ttft_s
         # max(0): float dust when decode == pause exactly must not
         # produce a negative per-token latency
-        per_token_s = (max(0.0, decode_s - migration_pause_s)
+        per_token_s = (max(0.0, decode_s - pause_s)
                        / (tokens - 1) if tokens > 1 else 0.0)
         prefill_s = ttft_s - queue_wait_s
 
@@ -218,8 +236,10 @@ class SloLedger:
             "ttft_s": ttft_s, "per_token_s": per_token_s,
             "decode_s": decode_s,
             "migration_pause_s": migration_pause_s,
+            "resume_pause_s": resume_pause_s,
             "e2e_s": e2e_s, "tokens": tokens,
             "good_tokens": good, "migrated": bool(migrated),
+            "resumed": bool(resumed),
             "replica": str(replica),
         }
         if error is not None:
@@ -243,6 +263,9 @@ class SloLedger:
             SLO_GOOD_TTFT_REQUESTS.inc(tenant=tenant)
         if migrated:
             SLO_MIGRATION_PAUSE.observe(migration_pause_s, tenant=tenant)
+        if resumed:
+            SLO_RESUMED_REQUESTS.inc(tenant=tenant)
+            SLO_RESUME_PAUSE.observe(resume_pause_s, tenant=tenant)
 
         with self._lock:
             self._recent.append(rec)
@@ -250,12 +273,13 @@ class SloLedger:
             ev.append((rec["ts"], tokens, bad))
             tot = self._totals.setdefault(
                 tenant, {"requests": 0, "tokens": 0, "good_tokens": 0,
-                         "failed": 0, "migrated": 0})
+                         "failed": 0, "migrated": 0, "resumed": 0})
             tot["requests"] += 1
             tot["tokens"] += tokens
             tot["good_tokens"] += good
             tot["failed"] += 1 if error is not None else 0
             tot["migrated"] += 1 if migrated else 0
+            tot["resumed"] += 1 if resumed else 0
             burn = self._burn_locked(tenant)
         for label, rate in burn.items():
             SLO_BURN_RATE.set(rate, tenant=tenant, window=label)
@@ -306,6 +330,7 @@ class SloLedger:
                     "requests": tot["requests"],
                     "failed": tot["failed"],
                     "migrated": tot["migrated"],
+                    "resumed": tot.get("resumed", 0),
                     "tokens": toks,
                     "good_tokens": tot["good_tokens"],
                     "goodput_ratio": (tot["good_tokens"] / toks

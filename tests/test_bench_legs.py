@@ -275,6 +275,42 @@ def test_leg_gateway_routing_structure_tiny():
     assert len(kl["survivors"]) >= 1
 
 
+@pytest.mark.slow
+def test_leg_stream_failover_structure_tiny():
+    """The stream_failover leg's CPU dryrun (the ISSUE-20 acceptance
+    shape): a replica dying mid-soak loses NOTHING — every stream
+    completes bit-identically to the unfailed reference via gateway
+    resume, the SLO ledger books the replay as a resume pause, the
+    documented error-line fallback stays reachable at resume_limit=0,
+    and both the survivor and the dead path hand their pages back."""
+    out = bench._leg_stream_failover("llama-test", n_req=4,
+                                     prompt_len=32, new_tokens=8,
+                                     slots=2, max_seq=256,
+                                     block_tokens=8, crash_after=2,
+                                     seed_victim=2)
+    assert "error" not in out
+    fo = out["failover"]
+    assert fo["requests"] == 4 and fo["completed"] == 4
+    assert out["failover_completed_100pct"] is True
+    assert out["failover_bit_identical"] is True
+    # the victim served >=2 pinned streams, each died 2 tokens in, and
+    # every death resumed exactly once on the survivor
+    assert out["resume_all_succeeded"] is True
+    assert fo["resume_attempts"] >= 2
+    assert fo["resume_ttf_p95_ms"] is not None
+    assert fo["resume_ttf_p95_ms"] > 0
+    # the ledger saw the same resumes the gateway counted, and the
+    # timeline decomposition still sums exactly
+    assert out["slo_books_resume"] is True
+    assert fo["slo_resume_pause_p95_ms"] > 0
+    # pre-§23 contract still reachable and documented
+    assert out["loss_documented_at_limit_0"] is True
+    assert 1 <= out["documented_loss"]["delivered_before_error"] < 8
+    # zero leaks on both the dead path and the survivor
+    assert out["zero_leak_survivor"] is True
+    assert out["zero_leak_victim"] is True
+
+
 # tier-1 budget: run_leg plumbing keeps its quick reps in the micro-
 # variants and dispatch-profile tests; this full-budget structure twin
 # rides the slow lane

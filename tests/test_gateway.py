@@ -579,15 +579,17 @@ def _post_stream(host, port, body, timeout=60):
         conn.close()
 
 
-def _gateway(replicas, *, retry_limit=1, sustain=3, min_prefix=8,
-             block_tokens=8, start_prober=False, cooldown=60.0):
+def _gateway(replicas, *, retry_limit=1, resume_limit=1, sustain=3,
+             min_prefix=8, block_tokens=8, start_prober=False,
+             cooldown=60.0):
     registry = ReplicaRegistry(replicas, sustain=sustain,
                                readmit_cooldown_s=cooldown,
                                probe_interval_s=0.2)
     router = PrefixAwareRouter(registry, min_prefix_tokens=min_prefix,
                                block_tokens=block_tokens)
     gw = GatewayHTTPServer(registry, router, port=0,
-                           retry_limit=retry_limit)
+                           retry_limit=retry_limit,
+                           resume_limit=resume_limit)
     if start_prober:
         gw.start()
     else:
@@ -889,10 +891,13 @@ class _CrashyBackend:
 
 
 def test_midstream_replica_kill_chaos_injected_crash(params):
-    """A replica dies mid-stream via a seeded comm/faults crash rule:
-    the client holds the delivered prefix plus an error line (never a
-    hang, never divergent tokens), and a follow-up request completes
-    the same greedy answer in full on the fleet."""
+    """A replica dies mid-stream via a seeded comm/faults crash rule
+    with resume DISABLED (--resume-limit 0): the client holds the
+    delivered prefix plus an error line (never a hang, never divergent
+    tokens), and a follow-up request completes the same greedy answer
+    in full on the fleet.  This pins the documented post-resume
+    fallback contract; the resume path itself is pinned in
+    test_stream_failover.py."""
     plan = FaultPlan(seed=7, rules=[FaultRule(kind="crash_after",
                                               n_msgs=3, max_count=1)])
     engines = [_engine(params) for _ in range(2)]
@@ -904,7 +909,7 @@ def test_midstream_replica_kill_chaos_injected_crash(params):
         srv.start()
         servers.append(srv)
     gw = _gateway([(s.host, s.port) for s in servers], min_prefix=8,
-                  block_tokens=8)
+                  block_tokens=8, resume_limit=0)
     try:
         toks = list(range(2, 18))
         crashy_rid = f"{servers[0].host}:{servers[0].port}"

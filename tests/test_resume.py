@@ -1,0 +1,207 @@
+"""Engine-side stream resumption (docs/DESIGN.md §23): bit-identity.
+
+`submit_resumed` re-derives a dead replica's delivered prefix through
+the NORMAL paged admission and streams only the suffix — so the
+contract is the strongest one available: for every cut point k, the
+delivered prefix plus the resumed suffix must equal the unfailed run
+token-for-token, greedy AND sampled, across page dtypes, and with
+speculation armed on the survivor.  A journal the survivor cannot
+reproduce fails LOUDLY (never a silently-wrong stream), and the SLO
+ledger books the replay window as a resume pause with the timeline
+decomposition still summing exactly.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+
+from distributed_inference_demo_tpu.models import get_model_config
+from distributed_inference_demo_tpu.models.decoder import init_full_params
+from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+from distributed_inference_demo_tpu.runtime.batching import (
+    ContinuousBatchingEngine)
+from distributed_inference_demo_tpu.telemetry.slo import (SloLedger,
+                                                          set_slo_ledger)
+
+CFG = get_model_config("llama-test")
+GREEDY = SamplingParams(greedy=True)
+SAMPLED = SamplingParams(temperature=0.9, top_k=40)
+PROMPT = list(range(3, 24))
+N = 10
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_full_params(jax.random.PRNGKey(0), CFG)
+
+
+def _engine(params, **kw):
+    kw.setdefault("sampling", GREEDY)
+    kw.setdefault("seed", 7)
+    kw.setdefault("max_seq", 96)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prompt_buckets", (16, 48))
+    kw.setdefault("kv_block_tokens", 8)
+    return ContinuousBatchingEngine(CFG, params, **kw)
+
+
+def _stream(eng, prompt=PROMPT, n=N, resume=None):
+    ids = np.asarray(prompt, np.int32)[None, :]
+    return [int(t[0]) for t in eng.generate_stream(ids, n, resume=resume)]
+
+
+def _resume_at(eng, ref, k, prompt=PROMPT, n=N):
+    resume = {"delivered_tokens": ref[:k], "rng_step_offset": k}
+    return ref[:k] + _stream(eng, prompt, n, resume=resume)
+
+
+def assert_no_leak(eng):
+    mgr = eng.kv_cache
+    assert mgr.used_blocks == mgr.tree.block_count, (
+        mgr.used_blocks, mgr.tree.block_count)
+    assert mgr.debug_state()["leased_nodes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: greedy and sampled, every cut point
+# ---------------------------------------------------------------------------
+
+@pytest.mark.quick
+def test_greedy_resume_bit_identical_and_zero_leak(params):
+    """Greedy cuts at the edges and the middle: delivered + suffix ==
+    the unfailed run, the replay never re-enters the stream, pages come
+    back, and the resume ledger counts one request per cut."""
+    with _engine(params) as eng:
+        ref = _stream(eng)
+        assert len(ref) == N
+        # ONE warm survivor serves every cut: greedy replay is exact on
+        # any survivor, busy or idle
+        for i, k in enumerate((1, N // 2, N - 1), start=1):
+            assert _resume_at(eng, ref, k) == ref, k
+            st = eng.stats()["resumed"]
+            assert st["requests"] == i and st["diverged"] == 0
+        assert_no_leak(eng)
+
+
+@pytest.mark.quick
+def test_sampled_resume_every_cut_point_bit_identical(params):
+    """The rng fast-forward property (ISSUE-20 satellite): for EVERY
+    cut k in [1, n) a sampled stream resumes bit-identically — the
+    survivor rewinds to the constructor seed and replays the original
+    per-step split schedule, so the rng history of the cut is
+    irrelevant."""
+    with _engine(params, sampling=SAMPLED) as eng:
+        ref = _stream(eng)
+    with _engine(params, sampling=SAMPLED) as eng:
+        for k in range(1, N):
+            assert _resume_at(eng, ref, k) == ref, k
+        st = eng.stats()["resumed"]
+        assert st["requests"] == N - 1 and st["diverged"] == 0
+        assert st["replayed_tokens"] == sum(range(1, N))
+        assert_no_leak(eng)
+
+
+@pytest.mark.parametrize("kv_dtype", [
+    # tier-1 budget: the quantized twins ride the slow lane — the
+    # quick-lane every-cut sampled test pins the resume contract on
+    # bf16 pages, and §17 pins quantized-page exactness itself
+    pytest.param("int8", marks=pytest.mark.slow),
+    pytest.param("int4", marks=pytest.mark.slow),
+])
+def test_sampled_resume_over_quantized_pages(params, kv_dtype):
+    """Quantized page pools change the logits, not the resume contract:
+    reference and survivor share the page dtype and the sampled stream
+    still cuts + resumes exactly."""
+    with _engine(params, sampling=SAMPLED, kv_dtype=kv_dtype) as eng:
+        ref = _stream(eng)
+    with _engine(params, sampling=SAMPLED, kv_dtype=kv_dtype) as eng:
+        for k in (1, N // 2, N - 1):
+            assert _resume_at(eng, ref, k) == ref, (kv_dtype, k)
+        assert_no_leak(eng)
+
+
+# tier-1 budget: slow-lane twin — the quick greedy test pins resume
+# bit-identity and the §22 suite pins greedy spec losslessness; this
+# composes the two on a spec-armed survivor
+@pytest.mark.slow
+def test_greedy_resume_with_speculation_armed_on_survivor(params):
+    """The survivor speculates, the dead replica did not: greedy spec
+    is lossless, so the resumed suffix still matches the plain run —
+    the replay rides the fused draft/verify dispatch like any other
+    row."""
+    with _engine(params) as eng:
+        ref = _stream(eng)
+    with _engine(params, prompt_lookup=True, num_draft=3,
+                 prefill_chunk=8, decode_block=4) as eng:
+        for k in (1, N - 2):
+            assert _resume_at(eng, ref, k) == ref, k
+        st = eng.stats()
+        assert st["resumed"]["diverged"] == 0
+        assert_no_leak(eng)
+
+
+# ---------------------------------------------------------------------------
+# failure semantics: loud divergence, validation, SLO accounting
+# ---------------------------------------------------------------------------
+
+@pytest.mark.quick
+def test_divergent_journal_fails_loudly_not_silently(params):
+    """A journal the survivor cannot re-derive (wrong token — torn
+    fleet state, config skew) must FAIL the request at the first
+    mismatched replay token, never stream a wrong suffix; the slot and
+    pages come back and the engine keeps serving."""
+    with _engine(params) as eng:
+        ref = _stream(eng)
+        bogus = [t + 1 for t in ref[:3]]       # never what argmax says
+        req = eng.submit_resumed(PROMPT, N, bogus)
+        with pytest.raises(RuntimeError, match="diverged"):
+            req.wait(timeout=300)
+        assert eng.stats()["resumed"]["diverged"] == 1
+        # the engine survived: same prompt still answers bit-identically
+        assert _stream(eng) == ref
+        assert_no_leak(eng)
+
+
+@pytest.mark.quick
+def test_submit_resumed_validation(params):
+    with _engine(params, eos_id=5) as eng:
+        with pytest.raises(ValueError, match="at least one"):
+            eng.submit_resumed(PROMPT, N, [])
+        with pytest.raises(ValueError, match="nothing to resume"):
+            eng.submit_resumed(PROMPT, 3, [7, 8, 9])
+        with pytest.raises(ValueError, match="eos"):
+            eng.submit_resumed(PROMPT, N, [7, 5])
+        ids = np.asarray([PROMPT, PROMPT], np.int32)
+        with pytest.raises(ValueError, match="single prompt row"):
+            list(eng.generate_stream(
+                ids, N, resume={"delivered_tokens": [7],
+                                "rng_step_offset": 1}))
+
+
+@pytest.mark.quick
+def test_resume_pause_books_into_slo_decomposition(params):
+    """The replay window lands in the ledger as resume_pause_s — the
+    migration-pause analog — and the timeline decomposition still sums
+    exactly: ttft + per_token*(n-1) + pauses == e2e."""
+    led = SloLedger(ttft_slo_ms=10_000, tpot_slo_ms=10_000)
+    set_slo_ledger(led)
+    try:
+        with _engine(params) as eng:
+            ref = _stream(eng)
+            assert _resume_at(eng, ref, N // 2) == ref
+        recs = [r for r in led.recent(16) if r.get("resumed")]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["resume_pause_s"] > 0.0
+        lhs = (rec["ttft_s"] + rec["per_token_s"] * (rec["tokens"] - 1)
+               + rec["migration_pause_s"] + rec["resume_pause_s"])
+        assert lhs == pytest.approx(rec["e2e_s"], rel=1e-6)
+        assert led.summary()["tenants"]["default"]["resumed"] == 1
+    finally:
+        set_slo_ledger(None)

@@ -1,0 +1,481 @@
+"""Gateway mid-stream failover (docs/DESIGN.md §23): zero-loss streams.
+
+Chaos at every seam the ISSUE names, cheapest first:
+
+- STUB replicas speaking the resume protocol pin the gateway-side
+  mechanics socket-free of engines: journal contents, torn-line
+  handling, the resume payload, routing exclusion of the dead replica,
+  step continuity, exhaustion fallback, and the resume_limit=0
+  behavior pin;
+- a seeded comm/faults ``crash_after`` rule over REAL batching engines
+  pins end-to-end bit-identity through the gateway hop (the replica's
+  own error line is the death signal on this seam — no socket ever
+  breaks);
+- a real SIGKILL'd replica subprocess (an OS-level death: FIN/RST with
+  no terminating chunk) resumes onto a survivor.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import jax  # noqa: E402
+
+from distributed_inference_demo_tpu.comm.faults import (FaultPlan,
+                                                        FaultRule)
+from distributed_inference_demo_tpu.models import get_model_config
+from distributed_inference_demo_tpu.models.decoder import init_full_params
+from distributed_inference_demo_tpu.ops.sampling import SamplingParams
+from distributed_inference_demo_tpu.runtime.http_server import (
+    InferenceHTTPServer)
+
+from test_gateway import (_CrashyBackend, _engine, _gateway,  # noqa: E402
+                          _post_stream)
+
+CFG = get_model_config("llama-test")
+TOKENS = list(range(100, 108))          # the stubs' canonical stream
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_full_params(jax.random.PRNGKey(0), CFG)
+
+
+class _ResumableStub:
+    """A replica double that speaks the RESUME side of the serving
+    surface: ``POST /generate`` streams ``TOKENS`` as chunked JSONL,
+    honoring ``{"resume": {"delivered_tokens": [...]}}`` by starting
+    after the delivered prefix with continuing step numbers.
+
+    ``sever_after=N`` kills the socket after N complete lines of its
+    OWN response (no terminating chunk); ``tear_line=True`` addition-
+    ally writes the first half of line N before severing — the torn
+    trailing fragment the gateway must never forward or journal."""
+
+    def __init__(self, sever_after=None, tear_line=False):
+        self.sever_after = sever_after
+        self.tear_line = tear_line
+        self.requests = 0
+        self.resumes = []               # every resume payload received
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"queue_depth": 0,
+                                   "kvcache": {"nodes": 1}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                outer.requests += 1
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                resume = req.get("resume")
+                start = 0
+                if resume is not None:
+                    outer.resumes.append(resume)
+                    start = len(resume["delivered_tokens"])
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data):
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+
+                def sever(partial=b""):
+                    if partial:
+                        # a chunk header promising MORE than the bytes
+                        # that follow: the reader sees a complete-
+                        # looking chunk stream end mid-line
+                        self.wfile.write(
+                            f"{len(partial) + 20:x}\r\n".encode())
+                        self.wfile.write(partial)
+                    self.wfile.flush()
+                    self.close_connection = True
+                    self.connection.shutdown(socket.SHUT_RDWR)
+
+                for i in range(start, len(TOKENS)):
+                    line_no = i - start
+                    if (outer.sever_after is not None
+                            and line_no >= outer.sever_after):
+                        line = json.dumps(
+                            {"step": i, "tokens": [TOKENS[i]]}
+                        ).encode() + b"\n"
+                        sever(line[:len(line) // 2]
+                              if outer.tear_line else b"")
+                        return
+                    chunk(json.dumps({"step": i, "tokens": [TOKENS[i]]}
+                                     ).encode() + b"\n")
+                chunk(b"")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.host, self.port = self.httpd.server_address
+        self.rid = f"{self.host}:{self.port}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _scrape(gw):
+    conn = HTTPConnection(gw.host, gw.port, timeout=10)
+    try:
+        conn.request("GET", "/metrics")
+        return conn.getresponse().read().decode()
+    finally:
+        conn.close()
+
+
+def _series(text, name):
+    for ln in text.splitlines():
+        if ln.startswith(name + " ") or ln.startswith(name + "{"):
+            return float(ln.rsplit(" ", 1)[1])
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# seam 1: severed socket (stub fleet — protocol mechanics)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.quick
+def test_sever_resumes_on_survivor_no_loss_no_duplicates():
+    victim = _ResumableStub(sever_after=2)
+    survivor = _ResumableStub()
+    gw = _gateway([(victim.host, victim.port),
+                   (survivor.host, survivor.port)], sustain=1)
+    try:
+        toks = list(range(2, 18))
+        gw.router.record(victim.rid, toks)
+        st, headers, lines, truncated = _post_stream(
+            gw.host, gw.port, {"prompt_ids": [toks],
+                               "max_new_tokens": 8, "stream": True},
+            timeout=30)
+        # the client sees ONE unbroken stream: every token exactly
+        # once, steps contiguous, no error line, clean termination
+        assert st == 200 and not truncated
+        assert [d["tokens"][0] for d in lines] == TOKENS
+        assert [d["step"] for d in lines] == list(range(8))
+        assert not any("error" in d for d in lines)
+        # the survivor got the journal: delivered prefix + offset
+        assert survivor.resumes == [
+            {"delivered_tokens": TOKENS[:2], "rng_step_offset": 2}]
+        # the victim was struck (reason=mid-stream), the survivor
+        # learned the prefix for future routing
+        assert not gw.registry.is_up(victim.rid)
+        reasons = gw.registry.debug_state()["failure_reasons"]
+        assert reasons["mid-stream"] == 1
+        assert gw.router.match_tokens(survivor.rid, toks) > 0
+        text = _scrape(gw)
+        assert _series(text, "dwt_gateway_resume_attempts_total") >= 1
+        assert _series(text, "dwt_gateway_resume_succeeded_total") >= 1
+        assert "dwt_gateway_resume_ttf_seconds" in text
+        assert 'dwt_gateway_replica_failures_total{reason="mid-stream"}' \
+            in text
+    finally:
+        gw.shutdown()
+        victim.close()
+        survivor.close()
+
+
+@pytest.mark.quick
+def test_torn_trailing_line_never_forwarded_journal_ends_complete():
+    """ISSUE-20 satellite: the victim tears mid-JSONL-line.  The
+    fragment must reach neither the client nor the journal — the
+    resume hands the survivor exactly the COMPLETE-line prefix, and
+    the client stream holds each token exactly once."""
+    victim = _ResumableStub(sever_after=2, tear_line=True)
+    survivor = _ResumableStub()
+    gw = _gateway([(victim.host, victim.port),
+                   (survivor.host, survivor.port)], sustain=1)
+    try:
+        toks = list(range(2, 18))
+        gw.router.record(victim.rid, toks)
+        st, _, lines, truncated = _post_stream(
+            gw.host, gw.port, {"prompt_ids": [toks],
+                               "max_new_tokens": 8, "stream": True},
+            timeout=30)
+        # _post_stream json-parses every line: a forwarded fragment
+        # would have flagged `truncated`
+        assert st == 200 and not truncated
+        assert [d["tokens"][0] for d in lines] == TOKENS
+        assert survivor.resumes == [
+            {"delivered_tokens": TOKENS[:2], "rng_step_offset": 2}]
+    finally:
+        gw.shutdown()
+        victim.close()
+        survivor.close()
+
+
+@pytest.mark.quick
+def test_resume_exhaustion_falls_back_to_error_line_not_a_hang():
+    victim = _ResumableStub(sever_after=2)
+    gw = _gateway([(victim.host, victim.port)], sustain=1)
+    try:
+        before = _scrape(gw)    # counters are process-global
+        st, _, lines, _ = _post_stream(
+            gw.host, gw.port, {"prompt_ids": [list(range(2, 18))],
+                               "max_new_tokens": 8, "stream": True},
+            timeout=30)
+        # no survivor: delivered prefix + ONE error line, terminated —
+        # exactly the pre-resume contract, and nothing duplicated
+        assert st == 200
+        assert [d["tokens"][0] for d in lines[:-1]] == TOKENS[:2]
+        assert "error" in lines[-1] and victim.rid in lines[-1]["error"]
+        assert not gw.registry.is_up(victim.rid)
+        text = _scrape(gw)
+        for name, delta in (
+                ("dwt_gateway_resume_exhausted_requests_total", 1),
+                ("dwt_gateway_resume_attempts_total", 1),
+                ("dwt_gateway_resume_succeeded_total", 0)):
+            assert _series(text, name) - _series(before, name) == delta, \
+                name
+    finally:
+        gw.shutdown()
+        victim.close()
+
+
+@pytest.mark.quick
+def test_resume_limit_zero_pins_the_error_line_contract():
+    """--resume-limit 0 restores the pre-§23 behavior byte-for-byte:
+    the healthy survivor is never consulted even though it could have
+    finished the stream."""
+    victim = _ResumableStub(sever_after=2)
+    survivor = _ResumableStub()
+    gw = _gateway([(victim.host, victim.port),
+                   (survivor.host, survivor.port)], sustain=1,
+                  resume_limit=0)
+    try:
+        toks = list(range(2, 18))
+        gw.router.record(victim.rid, toks)
+        st, _, lines, _ = _post_stream(
+            gw.host, gw.port, {"prompt_ids": [toks],
+                               "max_new_tokens": 8, "stream": True},
+            timeout=30)
+        assert st == 200
+        assert [d["tokens"][0] for d in lines[:-1]] == TOKENS[:2]
+        assert "error" in lines[-1]
+        assert survivor.requests == 0
+        assert survivor.resumes == []
+    finally:
+        gw.shutdown()
+        victim.close()
+        survivor.close()
+
+
+@pytest.mark.quick
+def test_second_sever_within_limit_resumes_again():
+    """resume_limit=2 survives TWO mid-stream deaths: the journal keeps
+    absorbing delivered lines across attempts, each survivor gets the
+    up-to-date prefix, and the client still sees every token once."""
+    stubs = [_ResumableStub() for _ in range(3)]
+    gw = _gateway([(s.host, s.port) for s in stubs], sustain=1,
+                  resume_limit=2, retry_limit=2)
+    try:
+        toks = list(range(2, 18))
+        # assign death order along the ROUTER's own rendezvous order
+        # (stable under eviction), so the chain victim -> dying
+        # survivor -> final survivor is deterministic
+        d = gw.router.route(toks)
+        by_rid = {s.rid: s for s in stubs}
+        order = [by_rid[r] for r in [d.rid] + d.candidates]
+        order[0].sever_after = 2          # the original victim
+        order[1].sever_after = 3          # dies AGAIN mid-resume
+        st, _, lines, truncated = _post_stream(
+            gw.host, gw.port, {"prompt_ids": [toks],
+                               "max_new_tokens": 8, "stream": True},
+            timeout=30)
+        assert st == 200 and not truncated
+        assert [d["tokens"][0] for d in lines] == TOKENS
+        assert not any("error" in d for d in lines)
+        # each resume carried the journal as of ITS moment
+        assert [len(r["delivered_tokens"]) for r in order[1].resumes] \
+            == [2]
+        assert [len(r["delivered_tokens"]) for r in order[2].resumes] \
+            == [5]
+    finally:
+        gw.shutdown()
+        for s in stubs:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# seam 2: FaultPlan crash_after over real engines (error-line seam)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sampling", [
+    SamplingParams(greedy=True),
+    # tier-1 budget: greedy is the quick rep for the engine-backed
+    # chaos leg; the sampled twin (same seam, rng fast-forward already
+    # pinned per-cut in test_resume.py) rides the slow lane
+    pytest.param(SamplingParams(temperature=0.9, top_k=40),
+                 marks=pytest.mark.slow),
+], ids=["greedy", "sampled"])
+def test_injected_crash_resumes_bit_identical(params, sampling):
+    """The seeded chaos plan from the ISSUE acceptance bar: a
+    crash_after rule kills replica0 after 3 streamed tokens (its error
+    line is the death signal — the socket never breaks on this seam);
+    the gateway must intercept it, resume on replica1, and hand the
+    client the exact token sequence of an unfailed run."""
+    plan = FaultPlan(seed=7, rules=[FaultRule(kind="crash_after",
+                                              n_msgs=3, max_count=1)])
+    engines = [_engine(params, sampling=sampling, seed=11)
+               for _ in range(2)]
+    servers = []
+    for i, eng in enumerate(engines):
+        backend = (_CrashyBackend(eng, plan, "replica0") if i == 0
+                   else eng)
+        srv = InferenceHTTPServer(backend, port=0)
+        srv.start()
+        servers.append(srv)
+    gw = _gateway([(s.host, s.port) for s in servers], min_prefix=8,
+                  block_tokens=8)
+    try:
+        toks = list(range(2, 18))
+        crashy_rid = f"{servers[0].host}:{servers[0].port}"
+        gw.router.record(crashy_rid, toks)
+        # the unfailed reference: replica1 directly, then drop the
+        # blocks so the resumed run re-prefills like a cold survivor
+        st, _, ref_lines, _ = _post_stream(
+            servers[1].host, servers[1].port,
+            {"prompt_ids": [toks], "max_new_tokens": 8, "stream": True},
+            timeout=300)
+        assert st == 200
+        ref = [d["tokens"][0] for d in ref_lines]
+        assert len(ref) == 8
+        st, _, lines, truncated = _post_stream(
+            gw.host, gw.port, {"prompt_ids": [toks],
+                               "max_new_tokens": 8, "stream": True},
+            timeout=300)
+        assert st == 200 and not truncated
+        assert [e["kind"] for e in plan.events] == ["crash_after"]
+        assert not any("error" in d for d in lines)
+        got = [d["tokens"][0] for d in lines]
+        assert got == ref                      # bit-identity across kill
+        assert [d["step"] for d in lines] == list(range(8))
+        # survivor-side evidence: one resumed request, zero divergence,
+        # no leaked pages
+        st1 = engines[1].stats()
+        assert st1["resumed"]["requests"] == 1
+        assert st1["resumed"]["diverged"] == 0
+        mgr = engines[1].kv_cache
+        assert mgr.used_blocks == mgr.tree.block_count
+    finally:
+        gw.shutdown()
+        for srv, eng in zip(servers, engines):
+            srv.shutdown()
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# seam 3: a real SIGKILL'd replica subprocess
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import json, sys, time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+TOKENS = list(range(100, 108))
+
+class H(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    def log_message(self, *a): pass
+    def do_GET(self):
+        body = json.dumps({"queue_depth": 0}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        for i, t in enumerate(TOKENS):
+            data = json.dumps({"step": i, "tokens": [t]}).encode() + b"\n"
+            self.wfile.write(f"{len(data):x}\r\n".encode())
+            self.wfile.write(data + b"\r\n")
+            self.wfile.flush()
+            time.sleep(0.25)      # slow enough to SIGKILL mid-stream
+        self.wfile.write(b"0\r\n\r\n")
+
+httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+print(f"PORT {httpd.server_address[1]}", flush=True)
+httpd.serve_forever()
+"""
+
+
+def test_sigkilled_replica_subprocess_resumes_on_survivor():
+    """An OS-level death: the victim is a separate PROCESS streaming
+    real chunked JSONL, SIGKILL'd mid-stream (kernel sends FIN with no
+    terminating chunk — nothing in the victim gets to clean up).  The
+    gateway resumes on the survivor stub and the client never sees the
+    kill."""
+    child = subprocess.Popen([sys.executable, "-c", _CHILD],
+                             stdout=subprocess.PIPE, text=True)
+    survivor = _ResumableStub()
+    gw = None
+    try:
+        port_line = child.stdout.readline().strip()
+        assert port_line.startswith("PORT ")
+        victim_port = int(port_line.split()[1])
+        victim_rid = f"127.0.0.1:{victim_port}"
+        gw = _gateway([("127.0.0.1", victim_port),
+                       (survivor.host, survivor.port)], sustain=1)
+        toks = list(range(2, 18))
+        gw.router.record(victim_rid, toks)
+
+        killed = {}
+
+        def kill_soon():
+            time.sleep(0.6)       # ~2 lines at 0.25s/line
+            os.kill(child.pid, signal.SIGKILL)
+            killed["t"] = time.time()
+
+        threading.Thread(target=kill_soon, daemon=True).start()
+        st, _, lines, truncated = _post_stream(
+            gw.host, gw.port, {"prompt_ids": [toks],
+                               "max_new_tokens": 8, "stream": True},
+            timeout=60)
+        assert killed, "the kill never fired"
+        assert st == 200 and not truncated
+        assert [d["tokens"][0] for d in lines] == TOKENS
+        assert not any("error" in d for d in lines)
+        assert [d["step"] for d in lines] == list(range(8))
+        # the survivor was handed the mid-kill journal
+        assert len(survivor.resumes) == 1
+        delivered = survivor.resumes[0]["delivered_tokens"]
+        assert 1 <= len(delivered) < 8
+        assert delivered == TOKENS[:len(delivered)]
+        assert not gw.registry.is_up(victim_rid)
+    finally:
+        if gw is not None:
+            gw.shutdown()
+        survivor.close()
+        if child.poll() is None:
+            child.kill()
+        child.wait(timeout=10)

@@ -45,7 +45,8 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
          "ratio", "bytes_per_second", "flops_per_second", "celsius",
          "info", "events", "bundles", "blocks", "nodes",
          "retries", "reconnects", "frames", "faults", "dispatches",
-         "pages", "replicas", "scrapes", "samples"}
+         "pages", "replicas", "scrapes", "samples", "attempts",
+         "failures"}
 
 # label names any series may declare.  The label VOCABULARY is linted
 # like the name vocabulary: a typo'd label ("tenent", "repilca") would
@@ -53,7 +54,8 @@ UNITS = {"seconds", "bytes", "messages", "steps", "tokens", "requests",
 # than a crash.  Extend deliberately, with the catalog.
 KNOWN_LABELS = {"role", "device", "route", "code", "kind", "engine",
                 "peer", "replica", "dtype", "tenant", "window",
-                "signature", "program", "owner", "tier", "bucket"}
+                "signature", "program", "owner", "tier", "bucket",
+                "reason"}
 
 # series whose label SET is pinned exactly — the fleet-plane families
 # whose labels dashboards and the federation relabeler join on.  A
@@ -104,6 +106,14 @@ REQUIRED_LABELS = {
     "dwt_kvcache_tier_resident_blocks": ("tier",),
     "dwt_kvcache_tier_capacity_bytes": ("tier",),
     "dwt_kvcache_tier_hits_total": ("tier",),
+    # zero-loss streams (docs/DESIGN.md §23): resume pause is a tenant
+    # SLO dimension like migration pause, and the failure-reason label
+    # is the bounded vocabulary /debugz and dashboards break down on —
+    # losing it would fold probe flakes and mid-stream deaths into one
+    # undiagnosable count
+    "dwt_slo_resume_pause_seconds": ("tenant",),
+    "dwt_slo_resumed_requests_total": ("tenant",),
+    "dwt_gateway_replica_failures_total": ("reason",),
 }
 
 # label names reserved for the federation relabeler: GET /metrics/fleet
@@ -135,7 +145,12 @@ UNIT_SUFFIX_EXEMPT = {"dwt_kvcache_blocks_in_use",
                       # adaptive-K occupancy gauge — "len" is the
                       # quantity itself (a draft LENGTH bucket), the
                       # value's unit is rows via the bucket label
-                      "dwt_batching_draft_len"}
+                      "dwt_batching_draft_len",
+                      # ISSUE-20 pins this exact name: the resumes that
+                      # finished the stream — "succeeded" names the
+                      # outcome where the unit would sit, pairing with
+                      # dwt_gateway_resume_attempts_total
+                      "dwt_gateway_resume_succeeded_total"}
 
 # series the catalog must always register (regressions here would blind
 # the flight-recorder/anomaly layer silently — a scrape with the series
@@ -273,6 +288,20 @@ REQUIRED_SERIES = {
     "dwt_compile_variant_budget_entries",
     "dwt_hbm_owner_bytes",
     "dwt_hbm_watermark_bytes",
+    # zero-loss streams (docs/DESIGN.md §23): attempts/succeeded
+    # diverging is the failed-failover signal, resumed_requests
+    # registered-and-zero is how a scrape PROVES no stream needed a
+    # survivor, and the diverged counter absent would let a journal the
+    # survivor cannot reproduce fail invisibly — the one failure mode
+    # the verify queue exists to make loud
+    "dwt_gateway_resume_attempts_total",
+    "dwt_gateway_resume_succeeded_total",
+    "dwt_gateway_resume_exhausted_requests_total",
+    "dwt_gateway_replica_failures_total",
+    "dwt_batching_resumed_requests_total",
+    "dwt_batching_resume_diverged_requests_total",
+    "dwt_slo_resume_pause_seconds",
+    "dwt_slo_resumed_requests_total",
 }
 
 

@@ -56,6 +56,9 @@ LEG_BUDGETS = {
     "long_context_sp": 1800,
     "disagg": 1500,
     "gateway_routing": 1500,
+    # two replica engines through three routed phases (reference soak,
+    # mid-soak failover, documented loss) — budget like gateway_routing
+    "stream_failover": 1500,
     "flagship_int8": 2400,
     "batching": 2400,
     # two full engines (serialized baseline + mixed) with background
